@@ -1,0 +1,43 @@
+"""nm03_capstone_project_tpu — a TPU-native medical-image-processing framework.
+
+A brand-new JAX / XLA / Pallas implementation of the capabilities of the
+reference system calebhabesh/NM03-Capstone-Project ("Optimizing Medical Image
+Processing: A Hybrid Approach with the FAST Framework and OpenMP"): a
+fault-tolerant brain-tumor segmentation pipeline over DICOM cohorts —
+
+    import DICOM -> intensity normalization -> intensity clipping
+    -> 7x7 vector median filter -> unsharp sharpening
+    -> seeded region growing (adaptive seed grid)
+    -> uint8 cast -> morphology (dilation / erosion)
+    -> 512x512 overlay JPEG export
+
+re-designed TPU-first:
+
+* The reference's FAST/OpenCL ProcessObjects (lazy DAG + eager per-stage
+  ``update()``, reference ``src/test/test_pipeline.cpp:53-125``) become pure
+  functions fused under a single ``jax.jit``.
+* The reference's OpenMP batch loop (``src/parallel/main_parallel.cpp:336``)
+  becomes ``jax.vmap`` over a padded slice stack plus a
+  ``jax.sharding.Mesh`` over TPU chips.
+* The hot per-pixel kernels (vector median filter, seeded region growing)
+  have Pallas TPU implementations alongside portable XLA reference
+  implementations.
+* DICOM decode feeds an async host->HBM prefetch queue so compute never
+  stalls on I/O; a native C++ loader backs the queue.
+
+Subpackage map (mirrors SURVEY.md section 7):
+
+* :mod:`~nm03_capstone_project_tpu.core`     — image containers, padding/dtype policy
+* :mod:`~nm03_capstone_project_tpu.ops`      — the operator set (elementwise, median, sharpen, morphology, region growing, seeds)
+* :mod:`~nm03_capstone_project_tpu.pipeline` — fused slice/volume pipelines
+* :mod:`~nm03_capstone_project_tpu.data`     — dataset discovery, DICOM-lite IO, synthetic cohorts, prefetch
+* :mod:`~nm03_capstone_project_tpu.render`   — 512x512 letterbox render + overlay + JPEG export
+* :mod:`~nm03_capstone_project_tpu.parallel` — device mesh, batch sharding, z-axis halo exchange
+* :mod:`~nm03_capstone_project_tpu.models`   — model families built on the op set
+* :mod:`~nm03_capstone_project_tpu.utils`    — reporter/logging, timing, manifest/resume, profiling
+* :mod:`~nm03_capstone_project_tpu.cli`      — the three entry points (test-pipeline, sequential, parallel)
+"""
+
+__version__ = "0.1.0"
+
+from nm03_capstone_project_tpu.config import PipelineConfig  # noqa: F401

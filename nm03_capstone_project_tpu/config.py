@@ -1,0 +1,104 @@
+"""Typed configuration covering every constant the reference hard-codes.
+
+The reference inlines all pipeline hyper-parameters at call sites (see
+SURVEY.md section 5 "Config / flag system"); this module lifts each one into a
+frozen dataclass so drivers, tests and benchmarks share a single source of
+truth. Each field cites where the reference pins the value.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    """Hyper-parameters of the 5-stage segmentation pipeline.
+
+    Defaults reproduce the reference's behavioral contract exactly.
+    """
+
+    # -- Intensity normalization -------------------------------------------
+    # reference: IntensityNormalization::create(0.5f, 2.5f, 0.0f, 10000.0f)
+    # (src/test/test_pipeline.cpp:55, src/sequential/main_sequential.cpp:195-196)
+    norm_low: float = 0.5
+    norm_high: float = 2.5
+    norm_intensity_min: float = 0.0
+    norm_intensity_max: float = 10000.0
+
+    # -- Intensity clipping -------------------------------------------------
+    # reference: IntensityClipping::create(0.68f, 4000.0f)
+    # (src/test/test_pipeline.cpp:60, main_sequential.cpp:200)
+    clip_low: float = 0.68
+    clip_high: float = 4000.0
+
+    # -- Vector median filter -----------------------------------------------
+    # reference: VectorMedianFilter::create(7) (test_pipeline.cpp:65-66)
+    median_window: int = 7
+
+    # -- Unsharp sharpening --------------------------------------------------
+    # reference: ImageSharpening::create(2.0f, 0.5f, 9) (test_pipeline.cpp:71)
+    sharpen_gain: float = 2.0
+    sharpen_sigma: float = 0.5
+    sharpen_kernel: int = 9
+
+    # -- Seeded region growing ----------------------------------------------
+    # reference: SeededRegionGrowing::create(0.74f, 0.91f, seeds)
+    # (test_pipeline.cpp:98, main_sequential.cpp:232-233)
+    grow_low: float = 0.74
+    grow_high: float = 0.91
+
+    # -- Morphology -----------------------------------------------------------
+    # reference: Dilation::create(3) / Erosion::create(3)
+    # (test_pipeline.cpp:119-125, main_sequential.cpp:250)
+    morph_size: int = 3
+
+    # -- Guards ---------------------------------------------------------------
+    # reference: width/height < 100 -> exception (main_sequential.cpp:189-192)
+    min_dim: int = 100
+
+    # -- Render / export -------------------------------------------------------
+    # reference: RenderToImage::create(Color::Black(), 512, 512)
+    # (test_pipeline.cpp:164, main_sequential.cpp:258); SegmentationRenderer
+    # (labelColors={1: White}, opacity 0.6, borderOpacity 1.0, borderRadius 2)
+    # (test_pipeline.cpp:136-146)
+    render_size: int = 512
+    overlay_opacity: float = 0.6
+    overlay_border_opacity: float = 1.0
+    overlay_border_radius: int = 2
+
+    # -- Compute policy (TPU-native; no reference equivalent) ------------------
+    # Static canvas the variable-size DICOM slices are padded to so that one
+    # compiled program serves the whole cohort (jit demands static shapes).
+    canvas: int = 256
+    # Region-growing fixpoint: dilations per convergence check and a hard cap.
+    grow_block_iters: int = 16
+    grow_max_iters: int = 1024
+    # Route the hot ops through the Pallas TPU kernels (ops.pallas_median /
+    # ops.pallas_region_growing) instead of the portable XLA implementations.
+    # Defaults False until the caller knows it's on a TPU backend.
+    use_pallas: bool = False
+
+    @property
+    def canvas_hw(self) -> Tuple[int, int]:
+        return (self.canvas, self.canvas)
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchConfig:
+    """Batch-orchestration knobs.
+
+    The reference fixes DEFAULT_BATCH_SIZE = 25 ("maximum number of slices per
+    patient", src/parallel/main_parallel.cpp:31-33) and 16 OpenMP threads
+    (main_parallel.cpp:401). On TPU the batch is a vmapped leading axis; the
+    size is a padding granularity rather than a thread count.
+    """
+
+    batch_size: int = 25
+    prefetch_depth: int = 2  # host->device double buffering
+    io_workers: int = 8  # DICOM decode thread pool
+
+
+DEFAULT_CONFIG = PipelineConfig()
+DEFAULT_BATCH = BatchConfig()

@@ -1,0 +1,4 @@
+"""Core containers and shape policy."""
+
+from nm03_capstone_project_tpu.core.image import SliceBatch, valid_mask  # noqa: F401
+from nm03_capstone_project_tpu.core.padding import pad_to_canvas  # noqa: F401

@@ -1,0 +1,59 @@
+"""Image containers.
+
+The reference moves ``fast::Image`` shared_ptrs between pipeline stages (e.g.
+``getOutputData<Image>(0)``, src/test/test_pipeline.cpp:45). On TPU the
+equivalent is a pytree of arrays with **static shapes**: every slice is padded
+to a fixed canvas and its true (height, width) ride along as data, so a single
+compiled program serves slices of any size (DICOM dims vary across the
+cohort) and a whole batch can be vmapped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SliceBatch:
+    """A batch of 2D slices padded to a common static canvas.
+
+    Attributes:
+      pixels: float32 array of shape (B, H, W) — padded pixel data. Padding
+        values are 0 and must be ignored via :func:`valid_mask`.
+      dims: int32 array of shape (B, 2) — the true (height, width) of each
+        slice before padding.
+    """
+
+    pixels: jax.Array
+    dims: jax.Array
+
+    @property
+    def batch(self) -> int:
+        return self.pixels.shape[0]
+
+    @property
+    def canvas_hw(self) -> Tuple[int, int]:
+        return self.pixels.shape[-2], self.pixels.shape[-1]
+
+    def __getitem__(self, i) -> "SliceBatch":
+        return SliceBatch(pixels=self.pixels[i], dims=self.dims[i])
+
+
+def valid_mask(dims: jax.Array, canvas_hw: Tuple[int, int]) -> jax.Array:
+    """Boolean mask of shape (..., H, W): True inside the true image extent.
+
+    ``dims`` has shape (..., 2) holding (height, width); the mask marks pixels
+    with row < height and col < width. Computed with broadcasted iota so it is
+    jit-friendly for traced dims.
+    """
+    h, w = canvas_hw
+    rows = jax.lax.broadcasted_iota(jnp.int32, (h, w), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (h, w), 1)
+    height = dims[..., 0:1, None]  # (..., 1, 1)
+    width = dims[..., 1:2, None]
+    return (rows < height) & (cols < width)

@@ -1,0 +1,40 @@
+"""Static-shape padding policy.
+
+jit traces a program once per shape; DICOM slice sizes vary across the cohort,
+so every slice is host-side padded (bottom/right, zeros) to a fixed canvas
+before it reaches the device. The true dims travel with the pixels (see
+:class:`~nm03_capstone_project_tpu.core.image.SliceBatch`) so downstream ops
+can mask out padding.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+from nm03_capstone_project_tpu.core.image import SliceBatch
+
+
+def pad_to_canvas(
+    arrays: Sequence[np.ndarray], canvas_hw: Tuple[int, int]
+) -> SliceBatch:
+    """Pad host-side 2D arrays to a common canvas and stack into a SliceBatch.
+
+    Raises ValueError if any slice exceeds the canvas — choose a canvas at
+    least as large as the biggest slice in the cohort (256 covers the TCIA
+    Brain-Tumor-Progression T1+C series the reference targets).
+    """
+    h, w = canvas_hw
+    batch = np.zeros((len(arrays), h, w), dtype=np.float32)
+    dims = np.zeros((len(arrays), 2), dtype=np.int32)
+    for i, a in enumerate(arrays):
+        if a.ndim != 2:
+            raise ValueError(f"slice {i}: expected 2D array, got shape {a.shape}")
+        if a.shape[0] > h or a.shape[1] > w:
+            raise ValueError(
+                f"slice {i}: shape {a.shape} exceeds canvas {canvas_hw}"
+            )
+        batch[i, : a.shape[0], : a.shape[1]] = a.astype(np.float32)
+        dims[i] = a.shape
+    return SliceBatch(pixels=batch, dims=dims)

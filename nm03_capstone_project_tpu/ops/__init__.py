@@ -1,0 +1,38 @@
+"""The operator set.
+
+TPU-native re-implementations of every FAST ProcessObject the reference
+instantiates (SURVEY.md section 2.2), as pure jit-friendly functions:
+
+=====================  =============================================  =========================
+Reference operator     This package                                   Reference instantiation
+=====================  =============================================  =========================
+IntensityNormalization :func:`elementwise.normalize`                  create(0.5, 2.5, 0, 10000)
+IntensityClipping      :func:`elementwise.clip_intensity`             create(0.68, 4000)
+VectorMedianFilter     :func:`median.vector_median_filter`            create(7)
+ImageSharpening        :func:`sharpen.sharpen`                        create(2.0, 0.5, 9)
+SeededRegionGrowing    :func:`region_growing.region_grow`             create(0.74, 0.91, seeds)
+ImageCaster            :func:`elementwise.cast_uint8`                 create(TYPE_UINT8)
+Dilation               :func:`morphology.dilate`                      create(3)
+Erosion                :func:`morphology.erode`                       create(3)
+(seed-point logic)     :func:`seeds.seed_mask`                        test_pipeline.cpp:79-106
+=====================  =============================================  =========================
+
+Also carried as an optional op (declared in the reference's header but never
+instantiated — FAST_directives.hpp:13): :func:`elementwise.binary_threshold`.
+"""
+
+from nm03_capstone_project_tpu.ops.elementwise import (  # noqa: F401
+    binary_threshold,
+    cast_uint8,
+    clip_intensity,
+    normalize,
+)
+from nm03_capstone_project_tpu.ops.median import (  # noqa: F401
+    vector_median_filter,
+    vector_median_filter_multichannel,
+)
+from nm03_capstone_project_tpu.ops.morphology import dilate, erode  # noqa: F401
+from nm03_capstone_project_tpu.ops.neighborhood import extend_edges  # noqa: F401
+from nm03_capstone_project_tpu.ops.region_growing import region_grow  # noqa: F401
+from nm03_capstone_project_tpu.ops.seeds import seed_mask  # noqa: F401
+from nm03_capstone_project_tpu.ops.sharpen import gaussian_blur, sharpen  # noqa: F401

@@ -1,0 +1,59 @@
+"""Elementwise intensity ops.
+
+These are the cheap stages XLA fuses into neighbours for free; they exist as
+named functions so the pipeline reads like the reference's operator chain.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def normalize(
+    x: jax.Array,
+    low: float = 0.5,
+    high: float = 2.5,
+    intensity_min: float = 0.0,
+    intensity_max: float = 10000.0,
+) -> jax.Array:
+    """Linear intensity rescale from [intensity_min, intensity_max] to [low, high].
+
+    TPU-native equivalent of FAST ``IntensityNormalization::create(0.5f, 2.5f,
+    0.0f, 10000.0f)`` (reference src/test/test_pipeline.cpp:55,
+    src/sequential/main_sequential.cpp:195-196): intensities are mapped
+    affinely so the source window [intensity_min, intensity_max] lands on
+    [low, high]. Values outside the source window extrapolate linearly (no
+    clamping — clamping is the job of :func:`clip_intensity`, the next stage).
+    """
+    scale = (high - low) / (intensity_max - intensity_min)
+    return (x - intensity_min) * scale + low
+
+
+def clip_intensity(x: jax.Array, low: float = 0.68, high: float = 4000.0) -> jax.Array:
+    """Clamp intensities to [low, high].
+
+    TPU-native equivalent of FAST ``IntensityClipping::create(0.68f, 4000.0f)``
+    (reference src/test/test_pipeline.cpp:60, main_sequential.cpp:200).
+    """
+    return jnp.clip(x, low, high)
+
+
+def cast_uint8(x: jax.Array) -> jax.Array:
+    """Cast to uint8.
+
+    TPU-native equivalent of FAST ``ImageCaster::create(TYPE_UINT8)``
+    (reference src/test/test_pipeline.cpp:114, main_sequential.cpp:246), used
+    to move the float segmentation labels into the dtype the morphology stage
+    expects.
+    """
+    return x.astype(jnp.uint8)
+
+
+def binary_threshold(x: jax.Array, low: float, high: float) -> jax.Array:
+    """1 where low <= x <= high else 0 (uint8).
+
+    Optional op: declared in the reference's API surface
+    (FAST_directives.hpp:13 ``BinaryThresholding``) but never instantiated.
+    """
+    return ((x >= low) & (x <= high)).astype(jnp.uint8)

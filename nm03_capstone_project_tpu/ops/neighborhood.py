@@ -1,0 +1,92 @@
+"""Shared neighborhood machinery for the stencil ops.
+
+The reference's per-pixel kernels run under OpenCL samplers with
+clamp-to-edge addressing; on a padded static canvas the equivalent is
+(a) replicating each slice's true edge into the padding region
+(:func:`extend_edges`) so stencils never mix padding zeros into real pixels,
+and (b) expressing small windows as stacks of shifted views
+(:func:`shifted_stack`), which XLA fuses into tight VPU loops.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def extend_edges(x: jax.Array, dims: jax.Array) -> jax.Array:
+    """Replicate each slice's true boundary into the canvas padding.
+
+    ``x`` is (..., H, W); ``dims`` is (..., 2) true (height, width). Every
+    pixel at (r, c) becomes x[min(r, h-1), min(c, w-1)], i.e. clamp-to-edge
+    addressing applied to the whole canvas. jit-friendly for traced dims
+    (gather with dynamic clamp indices).
+    """
+    h_canvas, w_canvas = x.shape[-2], x.shape[-1]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (h_canvas, w_canvas), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (h_canvas, w_canvas), 1)
+    h = dims[..., 0:1, None]
+    w = dims[..., 1:2, None]
+    r_idx = jnp.minimum(rows, h - 1)
+    c_idx = jnp.minimum(cols, w - 1)
+    return jnp.take_along_axis(
+        jnp.take_along_axis(x, r_idx, axis=-2), c_idx, axis=-1
+    )
+
+
+def shifted_stack(
+    x: jax.Array, offsets: List[Tuple[int, int]], pad_mode: str = "edge"
+) -> jax.Array:
+    """Stack shifted views of ``x`` along a new leading axis.
+
+    For each (dr, dc) in ``offsets`` the result holds x shifted so that entry
+    [k, ..., r, c] == x_padded[..., r + dr + R, c + dc + C] where R, C are the
+    max absolute offsets. Used to materialize k*k windows for median /
+    morphology / convolution-style ops; XLA fuses the stack away.
+    """
+    max_r = max(abs(dr) for dr, _ in offsets)
+    max_c = max(abs(dc) for _, dc in offsets)
+    pad_widths = [(0, 0)] * (x.ndim - 2) + [(max_r, max_r), (max_c, max_c)]
+    xp = jnp.pad(x, pad_widths, mode=pad_mode)
+    h, w = x.shape[-2], x.shape[-1]
+    views = [
+        jax.lax.dynamic_slice_in_dim(
+            jax.lax.dynamic_slice_in_dim(xp, max_r + dr, h, axis=-2),
+            max_c + dc,
+            w,
+            axis=-1,
+        )
+        for dr, dc in offsets
+    ]
+    return jnp.stack(views, axis=0)
+
+
+def window_offsets(size: int) -> List[Tuple[int, int]]:
+    """All (dr, dc) offsets of a size x size window centered at 0."""
+    r = size // 2
+    return [(dr, dc) for dr in range(-r, size - r) for dc in range(-r, size - r)]
+
+
+def footprint_offsets(size: int, shape: str) -> List[Tuple[int, int]]:
+    """Offsets of a structuring element.
+
+    shape: 'box' (full window), 'cross' (city-block radius size//2, the
+    4-connected element for size 3), or 'disk' (euclidean radius size/2).
+    """
+    r = size // 2
+    offs = []
+    for dr in range(-r, r + 1):
+        for dc in range(-r, r + 1):
+            if shape == "box":
+                offs.append((dr, dc))
+            elif shape == "cross":
+                if abs(dr) + abs(dc) <= r:
+                    offs.append((dr, dc))
+            elif shape == "disk":
+                if dr * dr + dc * dc <= (size / 2.0) ** 2:
+                    offs.append((dr, dc))
+            else:
+                raise ValueError(f"unknown footprint shape: {shape}")
+    return offs

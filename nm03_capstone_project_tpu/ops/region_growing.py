@@ -1,0 +1,78 @@
+"""Seeded region growing.
+
+TPU-native equivalent of FAST ``SeededRegionGrowing::create(0.74f, 0.91f,
+seeds)`` (reference src/test/test_pipeline.cpp:98-108,
+main_sequential.cpp:232-243) — the segmentation stage and the reference's
+hardest kernel: a data-dependent flood fill from ~30 adaptive seeds accepting
+pixels whose intensity lies in [low, high].
+
+A sequential BFS queue is the wrong shape for a TPU. Here the fill is a
+*fixpoint of masked label dilation*: the region mask grows by one
+4-connected ring per step via a 3x3 cross max, intersected with the intensity
+band, until nothing changes. Control flow is `lax.while_loop` over a
+`lax.fori_loop` block of ``block_iters`` steps — the inner block amortizes the
+convergence check (a device-wide reduction) over many cheap VPU steps, and
+everything stays inside one compiled program (no host round-trips, vmappable
+over a batch).
+
+Worst-case step count is the longest 4-connected path inside the band
+(bounded by H*W, practically by the region diameter); ``max_iters`` caps it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from nm03_capstone_project_tpu.ops.morphology import dilate
+
+
+def region_grow(
+    image: jax.Array,
+    seeds: jax.Array,
+    low: float = 0.74,
+    high: float = 0.91,
+    valid: jax.Array | None = None,
+    connectivity: int = 4,
+    block_iters: int = 16,
+    max_iters: int = 1024,
+) -> jax.Array:
+    """Flood-fill segmentation; returns a uint8 {0,1} mask shaped like image.
+
+    Args:
+      image: (..., H, W) float intensities.
+      seeds: (..., H, W) bool seed mask (see ops.seeds.seed_mask).
+      low/high: inclusive intensity band a pixel must lie in to join the
+        region (reference band [0.74, 0.91]).
+      valid: optional (..., H, W) bool mask of true-image pixels; padding
+        never joins the region.
+      connectivity: 4 (reference/FAST behavior) or 8.
+      block_iters: dilation steps per convergence check.
+      max_iters: hard cap on total steps (safety for pathological bands).
+    """
+    band = (image >= low) & (image <= high)
+    if valid is not None:
+        band = band & valid
+    shape = "cross" if connectivity == 4 else "box"
+    region0 = seeds & band
+
+    def grow_block(region):
+        def step(_, r):
+            return dilate(r, 3, shape) & band
+        return jax.lax.fori_loop(0, block_iters, step, region)
+
+    def cond(state):
+        region, prev_count, iters = state
+        return (region.sum() != prev_count) & (iters < max_iters)
+
+    def body(state):
+        region, _, iters = state
+        count = region.sum()
+        return grow_block(region), count, iters + block_iters
+
+    # Run at least one block, then iterate until the popcount stops changing.
+    # (popcount equality == set equality here because the region only grows.)
+    region, _, _ = jax.lax.while_loop(
+        cond, body, (grow_block(region0), region0.sum(), jnp.int32(block_iters))
+    )
+    return region.astype(jnp.uint8)

@@ -1,0 +1,73 @@
+"""Adaptive seed-point generation.
+
+The reference builds its SeededRegionGrowing seed list from the image
+dimensions with C++ integer arithmetic (src/test/test_pipeline.cpp:79-106,
+src/sequential/main_sequential.cpp:213-241, src/parallel/main_parallel.cpp:118-148):
+
+* a central seed (w/2, h/2),
+* four offset seeds at (w/2 +- w/8, h/2) and (w/2, h/2 +- h/8),
+* a grid over the central half: x in [w/4, 3*w/4) step w/10,
+  y in [h/4, 3*h/4) step h/10.
+
+Here the seed *list* becomes a seed *mask image* computed elementwise from
+broadcasted iotas — a pure function of traced (h, w), so one compiled program
+adapts its seeds to every slice size, and the whole thing vmaps over a batch.
+All divisions floor, matching C++ integer division on the positive operands
+involved.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def seed_mask(dims: jax.Array, canvas_hw: Tuple[int, int]) -> jax.Array:
+    """Boolean (..., H, W) mask marking the reference's adaptive seed points.
+
+    Args:
+      dims: int32 array (..., 2) of true (height, width) per slice.
+      canvas_hw: static padded canvas shape.
+    """
+    hh, ww = canvas_hw
+    rows = jax.lax.broadcasted_iota(jnp.int32, (hh, ww), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (hh, ww), 1)
+
+    h = dims[..., 0:1, None].astype(jnp.int32)  # (..., 1, 1)
+    w = dims[..., 1:2, None].astype(jnp.int32)
+
+    cx = w // 2
+    cy = h // 2
+    off_x = w // 8
+    off_y = h // 8
+
+    # The five explicit seeds: center plus axis-aligned offsets
+    # (test_pipeline.cpp:86-95).
+    fixed = (
+        ((cols == cx) & (rows == cy))
+        | ((cols == cx + off_x) & (rows == cy))
+        | ((cols == cx - off_x) & (rows == cy))
+        | ((cols == cx) & (rows == cy + off_y))
+        | ((cols == cx) & (rows == cy - off_y))
+    )
+
+    # The central-half grid (test_pipeline.cpp:102-106). Guard step >= 1 so
+    # degenerate tiny images (below the reference's own 100px guard) don't
+    # divide by zero.
+    step_x = jnp.maximum(w // 10, 1)
+    step_y = jnp.maximum(h // 10, 1)
+    x0 = w // 4
+    y0 = h // 4
+    grid = (
+        (cols >= x0)
+        & (cols < (3 * w) // 4)
+        & ((cols - x0) % step_x == 0)
+        & (rows >= y0)
+        & (rows < (3 * h) // 4)
+        & ((rows - y0) % step_y == 0)
+    )
+
+    inside = (rows < h) & (cols < w) & (rows >= 0) & (cols >= 0)
+    return (fixed | grid) & inside

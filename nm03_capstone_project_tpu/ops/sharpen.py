@@ -1,0 +1,64 @@
+"""Unsharp-mask sharpening.
+
+TPU-native equivalent of FAST ``ImageSharpening::create(2.0f, 0.5f, 9)``
+(reference src/test/test_pipeline.cpp:71, main_sequential.cpp:208): gaussian
+blur (sigma, odd kernel size) followed by the unsharp update
+
+    out = x + gain * (x - blur(x))
+
+The blur is a separable 1D convolution pair lowered through
+``lax.conv_general_dilated`` (XLA maps it onto the MXU/VPU and fuses the
+elementwise tail). Clamp-to-edge boundary handling matches the OpenCL
+sampler behavior the reference inherits.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.lru_cache(maxsize=None)
+def gaussian_kernel_1d(sigma: float, size: int) -> np.ndarray:
+    """Normalized 1D gaussian taps; host-side constant folded into the jit."""
+    if size % 2 != 1:
+        raise ValueError(f"kernel size must be odd, got {size}")
+    r = size // 2
+    xs = np.arange(-r, r + 1, dtype=np.float64)
+    k = np.exp(-(xs**2) / (2.0 * sigma * sigma))
+    return (k / k.sum()).astype(np.float32)
+
+
+def gaussian_blur(x: jax.Array, sigma: float, size: int) -> jax.Array:
+    """Separable gaussian blur over the last two axes, clamp-to-edge."""
+    k = jnp.asarray(gaussian_kernel_1d(sigma, size))
+    r = size // 2
+    lead = x.shape[:-2]
+    h, w = x.shape[-2], x.shape[-1]
+    xb = x.reshape((-1, 1, h, w))  # NCHW
+    xb = jnp.pad(
+        xb, [(0, 0), (0, 0), (r, r), (r, r)], mode="edge"
+    )
+    dn = jax.lax.conv_dimension_numbers(xb.shape, (1, 1, size, 1), ("NCHW", "OIHW", "NCHW"))
+    # precision='highest' keeps the taps in true f32: the default bf16 matmul
+    # path costs ~2e-3 absolute error, which the downstream [0.74, 0.91]
+    # segmentation band would amplify into flipped pixels.
+    xb = jax.lax.conv_general_dilated(
+        xb, k.reshape(1, 1, size, 1), (1, 1), "VALID",
+        dimension_numbers=dn, precision="highest",
+    )
+    xb = jax.lax.conv_general_dilated(
+        xb, k.reshape(1, 1, 1, size), (1, 1), "VALID",
+        dimension_numbers=dn, precision="highest",
+    )
+    return xb.reshape(lead + (h, w))
+
+
+def sharpen(
+    x: jax.Array, gain: float = 2.0, sigma: float = 0.5, size: int = 9
+) -> jax.Array:
+    """Unsharp mask with the reference's default (gain=2, sigma=0.5, size=9)."""
+    return x + gain * (x - gaussian_blur(x, sigma, size))

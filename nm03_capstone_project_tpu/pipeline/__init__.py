@@ -1,0 +1,10 @@
+"""Fused pipelines (2D slice and 3D volume)."""
+
+from nm03_capstone_project_tpu.pipeline.slice_pipeline import (  # noqa: F401
+    check_min_dims,
+    preprocess,
+    process_batch,
+    process_slice,
+    process_slice_stages,
+    segment,
+)

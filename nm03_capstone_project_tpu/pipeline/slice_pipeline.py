@@ -1,0 +1,144 @@
+"""The fused 2D slice pipeline.
+
+Where the reference wires FAST ProcessObjects stage-by-stage with an eager
+``update()`` after every ``connect`` (src/sequential/main_sequential.cpp:194-252
+— each update dispatches a separate OpenCL kernel), this module composes the
+whole operator chain as one pure function and lets ``jax.jit`` fuse it into a
+single XLA program: elementwise stages melt into their stencil neighbours,
+nothing round-trips through HBM between stages, and the same function vmaps
+over a padded slice stack (the TPU replacement for the reference's OpenMP
+batch loop, main_parallel.cpp:336).
+
+Two variants mirror the reference's drivers (SURVEY.md section 2.4):
+
+* :func:`process_slice` — the batch contract (main_sequential.cpp:170-272,
+  main_parallel.cpp:66-170): preprocess, region-grow, uint8 cast, dilation
+  only; returns (original, segmentation-after-dilation).
+* :func:`process_slice_stages` — the test-pipeline contract
+  (src/test/test_pipeline.cpp:53-125): additionally returns every
+  intermediate stage, with erosion and dilation as parallel branches off the
+  caster (erosion does NOT feed dilation).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from nm03_capstone_project_tpu.config import DEFAULT_CONFIG, PipelineConfig
+from nm03_capstone_project_tpu.core.image import valid_mask
+from nm03_capstone_project_tpu.ops.elementwise import cast_uint8, clip_intensity, normalize
+from nm03_capstone_project_tpu.ops.median import vector_median_filter
+from nm03_capstone_project_tpu.ops.morphology import dilate, erode
+from nm03_capstone_project_tpu.ops.neighborhood import extend_edges
+from nm03_capstone_project_tpu.ops.region_growing import region_grow
+from nm03_capstone_project_tpu.ops.seeds import seed_mask
+from nm03_capstone_project_tpu.ops.sharpen import sharpen
+
+
+def preprocess(
+    pixels: jax.Array, dims: jax.Array, cfg: PipelineConfig = DEFAULT_CONFIG
+) -> jax.Array:
+    """Normalize -> clip -> vector median -> sharpen (the preprocessing stage).
+
+    ``pixels`` is (..., H, W) on the static canvas; ``dims`` the true (h, w).
+    The slice's true edge is replicated into the canvas padding first so the
+    stencil stages see clamp-to-edge boundaries instead of padding zeros.
+    """
+    x = extend_edges(pixels, dims)
+    x = normalize(
+        x, cfg.norm_low, cfg.norm_high, cfg.norm_intensity_min, cfg.norm_intensity_max
+    )
+    x = clip_intensity(x, cfg.clip_low, cfg.clip_high)
+    x = vector_median_filter(x, cfg.median_window)
+    x = sharpen(x, cfg.sharpen_gain, cfg.sharpen_sigma, cfg.sharpen_kernel)
+    return x
+
+
+def segment(
+    preprocessed: jax.Array, dims: jax.Array, cfg: PipelineConfig = DEFAULT_CONFIG
+) -> jax.Array:
+    """Seeded region growing with the adaptive seed grid; uint8 {0,1} mask."""
+    canvas_hw = preprocessed.shape[-2:]
+    seeds = seed_mask(dims, canvas_hw)
+    valid = valid_mask(dims, canvas_hw)
+    return region_grow(
+        preprocessed,
+        seeds,
+        cfg.grow_low,
+        cfg.grow_high,
+        valid=valid,
+        block_iters=cfg.grow_block_iters,
+        max_iters=cfg.grow_max_iters,
+    )
+
+
+def process_slice(
+    pixels: jax.Array, dims: jax.Array, cfg: PipelineConfig = DEFAULT_CONFIG
+) -> Dict[str, jax.Array]:
+    """Full batch-driver pipeline for one slice (or a batch via vmap).
+
+    Returns {'original', 'mask'}: the untouched input pixels and the final
+    uint8 mask after dilation — the two images the batch drivers export per
+    slice (main_sequential.cpp:254-265).
+    """
+    pre = preprocess(pixels, dims, cfg)
+    seg = segment(pre, dims, cfg)
+    mask = dilate(cast_uint8(seg), cfg.morph_size)
+    # dilation must not spill into the canvas padding — the reference's
+    # Dilation runs on the exact-size image and can never write there
+    valid = valid_mask(dims, pixels.shape[-2:])
+    mask = mask * valid.astype(mask.dtype)
+    return {"original": pixels, "mask": mask}
+
+
+def process_slice_stages(
+    pixels: jax.Array, dims: jax.Array, cfg: PipelineConfig = DEFAULT_CONFIG
+) -> Dict[str, jax.Array]:
+    """Test-pipeline variant: every intermediate stage, erosion branch included.
+
+    Mirrors src/test/test_pipeline.cpp:53-125: erosion and dilation both
+    branch off the caster output (section 2.4 divergence). Keys match the
+    export names of the reference's test driver (test_pipeline.cpp:167-177).
+    """
+    pre = preprocess(pixels, dims, cfg)
+    seg = segment(pre, dims, cfg)
+    cast = cast_uint8(seg)
+    valid = valid_mask(dims, pixels.shape[-2:])
+    dilated = dilate(cast, cfg.morph_size) * valid.astype(jnp.uint8)
+    return {
+        "original_image": pixels,
+        "preprocessed_image": pre,
+        "segmentation": cast,
+        "erosion_result": erode(cast, cfg.morph_size),
+        "final_dilated_result": dilated,
+    }
+
+
+def process_batch(
+    pixels: jax.Array, dims: jax.Array, cfg: PipelineConfig = DEFAULT_CONFIG
+) -> Dict[str, jax.Array]:
+    """vmapped :func:`process_slice` over a (B, H, W) stack.
+
+    This is the TPU-native replacement for the reference's
+    ``#pragma omp parallel for`` over a batch (main_parallel.cpp:336): one
+    compiled program, batch dimension handled by the compiler, bit-identical
+    to the sequential path by construction (the property the reference can
+    only check by diffing output directories).
+    """
+    return jax.vmap(lambda p, d: process_slice(p, d, cfg))(pixels, dims)
+
+
+def check_min_dims(dims, min_dim: int = DEFAULT_CONFIG.min_dim):
+    """Host-side guard mirroring main_sequential.cpp:189-192.
+
+    Returns a bool (array) of slices that pass the reference's minimum
+    dimension check; callers skip failures and count them, preserving the
+    reference's catch-and-continue contract.
+    """
+    import numpy as np
+
+    d = np.asarray(dims)
+    return (d[..., 0] >= min_dim) & (d[..., 1] >= min_dim)

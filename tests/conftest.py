@@ -1,0 +1,28 @@
+"""Test harness configuration.
+
+Tests run on the CPU backend with 8 virtual devices so every sharding /
+collective path is exercised without TPU hardware (SURVEY.md section 7 step 8:
+"multi-chip via xla_force_host_platform_device_count fake-device testing").
+The env vars must be set before jax initializes, which this conftest
+guarantees because pytest imports it before any test module.
+"""
+
+import os
+
+# Hard-set, not setdefault: the surrounding environment may pin
+# JAX_PLATFORMS to a hardware backend, and unit tests must never
+# compete for (or hang on) a real accelerator.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

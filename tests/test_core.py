@@ -1,0 +1,46 @@
+import numpy as np
+import pytest
+
+from nm03_capstone_project_tpu.core import SliceBatch, pad_to_canvas, valid_mask
+
+
+def test_pad_to_canvas_shapes_and_values():
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    b = np.ones((4, 4), dtype=np.float32)
+    batch = pad_to_canvas([a, b], (8, 8))
+    assert batch.pixels.shape == (2, 8, 8)
+    assert batch.dims.tolist() == [[2, 3], [4, 4]]
+    np.testing.assert_array_equal(batch.pixels[0, :2, :3], a)
+    assert batch.pixels[0, 2:, :].sum() == 0
+    assert batch.pixels[0, :, 3:].sum() == 0
+
+
+def test_pad_to_canvas_rejects_oversize():
+    with pytest.raises(ValueError):
+        pad_to_canvas([np.zeros((9, 3), np.float32)], (8, 8))
+
+
+def test_pad_to_canvas_rejects_non_2d():
+    with pytest.raises(ValueError):
+        pad_to_canvas([np.zeros((2, 3, 4), np.float32)], (8, 8))
+
+
+def test_valid_mask_unbatched_and_batched():
+    dims = np.array([[2, 3], [4, 4]], dtype=np.int32)
+    m = np.asarray(valid_mask(dims, (8, 8)))
+    assert m.shape == (2, 8, 8)
+    assert m[0].sum() == 6
+    assert m[1].sum() == 16
+    assert m[0, :2, :3].all()
+    single = np.asarray(valid_mask(dims[0], (8, 8)))
+    np.testing.assert_array_equal(single, m[0])
+
+
+def test_slicebatch_is_pytree():
+    import jax
+
+    batch = pad_to_canvas([np.zeros((2, 2), np.float32)], (4, 4))
+    leaves = jax.tree_util.tree_leaves(batch)
+    assert len(leaves) == 2
+    out = jax.jit(lambda sb: SliceBatch(sb.pixels + 1, sb.dims))(batch)
+    assert float(out.pixels[0, 0, 0]) == 1.0

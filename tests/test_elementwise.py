@@ -1,0 +1,50 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nm03_capstone_project_tpu.ops import (
+    binary_threshold,
+    cast_uint8,
+    clip_intensity,
+    normalize,
+)
+
+
+def test_normalize_reference_window(rng):
+    """The reference window: [0, 10000] -> [0.5, 2.5]."""
+    x = rng.uniform(0, 10000, size=(32, 32)).astype(np.float32)
+    y = np.asarray(normalize(jnp.asarray(x)))
+    expected = x / 10000.0 * 2.0 + 0.5
+    np.testing.assert_allclose(y, expected, rtol=1e-6)
+    assert np.asarray(normalize(jnp.float32(0.0))) == 0.5
+    assert np.asarray(normalize(jnp.float32(10000.0))) == 2.5
+
+
+def test_normalize_extrapolates_outside_window():
+    # no clamping inside normalize — that's clip_intensity's job
+    assert float(normalize(jnp.float32(20000.0))) > 2.5
+
+
+def test_clip_reference_params(rng):
+    x = rng.uniform(-1, 5000, size=(16, 16)).astype(np.float32)
+    y = np.asarray(clip_intensity(jnp.asarray(x)))
+    np.testing.assert_allclose(y, np.clip(x, 0.68, 4000.0))
+
+
+def test_cast_uint8():
+    x = jnp.array([[0.0, 1.0, 1.9, 255.0]])
+    y = cast_uint8(x)
+    assert y.dtype == jnp.uint8
+    np.testing.assert_array_equal(np.asarray(y), [[0, 1, 1, 255]])
+
+
+def test_binary_threshold():
+    x = jnp.array([0.5, 0.74, 0.8, 0.91, 0.95])
+    y = np.asarray(binary_threshold(x, 0.74, 0.91))
+    np.testing.assert_array_equal(y, [0, 1, 1, 1, 0])
+
+
+def test_elementwise_chain_jits_and_fuses():
+    f = jax.jit(lambda x: clip_intensity(normalize(x)))
+    x = jnp.full((8, 8), 5000.0)
+    np.testing.assert_allclose(np.asarray(f(x)), 1.5)

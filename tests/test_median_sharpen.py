@@ -1,0 +1,85 @@
+import numpy as np
+import scipy.ndimage as ndi
+
+from nm03_capstone_project_tpu.ops import (
+    extend_edges,
+    gaussian_blur,
+    sharpen,
+    vector_median_filter,
+    vector_median_filter_multichannel,
+)
+
+
+def test_median_matches_scipy_interior(rng):
+    x = rng.random((40, 40)).astype(np.float32)
+    out = np.asarray(vector_median_filter(x, 7))
+    expected = ndi.median_filter(x, size=7, mode="nearest")
+    np.testing.assert_allclose(out, expected, atol=1e-6)
+
+
+def test_median_size3(rng):
+    x = rng.random((16, 16)).astype(np.float32)
+    out = np.asarray(vector_median_filter(x, 3))
+    expected = ndi.median_filter(x, size=3, mode="nearest")
+    np.testing.assert_allclose(out, expected, atol=1e-6)
+
+
+def test_median_batched(rng):
+    x = rng.random((3, 20, 20)).astype(np.float32)
+    out = np.asarray(vector_median_filter(x, 5))
+    for i in range(3):
+        np.testing.assert_allclose(
+            out[i], ndi.median_filter(x[i], size=5, mode="nearest"), atol=1e-6
+        )
+
+
+def test_vector_median_scalar_channel_agrees(rng):
+    """For C=1 the true L1 vector median equals the scalar median."""
+    x = rng.random((18, 18)).astype(np.float32)
+    vm = np.asarray(vector_median_filter_multichannel(x[None], 5))[0]
+    sm = np.asarray(vector_median_filter(x, 5))
+    np.testing.assert_allclose(vm, sm, atol=1e-6)
+
+
+def test_vector_median_multichannel_picks_window_sample(rng):
+    x = rng.random((3, 12, 12)).astype(np.float32)
+    vm = np.asarray(vector_median_filter_multichannel(x, 3))
+    # every output vector must be one of the window's input vectors
+    xpad = np.pad(x, [(0, 0), (1, 1), (1, 1)], mode="edge")
+    for r in range(12):
+        for c in range(3, 5):
+            window = xpad[:, r : r + 3, c : c + 3].reshape(3, -1).T
+            assert any(np.allclose(vm[:, r, c], w, atol=1e-6) for w in window)
+
+
+def test_gaussian_blur_matches_scipy(rng):
+    x = rng.random((32, 32)).astype(np.float32)
+    out = np.asarray(gaussian_blur(x, sigma=1.0, size=9))
+    expected = ndi.gaussian_filter(x, sigma=1.0, mode="nearest", radius=4)
+    np.testing.assert_allclose(out, expected, atol=1e-5)
+
+
+def test_sharpen_identity_on_constant():
+    x = np.full((16, 16), 3.25, np.float32)
+    out = np.asarray(sharpen(x))
+    np.testing.assert_allclose(out, x, atol=1e-6)
+
+
+def test_sharpen_amplifies_edge(rng):
+    x = np.zeros((16, 16), np.float32)
+    x[:, 8:] = 1.0
+    out = np.asarray(sharpen(x, gain=2.0, sigma=0.5, size=9))
+    # unsharp masking overshoots on both sides of the edge
+    assert out[:, 7].max() < 0.0
+    assert out[:, 8].min() > 1.0
+
+
+def test_extend_edges_replicates_true_boundary():
+    x = np.zeros((6, 6), np.float32)
+    x[:4, :5] = np.arange(20, dtype=np.float32).reshape(4, 5)
+    dims = np.array([4, 5], dtype=np.int32)
+    out = np.asarray(extend_edges(x, dims))
+    np.testing.assert_array_equal(out[:4, :5], x[:4, :5])
+    assert (out[4:, :5] == x[3, [0, 1, 2, 3, 4]]).all()
+    assert (out[:4, 5:] == x[:4, 4:5]).all()
+    assert (out[4:, 5:] == x[3, 4]).all()

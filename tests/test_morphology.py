@@ -1,0 +1,69 @@
+import numpy as np
+import scipy.ndimage as ndi
+
+from nm03_capstone_project_tpu.ops import dilate, erode
+
+
+def random_mask(rng, shape=(32, 32), p=0.3):
+    return (rng.random(shape) < p).astype(np.uint8)
+
+
+def cross_struct():
+    return ndi.generate_binary_structure(2, 1)
+
+
+def box_struct():
+    return np.ones((3, 3), bool)
+
+
+def test_dilate_cross_matches_scipy(rng):
+    m = random_mask(rng)
+    out = np.asarray(dilate(m, 3, "cross"))
+    expected = ndi.binary_dilation(m, structure=cross_struct()).astype(np.uint8)
+    np.testing.assert_array_equal(out, expected)
+
+
+def test_dilate_box_matches_scipy(rng):
+    m = random_mask(rng)
+    out = np.asarray(dilate(m, 3, "box"))
+    expected = ndi.binary_dilation(m, structure=box_struct()).astype(np.uint8)
+    np.testing.assert_array_equal(out, expected)
+
+
+def test_erode_cross_matches_scipy(rng):
+    m = random_mask(rng, p=0.7)
+    out = np.asarray(erode(m, 3, "cross"))
+    expected = ndi.binary_erosion(
+        m, structure=cross_struct(), border_value=0
+    ).astype(np.uint8)
+    np.testing.assert_array_equal(out, expected)
+
+
+def test_erode_erodes_border_foreground():
+    m = np.ones((8, 8), np.uint8)
+    out = np.asarray(erode(m, 3, "box"))
+    assert out[0, 0] == 0 and out[4, 4] == 1
+
+
+def test_morphology_preserves_bool_dtype():
+    m = np.zeros((8, 8), bool)
+    m[4, 4] = True
+    out = dilate(m, 3, "cross")
+    assert np.asarray(out).dtype == bool
+    assert np.asarray(out).sum() == 5
+
+
+def test_disk_size3_equals_box():
+    # euclidean radius 1.5 includes diagonals
+    m = np.zeros((9, 9), np.uint8)
+    m[4, 4] = 1
+    np.testing.assert_array_equal(
+        np.asarray(dilate(m, 3, "disk")), np.asarray(dilate(m, 3, "box"))
+    )
+
+
+def test_batched_matches_loop(rng):
+    ms = np.stack([random_mask(rng) for _ in range(4)])
+    out = np.asarray(dilate(ms, 3, "cross"))
+    for i in range(4):
+        np.testing.assert_array_equal(out[i], np.asarray(dilate(ms[i], 3, "cross")))

@@ -1,0 +1,121 @@
+import jax
+import numpy as np
+import pytest
+
+from nm03_capstone_project_tpu.config import PipelineConfig
+from nm03_capstone_project_tpu.core import pad_to_canvas
+from nm03_capstone_project_tpu.data.synthetic import phantom_series, phantom_slice
+from nm03_capstone_project_tpu.pipeline import (
+    check_min_dims,
+    process_batch,
+    process_slice,
+    process_slice_stages,
+)
+
+CFG = PipelineConfig(canvas=128)
+
+
+@pytest.fixture(scope="module")
+def small_phantom():
+    return phantom_slice(128, 128, seed=3)
+
+
+def test_process_slice_segments_lesion(small_phantom):
+    batch = pad_to_canvas([small_phantom], (128, 128))
+    out = process_slice(batch.pixels[0], batch.dims[0], CFG)
+    mask = np.asarray(out["mask"])
+    assert mask.dtype == np.uint8
+    assert set(np.unique(mask)) <= {0, 1}
+    h = w = 128
+    # the lesion is centered with radius 0.16*128 ~ 20px; the mask should
+    # cover a blob around the center and nothing near the rim
+    assert mask[h // 2, w // 2] == 1
+    assert mask[: h // 8, :].sum() == 0
+    area = mask.sum()
+    expected_area = np.pi * (0.16 * 128) ** 2
+    assert 0.5 * expected_area < area < 2.5 * expected_area
+    np.testing.assert_array_equal(np.asarray(out["original"]), batch.pixels[0])
+
+
+def test_stages_variant_contract(small_phantom):
+    batch = pad_to_canvas([small_phantom], (128, 128))
+    out = process_slice_stages(batch.pixels[0], batch.dims[0], CFG)
+    assert set(out) == {
+        "original_image",
+        "preprocessed_image",
+        "segmentation",
+        "erosion_result",
+        "final_dilated_result",
+    }
+    seg = np.asarray(out["segmentation"])
+    ero = np.asarray(out["erosion_result"])
+    dil = np.asarray(out["final_dilated_result"])
+    # erosion shrinks, dilation grows, both relative to the same caster output
+    assert ero.sum() < seg.sum() < dil.sum()
+    # erosion result is a subset of seg; seg a subset of dilation
+    assert not np.any(ero & ~seg)
+    assert not np.any(seg & ~dil)
+
+
+def test_vmapped_batch_equals_sequential():
+    """Formalizes the reference's implicit parallel==sequential invariant."""
+    slices = phantom_series(4, 128, 120, seed=7)
+    batch = pad_to_canvas(slices, (128, 128))
+    out_b = process_batch(batch.pixels, batch.dims, CFG)
+    for i in range(len(slices)):
+        out_s = process_slice(batch.pixels[i], batch.dims[i], CFG)
+        np.testing.assert_array_equal(
+            np.asarray(out_b["mask"][i]), np.asarray(out_s["mask"]), err_msg=f"slice {i}"
+        )
+
+
+def test_variable_dims_one_compiled_program():
+    """Different true dims share one jitted program on the static canvas."""
+    f = jax.jit(lambda p, d: process_slice(p, d, CFG)["mask"])
+    a = phantom_slice(128, 128, seed=1)
+    b = phantom_slice(110, 100, seed=1)
+    batch = pad_to_canvas([a, b], (128, 128))
+    m0 = np.asarray(f(batch.pixels[0], batch.dims[0]))
+    m1 = np.asarray(f(batch.pixels[1], batch.dims[1]))
+    assert m0[64, 64] == 1
+    assert m1[55, 50] == 1
+    # no segmentation in the padding of the smaller slice
+    assert m1[110:, :].sum() == 0 and m1[:, 100:].sum() == 0
+
+
+def test_dilation_never_spills_into_padding():
+    """Regression: final dilation must be clipped to the true image extent."""
+    # in-band strip connecting the central lesion to the bottom true border
+    img = phantom_slice(112, 104, seed=2)
+    img[56:112, 50:60] = 1600.0
+    batch = pad_to_canvas([img], (128, 128))
+    cfg = PipelineConfig(canvas=128)
+    out = process_slice(batch.pixels[0], batch.dims[0], cfg)
+    mask = np.asarray(out["mask"])
+    assert mask[111, 50:60].any()  # non-vacuous: region reaches the border row
+    assert mask[112:, :].sum() == 0 and mask[:, 104:].sum() == 0
+    stages = process_slice_stages(batch.pixels[0], batch.dims[0], cfg)
+    dil = np.asarray(stages["final_dilated_result"])
+    assert dil[111, 50:60].any()
+    assert dil[112:, :].sum() == 0 and dil[:, 104:].sum() == 0
+
+
+def test_min_dim_guard():
+    dims = np.array([[256, 256], [99, 256], [256, 12]], np.int32)
+    np.testing.assert_array_equal(check_min_dims(dims), [True, False, False])
+
+
+def test_golden_regression(small_phantom):
+    """Pin the pipeline output so silent numeric drift fails loudly.
+
+    If a deliberate contract change moves these numbers, update them in the
+    same commit that changes the op.
+    """
+    batch = pad_to_canvas([small_phantom], (128, 128))
+    mask = np.asarray(process_slice(batch.pixels[0], batch.dims[0], CFG)["mask"])
+    area = int(mask.sum())
+    ys, xs = np.nonzero(mask)
+    centroid = (float(ys.mean()), float(xs.mean()))
+    assert abs(centroid[0] - 63.5) < 3.0 and abs(centroid[1] - 63.5) < 3.0
+    # stash the exact area in the assertion message for easy refresh
+    assert 900 < area < 1800, f"golden area drifted: {area}"

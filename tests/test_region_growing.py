@@ -1,0 +1,102 @@
+import jax
+import numpy as np
+import scipy.ndimage as ndi
+
+from nm03_capstone_project_tpu.ops import region_grow
+
+
+def oracle_region_grow(image, seeds, low, high, connectivity=4):
+    """Connected-component oracle: pixels in band connected to any seed."""
+    band = (image >= low) & (image <= high)
+    structure = ndi.generate_binary_structure(2, 1 if connectivity == 4 else 2)
+    labels, _ = ndi.label(band, structure=structure)
+    seed_labels = np.unique(labels[seeds & band])
+    seed_labels = seed_labels[seed_labels != 0]
+    return np.isin(labels, seed_labels).astype(np.uint8)
+
+
+def test_region_grow_simple_blob():
+    img = np.zeros((32, 32), np.float32)
+    img[8:20, 8:20] = 0.8  # in band
+    img[25:30, 25:30] = 0.8  # in band but disconnected from seed
+    seeds = np.zeros((32, 32), bool)
+    seeds[10, 10] = True
+    out = np.asarray(region_grow(img, seeds, 0.74, 0.91))
+    expected = oracle_region_grow(img, seeds, 0.74, 0.91)
+    np.testing.assert_array_equal(out, expected)
+    assert out[26, 26] == 0  # disconnected blob excluded
+
+
+def test_region_grow_matches_oracle_random(rng):
+    for trial in range(5):
+        img = ndi.gaussian_filter(
+            rng.random((48, 48)).astype(np.float32), sigma=2.0
+        )
+        seeds = np.zeros((48, 48), bool)
+        seeds[24, 24] = True
+        seeds[10, 35] = True
+        lo, hi = 0.45, 0.6
+        out = np.asarray(region_grow(img, seeds, lo, hi, block_iters=8))
+        expected = oracle_region_grow(img, seeds, lo, hi)
+        np.testing.assert_array_equal(out, expected, err_msg=f"trial {trial}")
+
+
+def test_region_grow_seed_outside_band_is_dead():
+    img = np.full((16, 16), 0.5, np.float32)
+    seeds = np.zeros((16, 16), bool)
+    seeds[8, 8] = True
+    out = np.asarray(region_grow(img, seeds, 0.74, 0.91))
+    assert out.sum() == 0
+
+
+def test_region_grow_respects_valid_mask():
+    img = np.full((16, 16), 0.8, np.float32)
+    seeds = np.zeros((16, 16), bool)
+    seeds[4, 4] = True
+    valid = np.zeros((16, 16), bool)
+    valid[:8, :8] = True
+    out = np.asarray(region_grow(img, seeds, 0.74, 0.91, valid=valid))
+    assert out[:8, :8].all()
+    assert out[8:, :].sum() == 0 and out[:, 8:].sum() == 0
+
+
+def test_region_grow_snake_path():
+    """Long winding path exercises many fixpoint blocks."""
+    img = np.zeros((24, 24), np.float32)
+    path_rows = list(range(24))
+    for i, r in enumerate(path_rows):
+        if i % 2 == 0:
+            img[r, :23] = 0.8
+        else:
+            img[r, 1:] = 0.8
+    seeds = np.zeros((24, 24), bool)
+    seeds[0, 0] = True
+    out = np.asarray(region_grow(img, seeds, 0.74, 0.91, block_iters=4))
+    expected = oracle_region_grow(img, seeds, 0.74, 0.91)
+    np.testing.assert_array_equal(out, expected)
+    assert out.sum() == (img > 0).sum()  # whole snake reached
+
+
+def test_region_grow_vmap_matches_sequential(rng):
+    imgs = ndi.gaussian_filter(rng.random((4, 32, 32)), sigma=1.5, axes=(1, 2)).astype(
+        np.float32
+    )
+    seeds = np.zeros((4, 32, 32), bool)
+    seeds[:, 16, 16] = True
+    f = jax.vmap(lambda i, s: region_grow(i, s, 0.45, 0.6, block_iters=8))
+    out = np.asarray(f(imgs, seeds))
+    for i in range(4):
+        np.testing.assert_array_equal(
+            out[i], np.asarray(region_grow(imgs[i], seeds[i], 0.45, 0.6, block_iters=8))
+        )
+
+
+def test_region_grow_8_connectivity():
+    img = np.zeros((8, 8), np.float32)
+    img[0, 0] = img[1, 1] = img[2, 2] = 0.8  # diagonal chain
+    seeds = np.zeros((8, 8), bool)
+    seeds[0, 0] = True
+    out4 = np.asarray(region_grow(img, seeds, 0.74, 0.91, connectivity=4))
+    out8 = np.asarray(region_grow(img, seeds, 0.74, 0.91, connectivity=8))
+    assert out4.sum() == 1
+    assert out8.sum() == 3

@@ -1,0 +1,53 @@
+import jax
+import numpy as np
+
+from nm03_capstone_project_tpu.ops import seed_mask
+
+
+def reference_seed_points(width: int, height: int):
+    """Literal transcription of the reference's seed loop semantics.
+
+    (src/test/test_pipeline.cpp:79-106: center seed, 4 offset seeds, then a
+    grid x in [w/4, 3w/4) step w/10, y in [h/4, 3h/4) step h/10 — all C++
+    integer division.)
+    """
+    cx, cy = width // 2, height // 2
+    ox, oy = width // 8, height // 8
+    pts = {(cx, cy), (cx + ox, cy), (cx - ox, cy), (cx, cy + oy), (cx, cy - oy)}
+    x = width // 4
+    while x < width * 3 // 4:
+        y = height // 4
+        while y < height * 3 // 4:
+            pts.add((x, y))
+            y += max(height // 10, 1)
+        x += max(width // 10, 1)
+    # clip to image bounds (a seed outside the image can never grow)
+    return {(x, y) for (x, y) in pts if 0 <= x < width and 0 <= y < height}
+
+
+def mask_to_points(mask: np.ndarray):
+    ys, xs = np.nonzero(mask)
+    return set(zip(xs.tolist(), ys.tolist()))
+
+
+def test_seed_mask_matches_reference_loops():
+    for h, w in [(256, 256), (240, 256), (100, 100), (256, 230), (101, 255)]:
+        dims = np.array([h, w], dtype=np.int32)
+        m = np.asarray(seed_mask(dims, (256, 256)))
+        assert mask_to_points(m) == reference_seed_points(w, h), (h, w)
+
+
+def test_seed_mask_batched_and_jitted():
+    dims = np.array([[256, 256], [128, 200]], dtype=np.int32)
+    f = jax.jit(lambda d: seed_mask(d, (256, 256)))
+    m = np.asarray(f(dims))
+    assert m.shape == (2, 256, 256)
+    for i, (h, w) in enumerate(dims.tolist()):
+        assert mask_to_points(m[i]) == reference_seed_points(w, h)
+
+
+def test_seed_mask_no_seeds_in_padding():
+    dims = np.array([64, 64], dtype=np.int32)
+    m = np.asarray(seed_mask(dims, (256, 256)))
+    assert not m[64:, :].any()
+    assert not m[:, 64:].any()
