@@ -1,0 +1,156 @@
+"""Shared CLI plumbing.
+
+The reference hard-codes every knob (SURVEY.md section 5 "Config / flag
+system": dataset root via Config::getTestDataPath() + fixed subpath, output
+dirs as ctor defaults, batch size / thread count / all pipeline parameters
+inlined). Here every constant in the PipelineConfig is a flag, and device
+selection is explicit (``--device tpu|cpu|auto``).
+
+Device selection must happen before jax initializes, so CLI mains keep jax
+imports *inside* functions and call :func:`apply_device_env` first.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from pathlib import Path
+
+from nm03_capstone_project_tpu.config import BatchConfig, PipelineConfig
+
+# The reference resolves its cohort as Config::getTestDataPath() +
+# "Brain-Tumor-Progression/T1-Post-Combined-P001-P020/"
+# (main_sequential.cpp:83-84). The env var is this framework's equivalent of
+# FAST's configured test-data path.
+DATA_PATH_ENV = "NM03_DATA_PATH"
+DEFAULT_COHORT_SUBPATH = "Brain-Tumor-Progression/T1-Post-Combined-P001-P020"
+
+
+def add_common_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--base-path",
+        default=None,
+        help="cohort root (defaults to $NM03_DATA_PATH/"
+        f"{DEFAULT_COHORT_SUBPATH}); ignored with --synthetic",
+    )
+    parser.add_argument(
+        "--synthetic",
+        type=int,
+        default=0,
+        metavar="N",
+        help="generate an N-patient synthetic cohort instead of reading real data",
+    )
+    parser.add_argument(
+        "--synthetic-slices", type=int, default=8, help="slices per synthetic patient"
+    )
+    parser.add_argument(
+        "--device",
+        choices=["auto", "tpu", "cpu"],
+        default="auto",
+        help="compute backend (cpu uses the host XLA backend)",
+    )
+    parser.add_argument("--resume", action="store_true", help="skip slices already in the manifest")
+    parser.add_argument("--verbose", action="store_true", help="enable INFO logging")
+    parser.add_argument(
+        "--results-json",
+        default=None,
+        help="write a timing/success results JSON (in-tree replacement for the "
+        "reference's out-of-tree hyperfine artifacts)",
+    )
+
+
+def add_pipeline_args(parser: argparse.ArgumentParser) -> None:
+    d = PipelineConfig()
+    g = parser.add_argument_group("pipeline", "every constant the reference hard-codes")
+    g.add_argument("--norm-low", type=float, default=d.norm_low)
+    g.add_argument("--norm-high", type=float, default=d.norm_high)
+    g.add_argument("--norm-min", type=float, default=d.norm_intensity_min)
+    g.add_argument("--norm-max", type=float, default=d.norm_intensity_max)
+    g.add_argument("--clip-low", type=float, default=d.clip_low)
+    g.add_argument("--clip-high", type=float, default=d.clip_high)
+    g.add_argument("--median-window", type=int, default=d.median_window)
+    g.add_argument("--sharpen-gain", type=float, default=d.sharpen_gain)
+    g.add_argument("--sharpen-sigma", type=float, default=d.sharpen_sigma)
+    g.add_argument("--sharpen-kernel", type=int, default=d.sharpen_kernel)
+    g.add_argument("--grow-low", type=float, default=d.grow_low)
+    g.add_argument("--grow-high", type=float, default=d.grow_high)
+    g.add_argument("--morph-size", type=int, default=d.morph_size)
+    g.add_argument("--min-dim", type=int, default=d.min_dim)
+    g.add_argument("--render-size", type=int, default=d.render_size)
+    g.add_argument("--canvas", type=int, default=d.canvas)
+    g.add_argument(
+        "--use-pallas",
+        action="store_true",
+        help="route hot ops through the Pallas TPU kernels",
+    )
+
+
+def pipeline_config_from_args(args: argparse.Namespace) -> PipelineConfig:
+    return PipelineConfig(
+        norm_low=args.norm_low,
+        norm_high=args.norm_high,
+        norm_intensity_min=args.norm_min,
+        norm_intensity_max=args.norm_max,
+        clip_low=args.clip_low,
+        clip_high=args.clip_high,
+        median_window=args.median_window,
+        sharpen_gain=args.sharpen_gain,
+        sharpen_sigma=args.sharpen_sigma,
+        sharpen_kernel=args.sharpen_kernel,
+        grow_low=args.grow_low,
+        grow_high=args.grow_high,
+        morph_size=args.morph_size,
+        min_dim=args.min_dim,
+        render_size=args.render_size,
+        canvas=args.canvas,
+        use_pallas=args.use_pallas,
+    )
+
+
+def add_batch_args(parser: argparse.ArgumentParser) -> None:
+    d = BatchConfig()
+    parser.add_argument(
+        "--batch-size",
+        type=int,
+        default=d.batch_size,
+        help="slices per device batch (reference DEFAULT_BATCH_SIZE=25, "
+        "main_parallel.cpp:31-33)",
+    )
+    parser.add_argument("--io-workers", type=int, default=d.io_workers)
+    parser.add_argument("--prefetch-depth", type=int, default=d.prefetch_depth)
+
+
+def apply_device_env(device: str) -> None:
+    """Pin the JAX platform before jax is imported.
+
+    'cpu' forces the host backend (and skips any accelerator plugin handshake
+    via PALLAS_AXON_POOL_IPS removal on this image); 'tpu'/'auto' leave the
+    environment's default backend in charge.
+    """
+    if device == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+
+def resolve_base_path(args: argparse.Namespace, tmp_root: Path | None = None) -> Path:
+    """Cohort root: --synthetic generates one; else --base-path or env."""
+    if args.synthetic > 0:
+        from nm03_capstone_project_tpu.data.synthetic import write_synthetic_cohort
+
+        # key the directory by its parameters so changing --synthetic /
+        # --synthetic-slices regenerates instead of reusing a stale cohort
+        name = f"synthetic-cohort-{args.synthetic}x{args.synthetic_slices}"
+        root = (tmp_root or Path(args.output)) / name
+        if not (root.exists() and any(root.iterdir())):
+            write_synthetic_cohort(
+                root, n_patients=args.synthetic, n_slices=args.synthetic_slices
+            )
+        return root
+    if args.base_path:
+        return Path(args.base_path)
+    env = os.environ.get(DATA_PATH_ENV)
+    if env:
+        return Path(env) / DEFAULT_COHORT_SUBPATH
+    raise SystemExit(
+        "no data: pass --base-path, set $NM03_DATA_PATH, or use --synthetic N"
+    )

@@ -1,0 +1,38 @@
+"""Parallel batch driver.
+
+Entry point mirroring the reference's ``img_processing_parallel``
+(src/parallel/main_parallel.cpp:389-411). The reference parallelizes with 16
+OpenMP threads over a <=25-slice batch and serializes exports through one
+shared Qt render target; here the batch is a vmapped leading axis of ONE
+compiled XLA program (decode on an IO thread pool, JPEG encode overlapped
+with the next batch's device compute) — same contract, no threads to guard,
+bit-identical to the sequential driver by construction.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from nm03_capstone_project_tpu.cli import common
+from nm03_capstone_project_tpu.cli.sequential import run
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nm03-parallel", description=__doc__.strip().splitlines()[0]
+    )
+    p.add_argument("--output", default="out-parallel", help="output root directory")
+    common.add_common_args(p)
+    common.add_pipeline_args(p)
+    common.add_batch_args(p)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    common.apply_device_env(args.device)
+    return run(args, mode="parallel")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

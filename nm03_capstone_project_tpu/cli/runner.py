@@ -1,0 +1,360 @@
+"""Cohort orchestration: the batch-driver layer, once.
+
+The reference implements its orchestration twice — SequentialImageProcessor
+(main_sequential.cpp:9-344) and OptimizedParallelProcessor
+(main_parallel.cpp:19-387) — duplicating discovery, per-patient looping and
+fault tolerance. Here a single :class:`CohortProcessor` owns the loop and the
+two execution strategies differ only in how a patient's slices are executed:
+
+* ``sequential`` — one slice at a time through the jitted pipeline, export
+  interleaved per image (the reference's sequential contract).
+* ``parallel`` — slices decoded by an IO thread pool, stacked into device
+  batches, processed + rendered by ONE jitted vmapped program, JPEG-encoded
+  by a host thread pool that overlaps the next batch's compute. This is the
+  TPU-native replacement for the OpenMP parallel-for + serial-export split
+  (main_parallel.cpp:330-347): the "thread-safety" problem disappears
+  because rendering is pure device math.
+
+Fault tolerance mirrors the reference at both granularities
+(SURVEY.md section 5): per-slice catch-and-continue with success counting
+(main_sequential.cpp:267-271,288-294) and per-patient catch-and-continue
+(main_sequential.cpp:301-305); plus what the reference lacks — a manifest for
+``--resume`` instead of the destructive ``rm -rf`` rerun.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import functools
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from nm03_capstone_project_tpu.config import BatchConfig, PipelineConfig
+from nm03_capstone_project_tpu.data.dicomlite import read_dicom
+from nm03_capstone_project_tpu.data.discovery import (
+    find_patient_dirs,
+    load_dicom_files_for_patient,
+)
+from nm03_capstone_project_tpu.render.export import clean_directory, export_pairs
+from nm03_capstone_project_tpu.utils.manifest import (
+    STATUS_DONE,
+    STATUS_FAILED,
+    Manifest,
+)
+from nm03_capstone_project_tpu.utils.reporter import get_logger
+from nm03_capstone_project_tpu.utils.timing import Timer
+
+log = get_logger("runner")
+
+
+@functools.lru_cache(maxsize=8)
+def _compiled_slice_fn(cfg: PipelineConfig):
+    """jit of pipeline + on-device render for one slice."""
+    import jax
+
+    from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
+    from nm03_capstone_project_tpu.render.render import (
+        render_gray,
+        render_segmentation,
+    )
+
+    def f(pixels, dims):
+        out = process_slice(pixels, dims, cfg)
+        orig = render_gray(out["original"], dims, cfg.render_size)
+        proc = render_segmentation(
+            out["mask"],
+            dims,
+            cfg.render_size,
+            cfg.overlay_opacity,
+            cfg.overlay_border_opacity,
+            cfg.overlay_border_radius,
+        )
+        return orig, proc
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=8)
+def _compiled_batch_fn(cfg: PipelineConfig):
+    """jit of vmapped pipeline + render over a fixed-size slice stack."""
+    import jax
+
+    from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
+    from nm03_capstone_project_tpu.render.render import (
+        render_gray,
+        render_segmentation,
+    )
+
+    def one(pixels, dims):
+        out = process_slice(pixels, dims, cfg)
+        orig = render_gray(out["original"], dims, cfg.render_size)
+        proc = render_segmentation(
+            out["mask"],
+            dims,
+            cfg.render_size,
+            cfg.overlay_opacity,
+            cfg.overlay_border_opacity,
+            cfg.overlay_border_radius,
+        )
+        return orig, proc
+
+    return jax.jit(jax.vmap(one))
+
+
+@dataclass
+class PatientResult:
+    patient_id: str
+    total: int
+    succeeded: int
+    failed_slices: List[str] = field(default_factory=list)
+
+
+@dataclass
+class RunSummary:
+    patients: List[PatientResult] = field(default_factory=list)
+    patients_ok: int = 0
+
+    @property
+    def total_slices(self) -> int:
+        return sum(p.total for p in self.patients)
+
+    @property
+    def succeeded_slices(self) -> int:
+        return sum(p.succeeded for p in self.patients)
+
+    def as_dict(self) -> dict:
+        return {
+            "patients_ok": self.patients_ok,
+            "patients_total": len(self.patients),
+            "slices_ok": self.succeeded_slices,
+            "slices_total": self.total_slices,
+            "per_patient": {
+                p.patient_id: {"ok": p.succeeded, "total": p.total}
+                for p in self.patients
+            },
+        }
+
+
+class CohortProcessor:
+    """Drives the full cohort with either execution strategy."""
+
+    def __init__(
+        self,
+        base_path,
+        out_root,
+        cfg: PipelineConfig = PipelineConfig(),
+        batch_cfg: BatchConfig = BatchConfig(),
+        mode: str = "sequential",
+        resume: bool = False,
+    ):
+        if mode not in ("sequential", "parallel"):
+            raise ValueError(f"unknown mode: {mode}")
+        self.base_path = Path(base_path)
+        self.out_root = Path(out_root)
+        self.cfg = cfg
+        self.batch_cfg = batch_cfg
+        self.mode = mode
+        self.resume = resume
+        self.timer = Timer()
+        self.out_root.mkdir(parents=True, exist_ok=True)
+        self.manifest = (
+            Manifest.load_or_create(self.out_root) if resume else Manifest(self.out_root)
+        )
+
+    # -- data loading ------------------------------------------------------
+
+    def _read_slice(self, path: Path) -> Optional[np.ndarray]:
+        """Decode + guard one slice; None signals failure (null-ptr analog)."""
+        try:
+            s = read_dicom(path)
+        except Exception as e:  # noqa: BLE001 - per-slice containment
+            log.warning("failed to read %s: %s", path.name, e)
+            return None
+        h, w = s.pixels.shape
+        if h < self.cfg.min_dim or w < self.cfg.min_dim:
+            # reference: "Image dimensions too small" (main_sequential.cpp:189-192)
+            log.warning("image dimensions too small: %dx%d (%s)", w, h, path.name)
+            return None
+        if h > self.cfg.canvas or w > self.cfg.canvas:
+            log.warning(
+                "slice %s (%dx%d) exceeds canvas %d; raise --canvas",
+                path.name, w, h, self.cfg.canvas,
+            )
+            return None
+        return s.pixels
+
+    # -- patient processing ------------------------------------------------
+
+    def process_patient(self, patient_id: str) -> PatientResult:
+        print(f"\n=== Processing Patient: {patient_id} ===\n")
+        out_dir = self.out_root / patient_id
+        if not self.resume:
+            clean_directory(out_dir)
+        files = load_dicom_files_for_patient(self.base_path, patient_id)
+        print(f"Found {len(files)} DICOM files for patient {patient_id}")
+
+        todo = []
+        already = 0
+        for f in files:
+            stem = f.stem
+            if self.resume and self.manifest.is_done(patient_id, stem):
+                already += 1
+            else:
+                todo.append(f)
+
+        if self.mode == "sequential":
+            ok, failed = self._run_sequential(patient_id, out_dir, todo)
+        else:
+            ok, failed = self._run_parallel(patient_id, out_dir, todo)
+
+        result = PatientResult(
+            patient_id=patient_id,
+            total=len(files),
+            succeeded=ok + already,
+            failed_slices=failed,
+        )
+        self.manifest.flush()
+        print(
+            f"\nPatient {patient_id} completed. Successfully processed "
+            f"{result.succeeded}/{result.total} images."
+        )
+        return result
+
+    def _run_sequential(
+        self, patient_id: str, out_dir: Path, files: List[Path]
+    ) -> Tuple[int, List[str]]:
+        fn = _compiled_slice_fn(self.cfg)
+        ok, failed = 0, []
+        for f in files:
+            stem = f.stem
+            try:
+                with self.timer.section("decode"):
+                    pixels = self._read_slice(f)
+                if pixels is None:
+                    raise ValueError("decode/guard failed")
+                padded, dims = self._pad_one(pixels)
+                with self.timer.section("compute"):
+                    orig, proc = fn(padded, dims)
+                    orig, proc = np.asarray(orig), np.asarray(proc)
+                with self.timer.section("export"):
+                    written = export_pairs(
+                        [(stem, orig, proc)], out_dir, max_workers=1
+                    )
+                if stem not in written:
+                    raise IOError("JPEG export failed")
+                self.manifest.record(patient_id, stem, STATUS_DONE)
+                ok += 1
+            except Exception as e:  # noqa: BLE001 - reference: don't throw here
+                log.warning("error processing file %s: %s", f.name, e)
+                self.manifest.record(patient_id, stem, STATUS_FAILED)
+                failed.append(stem)
+        return ok, failed
+
+    def _run_parallel(
+        self, patient_id: str, out_dir: Path, files: List[Path]
+    ) -> Tuple[int, List[str]]:
+        fn = _compiled_batch_fn(self.cfg)
+        bs = self.batch_cfg.batch_size
+        ok, failed = 0, []
+        batches = [files[i : i + bs] for i in range(0, len(files), bs)]
+        export_futures = []
+        expected_stems: List[str] = []
+        with cf.ThreadPoolExecutor(self.batch_cfg.io_workers) as io_pool:
+            # decode runs `prefetch_depth` batches ahead of device compute
+            depth = max(self.batch_cfg.prefetch_depth, 1)
+            decode_futures: Dict[int, list] = {}
+
+            def prefetch(idx: int):
+                if idx < len(batches) and idx not in decode_futures:
+                    decode_futures[idx] = [
+                        io_pool.submit(self._read_slice, f) for f in batches[idx]
+                    ]
+
+            for i in range(depth):
+                prefetch(i)
+
+            for bi, batch_files in enumerate(batches):
+                prefetch(bi + depth)
+                with self.timer.section("decode"):
+                    decoded = [f.result() for f in decode_futures.pop(bi)]
+                stems = [f.stem for f in batch_files]
+                good = [(s, p) for s, p in zip(stems, decoded) if p is not None]
+                for s, p in zip(stems, decoded):
+                    if p is None:
+                        failed.append(s)
+                        self.manifest.record(patient_id, s, STATUS_FAILED)
+                if not good:
+                    continue
+                with self.timer.section("compute"):
+                    padded, dims = self._pad_stack([p for _, p in good], pad_to=bs)
+                    orig_b, proc_b = fn(padded, dims)
+                    orig_b = np.asarray(orig_b)
+                    proc_b = np.asarray(proc_b)
+                items = [
+                    (s, orig_b[i], proc_b[i]) for i, (s, _) in enumerate(good)
+                ]
+                # hand encoding to the IO pool; overlap with next batch compute
+                export_futures.append(io_pool.submit(export_pairs, items, out_dir, 4))
+                expected_stems.extend(s for s, _ in good)
+            with self.timer.section("export"):
+                written = set()
+                for fut in export_futures:
+                    written.update(fut.result())
+        # success is "the JPEG pair exists", not "compute finished"
+        for s in expected_stems:
+            if s in written:
+                self.manifest.record(patient_id, s, STATUS_DONE)
+                ok += 1
+            else:
+                log.warning("export failed for slice %s", s)
+                self.manifest.record(patient_id, s, STATUS_FAILED)
+                failed.append(s)
+        return ok, failed
+
+    # -- padding helpers ---------------------------------------------------
+
+    def _pad_one(self, pixels: np.ndarray):
+        c = self.cfg.canvas
+        out = np.zeros((c, c), np.float32)
+        out[: pixels.shape[0], : pixels.shape[1]] = pixels
+        return out, np.asarray(pixels.shape, np.int32)
+
+    def _pad_stack(self, arrays: List[np.ndarray], pad_to: int):
+        """Stack to a FIXED batch size so one compiled program serves all
+        batches (ragged final batches are padded with blank slices whose
+        outputs are simply not exported)."""
+        c = self.cfg.canvas
+        out = np.zeros((pad_to, c, c), np.float32)
+        dims = np.full((pad_to, 2), self.cfg.min_dim, np.int32)
+        for i, a in enumerate(arrays):
+            out[i, : a.shape[0], : a.shape[1]] = a
+            dims[i] = a.shape
+        return out, dims
+
+    # -- cohort loop -------------------------------------------------------
+
+    def process_all_patients(self) -> RunSummary:
+        mode_name = self.mode.capitalize()
+        print(f"\n=== Starting {mode_name} Processing for All Patients ===\n")
+        patients = find_patient_dirs(self.base_path)
+        print(f"Found {len(patients)} patient directories.")
+        summary = RunSummary()
+        if not patients:
+            print("No patient directories found. Exiting.")
+            return summary
+        for pid in patients:
+            try:
+                result = self.process_patient(pid)
+                summary.patients.append(result)
+                summary.patients_ok += 1
+            except Exception as e:  # noqa: BLE001 - reference: move to next patient
+                log.warning("failed to process patient %s: %s", pid, e)
+                summary.patients.append(PatientResult(pid, 0, 0))
+        print("\n=== All Processing Completed ===\n")
+        print(
+            f"Successfully processed {summary.patients_ok}/{len(patients)} patients."
+        )
+        return summary

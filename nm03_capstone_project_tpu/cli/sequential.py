@@ -1,0 +1,77 @@
+"""Sequential batch driver.
+
+Entry point mirroring the reference's ``img_processing_sequential``
+(src/sequential/main_sequential.cpp:346-363): all patients, one slice at a
+time, per-slice JPEG pair export, catch-and-continue fault tolerance, success
+accounting — plus what the reference lacks: ``--device``, flags for every
+constant, ``--resume``, ``--synthetic`` cohorts, and an in-tree results JSON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from nm03_capstone_project_tpu.cli import common
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nm03-sequential", description=__doc__.strip().splitlines()[0]
+    )
+    p.add_argument("--output", default="out-sequential", help="output root directory")
+    common.add_common_args(p)
+    common.add_pipeline_args(p)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    common.apply_device_env(args.device)
+    return run(args, mode="sequential")
+
+
+def run(args: argparse.Namespace, mode: str) -> int:
+    # jax-importing modules stay inside run() so --device can pin the backend
+    from pathlib import Path
+
+    from nm03_capstone_project_tpu.cli.runner import CohortProcessor
+    from nm03_capstone_project_tpu.config import BatchConfig
+    from nm03_capstone_project_tpu.utils.reporter import configure_reporting
+    from nm03_capstone_project_tpu.utils.timing import write_results_json
+
+    configure_reporting(verbose=args.verbose)
+    cfg = common.pipeline_config_from_args(args)
+    batch_cfg = BatchConfig(
+        batch_size=getattr(args, "batch_size", BatchConfig.batch_size),
+        io_workers=getattr(args, "io_workers", BatchConfig.io_workers),
+        prefetch_depth=getattr(args, "prefetch_depth", BatchConfig.prefetch_depth),
+    )
+    try:
+        base = common.resolve_base_path(args, tmp_root=Path(args.output))
+        proc = CohortProcessor(
+            base,
+            args.output,
+            cfg=cfg,
+            batch_cfg=batch_cfg,
+            mode=mode,
+            resume=args.resume,
+        )
+        summary = proc.process_all_patients()
+        if args.results_json:
+            write_results_json(
+                args.results_json,
+                {
+                    "mode": mode,
+                    "summary": summary.as_dict(),
+                    "timing_s": proc.timer.report(),
+                },
+            )
+        return 0
+    except Exception as e:  # noqa: BLE001 - reference: fatal-error catch in main
+        print(f"Fatal error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

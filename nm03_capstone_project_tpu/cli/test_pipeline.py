@@ -1,0 +1,99 @@
+"""Single-slice visual test driver.
+
+Entry point mirroring the reference's ``test_pipeline``
+(src/test/test_pipeline.cpp:29-182): one 2D slice through every stage, each
+intermediate exported as a JPEG to ``out-test/`` (the reference's
+golden-eyeball testing surface). The reference hard-codes one PGBM-017 slice
+and blocks on a 5-pane Qt window; here the input is a flag (``--input``,
+or a generated phantom by default), the "window" is the set of exported stage
+images (original, preprocessed, segmentation, erosion, dilation — the same 5
+panes, test_pipeline.cpp:148-158), and nothing blocks, so it runs headless.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from nm03_capstone_project_tpu.cli import common
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="nm03-test-pipeline", description=__doc__.strip().splitlines()[0])
+    p.add_argument("--input", default=None, help=".dcm slice to process (default: synthetic phantom)")
+    p.add_argument("--output", default="out-test", help="stage-image output directory")
+    p.add_argument(
+        "--device", choices=["auto", "tpu", "cpu"], default="auto", help="compute backend"
+    )
+    p.add_argument("--verbose", action="store_true")
+    common.add_pipeline_args(p)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    common.apply_device_env(args.device)
+    try:
+        return run(args)
+    except Exception as e:  # noqa: BLE001
+        print(f"Fatal error: {e}", file=sys.stderr)
+        return 1
+
+
+def run(args: argparse.Namespace) -> int:
+    import numpy as np
+
+    from nm03_capstone_project_tpu.data.synthetic import phantom_slice
+    from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice_stages
+    from nm03_capstone_project_tpu.render.export import clean_directory, save_jpeg
+    from nm03_capstone_project_tpu.render.render import (
+        render_gray,
+        render_segmentation,
+    )
+    from nm03_capstone_project_tpu.utils.reporter import configure_reporting
+
+    configure_reporting(verbose=args.verbose)
+    cfg = common.pipeline_config_from_args(args)
+
+    if args.input:
+        from nm03_capstone_project_tpu.data.dicomlite import read_dicom
+
+        pixels = read_dicom(args.input).pixels
+    else:
+        pixels = phantom_slice(256, 256, seed=17)
+
+    h, w = pixels.shape
+    if h > cfg.canvas or w > cfg.canvas:
+        raise ValueError(f"slice {w}x{h} exceeds canvas {cfg.canvas}; raise --canvas")
+    padded = np.zeros((cfg.canvas, cfg.canvas), np.float32)
+    padded[:h, :w] = pixels
+    dims = np.asarray([h, w], np.int32)
+
+    stages = process_slice_stages(padded, dims, cfg)
+
+    # the reference clean-recreates out-test (test_pipeline.cpp:13-14)
+    clean_directory(args.output)
+
+    def seg_render(m):
+        return render_segmentation(
+            m, dims, cfg.render_size, cfg.overlay_opacity,
+            cfg.overlay_border_opacity, cfg.overlay_border_radius,
+        )
+
+    exports = {
+        "original_image": render_gray(stages["original_image"], dims, cfg.render_size),
+        "preprocessed_image": render_gray(
+            stages["preprocessed_image"], dims, cfg.render_size
+        ),
+        "segmentation": seg_render(stages["segmentation"]),
+        "erosion_result": seg_render(stages["erosion_result"]),
+        "final_dilated_result": seg_render(stages["final_dilated_result"]),
+    }
+    for name, img in exports.items():
+        save_jpeg(np.asarray(img), f"{args.output}/{name}.jpg")
+        print(f"exported {args.output}/{name}.jpg")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

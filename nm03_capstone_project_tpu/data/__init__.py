@@ -1,0 +1,19 @@
+"""Data layer: discovery, DICOM-lite IO, synthetic cohorts, prefetch."""
+
+from nm03_capstone_project_tpu.data.dicomlite import (  # noqa: F401
+    DicomParseError,
+    DicomSlice,
+    read_dicom,
+    write_dicom,
+)
+from nm03_capstone_project_tpu.data.discovery import (  # noqa: F401
+    extract_file_number,
+    find_patient_dirs,
+    load_dicom_files_for_patient,
+)
+from nm03_capstone_project_tpu.data.synthetic import (  # noqa: F401
+    phantom_series,
+    phantom_slice,
+    phantom_volume,
+    write_synthetic_cohort,
+)

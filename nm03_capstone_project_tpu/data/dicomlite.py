@@ -1,0 +1,326 @@
+"""Minimal DICOM reader/writer (no external DICOM dependency).
+
+TPU-native replacement for the import side of FAST's ``DICOMFileImporter``
+(reference src/test/test_pipeline.cpp:33-42 — note ``setLoadSeries(false)``:
+one 2D slice per file, never a 3D volume). The reference delegates parsing to
+FAST/DCMTK; this framework ships its own single-file implementation of the
+subset the pipeline needs:
+
+* Part-10 files (128-byte preamble + ``DICM``) and bare data sets.
+* Explicit and implicit VR little endian transfer syntaxes
+  (1.2.840.10008.1.2.1 / 1.2.840.10008.1.2), uncompressed pixel data.
+* Monochrome 8/16-bit pixel data, signed or unsigned, with
+  RescaleSlope/Intercept applied — yielding float32 intensities.
+* Sequence (SQ) elements are skipped structurally (defined and undefined
+  length), so real-world headers parse even though their content is unused.
+
+The writer emits valid explicit-VR-LE Part-10 files and exists so tests and
+the ``--synthetic`` CLI mode can materialize cohorts that round-trip through
+the same reader the real data would use. A native C++ parser
+(csrc/dicomlite.cpp) mirrors this logic for the threaded prefetch loader.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import struct
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+EXPLICIT_VR_LE = "1.2.840.10008.1.2.1"
+IMPLICIT_VR_LE = "1.2.840.10008.1.2"
+
+# VRs whose explicit encoding uses a 2-byte reserved field + 4-byte length
+_LONG_VRS = {b"OB", b"OW", b"OF", b"OD", b"OL", b"SQ", b"UC", b"UR", b"UT", b"UN"}
+
+_ITEM = (0xFFFE, 0xE000)
+_ITEM_DELIM = (0xFFFE, 0xE00D)
+_SEQ_DELIM = (0xFFFE, 0xE0DD)
+
+
+class DicomParseError(ValueError):
+    """Raised when a file is not parseable as DICOM."""
+
+
+@dataclasses.dataclass
+class DicomSlice:
+    """One decoded 2D slice."""
+
+    pixels: np.ndarray  # float32 (rows, cols), rescale applied
+    rows: int
+    cols: int
+    raw_dtype: np.dtype
+    rescale_slope: float
+    rescale_intercept: float
+    meta: Dict[Tuple[int, int], bytes]
+
+    def meta_str(self, tag: Tuple[int, int]) -> Optional[str]:
+        v = self.meta.get(tag)
+        return v.decode("ascii", "replace").strip("\x00 ") if v is not None else None
+
+
+class _Reader:
+    def __init__(self, buf: bytes, explicit: bool):
+        self.buf = buf
+        self.pos = 0
+        self.explicit = explicit
+
+    def u16(self) -> int:
+        v = struct.unpack_from("<H", self.buf, self.pos)[0]
+        self.pos += 2
+        return v
+
+    def u32(self) -> int:
+        v = struct.unpack_from("<I", self.buf, self.pos)[0]
+        self.pos += 4
+        return v
+
+    def atend(self) -> bool:
+        return self.pos + 8 > len(self.buf)
+
+    def element(self):
+        """Decode one data element header; returns (group, elem, vr, length)."""
+        group = self.u16()
+        elem = self.u16()
+        if (group, elem) in (_ITEM, _ITEM_DELIM, _SEQ_DELIM):
+            return group, elem, b"", self.u32()
+        if self.explicit and group != 0xFFFE:
+            vr = self.buf[self.pos : self.pos + 2]
+            self.pos += 2
+            if vr in _LONG_VRS:
+                self.pos += 2  # reserved
+                length = self.u32()
+            else:
+                length = self.u16()
+        else:
+            vr = b""
+            length = self.u32()
+        return group, elem, vr, length
+
+    def skip_sequence(self):
+        """Skip an undefined-length sequence body (until sequence delimiter)."""
+        while not self.atend():
+            group, elem, _, length = self.element()
+            if (group, elem) == _SEQ_DELIM:
+                return
+            if (group, elem) == _ITEM:
+                if length == 0xFFFFFFFF:
+                    self._skip_item_undefined()
+                else:
+                    self.pos += length
+            else:  # malformed; bail out of the sequence
+                self.pos += 0 if length == 0xFFFFFFFF else length
+                return
+
+    def _skip_item_undefined(self):
+        """Skip an undefined-length item (may contain nested sequences)."""
+        while not self.atend():
+            group, elem, _vr, length = self.element()
+            if (group, elem) == _ITEM_DELIM:
+                return
+            if length == 0xFFFFFFFF:
+                self.skip_sequence()  # nested undefined-length sequence
+            else:
+                self.pos += length
+
+
+def _parse_dataset(
+    buf: bytes, explicit: bool, want_pixels: bool
+) -> Tuple[Dict[Tuple[int, int], bytes], Optional[bytes]]:
+    r = _Reader(buf, explicit)
+    meta: Dict[Tuple[int, int], bytes] = {}
+    pixel_data: Optional[bytes] = None
+    while not r.atend():
+        group, elem, vr, length = r.element()
+        if (group, elem) == (0x7FE0, 0x0010):
+            if length == 0xFFFFFFFF:
+                raise DicomParseError(
+                    "encapsulated (compressed) PixelData is not supported"
+                )
+            pixel_data = r.buf[r.pos : r.pos + length] if want_pixels else None
+            r.pos += length
+            continue
+        if length == 0xFFFFFFFF:
+            r.skip_sequence()
+            continue
+        if vr == b"SQ":
+            r.pos += length
+            continue
+        if group == 0xFFFE:
+            r.pos += length
+            continue
+        if length > len(r.buf) - r.pos:
+            raise DicomParseError(
+                f"element ({group:04x},{elem:04x}) length {length} overruns file"
+            )
+        meta[(group, elem)] = r.buf[r.pos : r.pos + length]
+        r.pos += length
+    return meta, pixel_data
+
+
+def _meta_int(meta, tag, default=None) -> Optional[int]:
+    v = meta.get(tag)
+    if v is None:
+        return default
+    if len(v) == 2:
+        return struct.unpack("<H", v)[0]
+    if len(v) == 4:
+        return struct.unpack("<I", v)[0]
+    try:
+        return int(v.decode("ascii").strip("\x00 "))
+    except (UnicodeDecodeError, ValueError):
+        return default
+
+
+def _meta_float(meta, tag, default: float) -> float:
+    v = meta.get(tag)
+    if v is None:
+        return default
+    try:
+        return float(v.decode("ascii").strip("\x00 "))
+    except (UnicodeDecodeError, ValueError):
+        return default
+
+
+def read_dicom(path: str | os.PathLike) -> DicomSlice:
+    """Read one 2D DICOM slice, returning float32 rescaled intensities.
+
+    Mirrors the reference importer's contract: exactly one 2D image per file
+    (DICOMFileImporter with setLoadSeries(false), test_pipeline.cpp:38-41).
+    """
+    with open(path, "rb") as f:
+        raw = f.read()
+
+    # Part-10 preamble, or a bare dataset
+    body = raw
+    transfer_syntax = EXPLICIT_VR_LE
+    if len(raw) >= 132 and raw[128:132] == b"DICM":
+        # file meta group is always explicit VR LE
+        r = _Reader(raw, explicit=True)
+        r.pos = 132
+        meta_end = len(raw)
+        first = True
+        while r.pos < meta_end and not r.atend():
+            mark = r.pos
+            group, elem, vr, length = r.element()
+            if group != 0x0002:
+                r.pos = mark
+                break
+            value = r.buf[r.pos : r.pos + length]
+            r.pos += length
+            if first and (group, elem) == (0x0002, 0x0000) and len(value) == 4:
+                meta_end = r.pos + struct.unpack("<I", value)[0]
+            if (group, elem) == (0x0002, 0x0010):
+                transfer_syntax = value.decode("ascii").strip("\x00 ")
+            first = False
+        body = raw[r.pos :]
+    elif raw[:4] == b"DICM":
+        body = raw[4:]
+    if transfer_syntax not in (EXPLICIT_VR_LE, IMPLICIT_VR_LE):
+        raise DicomParseError(f"unsupported transfer syntax: {transfer_syntax}")
+
+    explicit = transfer_syntax == EXPLICIT_VR_LE
+    try:
+        meta, pixel_data = _parse_dataset(body, explicit, want_pixels=True)
+    except struct.error as e:
+        raise DicomParseError(f"truncated DICOM element structure: {e}") from e
+
+    rows = _meta_int(meta, (0x0028, 0x0010))
+    cols = _meta_int(meta, (0x0028, 0x0011))
+    if rows is None or cols is None or pixel_data is None:
+        raise DicomParseError("missing Rows/Columns/PixelData")
+    bits = _meta_int(meta, (0x0028, 0x0100), 16)
+    signed = _meta_int(meta, (0x0028, 0x0103), 0) == 1
+    samples = _meta_int(meta, (0x0028, 0x0002), 1)
+    if samples != 1:
+        raise DicomParseError(f"only monochrome supported, SamplesPerPixel={samples}")
+    if bits == 16:
+        dtype = np.dtype("<i2") if signed else np.dtype("<u2")
+    elif bits == 8:
+        dtype = np.dtype("i1") if signed else np.dtype("u1")
+    else:
+        raise DicomParseError(f"unsupported BitsAllocated={bits}")
+
+    expected = rows * cols * dtype.itemsize
+    if len(pixel_data) < expected:
+        raise DicomParseError(
+            f"PixelData has {len(pixel_data)} bytes, expected {expected}"
+        )
+    pixels = np.frombuffer(pixel_data[:expected], dtype=dtype).reshape(rows, cols)
+
+    slope = _meta_float(meta, (0x0028, 0x1053), 1.0)
+    intercept = _meta_float(meta, (0x0028, 0x1052), 0.0)
+    out = pixels.astype(np.float32) * np.float32(slope) + np.float32(intercept)
+    return DicomSlice(
+        pixels=out,
+        rows=rows,
+        cols=cols,
+        raw_dtype=dtype,
+        rescale_slope=slope,
+        rescale_intercept=intercept,
+        meta=meta,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Writer (explicit VR little endian)
+# ---------------------------------------------------------------------------
+
+
+def _element(group: int, elem: int, vr: bytes, value: bytes) -> bytes:
+    if len(value) % 2 == 1:
+        value += b" " if vr in (b"UI", b"DS", b"IS", b"CS", b"LO", b"PN", b"SH") else b"\x00"
+    head = struct.pack("<HH", group, elem) + vr
+    if vr in _LONG_VRS:
+        return head + b"\x00\x00" + struct.pack("<I", len(value)) + value
+    return head + struct.pack("<H", len(value)) + value
+
+
+def write_dicom(
+    path: str | os.PathLike,
+    pixels: np.ndarray,
+    *,
+    patient_id: str = "ANON",
+    series_uid: str = "1.2.826.0.1.3680043.9999.1",
+    instance_number: int = 1,
+    rescale_slope: float = 1.0,
+    rescale_intercept: float = 0.0,
+) -> None:
+    """Write a monochrome uint16 slice as an explicit-VR-LE Part-10 file."""
+    if pixels.ndim != 2:
+        raise ValueError(f"expected 2D pixels, got {pixels.shape}")
+    data = np.ascontiguousarray(pixels.astype("<u2"))
+    rows, cols = data.shape
+
+    sop_uid = f"{series_uid}.{instance_number}"
+    meta_elems = _element(0x0002, 0x0010, b"UI", EXPLICIT_VR_LE.encode())
+    meta_group = (
+        _element(0x0002, 0x0000, b"UL", struct.pack("<I", len(meta_elems)))
+        + meta_elems
+    )
+
+    ds = b"".join(
+        [
+            _element(0x0008, 0x0016, b"UI", b"1.2.840.10008.5.1.4.1.1.4"),  # MR
+            _element(0x0008, 0x0018, b"UI", sop_uid.encode()),
+            _element(0x0010, 0x0020, b"LO", patient_id.encode()),
+            _element(0x0020, 0x000E, b"UI", series_uid.encode()),
+            _element(0x0020, 0x0013, b"IS", str(instance_number).encode()),
+            _element(0x0028, 0x0002, b"US", struct.pack("<H", 1)),
+            _element(0x0028, 0x0004, b"CS", b"MONOCHROME2"),
+            _element(0x0028, 0x0010, b"US", struct.pack("<H", rows)),
+            _element(0x0028, 0x0011, b"US", struct.pack("<H", cols)),
+            _element(0x0028, 0x0100, b"US", struct.pack("<H", 16)),
+            _element(0x0028, 0x0101, b"US", struct.pack("<H", 16)),
+            _element(0x0028, 0x0102, b"US", struct.pack("<H", 15)),
+            _element(0x0028, 0x0103, b"US", struct.pack("<H", 0)),
+            _element(0x0028, 0x1052, b"DS", f"{rescale_intercept:g}".encode()),
+            _element(0x0028, 0x1053, b"DS", f"{rescale_slope:g}".encode()),
+            _element(0x7FE0, 0x0010, b"OW", data.tobytes()),
+        ]
+    )
+
+    with open(path, "wb") as f:
+        f.write(b"\x00" * 128 + b"DICM" + meta_group + ds)

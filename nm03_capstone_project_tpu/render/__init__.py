@@ -1,0 +1,12 @@
+"""Render + export: the TPU-native visualization stack."""
+
+from nm03_capstone_project_tpu.render.export import (  # noqa: F401
+    clean_directory,
+    export_pairs,
+    save_jpeg,
+)
+from nm03_capstone_project_tpu.render.render import (  # noqa: F401
+    render_gray,
+    render_overlay,
+    render_segmentation,
+)

@@ -1,0 +1,81 @@
+"""Host-side JPEG export.
+
+TPU-native equivalent of FAST ``ImageFileExporter`` (reference
+main_sequential.cpp:61-73: two JPEGs per slice, ``<stem>_original.jpg`` and
+``<stem>_processed.jpg``). Where the reference must serialize its whole
+render+encode path through one shared Qt ``RenderToImage`` (the per-batch
+barrier at main_parallel.cpp:172-216), here rendering happened on device and
+only JPEG encoding runs on the host — embarrassingly parallel across a small
+thread pool that overlaps with the next batch's device compute.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import os
+from pathlib import Path
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from nm03_capstone_project_tpu.utils.reporter import get_logger
+
+_log = get_logger("export")
+
+
+def save_jpeg(image: np.ndarray, path: str | os.PathLike, quality: int = 90) -> None:
+    """Write a uint8 grayscale (H, W) array as JPEG."""
+    from PIL import Image
+
+    arr = np.asarray(image)
+    if arr.dtype != np.uint8:
+        raise ValueError(f"expected uint8 image, got {arr.dtype}")
+    Path(path).parent.mkdir(parents=True, exist_ok=True)
+    Image.fromarray(arr, mode="L").save(path, quality=quality)
+
+
+def export_pairs(
+    items: Sequence[Tuple[str, np.ndarray, np.ndarray]],
+    out_dir: str | os.PathLike,
+    max_workers: int = 8,
+) -> List[str]:
+    """Write (stem, original, processed) triples as JPEG pairs concurrently.
+
+    Returns the stems successfully written; encoding failures are contained
+    per slice (the reference's catch-and-continue at the export stage,
+    main_sequential.cpp:267-271).
+    """
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    done: List[str] = []
+
+    def write_one(stem: str, orig: np.ndarray, proc: np.ndarray) -> Optional[str]:
+        save_jpeg(orig, out / f"{stem}_original.jpg")
+        save_jpeg(proc, out / f"{stem}_processed.jpg")
+        return stem
+
+    with cf.ThreadPoolExecutor(max_workers=max_workers) as pool:
+        futures = {
+            pool.submit(write_one, stem, o, p): stem for stem, o, p in items
+        }
+        for fut in cf.as_completed(futures):
+            try:
+                done.append(fut.result())
+            except Exception as e:  # noqa: BLE001 - per-slice containment
+                _log.warning("export failed for %s: %s", futures[fut], e)
+    return sorted(done)
+
+
+def clean_directory(path: str | os.PathLike) -> None:
+    """Recreate a directory empty.
+
+    The reference does ``mkdir -p X && cd X && rm -rf *`` via system()
+    (main_sequential.cpp:32-47); this is the same destructive clean-recreate
+    without a shell.
+    """
+    import shutil
+
+    p = Path(path)
+    if p.exists():
+        shutil.rmtree(p)
+    p.mkdir(parents=True, exist_ok=True)

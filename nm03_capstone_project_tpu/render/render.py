@@ -1,0 +1,165 @@
+"""On-device rendering: letterboxed grayscale + segmentation overlay.
+
+TPU-native equivalent of the reference's export-side render stack
+(SURVEY.md section 2.2): ``RenderToImage::create(Color::Black(), 512, 512)``
+(test_pipeline.cpp:164, main_sequential.cpp:258) with an ``ImageRenderer``
+for the original and a ``SegmentationRenderer`` (label 1 = white, fill
+opacity 0.6, border opacity 1.0, border radius 2; test_pipeline.cpp:136-146)
+for the mask.
+
+Rendering is pure array math, so it runs *on device, batched, inside the same
+jit* as the pipeline — where the reference must serialize exports through one
+shared Qt/OpenGL ``RenderToImage`` (the thread-safety barrier at
+main_parallel.cpp:336-346), here the whole batch renders in parallel and only
+finished uint8 canvases cross back to the host for JPEG encoding.
+
+Geometry: the slice is scaled (bilinear for grayscale, nearest for masks) by
+``min(out/h, out/w)`` and centered on a black canvas — aspect-preserving
+letterboxing of arbitrary (traced) slice dims onto the static output size.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from nm03_capstone_project_tpu.core.image import valid_mask
+from nm03_capstone_project_tpu.ops.morphology import erode
+
+
+def _letterbox_coords(dims: jax.Array, out_size: int):
+    """Source sampling coords for each output pixel, plus the in-bounds mask.
+
+    Returns (src_y, src_x, inside) each shaped (out, out), as float32 source
+    coordinates; `inside` marks output pixels that fall inside the scaled
+    slice. Works with traced dims: the scale is computed at run time, the
+    shapes are static.
+    """
+    h = dims[..., 0].astype(jnp.float32)
+    w = dims[..., 1].astype(jnp.float32)
+    scale = jnp.minimum(out_size / h, out_size / w)
+    dest_h = h * scale
+    dest_w = w * scale
+    off_y = (out_size - dest_h) / 2.0
+    off_x = (out_size - dest_w) / 2.0
+    oy = jax.lax.broadcasted_iota(jnp.float32, (out_size, out_size), 0)
+    ox = jax.lax.broadcasted_iota(jnp.float32, (out_size, out_size), 1)
+    src_y = (oy - off_y + 0.5) / scale - 0.5
+    src_x = (ox - off_x + 0.5) / scale - 0.5
+    inside = (
+        (oy >= jnp.floor(off_y))
+        & (oy < jnp.ceil(off_y + dest_h))
+        & (ox >= jnp.floor(off_x))
+        & (ox < jnp.ceil(off_x + dest_w))
+    )
+    return src_y, src_x, inside
+
+
+def _sample_bilinear(img: jax.Array, src_y, src_x, dims) -> jax.Array:
+    h = dims[..., 0]
+    w = dims[..., 1]
+    y0 = jnp.clip(jnp.floor(src_y).astype(jnp.int32), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(src_x).astype(jnp.int32), 0, w - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    fy = jnp.clip(src_y - y0.astype(jnp.float32), 0.0, 1.0)
+    fx = jnp.clip(src_x - x0.astype(jnp.float32), 0.0, 1.0)
+
+    def at(yy, xx):
+        return img[yy, xx]
+
+    v00 = at(y0, x0)
+    v01 = at(y0, x1)
+    v10 = at(y1, x0)
+    v11 = at(y1, x1)
+    top = v00 * (1 - fx) + v01 * fx
+    bot = v10 * (1 - fx) + v11 * fx
+    return top * (1 - fy) + bot * fy
+
+
+def _sample_nearest(img: jax.Array, src_y, src_x, dims) -> jax.Array:
+    h = dims[..., 0]
+    w = dims[..., 1]
+    yy = jnp.clip(jnp.round(src_y).astype(jnp.int32), 0, h - 1)
+    xx = jnp.clip(jnp.round(src_x).astype(jnp.int32), 0, w - 1)
+    return img[yy, xx]
+
+
+def render_gray(
+    pixels: jax.Array, dims: jax.Array, out_size: int = 512
+) -> jax.Array:
+    """Letterboxed window-normalized grayscale render -> uint8 (out, out).
+
+    Equivalent of ImageRenderer feeding RenderToImage: intensities are
+    windowed to the slice's own [min, max] over its true extent (FAST's
+    renderer auto-windows from the image's intensity range), scaled to 0..255
+    on a black canvas.
+    """
+    canvas_hw: Tuple[int, int] = (pixels.shape[-2], pixels.shape[-1])
+    vmask = valid_mask(dims, canvas_hw)
+    big = jnp.float32(3.4e38)
+    vmin = jnp.min(jnp.where(vmask, pixels, big))
+    vmax = jnp.max(jnp.where(vmask, pixels, -big))
+    rng = jnp.maximum(vmax - vmin, 1e-6)
+    src_y, src_x, inside = _letterbox_coords(dims, out_size)
+    sampled = _sample_bilinear(pixels, src_y, src_x, dims)
+    gray = (sampled - vmin) / rng * 255.0
+    gray = jnp.where(inside, gray, 0.0)
+    return jnp.clip(gray, 0, 255).astype(jnp.uint8)
+
+
+def render_segmentation(
+    mask: jax.Array,
+    dims: jax.Array,
+    out_size: int = 512,
+    opacity: float = 0.6,
+    border_opacity: float = 1.0,
+    border_radius: int = 2,
+) -> jax.Array:
+    """Letterboxed white-on-black label render -> uint8 (out, out).
+
+    Equivalent of SegmentationRenderer::create({1: White}, 0.6, 1.0, 2)
+    rendered alone into RenderToImage (the reference's batch drivers connect
+    only the segmentation renderer for the ``_processed`` export,
+    main_sequential.cpp:66-73): label pixels composite white over black at
+    ``opacity``; a border band of ``border_radius`` pixels (in render space)
+    at the region boundary composites at ``border_opacity``.
+    """
+    alpha = _mask_alpha(mask, dims, out_size, opacity, border_opacity, border_radius)
+    return jnp.clip(alpha * 255.0, 0, 255).astype(jnp.uint8)
+
+
+def _mask_alpha(
+    mask, dims, out_size, opacity, border_opacity, border_radius
+) -> jax.Array:
+    """Per-pixel overlay alpha in render space: fill opacity inside the
+    label, border opacity on the `border_radius`-pixel boundary band."""
+    src_y, src_x, inside = _letterbox_coords(dims, out_size)
+    m = _sample_nearest((mask > 0).astype(jnp.uint8), src_y, src_x, dims)
+    m = (m > 0) & inside
+    interior = erode(m, 2 * border_radius + 1, "disk")
+    border = m & ~interior
+    return jnp.where(border, border_opacity, jnp.where(m, opacity, 0.0))
+
+
+def render_overlay(
+    pixels: jax.Array,
+    mask: jax.Array,
+    dims: jax.Array,
+    out_size: int = 512,
+    opacity: float = 0.6,
+    border_opacity: float = 1.0,
+    border_radius: int = 2,
+) -> jax.Array:
+    """Grayscale render with the white label composited on top -> uint8.
+
+    The reference's test window stacks ImageRenderer + SegmentationRenderer
+    in one view; this produces that composite for anyone who wants the mask
+    in anatomical context (not part of the batch export contract).
+    """
+    gray = render_gray(pixels, dims, out_size).astype(jnp.float32)
+    alpha = _mask_alpha(mask, dims, out_size, opacity, border_opacity, border_radius)
+    out = gray * (1.0 - alpha) + 255.0 * alpha
+    return jnp.clip(out, 0, 255).astype(jnp.uint8)

@@ -1,0 +1,12 @@
+"""Aux subsystems: reporting, timing, manifest/resume, profiling."""
+
+from nm03_capstone_project_tpu.utils.manifest import Manifest  # noqa: F401
+from nm03_capstone_project_tpu.utils.reporter import (  # noqa: F401
+    configure_reporting,
+    get_logger,
+)
+from nm03_capstone_project_tpu.utils.timing import (  # noqa: F401
+    Timer,
+    timeit_sync,
+    write_results_json,
+)

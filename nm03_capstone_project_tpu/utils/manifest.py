@@ -1,0 +1,65 @@
+"""Output manifest + resume.
+
+The reference has no checkpoint/resume: every rerun wipes each patient's
+output directory (``rm -rf *`` in setupOutputDirectory,
+main_sequential.cpp:35-37) and recomputes everything. SURVEY.md section 5
+calls for a resumable manifest; this is it: a JSON file per output root
+recording per-patient, per-slice status, written atomically after every
+patient so an interrupted run restarts where it stopped (``--resume``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Dict
+
+MANIFEST_NAME = "manifest.json"
+
+STATUS_DONE = "done"
+STATUS_FAILED = "failed"
+
+
+class Manifest:
+    """Per-run record: {patient_id: {slice_stem: status}}."""
+
+    def __init__(self, out_root: str | os.PathLike):
+        self.path = Path(out_root) / MANIFEST_NAME
+        self.data: Dict[str, Dict[str, str]] = {}
+
+    @classmethod
+    def load_or_create(cls, out_root: str | os.PathLike) -> "Manifest":
+        m = cls(out_root)
+        if m.path.exists():
+            try:
+                m.data = json.loads(m.path.read_text())
+            except (json.JSONDecodeError, OSError):
+                m.data = {}
+        return m
+
+    def record(self, patient_id: str, stem: str, status: str) -> None:
+        self.data.setdefault(patient_id, {})[stem] = status
+
+    def is_done(self, patient_id: str, stem: str) -> bool:
+        return self.data.get(patient_id, {}).get(stem) == STATUS_DONE
+
+    def patient_done(self, patient_id: str, stems) -> bool:
+        done = self.data.get(patient_id, {})
+        return all(done.get(s) == STATUS_DONE for s in stems) and bool(stems)
+
+    def flush(self) -> None:
+        """Atomic write (tmp + rename) so a crash never corrupts the manifest."""
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = self.path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(self.data, indent=1, sort_keys=True))
+        os.replace(tmp, self.path)
+
+    def summary(self) -> Dict[str, int]:
+        done = sum(
+            1 for p in self.data.values() for s in p.values() if s == STATUS_DONE
+        )
+        failed = sum(
+            1 for p in self.data.values() for s in p.values() if s == STATUS_FAILED
+        )
+        return {"patients": len(self.data), "done": done, "failed": failed}

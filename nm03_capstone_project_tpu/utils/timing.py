@@ -1,0 +1,74 @@
+"""In-tree timing harness + results JSON.
+
+The reference benchmarks *outside* the repo (hyperfine / ``time``,
+README.md:90-96) and gitignores the results
+(parallel_results.json/sequential_results.json, .gitignore:46-47). Per
+SURVEY.md section 5, this framework keeps the harness in-tree: wall-clock
+sections with device synchronization (``block_until_ready``), per-stage
+accumulation, and a writer for the results JSON the reference kept
+out-of-tree.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List
+
+
+def sync(tree) -> None:
+    """Block until every array in the pytree is computed (honest timing)."""
+    import jax
+
+    jax.block_until_ready(tree)
+
+
+@dataclass
+class Timer:
+    """Named wall-clock sections; re-entrant accumulation."""
+
+    sections: Dict[str, float] = field(default_factory=dict)
+    counts: Dict[str, int] = field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def section(self, name: str, tree=None):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if tree is not None:
+                sync(tree)
+            dt = time.perf_counter() - t0
+            self.sections[name] = self.sections.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def report(self) -> Dict[str, float]:
+        return dict(sorted(self.sections.items()))
+
+
+def timeit_sync(fn, *args, warmup: int = 1, iters: int = 5) -> Dict[str, float]:
+    """Median/mean wall-clock of fn(*args) with device sync each call."""
+    for _ in range(warmup):
+        sync(fn(*args))
+    times: List[float] = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        sync(fn(*args))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return {
+        "median_s": times[len(times) // 2],
+        "mean_s": sum(times) / len(times),
+        "min_s": times[0],
+        "iters": iters,
+    }
+
+
+def write_results_json(path: str, payload: dict) -> None:
+    """The in-tree replacement for the reference's out-of-tree results files."""
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps(payload, indent=1, sort_keys=True) + "\n")

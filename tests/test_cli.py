@@ -1,0 +1,161 @@
+"""End-to-end CLI/runner tests on a tiny synthetic cohort.
+
+Formalizes the reference's manual testing (SURVEY.md section 4): the
+parallel==sequential output invariant, per-slice fault containment with
+success counting, and the resume manifest this framework adds.
+"""
+
+import hashlib
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from nm03_capstone_project_tpu.cli.runner import CohortProcessor
+from nm03_capstone_project_tpu.config import BatchConfig, PipelineConfig
+from nm03_capstone_project_tpu.data.synthetic import write_synthetic_cohort
+
+CFG = PipelineConfig(canvas=128, render_size=128)
+BCFG = BatchConfig(batch_size=3, io_workers=2)
+
+
+@pytest.fixture(scope="module")
+def cohort(tmp_path_factory):
+    root = tmp_path_factory.mktemp("cohort")
+    write_synthetic_cohort(root, n_patients=2, n_slices=4, height=128, width=120)
+    return root
+
+
+def digest_tree(root) -> str:
+    h = hashlib.sha256()
+    for p in sorted(Path(root).rglob("*.jpg")):
+        h.update(p.name.encode())
+        h.update(p.read_bytes())
+    return h.hexdigest()
+
+
+def test_sequential_run(cohort, tmp_path):
+    proc = CohortProcessor(cohort, tmp_path / "seq", cfg=CFG, mode="sequential")
+    summary = proc.process_all_patients()
+    assert summary.patients_ok == 2
+    assert summary.succeeded_slices == 8
+    jpgs = list((tmp_path / "seq").rglob("*.jpg"))
+    assert len(jpgs) == 16  # 2 per slice
+    assert (tmp_path / "seq" / "manifest.json").exists()
+
+
+def test_parallel_equals_sequential(cohort, tmp_path):
+    seq = CohortProcessor(cohort, tmp_path / "seq", cfg=CFG, mode="sequential")
+    seq.process_all_patients()
+    par = CohortProcessor(
+        cohort, tmp_path / "par", cfg=CFG, batch_cfg=BCFG, mode="parallel"
+    )
+    par_summary = par.process_all_patients()
+    assert par_summary.succeeded_slices == 8
+    assert digest_tree(tmp_path / "seq") == digest_tree(tmp_path / "par")
+
+
+def test_corrupt_slice_contained(cohort, tmp_path):
+    """A corrupt .dcm is skipped and counted; the run continues (reference
+    catch-and-continue, main_sequential.cpp:267-271)."""
+    bad_root = tmp_path / "cohort2"
+    write_synthetic_cohort(bad_root, n_patients=1, n_slices=3, height=128, width=128)
+    series = next((bad_root / "PGBM-0001").iterdir())
+    (series / "1-02.dcm").write_bytes(b"\x00" * 200)  # corrupt
+    proc = CohortProcessor(
+        bad_root, tmp_path / "out", cfg=CFG, batch_cfg=BCFG, mode="parallel"
+    )
+    summary = proc.process_all_patients()
+    assert summary.patients_ok == 1  # patient still "succeeds" overall
+    p = summary.patients[0]
+    assert p.total == 3 and p.succeeded == 2
+    assert p.failed_slices == ["1-02"]
+
+
+def test_undersized_slice_guard(tmp_path):
+    root = tmp_path / "c"
+    write_synthetic_cohort(root, n_patients=1, n_slices=2, height=64, width=128)
+    proc = CohortProcessor(root, tmp_path / "o", cfg=CFG, mode="sequential")
+    summary = proc.process_all_patients()
+    # 64 < min_dim 100 -> every slice fails the reference's dimension guard
+    assert summary.succeeded_slices == 0
+    assert summary.patients[0].total == 2
+
+
+def test_resume_skips_done(cohort, tmp_path):
+    out = tmp_path / "res"
+    proc = CohortProcessor(cohort, out, cfg=CFG, mode="sequential")
+    proc.process_all_patients()
+    stamp = {p: p.stat().st_mtime for p in out.rglob("*.jpg")}
+    proc2 = CohortProcessor(cohort, out, cfg=CFG, mode="sequential", resume=True)
+    summary = proc2.process_all_patients()
+    assert summary.succeeded_slices == 8  # counted as done
+    for p in out.rglob("*.jpg"):
+        assert p.stat().st_mtime == stamp[p]  # nothing rewritten
+
+
+def test_missing_series_dir_is_patient_failure(tmp_path):
+    root = tmp_path / "c"
+    (root / "PGBM-0001").mkdir(parents=True)  # patient with no series
+    write_synthetic_cohort(root, n_patients=1, n_slices=2, height=128, width=128)
+    # write_synthetic_cohort created PGBM-0001 with a series; add empty patient
+    (root / "PGBM-0002").mkdir()
+    proc = CohortProcessor(root, tmp_path / "o", cfg=CFG, mode="sequential")
+    summary = proc.process_all_patients()
+    assert summary.patients_ok == 1
+    assert len(summary.patients) == 2
+
+
+def test_cli_arg_round_trip():
+    from nm03_capstone_project_tpu.cli.sequential import build_parser
+
+    args = build_parser().parse_args(
+        ["--grow-low", "0.5", "--grow-high", "0.8", "--canvas", "128", "--synthetic", "1"]
+    )
+    from nm03_capstone_project_tpu.cli import common
+
+    cfg = common.pipeline_config_from_args(args)
+    assert cfg.grow_low == 0.5 and cfg.grow_high == 0.8 and cfg.canvas == 128
+    # defaults match the reference contract
+    d = PipelineConfig()
+    assert (d.norm_low, d.norm_high) == (0.5, 2.5)
+    assert (d.clip_low, d.clip_high) == (0.68, 4000.0)
+    assert (d.grow_low, d.grow_high) == (0.74, 0.91)
+
+
+def test_export_failure_not_counted_as_success(cohort, tmp_path, monkeypatch):
+    """A slice whose JPEG never hits disk must be FAILED, not DONE."""
+    import nm03_capstone_project_tpu.render.export as export_mod
+
+    real = export_mod.save_jpeg
+
+    def flaky(image, path, quality=90):
+        if "1-03" in str(path):
+            raise IOError("disk full")
+        return real(image, path, quality)
+
+    monkeypatch.setattr(export_mod, "save_jpeg", flaky)
+    for mode, bcfg in [("sequential", None), ("parallel", BCFG)]:
+        out = tmp_path / mode
+        proc = CohortProcessor(
+            cohort, out, cfg=CFG, batch_cfg=bcfg or BatchConfig(), mode=mode
+        )
+        summary = proc.process_all_patients()
+        assert summary.succeeded_slices == 6, mode  # 1-03 fails in each patient
+        for p in summary.patients:
+            assert p.failed_slices == ["1-03"], mode
+        assert not proc.manifest.is_done("PGBM-0001", "1-03")
+
+
+def test_manifest_atomicity(tmp_path):
+    from nm03_capstone_project_tpu.utils.manifest import Manifest
+
+    m = Manifest(tmp_path)
+    m.record("PGBM-0001", "1-01", "done")
+    m.flush()
+    m2 = Manifest.load_or_create(tmp_path)
+    assert m2.is_done("PGBM-0001", "1-01")
+    # corrupt manifest falls back to empty rather than crashing
+    (tmp_path / "manifest.json").write_text("{not json")
+    m3 = Manifest.load_or_create(tmp_path)
+    assert m3.data == {}

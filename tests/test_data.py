@@ -1,0 +1,169 @@
+import struct
+
+import numpy as np
+import pytest
+
+from nm03_capstone_project_tpu.data import (
+    DicomParseError,
+    extract_file_number,
+    find_patient_dirs,
+    load_dicom_files_for_patient,
+    phantom_slice,
+    read_dicom,
+    write_dicom,
+    write_synthetic_cohort,
+)
+
+
+def test_dicom_round_trip(tmp_path, rng):
+    img = (rng.random((64, 48)) * 4000).astype(np.uint16)
+    p = tmp_path / "x.dcm"
+    write_dicom(p, img, patient_id="PGBM-0007", instance_number=3)
+    s = read_dicom(p)
+    assert (s.rows, s.cols) == (64, 48)
+    np.testing.assert_array_equal(s.pixels, img.astype(np.float32))
+    assert s.meta_str((0x0010, 0x0020)) == "PGBM-0007"
+    assert s.meta_str((0x0020, 0x0013)).strip() == "3"
+
+
+def test_dicom_rescale_applied(tmp_path):
+    img = np.full((16, 16), 100, np.uint16)
+    p = tmp_path / "r.dcm"
+    write_dicom(p, img, rescale_slope=2.0, rescale_intercept=-50.0)
+    s = read_dicom(p)
+    np.testing.assert_allclose(s.pixels, 150.0)
+
+
+def test_dicom_implicit_vr(tmp_path):
+    """Reader handles implicit VR LE datasets (written by hand here)."""
+
+    def elem(group, el, value):
+        return struct.pack("<HHI", group, el, len(value)) + value
+
+    img = np.arange(12, dtype="<u2").reshape(3, 4)
+    meta_elems = struct.pack("<HH", 0x0002, 0x0010) + b"UI" + struct.pack(
+        "<H", 18
+    ) + b"1.2.840.10008.1.2\x00"
+    meta = (
+        struct.pack("<HH", 0x0002, 0x0000)
+        + b"UL"
+        + struct.pack("<H", 4)
+        + struct.pack("<I", len(meta_elems))
+        + meta_elems
+    )
+    ds = (
+        elem(0x0028, 0x0010, struct.pack("<H", 3))
+        + elem(0x0028, 0x0011, struct.pack("<H", 4))
+        + elem(0x0028, 0x0100, struct.pack("<H", 16))
+        + elem(0x7FE0, 0x0010, img.tobytes())
+    )
+    p = tmp_path / "implicit.dcm"
+    p.write_bytes(b"\x00" * 128 + b"DICM" + meta + ds)
+    s = read_dicom(p)
+    np.testing.assert_array_equal(s.pixels, img.astype(np.float32))
+
+
+def test_dicom_skips_sequences(tmp_path):
+    """Undefined-length SQ elements are skipped structurally."""
+    img = np.ones((2, 2), dtype="<u2")
+
+    def ex_elem(group, el, vr, value):
+        return struct.pack("<HH", group, el) + vr + struct.pack("<H", len(value)) + value
+
+    sq = (
+        struct.pack("<HH", 0x0008, 0x1140)
+        + b"SQ\x00\x00"
+        + struct.pack("<I", 0xFFFFFFFF)
+        + struct.pack("<HHI", 0xFFFE, 0xE000, 0xFFFFFFFF)  # item, undefined
+        + ex_elem(0x0008, 0x0100, b"SH", b"CODE")
+        + struct.pack("<HHI", 0xFFFE, 0xE00D, 0)  # item delimiter
+        + struct.pack("<HHI", 0xFFFE, 0xE0DD, 0)  # sequence delimiter
+    )
+    meta_elems = (
+        struct.pack("<HH", 0x0002, 0x0010)
+        + b"UI"
+        + struct.pack("<H", 20)
+        + b"1.2.840.10008.1.2.1\x00"
+    )
+    meta = (
+        struct.pack("<HH", 0x0002, 0x0000)
+        + b"UL"
+        + struct.pack("<H", 4)
+        + struct.pack("<I", len(meta_elems))
+        + meta_elems
+    )
+    ds = (
+        sq
+        + ex_elem(0x0028, 0x0010, b"US", struct.pack("<H", 2))
+        + ex_elem(0x0028, 0x0011, b"US", struct.pack("<H", 2))
+        + ex_elem(0x0028, 0x0100, b"US", struct.pack("<H", 16))
+        + struct.pack("<HH", 0x7FE0, 0x0010)
+        + b"OW\x00\x00"
+        + struct.pack("<I", 8)
+        + img.tobytes()
+    )
+    p = tmp_path / "sq.dcm"
+    p.write_bytes(b"\x00" * 128 + b"DICM" + meta + ds)
+    s = read_dicom(p)
+    np.testing.assert_array_equal(s.pixels, np.ones((2, 2), np.float32))
+
+
+def test_dicom_corrupt_rejected(tmp_path):
+    p = tmp_path / "bad.dcm"
+    p.write_bytes(b"\x00" * 128 + b"DICM" + b"\x01\x02\x03")
+    with pytest.raises(DicomParseError):
+        read_dicom(p)
+    p2 = tmp_path / "trunc.dcm"
+    write_dicom(p2, np.ones((32, 32), np.uint16))
+    data = p2.read_bytes()
+    p2.write_bytes(data[: len(data) // 2])
+    with pytest.raises(DicomParseError):
+        read_dicom(p2)
+
+
+def test_extract_file_number():
+    assert extract_file_number("1-14.dcm") == 14
+    assert extract_file_number("1-1.dcm") == 1
+    assert extract_file_number("series2-003.dcm") == 3
+    assert extract_file_number("nonumber.dcm") == 1000
+    assert extract_file_number("1-14.txt") == 1000
+
+
+def test_discovery_contract(tmp_path):
+    # two patients, one distractor dir, out-of-order filenames
+    for pid in ["PGBM-0002", "PGBM-0001", "LICENSE-DIR"]:
+        (tmp_path / pid / "seriesA").mkdir(parents=True)
+    (tmp_path / "PGBM-0001" / "seriesB").mkdir()
+    for name in ["1-10.dcm", "1-2.dcm", "1-1.dcm", "notes.txt", "weird.dcm"]:
+        (tmp_path / "PGBM-0001" / "seriesA" / name).write_bytes(b"")
+    patients = find_patient_dirs(tmp_path)
+    assert patients == ["PGBM-0001", "PGBM-0002"]
+    files = load_dicom_files_for_patient(tmp_path, "PGBM-0001")
+    assert [f.name for f in files] == ["1-1.dcm", "1-2.dcm", "1-10.dcm", "weird.dcm"]
+    # first series dir in sorted order is used
+    assert all("seriesA" in str(f) for f in files)
+
+
+def test_discovery_missing_root(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        find_patient_dirs(tmp_path / "nope")
+    (tmp_path / "PGBM-0009").mkdir()
+    with pytest.raises(FileNotFoundError):
+        load_dicom_files_for_patient(tmp_path, "PGBM-0009")
+
+
+def test_synthetic_cohort_end_to_end(tmp_path):
+    pids = write_synthetic_cohort(tmp_path, n_patients=2, n_slices=3, height=128, width=128)
+    assert find_patient_dirs(tmp_path) == pids
+    files = load_dicom_files_for_patient(tmp_path, pids[0])
+    assert len(files) == 3
+    s = read_dicom(files[0])
+    assert (s.rows, s.cols) == (128, 128)
+    assert s.meta_str((0x0010, 0x0020)) == pids[0]
+
+
+def test_phantom_intensity_structure():
+    img = phantom_slice(256, 256, seed=0)
+    c = img[128, 128]
+    assert 1200 <= c <= 2050  # lesion in the region-growing band (raw units)
+    assert img[128, 10] == 0.0  # outside the head

@@ -1,0 +1,79 @@
+import numpy as np
+
+from nm03_capstone_project_tpu.render.export import export_pairs, save_jpeg
+from nm03_capstone_project_tpu.render.render import (
+    render_gray,
+    render_overlay,
+    render_segmentation,
+)
+
+
+def test_render_gray_letterbox_geometry():
+    # wide slice: 100x200 -> scaled to 256x128 region centered vertically
+    img = np.full((100, 200), 500.0, np.float32)
+    img[0, 0] = 0.0  # establish a window
+    canvas = np.zeros((256, 256), np.float32)
+    canvas[:100, :200] = img
+    dims = np.asarray([100, 200], np.int32)
+    out = np.asarray(render_gray(canvas, dims, 256))
+    assert out.shape == (256, 256)
+    assert out[:60, :].max() == 0  # top letterbox band is black
+    assert out[196:, :].max() == 0  # bottom band
+    assert out[128, 128] > 200  # center is bright (value 500 in window [0,500])
+
+
+def test_render_gray_constant_image_no_nan():
+    canvas = np.full((64, 64), 7.0, np.float32)
+    dims = np.asarray([64, 64], np.int32)
+    out = np.asarray(render_gray(canvas, dims, 64))
+    assert out.min() >= 0 and out.max() <= 255
+
+
+def test_render_segmentation_opacity_and_border():
+    mask = np.zeros((64, 64), np.uint8)
+    mask[16:48, 16:48] = 1
+    dims = np.asarray([64, 64], np.int32)
+    out = np.asarray(render_segmentation(mask, dims, 64, 0.6, 1.0, 2))
+    # interior at fill opacity, border at full opacity, outside black
+    assert out[32, 32] == 153  # 0.6 * 255
+    assert out[16, 32] == 255  # border band
+    assert out[8, 8] == 0
+
+
+def test_render_segmentation_scales_to_output():
+    mask = np.zeros((32, 32), np.uint8)
+    mask[8:24, 8:24] = 1
+    dims = np.asarray([32, 32], np.int32)
+    out = np.asarray(render_segmentation(mask, dims, 128, 0.6, 1.0, 2))
+    assert out[64, 64] > 0
+    ys, xs = np.nonzero(out)
+    # the 16px square maps to ~64px in render space
+    assert 30 <= ys.min() <= 34 and 94 <= ys.max() <= 98
+
+
+def test_render_overlay_composites():
+    canvas = np.full((64, 64), 100.0, np.float32)
+    canvas[0, 0] = 0.0
+    canvas[0, 1] = 200.0  # window [0, 200] -> background gray ~127
+    mask = np.zeros((64, 64), np.uint8)
+    mask[20:40, 20:40] = 1
+    dims = np.asarray([64, 64], np.int32)
+    out = np.asarray(render_overlay(canvas, mask, dims, 64))
+    assert out[30, 30] > out[10, 10] + 50  # white overlay lifts the lesion
+
+
+def test_save_jpeg_and_export_pairs(tmp_path):
+    img = np.zeros((32, 32), np.uint8)
+    save_jpeg(img, tmp_path / "a.jpg")
+    assert (tmp_path / "a.jpg").stat().st_size > 0
+    done = export_pairs(
+        [("s1", img, img), ("s2", img, img)], tmp_path / "pairs"
+    )
+    assert done == ["s1", "s2"]
+    names = sorted(p.name for p in (tmp_path / "pairs").iterdir())
+    assert names == [
+        "s1_original.jpg",
+        "s1_processed.jpg",
+        "s2_original.jpg",
+        "s2_processed.jpg",
+    ]
