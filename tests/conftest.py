@@ -19,6 +19,15 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+# A TPU PJRT plugin loaded via sitecustomize may have already called
+# jax.config.update("jax_platforms", ...) at interpreter startup, which
+# overrides the env var above and would make the first jax.devices()
+# dial real hardware (and hang the suite).  Re-pin the live config to
+# the CPU backend; this must happen before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
