@@ -36,3 +36,8 @@ from nm03_capstone_project_tpu.ops.neighborhood import extend_edges  # noqa: F40
 from nm03_capstone_project_tpu.ops.region_growing import region_grow  # noqa: F401
 from nm03_capstone_project_tpu.ops.seeds import seed_mask  # noqa: F401
 from nm03_capstone_project_tpu.ops.sharpen import gaussian_blur, sharpen  # noqa: F401
+from nm03_capstone_project_tpu.ops.volume import (  # noqa: F401
+    dilate3d,
+    erode3d,
+    region_grow_3d,
+)

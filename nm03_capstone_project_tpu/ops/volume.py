@@ -1,0 +1,160 @@
+"""3D volumetric operators.
+
+The reference is strictly 2D — ``setLoadSeries(false)`` everywhere
+(src/test/test_pipeline.cpp:41) — and its "scale" axis is slices-per-patient.
+The TPU-native framework's volumetric capability (BASELINE.json config 4)
+stacks a patient's T1+C series into a (D, H, W) volume and runs seeded region
+growing / morphology with true 3D connectivity, so a lesion is segmented as
+one connected body instead of D independent 2D islands.
+
+All ops operate on the last three axes and vmap over any leading batch axes.
+The 'cross' footprint at size 3 is the 6-connected structuring element — the
+3D analog of the reference's 4-connected flood fill; 'box' gives
+26-connectivity.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def footprint_offsets_3d(size: int, shape: str = "cross") -> List[Tuple[int, int, int]]:
+    """Offsets (dz, dr, dc) of a 3D structuring element.
+
+    shape: 'box' (full cube), 'cross' (city-block radius size//2 — the
+    6-connected element for size 3), or 'ball' (euclidean radius size/2).
+    """
+    r = size // 2
+    offs = []
+    for dz in range(-r, r + 1):
+        for dr in range(-r, r + 1):
+            for dc in range(-r, r + 1):
+                if shape == "box":
+                    offs.append((dz, dr, dc))
+                elif shape == "cross":
+                    if abs(dz) + abs(dr) + abs(dc) <= r:
+                        offs.append((dz, dr, dc))
+                elif shape == "ball":
+                    if dz * dz + dr * dr + dc * dc <= (size / 2.0) ** 2:
+                        offs.append((dz, dr, dc))
+                else:
+                    raise ValueError(f"unknown footprint shape: {shape}")
+    return offs
+
+
+def shifted_stack_3d(
+    x: jax.Array,
+    offsets: List[Tuple[int, int, int]],
+    pad_mode: str = "constant",
+) -> jax.Array:
+    """Stack 3D-shifted views of ``x`` (..., D, H, W) along a new leading axis.
+
+    The volumetric counterpart of :func:`ops.neighborhood.shifted_stack`; XLA
+    fuses the stack into the consuming reduction.
+    """
+    max_z = max(abs(dz) for dz, _, _ in offsets)
+    max_r = max(abs(dr) for _, dr, _ in offsets)
+    max_c = max(abs(dc) for _, _, dc in offsets)
+    pad_widths = [(0, 0)] * (x.ndim - 3) + [
+        (max_z, max_z),
+        (max_r, max_r),
+        (max_c, max_c),
+    ]
+    xp = jnp.pad(x, pad_widths, mode=pad_mode)
+    d, h, w = x.shape[-3], x.shape[-2], x.shape[-1]
+    views = [
+        jax.lax.dynamic_slice_in_dim(
+            jax.lax.dynamic_slice_in_dim(
+                jax.lax.dynamic_slice_in_dim(xp, max_z + dz, d, axis=-3),
+                max_r + dr,
+                h,
+                axis=-2,
+            ),
+            max_c + dc,
+            w,
+            axis=-1,
+        )
+        for dz, dr, dc in offsets
+    ]
+    return jnp.stack(views, axis=0)
+
+
+def _morph3d(x: jax.Array, size: int, shape: str, is_max: bool) -> jax.Array:
+    offs = footprint_offsets_3d(size, shape)
+    orig_dtype = x.dtype
+    work = x.astype(jnp.uint8) if orig_dtype == jnp.bool_ else x
+    stack = shifted_stack_3d(work, offs, pad_mode="constant")
+    out = stack.max(axis=0) if is_max else stack.min(axis=0)
+    return out.astype(orig_dtype)
+
+
+def dilate3d(x: jax.Array, size: int = 3, shape: str = "cross") -> jax.Array:
+    """3D dilation over (..., D, H, W); outside-volume counts as background.
+
+    Volumetric extension of FAST ``Dilation::create(3)``
+    (src/sequential/main_sequential.cpp:250) with 6-connectivity by default.
+    """
+    return _morph3d(x, size, shape, is_max=True)
+
+
+def erode3d(x: jax.Array, size: int = 3, shape: str = "cross") -> jax.Array:
+    """3D erosion over (..., D, H, W); foreground erodes at volume borders."""
+    return _morph3d(x, size, shape, is_max=False)
+
+
+def region_grow_3d(
+    volume: jax.Array,
+    seeds: jax.Array,
+    low: float = 0.74,
+    high: float = 0.91,
+    valid: jax.Array | None = None,
+    connectivity: int = 6,
+    block_iters: int = 16,
+    max_iters: int = 4096,
+) -> jax.Array:
+    """3D seeded region growing; returns a uint8 {0,1} mask shaped like volume.
+
+    The volumetric extension of the reference's SeededRegionGrowing
+    (src/sequential/main_sequential.cpp:232-243): the flood fill is a fixpoint
+    of masked 3D label dilation — grow one 6-connected (or 26-connected)
+    shell per step, intersect with the intensity band [low, high], repeat
+    until the popcount stops changing (region only grows, so popcount
+    equality is set equality).
+
+    Args:
+      volume: (..., D, H, W) float intensities (already preprocessed).
+      seeds: (..., D, H, W) bool seed mask.
+      valid: optional bool mask of true-volume voxels; padding never joins.
+      connectivity: 6 (face neighbors) or 26 (full cube).
+      block_iters: dilation steps per convergence check (amortizes the
+        device-wide reduction over many cheap VPU steps).
+      max_iters: hard cap on total steps.
+    """
+    band = (volume >= low) & (volume <= high)
+    if valid is not None:
+        band = band & valid
+    shape = "cross" if connectivity == 6 else "box"
+    region0 = seeds & band
+
+    def grow_block(region):
+        def step(_, r):
+            return dilate3d(r, 3, shape) & band
+
+        return jax.lax.fori_loop(0, block_iters, step, region)
+
+    def cond(state):
+        region, prev_count, iters = state
+        return (region.sum() != prev_count) & (iters < max_iters)
+
+    def body(state):
+        region, _, iters = state
+        count = region.sum()
+        return grow_block(region), count, iters + block_iters
+
+    region, _, _ = jax.lax.while_loop(
+        cond, body, (grow_block(region0), region0.sum(), jnp.int32(block_iters))
+    )
+    return region.astype(jnp.uint8)

@@ -1,0 +1,161 @@
+"""3D volumetric ops vs scipy oracles + volume pipeline integration.
+
+Formalizes the volumetric capability (BASELINE.json config 4) the same way
+the 2D suite formalizes the reference's per-slice contract: each kernel is
+property-tested against a scipy.ndimage oracle, and the full volume pipeline
+is checked to segment a phantom lesion as one connected 3D body.
+"""
+
+import numpy as np
+import pytest
+from scipy import ndimage
+
+import jax.numpy as jnp
+
+from nm03_capstone_project_tpu.data.synthetic import phantom_volume
+from nm03_capstone_project_tpu.ops.volume import (
+    dilate3d,
+    erode3d,
+    footprint_offsets_3d,
+    region_grow_3d,
+)
+
+
+def _structure(connectivity):
+    # scipy's generate_binary_structure(3, 1) is the 6-connected cross,
+    # (3, 3) the full 26-connected cube
+    return ndimage.generate_binary_structure(3, 1 if connectivity == 6 else 3)
+
+
+class TestFootprints:
+    def test_cross_size3_is_6_connected(self):
+        offs = footprint_offsets_3d(3, "cross")
+        assert len(offs) == 7  # center + 6 face neighbors
+        assert (0, 0, 0) in offs
+
+    def test_box_size3_is_26_connected(self):
+        assert len(footprint_offsets_3d(3, "box")) == 27
+
+    def test_unknown_shape_raises(self):
+        with pytest.raises(ValueError):
+            footprint_offsets_3d(3, "banana")
+
+
+class TestMorphology3D:
+    @pytest.mark.parametrize("shape,conn", [("cross", 6), ("box", 26)])
+    def test_dilate_matches_scipy(self, rng, shape, conn):
+        x = (rng.random((6, 12, 12)) > 0.7).astype(np.uint8)
+        got = np.asarray(dilate3d(jnp.asarray(x), 3, shape))
+        want = ndimage.binary_dilation(x, structure=_structure(conn)).astype(np.uint8)
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("shape,conn", [("cross", 6), ("box", 26)])
+    def test_erode_matches_scipy(self, rng, shape, conn):
+        x = (rng.random((6, 12, 12)) > 0.3).astype(np.uint8)
+        got = np.asarray(erode3d(jnp.asarray(x), 3, shape))
+        # outside-volume counts as background, so border foreground erodes:
+        # scipy equivalent is border_value=0 (its default)
+        want = ndimage.binary_erosion(x, structure=_structure(conn)).astype(np.uint8)
+        np.testing.assert_array_equal(got, want)
+
+    def test_bool_dtype_round_trips(self, rng):
+        x = rng.random((4, 8, 8)) > 0.5
+        assert np.asarray(dilate3d(jnp.asarray(x))).dtype == np.bool_
+
+    def test_batch_axis_vmaps(self, rng):
+        x = (rng.random((2, 4, 8, 8)) > 0.6).astype(np.uint8)
+        got = np.asarray(dilate3d(jnp.asarray(x)))
+        for b in range(2):
+            np.testing.assert_array_equal(
+                got[b], np.asarray(dilate3d(jnp.asarray(x[b])))
+            )
+
+
+def _oracle_region_grow(volume, seeds, low, high, connectivity=6):
+    """Connected components of the band that contain a seed."""
+    band = (volume >= low) & (volume <= high)
+    labels, n = ndimage.label(band, structure=_structure(connectivity))
+    hit = np.unique(labels[seeds & band])
+    hit = hit[hit != 0]
+    return np.isin(labels, hit).astype(np.uint8)
+
+
+class TestRegionGrow3D:
+    @pytest.mark.parametrize("connectivity", [6, 26])
+    def test_matches_connected_component_oracle(self, rng, connectivity):
+        vol = rng.random((8, 16, 16)).astype(np.float32)
+        seeds = np.zeros_like(vol, dtype=bool)
+        seeds[4, 8, 8] = True
+        seeds[2, 3, 12] = True
+        got = np.asarray(
+            region_grow_3d(
+                jnp.asarray(vol),
+                jnp.asarray(seeds),
+                0.4,
+                0.9,
+                connectivity=connectivity,
+                block_iters=4,
+            )
+        )
+        want = _oracle_region_grow(vol, seeds, 0.4, 0.9, connectivity)
+        np.testing.assert_array_equal(got, want)
+
+    def test_z_connectivity_crosses_slices(self):
+        # two in-band blobs on adjacent slices that only touch through z
+        vol = np.zeros((3, 8, 8), np.float32)
+        vol[0, 2:4, 2:4] = 0.5
+        vol[1, 3, 3] = 0.5  # overlaps (3,3) of slice 0 through z
+        vol[2, 6, 6] = 0.5  # in band but not connected
+        seeds = np.zeros_like(vol, dtype=bool)
+        seeds[0, 2, 2] = True
+        got = np.asarray(
+            region_grow_3d(jnp.asarray(vol), jnp.asarray(seeds), 0.4, 0.6)
+        )
+        assert got[1, 3, 3] == 1  # reached through z
+        assert got[2, 6, 6] == 0  # disconnected blob untouched
+
+    def test_valid_mask_blocks_padding(self):
+        vol = np.full((2, 6, 6), 0.5, np.float32)
+        seeds = np.zeros_like(vol, dtype=bool)
+        seeds[0, 1, 1] = True
+        valid = np.zeros_like(vol, dtype=bool)
+        valid[:, :3, :3] = True
+        got = np.asarray(
+            region_grow_3d(
+                jnp.asarray(vol), jnp.asarray(seeds), 0.4, 0.6,
+                valid=jnp.asarray(valid),
+            )
+        )
+        assert got[:, :3, :3].sum() == 18
+        assert got[:, 3:, :].sum() == 0 and got[:, :, 3:].sum() == 0
+
+
+class TestVolumePipeline:
+    def test_phantom_lesion_segmented_as_one_body(self):
+        from nm03_capstone_project_tpu.pipeline.volume_pipeline import process_volume
+
+        vol = phantom_volume(n_slices=8, height=96, width=96, seed=1)
+        out = process_volume(jnp.asarray(vol), jnp.asarray([96, 96], jnp.int32))
+        mask = np.asarray(out["mask"])
+        assert mask.shape == vol.shape
+        assert mask.dtype == np.uint8
+        assert mask.sum() > 0
+        # the dilated lesion forms one 6-connected component
+        labels, n = ndimage.label(
+            mask, structure=ndimage.generate_binary_structure(3, 1)
+        )
+        assert n == 1
+        # mask present on several central slices (lesion waxes/wanes)
+        per_slice = mask.reshape(mask.shape[0], -1).sum(axis=1)
+        assert (per_slice > 0).sum() >= 3
+
+    def test_respects_canvas_padding(self):
+        from nm03_capstone_project_tpu.pipeline.volume_pipeline import process_volume
+
+        vol = phantom_volume(n_slices=4, height=64, width=64, seed=2)
+        canvas = np.zeros((4, 96, 96), np.float32)
+        canvas[:, :64, :64] = vol
+        out = process_volume(jnp.asarray(canvas), jnp.asarray([64, 64], jnp.int32))
+        mask = np.asarray(out["mask"])
+        assert mask[:, 64:, :].sum() == 0
+        assert mask[:, :, 64:].sum() == 0
