@@ -1,0 +1,24 @@
+"""Multi-device execution: meshes, data parallelism, z-sharded volumes.
+
+The reference's entire parallel story is one OpenMP pragma over a slice batch
+(src/parallel/main_parallel.cpp:336) plus the mutex discipline around its
+non-thread-safe export path. Here parallelism is declarative: a
+`jax.sharding.Mesh` with named axes, `NamedSharding` annotations, and XLA
+inserting the collectives —
+
+* :mod:`.mesh` — mesh construction, batch shardings, batch padding.
+* :mod:`.dp`   — slice/patient data parallelism (zero-communication SPMD).
+* :mod:`.zshard` — sequence-parallel analog: volumes sharded along z with
+  ring halo exchange (`ppermute`) per growth step and `psum` convergence.
+"""
+
+from nm03_capstone_project_tpu.parallel.dp import process_batch_sharded  # noqa: F401
+from nm03_capstone_project_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharding,
+    make_mesh,
+    pad_to_multiple,
+    replicated,
+)
+from nm03_capstone_project_tpu.parallel.zshard import (  # noqa: F401
+    process_volume_zsharded,
+)

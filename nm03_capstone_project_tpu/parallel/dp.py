@@ -1,0 +1,88 @@
+"""Data-parallel cohort execution over a device mesh.
+
+TPU-native replacement for the reference's OpenMP batch loop
+(src/parallel/main_parallel.cpp:330-347): where the reference forks 16
+threads over a ≤25-slice batch, here the batch axis is sharded across chips
+with `NamedSharding` and the vmapped pipeline runs as ONE compiled SPMD
+program — no threads, no mutexes, no serial-export bottleneck, and
+bit-identical results to the sequential path by construction.
+
+There is no cross-device communication in this path (each slice is
+independent), so scaling is embarrassingly linear over ICI-connected chips;
+the only collective XLA inserts is for the vmapped region-growing
+convergence test, which reduces over the *slice*, not the mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nm03_capstone_project_tpu.config import DEFAULT_CONFIG, PipelineConfig
+from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
+
+
+@functools.lru_cache(maxsize=8)
+def _compiled_sharded_batch(mesh: Mesh, cfg: PipelineConfig, with_render: bool):
+    """jit of the vmapped pipeline with batch-axis in/out shardings."""
+    shard3 = NamedSharding(mesh, P("data", None, None))
+    shard2 = NamedSharding(mesh, P("data", None))
+
+    if with_render:
+        from nm03_capstone_project_tpu.render.render import (
+            render_gray,
+            render_segmentation,
+        )
+
+        def one(pixels, dims):
+            out = process_slice(pixels, dims, cfg)
+            orig = render_gray(out["original"], dims, cfg.render_size)
+            proc = render_segmentation(
+                out["mask"],
+                dims,
+                cfg.render_size,
+                cfg.overlay_opacity,
+                cfg.overlay_border_opacity,
+                cfg.overlay_border_radius,
+            )
+            return {"original": orig, "mask": proc}
+
+    else:
+
+        def one(pixels, dims):
+            return process_slice(pixels, dims, cfg)
+
+    return jax.jit(
+        jax.vmap(one),
+        in_shardings=(shard3, shard2),
+        out_shardings=shard3,
+    )
+
+
+def process_batch_sharded(
+    pixels: jax.Array,
+    dims: jax.Array,
+    cfg: PipelineConfig = DEFAULT_CONFIG,
+    mesh: Optional[Mesh] = None,
+    with_render: bool = False,
+) -> Dict[str, jax.Array]:
+    """Run a (B, H, W) slice batch data-parallel across the mesh.
+
+    B must divide the mesh's ``data`` axis evenly — use
+    :func:`.mesh.pad_to_multiple` on the host batch first.
+
+    Args:
+      pixels: (B, H, W) float canvas batch.
+      dims: (B, 2) true dims.
+      mesh: a mesh with a ``data`` axis (default: all devices).
+      with_render: additionally produce the 512x512 rendered pair on-device
+        (the reference's export stage, main_sequential.cpp:254-265).
+    """
+    if mesh is None:
+        from nm03_capstone_project_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+    return _compiled_sharded_batch(mesh, cfg, with_render)(pixels, dims)

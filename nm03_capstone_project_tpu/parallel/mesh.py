@@ -1,0 +1,84 @@
+"""Device-mesh construction and sharding helpers.
+
+The reference's parallelism is 16 OpenMP threads over a ≤25-slice batch on one
+shared-memory node (src/parallel/main_parallel.cpp:336,401). The TPU-native
+replacement is a `jax.sharding.Mesh` over chips with named axes:
+
+* ``data`` — batch/data parallelism: slices (and whole patients) spread
+  across devices, no cross-device communication inside the pipeline.
+* ``z``   — volume sharding: a (D, H, W) series split along z, stencils and
+  region growing communicating one halo plane per step over ICI
+  (see :mod:`.zshard`).
+
+A mesh is cheap to build and purely declarative; XLA inserts the collectives.
+On a single host the same code runs over `xla_force_host_platform_device_count`
+virtual devices, which is how the test suite exercises every collective path
+without TPU hardware (SURVEY.md section 7 step 8).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_names: Sequence[str] = ("data",),
+    axis_sizes: Optional[Sequence[int]] = None,
+) -> Mesh:
+    """Build a mesh over the first ``n_devices`` devices.
+
+    Args:
+      n_devices: number of devices to use (default: all available).
+      axis_names: mesh axis names, e.g. ("data",) or ("data", "z").
+      axis_sizes: sizes per axis; must multiply to n_devices. Defaults to all
+        devices on the first axis.
+    """
+    devices = jax.devices()
+    n = len(devices) if n_devices is None else n_devices
+    if n > len(devices):
+        raise ValueError(f"requested {n} devices, only {len(devices)} available")
+    if axis_sizes is None:
+        axis_sizes = [n] + [1] * (len(axis_names) - 1)
+    if int(np.prod(axis_sizes)) != n:
+        raise ValueError(f"axis_sizes {axis_sizes} != n_devices {n}")
+    dev_array = np.asarray(devices[:n]).reshape(axis_sizes)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def batch_sharding(mesh: Mesh, ndim: int, axis: str = "data") -> NamedSharding:
+    """Sharding that splits axis 0 of an ndim-array across ``axis``."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(
+    pixels: np.ndarray, dims: np.ndarray, multiple: int
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Pad a (B, H, W) host batch along B so it divides the mesh evenly.
+
+    Filler slices get dims (1, 1): they fail the reference's min-dimension
+    guard (main_sequential.cpp:189-192) by construction, so callers that
+    count successes never see them, and their valid-region is a single pixel
+    so the padded lanes converge immediately in the region-growing fixpoint.
+
+    Returns (pixels, dims, real_count).
+    """
+    b = pixels.shape[0]
+    rem = (-b) % multiple
+    if rem == 0:
+        return pixels, dims, b
+    pad_px = np.zeros((rem,) + pixels.shape[1:], pixels.dtype)
+    pad_dims = np.ones((rem, 2), dims.dtype)
+    return (
+        np.concatenate([pixels, pad_px]),
+        np.concatenate([dims, pad_dims]),
+        b,
+    )
