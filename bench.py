@@ -1,0 +1,115 @@
+"""Benchmark: DICOM slices/sec/chip through the fused segmentation pipeline.
+
+Prints ONE JSON line:
+    {"metric": "slices_per_sec_per_chip", "value": N, "unit": "slices/s",
+     "vs_baseline": R}
+
+``value`` is the throughput of the full 7-op pipeline (normalize → clip →
+7x7 vector median → sharpen → seeded region growing → cast → dilate,
+the reference's batch-driver contract, src/sequential/main_sequential.cpp:170-272)
+vmapped over a 256x256 slice batch on ONE device of the default jax backend
+(the TPU chip under the driver).
+
+``vs_baseline`` is the speedup over the same program executed on the CPU
+backend — the stand-in for the reference's OpenMP-parallel CPU driver
+(src/parallel/main_parallel.cpp:336; XLA:CPU also uses the host's cores, so
+this is parallel-CPU vs one TPU chip, the north-star ratio in BASELINE.json).
+
+All progress chatter goes to stderr; stdout carries only the JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BATCH = 32
+CANVAS = 256
+TPU_REPS = 5
+CPU_REPS = 2
+
+
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _make_batch():
+    import numpy as np
+
+    from nm03_capstone_project_tpu.data.synthetic import phantom_slice
+
+    pixels = np.stack(
+        [
+            phantom_slice(CANVAS, CANVAS, seed=i, lesion_radius=0.12 + 0.002 * i)
+            for i in range(BATCH)
+        ]
+    ).astype(np.float32)
+    dims = np.full((BATCH, 2), CANVAS, np.int32)
+    return pixels, dims
+
+
+def _bench_on(device, pixels, dims, reps) -> float:
+    """Slices/sec of the jitted vmapped pipeline on one device."""
+    import jax
+    import jax.numpy as jnp
+
+    from nm03_capstone_project_tpu.config import PipelineConfig
+    from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_batch
+
+    cfg = PipelineConfig()
+
+    def f(px, dm):
+        return process_batch(px, dm, cfg)["mask"]
+
+    px = jax.device_put(jnp.asarray(pixels), device)
+    dm = jax.device_put(jnp.asarray(dims), device)
+    fn = jax.jit(f)
+
+    t0 = time.perf_counter()
+    fn(px, dm).block_until_ready()
+    _log(f"{device.platform}: compile+first run {time.perf_counter() - t0:.1f}s")
+
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn(px, dm).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return BATCH / best
+
+
+def main() -> None:
+    import jax
+
+    pixels, dims = _make_batch()
+
+    devices = jax.devices()
+    main_dev = devices[0]
+    _log(f"default backend: {main_dev.platform} ({len(devices)} devices)")
+    tput = _bench_on(main_dev, pixels, dims, TPU_REPS)
+    _log(f"{main_dev.platform} throughput: {tput:.2f} slices/s")
+
+    vs_baseline = 1.0
+    if main_dev.platform != "cpu":
+        try:
+            cpu_dev = jax.devices("cpu")[0]
+            cpu_tput = _bench_on(cpu_dev, pixels, dims, CPU_REPS)
+            _log(f"cpu baseline throughput: {cpu_tput:.2f} slices/s")
+            vs_baseline = tput / cpu_tput
+        except Exception as e:  # no cpu backend reachable — report raw value
+            _log(f"cpu baseline unavailable: {e}")
+
+    print(
+        json.dumps(
+            {
+                "metric": "slices_per_sec_per_chip",
+                "value": round(tput, 2),
+                "unit": "slices/s",
+                "vs_baseline": round(vs_baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

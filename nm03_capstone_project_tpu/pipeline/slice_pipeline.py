@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from nm03_capstone_project_tpu.config import DEFAULT_CONFIG, PipelineConfig
 from nm03_capstone_project_tpu.core.image import valid_mask
 from nm03_capstone_project_tpu.ops.elementwise import cast_uint8, clip_intensity, normalize
-from nm03_capstone_project_tpu.ops.median import vector_median_filter
+from nm03_capstone_project_tpu.ops.pallas_median import median_filter
 from nm03_capstone_project_tpu.ops.morphology import dilate, erode
 from nm03_capstone_project_tpu.ops.neighborhood import extend_edges
 from nm03_capstone_project_tpu.ops.region_growing import region_grow
@@ -52,7 +52,7 @@ def preprocess(
         x, cfg.norm_low, cfg.norm_high, cfg.norm_intensity_min, cfg.norm_intensity_max
     )
     x = clip_intensity(x, cfg.clip_low, cfg.clip_high)
-    x = vector_median_filter(x, cfg.median_window)
+    x = median_filter(x, cfg.median_window, use_pallas=cfg.use_pallas)
     x = sharpen(x, cfg.sharpen_gain, cfg.sharpen_sigma, cfg.sharpen_kernel)
     return x
 
