@@ -34,6 +34,7 @@ import numpy as np
 
 from nm03_capstone_project_tpu.config import BatchConfig, PipelineConfig
 from nm03_capstone_project_tpu.data.dicomlite import read_dicom
+from nm03_capstone_project_tpu.data.prefetch import prefetch_to_device
 from nm03_capstone_project_tpu.data.discovery import (
     find_patient_dirs,
     load_dicom_files_for_patient,
@@ -276,29 +277,44 @@ class CohortProcessor:
             for i in range(depth):
                 prefetch(i)
 
-            for bi, batch_files in enumerate(batches):
-                prefetch(bi + depth)
-                with self.timer.section("decode"):
-                    decoded = [f.result() for f in decode_futures.pop(bi)]
-                stems = [f.stem for f in batch_files]
-                good = [(s, p) for s, p in zip(stems, decoded) if p is not None]
-                for s, p in zip(stems, decoded):
-                    if p is None:
-                        failed.append(s)
-                        self.manifest.record(patient_id, s, STATUS_FAILED)
-                if not good:
+            def staged():
+                """Decode + pad batches; device staging handled downstream."""
+                for bi, batch_files in enumerate(batches):
+                    prefetch(bi + depth)
+                    with self.timer.section("decode"):
+                        decoded = [f.result() for f in decode_futures.pop(bi)]
+                    stems = [f.stem for f in batch_files]
+                    bad = [s for s, p in zip(stems, decoded) if p is None]
+                    good = [(s, p) for s, p in zip(stems, decoded) if p is not None]
+                    if not good:
+                        yield {"stems": [], "bad": bad, "pixels": None, "dims": None}
+                        continue
+                    padded, dims = self._pad_stack([p for _, p in good], pad_to=bs)
+                    yield {
+                        "stems": [s for s, _ in good],
+                        "bad": bad,
+                        "pixels": padded,
+                        "dims": dims,
+                    }
+
+            # host->HBM double buffering: the next batch's device_put is
+            # enqueued while the current batch computes
+            for batch in prefetch_to_device(staged(), depth=depth):
+                for s in batch["bad"]:
+                    failed.append(s)
+                    self.manifest.record(patient_id, s, STATUS_FAILED)
+                if not batch["stems"]:
                     continue
                 with self.timer.section("compute"):
-                    padded, dims = self._pad_stack([p for _, p in good], pad_to=bs)
-                    orig_b, proc_b = fn(padded, dims)
+                    orig_b, proc_b = fn(batch["pixels"], batch["dims"])
                     orig_b = np.asarray(orig_b)
                     proc_b = np.asarray(proc_b)
                 items = [
-                    (s, orig_b[i], proc_b[i]) for i, (s, _) in enumerate(good)
+                    (s, orig_b[i], proc_b[i]) for i, s in enumerate(batch["stems"])
                 ]
                 # hand encoding to the IO pool; overlap with next batch compute
                 export_futures.append(io_pool.submit(export_pairs, items, out_dir, 4))
-                expected_stems.extend(s for s, _ in good)
+                expected_stems.extend(batch["stems"])
             with self.timer.section("export"):
                 written = set()
                 for fut in export_futures:

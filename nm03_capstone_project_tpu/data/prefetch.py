@@ -1,0 +1,65 @@
+"""Host -> HBM prefetch: keep the chip fed while the host decodes.
+
+The reference's OpenMP driver keeps its (CPU) compute units busy by forking
+threads over a shared heap (src/parallel/main_parallel.cpp:336); a TPU is fed
+across PCIe instead, so the equivalent discipline is a *transfer pipeline*:
+``jax.device_put`` is asynchronous, so enqueuing the next batch's H2D copy
+while the current batch computes hides the transfer entirely (double
+buffering, SURVEY.md section 7 step 4 "hard part #2").
+
+Composes with the decode thread pool in :mod:`..cli.runner`: IO workers
+decode DICOMs ahead -> :func:`prefetch_to_device` stages them in HBM ahead ->
+the jitted program consumes device-resident arrays with zero upload stall.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+from typing import Any, Callable, Iterable, Iterator, Optional, TypeVar
+
+import jax
+
+T = TypeVar("T")
+
+
+def prefetch_to_device(
+    iterator: Iterable[T],
+    depth: int = 2,
+    device: Optional[Any] = None,
+    to_device: Optional[Callable[[Any], Any]] = None,
+) -> Iterator[T]:
+    """Yield items from ``iterator`` with arrays staged on device ``depth`` ahead.
+
+    Each item is a pytree; its array leaves are moved with ``jax.device_put``
+    (asynchronous — the copy overlaps whatever the device is running).
+    Non-array leaves (strings, metadata) pass through untouched.
+
+    Args:
+      iterator: source of pytree batches.
+      depth: how many batches to keep in flight (2 = double buffering).
+      device: target `jax.Device` or `Sharding` (default backend's device 0).
+      to_device: override the per-item transfer (e.g. to apply a
+        NamedSharding to some leaves only).
+    """
+    it = iter(iterator)
+    if to_device is None:
+        tgt = device if device is not None else jax.devices()[0]
+
+        def to_device(item):
+            return jax.tree.map(
+                lambda x: jax.device_put(x, tgt) if hasattr(x, "shape") else x,
+                item,
+            )
+
+    queue: collections.deque = collections.deque()
+
+    def enqueue(n: int) -> None:
+        for item in itertools.islice(it, n):
+            queue.append(to_device(item))
+
+    enqueue(max(depth, 1))
+    while queue:
+        out = queue.popleft()
+        enqueue(1)
+        yield out

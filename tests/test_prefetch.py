@@ -1,0 +1,45 @@
+"""Host->device prefetch pipeline."""
+
+import numpy as np
+
+import jax
+
+from nm03_capstone_project_tpu.data.prefetch import prefetch_to_device
+
+
+class TestPrefetchToDevice:
+    def test_yields_all_items_in_order(self):
+        items = [{"x": np.full((4,), i, np.float32), "name": f"s{i}"} for i in range(7)]
+        out = list(prefetch_to_device(iter(items), depth=2))
+        assert [o["name"] for o in out] == [f"s{i}" for i in range(7)]
+        for i, o in enumerate(out):
+            np.testing.assert_array_equal(np.asarray(o["x"]), items[i]["x"])
+
+    def test_arrays_land_on_device(self):
+        items = [{"x": np.ones((3, 3), np.float32)}]
+        (out,) = list(prefetch_to_device(iter(items), depth=2))
+        assert isinstance(out["x"], jax.Array)
+        assert out["x"].device == jax.devices()[0]
+
+    def test_non_array_leaves_pass_through(self):
+        items = [{"meta": "hello", "n": 3, "x": np.zeros(2)}]
+        (out,) = list(prefetch_to_device(iter(items)))
+        assert out["meta"] == "hello" and out["n"] == 3
+
+    def test_empty_iterator(self):
+        assert list(prefetch_to_device(iter([]))) == []
+
+    def test_depth_one_still_works(self):
+        items = [{"x": np.ones(2)} for _ in range(3)]
+        assert len(list(prefetch_to_device(iter(items), depth=1))) == 3
+
+    def test_custom_device(self):
+        dev = jax.devices()[-1]
+        items = [{"x": np.ones(2)}]
+        (out,) = list(prefetch_to_device(iter(items), device=dev))
+        assert out["x"].device == dev
+
+    def test_none_leaves_ok(self):
+        items = [{"x": None, "stems": []}, {"x": np.ones(2), "stems": ["a"]}]
+        out = list(prefetch_to_device(iter(items), depth=2))
+        assert out[0]["x"] is None and out[1]["stems"] == ["a"]
