@@ -1,0 +1,645 @@
+// Native runtime layer for the TPU framework.
+//
+// The reference (calebhabesh/NM03-Capstone-Project) is a C++17 system: its
+// import path (FAST DICOMFileImporter, src/test/test_pipeline.cpp:33-42), its
+// batch parallelism (OpenMP parallel-for, src/parallel/main_parallel.cpp:336)
+// and its export path (Qt/FAST ImageFileExporter,
+// src/sequential/main_sequential.cpp:61-73) are all native code. This file is
+// the TPU-native counterpart of that host-side runtime — everything that is
+// NOT device math: DICOM decode, threaded batch staging for the HBM prefetch
+// queue, and JPEG encoding. Device compute stays in JAX/XLA/Pallas.
+//
+// Exposed as a C ABI (ctypes-friendly, no pybind11):
+//   nm03_dicom_read         — decode one 2D slice to float32 (rescale applied)
+//   nm03_load_batch         — thread-pool decode of N files into a padded
+//                             canvas arena + dims + per-file ok flags
+//   nm03_jpeg_encode_gray   — baseline JPEG (grayscale) encoder
+//   nm03_last_error         — thread-local error string
+//
+// Contracts mirror the Python implementations in
+// nm03_capstone_project_tpu/data/dicomlite.py (parser) and
+// nm03_capstone_project_tpu/render/export.py (encoder); tests/test_native.py
+// checks native == Python on round-trips.
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <string>
+#include <thread>
+#include <vector>
+
+#if defined(_WIN32)
+#define NM03_EXPORT extern "C" __declspec(dllexport)
+#else
+#define NM03_EXPORT extern "C" __attribute__((visibility("default")))
+#endif
+
+namespace {
+
+thread_local std::string g_error;
+
+void set_error(const std::string& msg) { g_error = msg; }
+
+// ---------------------------------------------------------------------------
+// DICOM-lite parser (explicit/implicit VR little endian, uncompressed mono)
+// ---------------------------------------------------------------------------
+
+struct ByteReader {
+  const uint8_t* buf;
+  size_t len;
+  size_t pos = 0;
+  bool explicit_vr;
+  bool ok = true;
+
+  uint16_t u16() {
+    if (pos + 2 > len) { ok = false; return 0; }
+    uint16_t v = (uint16_t)(buf[pos] | (buf[pos + 1] << 8));
+    pos += 2;
+    return v;
+  }
+  uint32_t u32() {
+    if (pos + 4 > len) { ok = false; return 0; }
+    uint32_t v = (uint32_t)buf[pos] | ((uint32_t)buf[pos + 1] << 8) |
+                 ((uint32_t)buf[pos + 2] << 16) | ((uint32_t)buf[pos + 3] << 24);
+    pos += 4;
+    return v;
+  }
+  bool atend() const { return pos + 8 > len; }
+};
+
+constexpr uint32_t kUndefined = 0xFFFFFFFFu;
+
+bool is_long_vr(const char vr[2]) {
+  static const char* kLong[] = {"OB", "OW", "OF", "OD", "OL",
+                                "SQ", "UC", "UR", "UT", "UN"};
+  for (const char* s : kLong)
+    if (vr[0] == s[0] && vr[1] == s[1]) return true;
+  return false;
+}
+
+struct Element {
+  uint16_t group, elem;
+  char vr[2];
+  uint32_t length;
+};
+
+// Decode one data element header (mirrors _Reader.element in dicomlite.py).
+Element read_element(ByteReader& r) {
+  Element e{};
+  e.group = r.u16();
+  e.elem = r.u16();
+  bool delim = e.group == 0xFFFE &&
+               (e.elem == 0xE000 || e.elem == 0xE00D || e.elem == 0xE0DD);
+  if (delim) {
+    e.length = r.u32();
+    return e;
+  }
+  if (r.explicit_vr && e.group != 0xFFFE) {
+    if (r.pos + 2 > r.len) { r.ok = false; return e; }
+    e.vr[0] = (char)r.buf[r.pos];
+    e.vr[1] = (char)r.buf[r.pos + 1];
+    r.pos += 2;
+    if (is_long_vr(e.vr)) {
+      r.pos += 2;  // reserved
+      e.length = r.u32();
+    } else {
+      e.length = r.u16();
+    }
+  } else {
+    e.length = r.u32();
+  }
+  return e;
+}
+
+void skip_item_undefined(ByteReader& r);
+
+// Skip an undefined-length sequence body (until sequence delimiter).
+void skip_sequence(ByteReader& r) {
+  while (!r.atend() && r.ok) {
+    Element e = read_element(r);
+    if (e.group == 0xFFFE && e.elem == 0xE0DD) return;  // seq delimiter
+    if (e.group == 0xFFFE && e.elem == 0xE000) {        // item
+      if (e.length == kUndefined)
+        skip_item_undefined(r);
+      else
+        r.pos += e.length;
+    } else {  // malformed; bail out of the sequence
+      if (e.length != kUndefined) r.pos += e.length;
+      return;
+    }
+  }
+}
+
+void skip_item_undefined(ByteReader& r) {
+  while (!r.atend() && r.ok) {
+    Element e = read_element(r);
+    if (e.group == 0xFFFE && e.elem == 0xE00D) return;  // item delimiter
+    if (e.length == kUndefined)
+      skip_sequence(r);  // nested undefined-length sequence
+    else
+      r.pos += e.length;
+  }
+}
+
+using Tag = uint32_t;
+constexpr Tag tag(uint16_t g, uint16_t e) { return ((Tag)g << 16) | e; }
+
+struct DataSet {
+  std::map<Tag, std::vector<uint8_t>> meta;
+  const uint8_t* pixel_data = nullptr;
+  size_t pixel_len = 0;
+};
+
+bool parse_dataset(const uint8_t* buf, size_t len, bool explicit_vr,
+                   DataSet* out) {
+  ByteReader r{buf, len, 0, explicit_vr};
+  while (!r.atend()) {
+    Element e = read_element(r);
+    if (!r.ok) { set_error("truncated DICOM element structure"); return false; }
+    if (e.group == 0x7FE0 && e.elem == 0x0010) {
+      if (e.length == kUndefined) {
+        set_error("encapsulated (compressed) PixelData is not supported");
+        return false;
+      }
+      // clamp a declared length that overruns the file (Python's slice
+      // semantics in dicomlite.py:142); the rows*cols sufficiency check
+      // below decides whether the slice is still decodable
+      size_t avail = len - r.pos;
+      out->pixel_data = buf + r.pos;
+      out->pixel_len = e.length < avail ? e.length : avail;
+      r.pos += out->pixel_len;
+      continue;
+    }
+    if (e.length == kUndefined) { skip_sequence(r); continue; }
+    if (e.vr[0] == 'S' && e.vr[1] == 'Q') { r.pos += e.length; continue; }
+    if (e.group == 0xFFFE) { r.pos += e.length; continue; }
+    if (e.length > len - r.pos) {
+      char msg[96];
+      std::snprintf(msg, sizeof msg, "element (%04x,%04x) length %u overruns file",
+                    e.group, e.elem, e.length);
+      set_error(msg);
+      return false;
+    }
+    out->meta[tag(e.group, e.elem)].assign(buf + r.pos, buf + r.pos + e.length);
+    r.pos += e.length;
+  }
+  return true;
+}
+
+std::string ascii_value(const std::vector<uint8_t>& v) {
+  std::string s(v.begin(), v.end());
+  while (!s.empty() && (s.back() == '\0' || s.back() == ' ')) s.pop_back();
+  size_t i = 0;
+  while (i < s.size() && (s[i] == '\0' || s[i] == ' ')) ++i;
+  return s.substr(i);
+}
+
+bool meta_int(const DataSet& ds, Tag t, long* out) {
+  auto it = ds.meta.find(t);
+  if (it == ds.meta.end()) return false;
+  const auto& v = it->second;
+  if (v.size() == 2) { *out = v[0] | (v[1] << 8); return true; }
+  if (v.size() == 4) {
+    *out = (long)((uint32_t)v[0] | ((uint32_t)v[1] << 8) |
+                  ((uint32_t)v[2] << 16) | ((uint32_t)v[3] << 24));
+    return true;
+  }
+  try {
+    *out = std::stol(ascii_value(v));
+    return true;
+  } catch (...) { return false; }
+}
+
+double meta_float(const DataSet& ds, Tag t, double dflt) {
+  auto it = ds.meta.find(t);
+  if (it == ds.meta.end()) return dflt;
+  try { return std::stod(ascii_value(it->second)); } catch (...) { return dflt; }
+}
+
+bool read_file(const char* path, std::vector<uint8_t>* out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) { set_error(std::string("cannot open ") + path); return false; }
+  std::fseek(f, 0, SEEK_END);
+  long n = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (n < 0) { std::fclose(f); set_error("ftell failed"); return false; }
+  out->resize((size_t)n);
+  size_t got = n ? std::fread(out->data(), 1, (size_t)n, f) : 0;
+  std::fclose(f);
+  if (got != (size_t)n) { set_error("short read"); return false; }
+  return true;
+}
+
+// Decode one slice into `pixels` (resized), returning rows/cols.
+// Mirrors read_dicom() in dicomlite.py.
+bool decode_dicom(const uint8_t* raw, size_t raw_len,
+                  std::vector<float>* pixels, int* rows_out, int* cols_out) {
+  const uint8_t* body = raw;
+  size_t body_len = raw_len;
+  std::string transfer_syntax = "1.2.840.10008.1.2.1";
+
+  if (raw_len >= 132 && std::memcmp(raw + 128, "DICM", 4) == 0) {
+    // file meta group is always explicit VR LE
+    ByteReader r{raw, raw_len, 132, true};
+    size_t meta_end = raw_len;
+    bool first = true;
+    while (r.pos < meta_end && !r.atend()) {
+      size_t mark = r.pos;
+      Element e = read_element(r);
+      if (!r.ok) break;
+      if (e.group != 0x0002) { r.pos = mark; break; }
+      if (e.length > raw_len - r.pos) { set_error("file meta overruns"); return false; }
+      std::vector<uint8_t> value(raw + r.pos, raw + r.pos + e.length);
+      r.pos += e.length;
+      if (first && e.group == 0x0002 && e.elem == 0x0000 && value.size() == 4) {
+        uint32_t glen = (uint32_t)value[0] | ((uint32_t)value[1] << 8) |
+                        ((uint32_t)value[2] << 16) | ((uint32_t)value[3] << 24);
+        meta_end = r.pos + glen;
+      }
+      if (e.group == 0x0002 && e.elem == 0x0010)
+        transfer_syntax = ascii_value(value);
+      first = false;
+    }
+    body = raw + r.pos;
+    body_len = raw_len - r.pos;
+  } else if (raw_len >= 4 && std::memcmp(raw, "DICM", 4) == 0) {
+    body = raw + 4;
+    body_len = raw_len - 4;
+  }
+
+  bool explicit_vr;
+  if (transfer_syntax == "1.2.840.10008.1.2.1") explicit_vr = true;
+  else if (transfer_syntax == "1.2.840.10008.1.2") explicit_vr = false;
+  else { set_error("unsupported transfer syntax: " + transfer_syntax); return false; }
+
+  DataSet ds;
+  if (!parse_dataset(body, body_len, explicit_vr, &ds)) return false;
+
+  long rows = 0, cols = 0;
+  if (!meta_int(ds, tag(0x0028, 0x0010), &rows) ||
+      !meta_int(ds, tag(0x0028, 0x0011), &cols) || !ds.pixel_data) {
+    set_error("missing Rows/Columns/PixelData");
+    return false;
+  }
+  long bits = 16, pixrep = 0, samples = 1;
+  meta_int(ds, tag(0x0028, 0x0100), &bits);
+  meta_int(ds, tag(0x0028, 0x0103), &pixrep);
+  meta_int(ds, tag(0x0028, 0x0002), &samples);
+  if (samples != 1) { set_error("only monochrome supported"); return false; }
+  if (bits != 8 && bits != 16) { set_error("unsupported BitsAllocated"); return false; }
+  bool is_signed = pixrep == 1;
+
+  size_t expected = (size_t)rows * cols * (bits / 8);
+  if (ds.pixel_len < expected) { set_error("PixelData truncated"); return false; }
+
+  double slope = meta_float(ds, tag(0x0028, 0x1053), 1.0);
+  double intercept = meta_float(ds, tag(0x0028, 0x1052), 0.0);
+  float fslope = (float)slope, fintercept = (float)intercept;
+
+  pixels->resize((size_t)rows * cols);
+  const uint8_t* p = ds.pixel_data;
+  float* dst = pixels->data();
+  size_t n = (size_t)rows * cols;
+  if (bits == 16 && !is_signed) {
+    for (size_t i = 0; i < n; ++i)
+      dst[i] = (float)(uint16_t)(p[2 * i] | (p[2 * i + 1] << 8)) * fslope + fintercept;
+  } else if (bits == 16) {
+    for (size_t i = 0; i < n; ++i)
+      dst[i] = (float)(int16_t)(p[2 * i] | (p[2 * i + 1] << 8)) * fslope + fintercept;
+  } else if (!is_signed) {
+    for (size_t i = 0; i < n; ++i) dst[i] = (float)p[i] * fslope + fintercept;
+  } else {
+    for (size_t i = 0; i < n; ++i) dst[i] = (float)(int8_t)p[i] * fslope + fintercept;
+  }
+  *rows_out = (int)rows;
+  *cols_out = (int)cols;
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Baseline JPEG encoder (grayscale)
+// ---------------------------------------------------------------------------
+
+const uint8_t kZigzag[64] = {
+    0,  1,  8,  16, 9,  2,  3,  10, 17, 24, 32, 25, 18, 11, 4,  5,
+    12, 19, 26, 33, 40, 48, 41, 34, 27, 20, 13, 6,  7,  14, 21, 28,
+    35, 42, 49, 56, 57, 50, 43, 36, 29, 22, 15, 23, 30, 37, 44, 51,
+    58, 59, 52, 45, 38, 31, 39, 46, 53, 60, 61, 54, 47, 55, 62, 63};
+
+// ITU-T T.81 Table K.1 (luminance quantization)
+const int kQuantLum[64] = {
+    16, 11, 10, 16, 24,  40,  51,  61,  12, 12, 14, 19, 26,  58,  60,  55,
+    14, 13, 16, 24, 40,  57,  69,  56,  14, 17, 22, 29, 51,  87,  80,  62,
+    18, 22, 37, 56, 68,  109, 103, 77,  24, 35, 55, 64, 81,  104, 113, 92,
+    49, 64, 78, 87, 103, 121, 120, 101, 72, 92, 95, 98, 112, 100, 103, 99};
+
+// ITU-T T.81 Annex K.3 standard luminance Huffman tables
+const uint8_t kDcBits[17] = {0, 0, 1, 5, 1, 1, 1, 1, 1, 1, 0, 0, 0, 0, 0, 0, 0};
+const uint8_t kDcVals[12] = {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11};
+const uint8_t kAcBits[17] = {0, 0, 2, 1, 3, 3, 2, 4, 3, 5, 5, 4, 4, 0, 0, 1, 0x7d};
+const uint8_t kAcVals[162] = {
+    0x01, 0x02, 0x03, 0x00, 0x04, 0x11, 0x05, 0x12, 0x21, 0x31, 0x41, 0x06,
+    0x13, 0x51, 0x61, 0x07, 0x22, 0x71, 0x14, 0x32, 0x81, 0x91, 0xa1, 0x08,
+    0x23, 0x42, 0xb1, 0xc1, 0x15, 0x52, 0xd1, 0xf0, 0x24, 0x33, 0x62, 0x72,
+    0x82, 0x09, 0x0a, 0x16, 0x17, 0x18, 0x19, 0x1a, 0x25, 0x26, 0x27, 0x28,
+    0x29, 0x2a, 0x34, 0x35, 0x36, 0x37, 0x38, 0x39, 0x3a, 0x43, 0x44, 0x45,
+    0x46, 0x47, 0x48, 0x49, 0x4a, 0x53, 0x54, 0x55, 0x56, 0x57, 0x58, 0x59,
+    0x5a, 0x63, 0x64, 0x65, 0x66, 0x67, 0x68, 0x69, 0x6a, 0x73, 0x74, 0x75,
+    0x76, 0x77, 0x78, 0x79, 0x7a, 0x83, 0x84, 0x85, 0x86, 0x87, 0x88, 0x89,
+    0x8a, 0x92, 0x93, 0x94, 0x95, 0x96, 0x97, 0x98, 0x99, 0x9a, 0xa2, 0xa3,
+    0xa4, 0xa5, 0xa6, 0xa7, 0xa8, 0xa9, 0xaa, 0xb2, 0xb3, 0xb4, 0xb5, 0xb6,
+    0xb7, 0xb8, 0xb9, 0xba, 0xc2, 0xc3, 0xc4, 0xc5, 0xc6, 0xc7, 0xc8, 0xc9,
+    0xca, 0xd2, 0xd3, 0xd4, 0xd5, 0xd6, 0xd7, 0xd8, 0xd9, 0xda, 0xe1, 0xe2,
+    0xe3, 0xe4, 0xe5, 0xe6, 0xe7, 0xe8, 0xe9, 0xea, 0xf1, 0xf2, 0xf3, 0xf4,
+    0xf5, 0xf6, 0xf7, 0xf8, 0xf9, 0xfa};
+
+struct HuffCode { uint16_t code; uint8_t len; };
+
+// Canonical Huffman code assignment (T.81 Annex C).
+void build_codes(const uint8_t bits[17], const uint8_t* vals, int nvals,
+                 HuffCode table[256]) {
+  int code = 0, k = 0;
+  for (int len = 1; len <= 16; ++len) {
+    for (int i = 0; i < bits[len]; ++i) {
+      table[vals[k]] = {(uint16_t)code, (uint8_t)len};
+      ++code;
+      ++k;
+    }
+    code <<= 1;
+  }
+  (void)nvals;
+}
+
+struct BitWriter {
+  std::vector<uint8_t>& out;
+  uint32_t acc = 0;
+  int nbits = 0;
+
+  void put(uint32_t bits, int len) {
+    acc = (acc << len) | (bits & ((1u << len) - 1));
+    nbits += len;
+    while (nbits >= 8) {
+      uint8_t b = (uint8_t)(acc >> (nbits - 8));
+      out.push_back(b);
+      if (b == 0xFF) out.push_back(0x00);  // byte stuffing
+      nbits -= 8;
+    }
+  }
+  void flush() {
+    if (nbits > 0) put(0x7F, 8 - nbits);  // pad with 1s
+  }
+};
+
+void put_marker_u16(std::vector<uint8_t>& o, uint16_t v) {
+  o.push_back((uint8_t)(v >> 8));
+  o.push_back((uint8_t)(v & 0xFF));
+}
+
+int bit_category(int v) {
+  int a = v < 0 ? -v : v;
+  int n = 0;
+  while (a) { ++n; a >>= 1; }
+  return n;
+}
+
+// Plain separable float DCT-II with precomputed basis; clear and fast enough
+// for host-side export (encoding overlaps device compute in the runner).
+struct DctBasis {
+  float c[8][8];
+  DctBasis() {
+    for (int k = 0; k < 8; ++k)
+      for (int x = 0; x < 8; ++x)
+        c[k][x] = std::cos((2 * x + 1) * k * 3.14159265358979323846 / 16.0) *
+                  (k == 0 ? std::sqrt(0.125) : 0.5);
+  }
+};
+
+long jpeg_encode_gray(const uint8_t* pix, int h, int w, int quality,
+                      uint8_t* out, long cap) {
+  if (h <= 0 || w <= 0 || h > 65500 || w > 65500) { set_error("bad dims"); return -1; }
+  quality = std::min(100, std::max(1, quality));
+  int scale = quality < 50 ? 5000 / quality : 200 - 2 * quality;
+  uint8_t qt[64];
+  for (int i = 0; i < 64; ++i) {
+    int v = (kQuantLum[i] * scale + 50) / 100;
+    qt[i] = (uint8_t)std::min(255, std::max(1, v));
+  }
+
+  // magic statics: thread-safe one-time init (encoder runs on a thread pool)
+  struct HuffTables {
+    HuffCode dc[256] = {}, ac[256] = {};
+    HuffTables() {
+      build_codes(kDcBits, kDcVals, 12, dc);
+      build_codes(kAcBits, kAcVals, 162, ac);
+    }
+  };
+  static const HuffTables huff;
+  const HuffCode* dc_table = huff.dc;
+  const HuffCode* ac_table = huff.ac;
+  static const DctBasis basis;
+
+  std::vector<uint8_t> o;
+  o.reserve((size_t)h * w / 4 + 1024);
+
+  // SOI, APP0/JFIF
+  put_marker_u16(o, 0xFFD8);
+  put_marker_u16(o, 0xFFE0);
+  put_marker_u16(o, 16);
+  const char jfif[] = "JFIF";
+  o.insert(o.end(), jfif, jfif + 5);
+  o.push_back(1); o.push_back(1);       // version 1.1
+  o.push_back(0);                        // aspect-ratio units
+  put_marker_u16(o, 1); put_marker_u16(o, 1);
+  o.push_back(0); o.push_back(0);       // no thumbnail
+
+  // DQT (zigzag order)
+  put_marker_u16(o, 0xFFDB);
+  put_marker_u16(o, 2 + 1 + 64);
+  o.push_back(0x00);
+  for (int i = 0; i < 64; ++i) o.push_back(qt[kZigzag[i]]);
+
+  // SOF0: 8-bit, 1 component
+  put_marker_u16(o, 0xFFC0);
+  put_marker_u16(o, 2 + 6 + 3);
+  o.push_back(8);
+  put_marker_u16(o, (uint16_t)h);
+  put_marker_u16(o, (uint16_t)w);
+  o.push_back(1);
+  o.push_back(1); o.push_back(0x11); o.push_back(0);
+
+  // DHT: DC then AC
+  put_marker_u16(o, 0xFFC4);
+  put_marker_u16(o, (uint16_t)(2 + 1 + 16 + 12));
+  o.push_back(0x00);
+  for (int i = 1; i <= 16; ++i) o.push_back(kDcBits[i]);
+  o.insert(o.end(), kDcVals, kDcVals + 12);
+  put_marker_u16(o, 0xFFC4);
+  put_marker_u16(o, (uint16_t)(2 + 1 + 16 + 162));
+  o.push_back(0x10);
+  for (int i = 1; i <= 16; ++i) o.push_back(kAcBits[i]);
+  o.insert(o.end(), kAcVals, kAcVals + 162);
+
+  // SOS
+  put_marker_u16(o, 0xFFDA);
+  put_marker_u16(o, 2 + 1 + 2 + 3);
+  o.push_back(1);
+  o.push_back(1); o.push_back(0x00);
+  o.push_back(0); o.push_back(63); o.push_back(0);
+
+  BitWriter bw{o};
+  int prev_dc = 0;
+  float block[64], tmp[64], coef[64];
+
+  for (int by = 0; by < h; by += 8) {
+    for (int bx = 0; bx < w; bx += 8) {
+      // fetch 8x8 block, edge-replicated, level-shifted
+      for (int y = 0; y < 8; ++y) {
+        int sy = std::min(by + y, h - 1);
+        for (int x = 0; x < 8; ++x) {
+          int sx = std::min(bx + x, w - 1);
+          block[y * 8 + x] = (float)pix[(size_t)sy * w + sx] - 128.0f;
+        }
+      }
+      // rows then columns
+      for (int y = 0; y < 8; ++y)
+        for (int k = 0; k < 8; ++k) {
+          float s = 0;
+          for (int x = 0; x < 8; ++x) s += block[y * 8 + x] * basis.c[k][x];
+          tmp[y * 8 + k] = s;
+        }
+      for (int k = 0; k < 8; ++k)
+        for (int u = 0; u < 8; ++u) {
+          float s = 0;
+          for (int y = 0; y < 8; ++y) s += tmp[y * 8 + k] * basis.c[u][y];
+          coef[u * 8 + k] = s;
+        }
+
+      int q[64];
+      for (int i = 0; i < 64; ++i) {
+        float v = coef[kZigzag[i]] / (float)qt[kZigzag[i]];
+        q[i] = (int)std::lround(v);
+      }
+
+      // DC
+      int diff = q[0] - prev_dc;
+      prev_dc = q[0];
+      int s = bit_category(diff);
+      bw.put(dc_table[s].code, dc_table[s].len);
+      if (s) bw.put(diff < 0 ? (uint32_t)(diff + (1 << s) - 1) : (uint32_t)diff, s);
+
+      // AC with run-length, ZRL, EOB
+      int run = 0;
+      for (int i = 1; i < 64; ++i) {
+        if (q[i] == 0) { ++run; continue; }
+        while (run > 15) {
+          bw.put(ac_table[0xF0].code, ac_table[0xF0].len);
+          run -= 16;
+        }
+        int sz = bit_category(q[i]);
+        int sym = (run << 4) | sz;
+        bw.put(ac_table[sym].code, ac_table[sym].len);
+        bw.put(q[i] < 0 ? (uint32_t)(q[i] + (1 << sz) - 1) : (uint32_t)q[i], sz);
+        run = 0;
+      }
+      if (run > 0) bw.put(ac_table[0x00].code, ac_table[0x00].len);
+    }
+  }
+  bw.flush();
+  put_marker_u16(o, 0xFFD9);
+
+  if ((long)o.size() > cap) { set_error("output buffer too small"); return -1; }
+  std::memcpy(out, o.data(), o.size());
+  return (long)o.size();
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+NM03_EXPORT const char* nm03_last_error() { return g_error.c_str(); }
+
+NM03_EXPORT int nm03_version() { return 1; }
+
+// Decode one slice. `out` must hold max_elems floats; rows*cols must fit.
+// Returns 0 on success.
+NM03_EXPORT int nm03_dicom_read(const char* path, float* out, long max_elems,
+                                int* rows, int* cols) {
+  std::vector<uint8_t> raw;
+  if (!read_file(path, &raw)) return 1;
+  std::vector<float> pixels;
+  if (!decode_dicom(raw.data(), raw.size(), &pixels, rows, cols)) return 2;
+  if ((long)pixels.size() > max_elems) { set_error("output buffer too small"); return 3; }
+  std::memcpy(out, pixels.data(), pixels.size() * sizeof(float));
+  return 0;
+}
+
+// Thread-pool batch decode into a padded canvas arena.
+//
+// This is the native core of the host->HBM prefetch path: the TPU-side
+// replacement for the reference's OpenMP parallel-for over a slice batch
+// (main_parallel.cpp:336) applied where it belongs on TPU — the host decode
+// stage, so the device sees one contiguous (n, canvas_h, canvas_w) float32
+// arena ready for device_put.
+//
+//   paths    — n C strings
+//   out      — n * canvas_h * canvas_w floats, zero-padded per slot
+//   dims     — n * 2 ints (rows, cols); untouched slots stay as passed in
+//   ok       — n flags: 1 decoded + guards passed, 0 failed (per-slice
+//              catch-and-continue, main_sequential.cpp:267-271)
+//   err      — optional (may be NULL) n codes: 0 ok, 1 read failed,
+//              2 parse failed, 3 below min_dim, 4 exceeds canvas
+//   min_dim  — reject slices smaller than this (main_sequential.cpp:189-192)
+// Returns the number of successfully decoded slices.
+NM03_EXPORT int nm03_load_batch(const char** paths, int n, int canvas_h,
+                                int canvas_w, int min_dim, int threads,
+                                float* out, int* dims, unsigned char* ok,
+                                int* err) {
+  if (n <= 0) return 0;
+  threads = std::max(1, std::min(threads, n));
+  std::atomic<int> next(0), good(0);
+  auto worker = [&]() {
+    std::vector<float> pixels;
+    for (;;) {
+      int i = next.fetch_add(1);
+      if (i >= n) return;
+      ok[i] = 0;
+      auto fail = [&](int code) { if (err) err[i] = code; };
+      int rows = 0, cols = 0;
+      std::vector<uint8_t> raw;
+      if (!read_file(paths[i], &raw)) { fail(1); continue; }
+      if (!decode_dicom(raw.data(), raw.size(), &pixels, &rows, &cols)) {
+        fail(2);
+        continue;
+      }
+      if (rows < min_dim || cols < min_dim) { fail(3); continue; }
+      if (rows > canvas_h || cols > canvas_w) { fail(4); continue; }
+      if (err) err[i] = 0;
+      float* slot = out + (size_t)i * canvas_h * canvas_w;
+      std::memset(slot, 0, (size_t)canvas_h * canvas_w * sizeof(float));
+      for (int y = 0; y < rows; ++y)
+        std::memcpy(slot + (size_t)y * canvas_w, pixels.data() + (size_t)y * cols,
+                    (size_t)cols * sizeof(float));
+      dims[2 * i] = rows;
+      dims[2 * i + 1] = cols;
+      ok[i] = 1;
+      good.fetch_add(1);
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int t = 0; t < threads; ++t) pool.emplace_back(worker);
+  for (auto& t : pool) t.join();
+  return good.load();
+}
+
+// Baseline JPEG (grayscale). Returns bytes written, or -1 on error.
+NM03_EXPORT long nm03_jpeg_encode_gray(const unsigned char* pixels, int h,
+                                       int w, int quality, unsigned char* out,
+                                       long out_capacity) {
+  return jpeg_encode_gray(pixels, h, w, quality, out, out_capacity);
+}

@@ -52,6 +52,12 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--resume", action="store_true", help="skip slices already in the manifest")
     parser.add_argument("--verbose", action="store_true", help="enable INFO logging")
     parser.add_argument(
+        "--no-native",
+        action="store_true",
+        help="force the pure-Python decode/encode path even when the C++ "
+        "runtime (csrc/) is buildable",
+    )
+    parser.add_argument(
         "--results-json",
         default=None,
         help="write a timing/success results JSON (in-tree replacement for the "
@@ -118,6 +124,12 @@ def add_batch_args(parser: argparse.ArgumentParser) -> None:
     )
     parser.add_argument("--io-workers", type=int, default=d.io_workers)
     parser.add_argument("--prefetch-depth", type=int, default=d.prefetch_depth)
+
+
+def apply_native_flag(args: argparse.Namespace) -> None:
+    """--no-native disables the whole C++ layer (decode AND JPEG encode)."""
+    if getattr(args, "no_native", False):
+        os.environ["NM03_NO_NATIVE"] = "1"
 
 
 def apply_device_env(device: str) -> None:

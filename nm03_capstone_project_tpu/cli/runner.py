@@ -51,6 +51,12 @@ from nm03_capstone_project_tpu.utils.timing import Timer
 log = get_logger("runner")
 
 
+def _native_available() -> bool:
+    from nm03_capstone_project_tpu import native
+
+    return native.available()
+
+
 @functools.lru_cache(maxsize=8)
 def _compiled_slice_fn(cfg: PipelineConfig):
     """jit of pipeline + on-device render for one slice."""
@@ -263,6 +269,7 @@ class CohortProcessor:
         batches = [files[i : i + bs] for i in range(0, len(files), bs)]
         export_futures = []
         expected_stems: List[str] = []
+        use_native = self.batch_cfg.use_native and _native_available()
         with cf.ThreadPoolExecutor(self.batch_cfg.io_workers) as io_pool:
             # decode runs `prefetch_depth` batches ahead of device compute
             depth = max(self.batch_cfg.prefetch_depth, 1)
@@ -270,9 +277,17 @@ class CohortProcessor:
 
             def prefetch(idx: int):
                 if idx < len(batches) and idx not in decode_futures:
-                    decode_futures[idx] = [
-                        io_pool.submit(self._read_slice, f) for f in batches[idx]
-                    ]
+                    if use_native:
+                        # one future per batch: the C++ thread pool decodes
+                        # + pads the whole batch (csrc nm03_load_batch)
+                        decode_futures[idx] = io_pool.submit(
+                            self._decode_batch_native, batches[idx], bs
+                        )
+                    else:
+                        decode_futures[idx] = [
+                            io_pool.submit(self._read_slice, f)
+                            for f in batches[idx]
+                        ]
 
             for i in range(depth):
                 prefetch(i)
@@ -281,6 +296,10 @@ class CohortProcessor:
                 """Decode + pad batches; device staging handled downstream."""
                 for bi, batch_files in enumerate(batches):
                     prefetch(bi + depth)
+                    if use_native:
+                        with self.timer.section("decode"):
+                            yield decode_futures.pop(bi).result()
+                        continue
                     with self.timer.section("decode"):
                         decoded = [f.result() for f in decode_futures.pop(bi)]
                     stems = [f.stem for f in batch_files]
@@ -329,6 +348,50 @@ class CohortProcessor:
                 self.manifest.record(patient_id, s, STATUS_FAILED)
                 failed.append(s)
         return ok, failed
+
+    def _decode_batch_native(self, batch_files: List[Path], pad_to: int) -> dict:
+        """Decode one batch via the C++ thread-pool loader.
+
+        Same output contract as the Python path in ``staged()``: good slices
+        compacted into the leading rows of a fixed (pad_to, canvas, canvas)
+        stack, failed stems listed in ``bad``.
+        """
+        from nm03_capstone_project_tpu import native
+
+        # `prefetch_depth` batches decode concurrently; split the io_workers
+        # budget between them instead of spawning depth x io_workers threads
+        depth = max(self.batch_cfg.prefetch_depth, 1)
+        threads = max(1, self.batch_cfg.io_workers // depth)
+        pixels, dims, okf, errs = native.load_batch_native(
+            batch_files,
+            canvas=self.cfg.canvas,
+            min_dim=self.cfg.min_dim,
+            threads=threads,
+        )
+        stems = [f.stem for f in batch_files]
+        bad = [s for s, o in zip(stems, okf) if not o]
+        for f, o, e in zip(batch_files, okf, errs):
+            if not o:
+                log.warning(
+                    "failed to decode %s: %s",
+                    f.name,
+                    native.BATCH_ERRORS.get(int(e), f"error {e}"),
+                )
+        idx = np.flatnonzero(okf)
+        if idx.size == 0:
+            return {"stems": [], "bad": bad, "pixels": None, "dims": None}
+        if idx.size == pad_to:  # full all-ok batch: arena is already in shape
+            return {"stems": stems, "bad": [], "pixels": pixels, "dims": dims}
+        out = np.zeros((pad_to, self.cfg.canvas, self.cfg.canvas), np.float32)
+        out_dims = np.full((pad_to, 2), self.cfg.min_dim, np.int32)
+        out[: idx.size] = pixels[idx]
+        out_dims[: idx.size] = dims[idx]
+        return {
+            "stems": [stems[i] for i in idx],
+            "bad": bad,
+            "pixels": out,
+            "dims": out_dims,
+        }
 
     # -- padding helpers ---------------------------------------------------
 

@@ -41,11 +41,13 @@ def run(args: argparse.Namespace, mode: str) -> int:
     from nm03_capstone_project_tpu.utils.timing import write_results_json
 
     configure_reporting(verbose=args.verbose)
+    common.apply_native_flag(args)
     cfg = common.pipeline_config_from_args(args)
     batch_cfg = BatchConfig(
         batch_size=getattr(args, "batch_size", BatchConfig.batch_size),
         io_workers=getattr(args, "io_workers", BatchConfig.io_workers),
         prefetch_depth=getattr(args, "prefetch_depth", BatchConfig.prefetch_depth),
+        use_native=not getattr(args, "no_native", False),
     )
     try:
         base = common.resolve_base_path(args, tmp_root=Path(args.output))

@@ -53,6 +53,7 @@ def run(args: argparse.Namespace) -> int:
     from nm03_capstone_project_tpu.utils.reporter import configure_reporting
 
     configure_reporting(verbose=args.verbose)
+    common.apply_native_flag(args)
     cfg = common.pipeline_config_from_args(args)
 
     if args.input:

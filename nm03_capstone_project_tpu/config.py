@@ -98,6 +98,7 @@ class BatchConfig:
     batch_size: int = 25
     prefetch_depth: int = 2  # host->device double buffering
     io_workers: int = 8  # DICOM decode thread pool
+    use_native: bool = True  # C++ batch decoder (csrc/) when buildable
 
 
 DEFAULT_CONFIG = PipelineConfig()

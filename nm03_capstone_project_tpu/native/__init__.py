@@ -1,0 +1,233 @@
+"""ctypes bindings for the native C++ runtime (csrc/nm03native.cpp).
+
+The reference's host-side runtime — DICOM import, batch-parallel decode,
+JPEG export — is native C++ (FAST/Qt/OpenMP). This package binds the
+TPU framework's own native layer the same way the rest of the system is
+built: no pybind11, just a C ABI loaded via ctypes.
+
+The shared library is compiled on first use with g++ (cached under
+``csrc/build/``, keyed by a source hash) or can be prebuilt with
+``cmake csrc && make``. Every entry point has a pure-Python fallback
+(data.dicomlite, PIL) so the framework still runs where no C++ toolchain
+exists; ``available()`` says which path is active, and
+``NM03_NO_NATIVE=1`` forces the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from nm03_capstone_project_tpu.utils.reporter import get_logger
+
+_log = get_logger("native")
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+_SRC = _REPO_ROOT / "csrc" / "nm03native.cpp"
+_BUILD_DIR = _REPO_ROOT / "csrc" / "build"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _source_hash() -> str:
+    return hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+
+
+def _compile() -> Optional[Path]:
+    """Build the shared library with g++; returns its path or None."""
+    if not _SRC.exists():
+        _log.warning("native source %s not found", _SRC)
+        return None
+    out = _BUILD_DIR / f"libnm03native-{_source_hash()}.so"
+    if out.exists():
+        return out
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    # compile to a process-private name, then publish atomically so a
+    # concurrent process never CDLL-loads a half-written library
+    tmp = out.with_name(f".{out.name}.{os.getpid()}.tmp")
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        str(_SRC), "-o", str(tmp),
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=180
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        _log.warning("native build failed to run: %s", e)
+        return None
+    if proc.returncode != 0:
+        _log.warning("native build failed:\n%s", proc.stderr[-2000:])
+        tmp.unlink(missing_ok=True)
+        return None
+    os.replace(tmp, out)
+    # drop stale builds of older source revisions
+    for old in _BUILD_DIR.glob("libnm03native-*.so"):
+        if old != out:
+            try:
+                old.unlink()
+            except OSError:
+                pass
+    return out
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    with _lock:
+        if _load_attempted:
+            return _lib
+        _load_attempted = True
+        if os.environ.get("NM03_NO_NATIVE") == "1":
+            _log.info("native layer disabled via NM03_NO_NATIVE")
+            return None
+        path = _compile()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(str(path))
+        except OSError as e:
+            _log.warning("failed to load %s: %s", path, e)
+            return None
+
+        lib.nm03_last_error.restype = ctypes.c_char_p
+        lib.nm03_version.restype = ctypes.c_int
+        lib.nm03_dicom_read.restype = ctypes.c_int
+        lib.nm03_dicom_read.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.nm03_load_batch.restype = ctypes.c_int
+        lib.nm03_load_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_ubyte),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.nm03_jpeg_encode_gray.restype = ctypes.c_long
+        lib.nm03_jpeg_encode_gray.argtypes = [
+            ctypes.POINTER(ctypes.c_ubyte),
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_ubyte),
+            ctypes.c_long,
+        ]
+        _lib = lib
+        _log.info("native layer loaded (%s)", path.name)
+        return _lib
+
+
+def available() -> bool:
+    """True when the native shared library is loaded (or loadable)."""
+    return _load() is not None
+
+
+def last_error() -> str:
+    lib = _load()
+    return lib.nm03_last_error().decode() if lib else "native layer unavailable"
+
+
+def read_dicom_native(path: str | os.PathLike,
+                      max_dim: int = 4096) -> np.ndarray:
+    """Decode one DICOM slice via the C++ parser → float32 (rows, cols).
+
+    Raises ValueError on parse failure (same failure surface as
+    data.dicomlite.read_dicom).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    buf = np.empty(max_dim * max_dim, np.float32)
+    rows = ctypes.c_int(0)
+    cols = ctypes.c_int(0)
+    rc = lib.nm03_dicom_read(
+        os.fspath(path).encode(),
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        buf.size,
+        ctypes.byref(rows),
+        ctypes.byref(cols),
+    )
+    if rc != 0:
+        raise ValueError(f"native DICOM decode failed: {last_error()}")
+    return buf[: rows.value * cols.value].reshape(rows.value, cols.value).copy()
+
+
+# error codes returned per-slice by nm03_load_batch
+BATCH_ERRORS = {
+    0: "ok",
+    1: "cannot read file",
+    2: "DICOM parse failed",
+    3: "image dimensions too small",
+    4: "slice exceeds canvas; raise --canvas",
+}
+
+
+def load_batch_native(
+    paths: Sequence[str | os.PathLike],
+    canvas: int,
+    min_dim: int,
+    threads: int = 8,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Threaded decode of a slice batch into a padded canvas arena.
+
+    Returns (pixels, dims, ok, err): pixels (n, canvas, canvas) float32
+    zero-padded, dims (n, 2) int32 rows/cols, ok (n,) bool, err (n,) int32
+    per-slice failure codes (see BATCH_ERRORS). Failed slices have ok=False
+    and keep min_dim dims + a zero slot — the contract _pad_stack/_read_slice
+    implement in Python (cli/runner.py).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    n = len(paths)
+    pixels = np.zeros((n, canvas, canvas), np.float32)
+    dims = np.full((n, 2), min_dim, np.int32)
+    ok = np.zeros(n, np.uint8)
+    err = np.zeros(n, np.int32)
+    if n == 0:
+        return pixels, dims, ok.astype(bool), err
+    encoded = [os.fspath(p).encode() for p in paths]
+    arr = (ctypes.c_char_p * n)(*encoded)
+    lib.nm03_load_batch(
+        arr, n, canvas, canvas, min_dim, threads,
+        pixels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        dims.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        err.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+    )
+    return pixels, dims, ok.astype(bool), err
+
+
+def encode_jpeg_gray(image: np.ndarray, quality: int = 90) -> bytes:
+    """Encode a uint8 grayscale (H, W) array as baseline JPEG bytes."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    arr = np.ascontiguousarray(image)
+    if arr.dtype != np.uint8 or arr.ndim != 2:
+        raise ValueError(f"expected 2D uint8 image, got {arr.dtype} {arr.shape}")
+    h, w = arr.shape
+    cap = h * w * 2 + 4096  # worst case far below uncompressed x2 + headers
+    out = np.empty(cap, np.uint8)
+    n = lib.nm03_jpeg_encode_gray(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        h, w, quality,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        cap,
+    )
+    if n < 0:
+        raise ValueError(f"native JPEG encode failed: {last_error()}")
+    return out[:n].tobytes()

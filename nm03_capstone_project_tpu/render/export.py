@@ -24,13 +24,25 @@ _log = get_logger("export")
 
 
 def save_jpeg(image: np.ndarray, path: str | os.PathLike, quality: int = 90) -> None:
-    """Write a uint8 grayscale (H, W) array as JPEG."""
-    from PIL import Image
+    """Write a uint8 grayscale (H, W) array as JPEG.
 
+    Prefers the native C++ encoder (csrc/nm03native.cpp — the counterpart of
+    the reference's native ImageFileExporter, main_sequential.cpp:61-73);
+    falls back to PIL when no C++ toolchain is available.
+    """
     arr = np.asarray(image)
     if arr.dtype != np.uint8:
         raise ValueError(f"expected uint8 image, got {arr.dtype}")
     Path(path).parent.mkdir(parents=True, exist_ok=True)
+
+    from nm03_capstone_project_tpu import native
+
+    if arr.ndim == 2 and native.available():
+        Path(path).write_bytes(native.encode_jpeg_gray(arr, quality))
+        return
+
+    from PIL import Image
+
     Image.fromarray(arr, mode="L").save(path, quality=quality)
 
 

@@ -1,0 +1,202 @@
+"""Native C++ runtime layer vs its Python reference implementations.
+
+The contract: the C++ DICOM parser (csrc/nm03native.cpp) decodes exactly what
+data.dicomlite decodes; the threaded batch loader reproduces the runner's
+decode/pad/guard semantics; the JPEG encoder produces baseline JPEGs that
+PIL decodes back to within a small PSNR of the input.
+"""
+
+import numpy as np
+import pytest
+
+from nm03_capstone_project_tpu.data.dicomlite import read_dicom, write_dicom
+from nm03_capstone_project_tpu.data.synthetic import phantom_slice
+from nm03_capstone_project_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native layer unavailable (no g++?)"
+)
+
+
+def _write_slice(path, h=64, w=48, seed=0, slope=2.0, intercept=-100.0):
+    rng = np.random.default_rng(seed)
+    pixels = rng.integers(0, 4000, size=(h, w)).astype(np.uint16)
+    write_dicom(
+        path, pixels, rescale_slope=slope, rescale_intercept=intercept
+    )
+    return pixels
+
+
+class TestNativeDicom:
+    def test_matches_python_reader(self, tmp_path):
+        p = tmp_path / "a.dcm"
+        _write_slice(p, h=70, w=50, seed=1)
+        py = read_dicom(p)
+        nat = native.read_dicom_native(p)
+        assert nat.shape == (70, 50)
+        assert nat.dtype == np.float32
+        np.testing.assert_array_equal(nat, py.pixels)
+
+    def test_rescale_applied(self, tmp_path):
+        p = tmp_path / "r.dcm"
+        raw = _write_slice(p, h=16, w=16, seed=2, slope=0.5, intercept=10.0)
+        nat = native.read_dicom_native(p)
+        np.testing.assert_allclose(
+            nat, raw.astype(np.float32) * 0.5 + 10.0, rtol=1e-6
+        )
+
+    def test_rejects_garbage(self, tmp_path):
+        p = tmp_path / "bad.dcm"
+        p.write_bytes(b"not a dicom file at all, definitely not")
+        with pytest.raises(ValueError):
+            native.read_dicom_native(p)
+
+    def test_rejects_truncated(self, tmp_path):
+        p = tmp_path / "t.dcm"
+        _write_slice(p)
+        data = p.read_bytes()
+        p.write_bytes(data[: len(data) // 2])
+        with pytest.raises(ValueError):
+            native.read_dicom_native(p)
+
+    def test_overlong_pixeldata_length_clamped_like_python(self, tmp_path):
+        """A PixelData length that overruns the file must not be fatal if
+        rows*cols bytes remain (Python slice-clamp semantics)."""
+        import struct
+
+        p = tmp_path / "o.dcm"
+        _write_slice(p, h=8, w=8)
+        data = bytearray(p.read_bytes())
+        # PixelData element: tag (7FE0,0010) VR OW, 2 reserved, 4-byte length
+        i = data.find(bytes.fromhex("e07f1000") + b"OW")
+        assert i > 0
+        (orig_len,) = struct.unpack_from("<I", data, i + 8)
+        struct.pack_into("<I", data, i + 8, orig_len + 1000)
+        p.write_bytes(bytes(data))
+        py = read_dicom(p)
+        nat = native.read_dicom_native(p)
+        np.testing.assert_array_equal(nat, py.pixels)
+
+
+class TestNativeBatchLoader:
+    def test_batch_pads_and_flags(self, tmp_path):
+        paths = []
+        shapes = [(64, 48), (100, 100), (32, 80)]
+        for i, (h, w) in enumerate(shapes):
+            p = tmp_path / f"{i}.dcm"
+            _write_slice(p, h=h, w=w, seed=i)
+            paths.append(p)
+        bad = tmp_path / "bad.dcm"
+        bad.write_bytes(b"garbage")
+        paths.insert(2, bad)
+
+        pixels, dims, ok, errs = native.load_batch_native(
+            paths, canvas=128, min_dim=16, threads=4
+        )
+        assert errs[2] == 2  # DICOM parse failed
+        assert errs[0] == 0
+        assert pixels.shape == (4, 128, 128)
+        assert list(ok) == [True, True, False, True]
+        np.testing.assert_array_equal(dims[0], [64, 48])
+        np.testing.assert_array_equal(dims[3], [32, 80])
+        # padded region is zero; content matches the Python reader
+        ref = read_dicom(paths[0]).pixels
+        np.testing.assert_array_equal(pixels[0, :64, :48], ref)
+        assert pixels[0, 64:, :].sum() == 0
+        assert pixels[2].sum() == 0  # failed slot left zeroed
+
+    def test_min_dim_and_canvas_guards(self, tmp_path):
+        small = tmp_path / "small.dcm"
+        _write_slice(small, h=8, w=8)
+        big = tmp_path / "big.dcm"
+        _write_slice(big, h=300, w=300)
+        okp = tmp_path / "ok.dcm"
+        _write_slice(okp, h=64, w=64)
+        _, _, ok, errs = native.load_batch_native(
+            [small, big, okp], canvas=256, min_dim=16, threads=2
+        )
+        assert list(ok) == [False, False, True]
+        assert errs[0] == 3 and errs[1] == 4  # too small / exceeds canvas
+
+    def test_empty_batch(self):
+        pixels, dims, ok, _ = native.load_batch_native([], canvas=64, min_dim=16)
+        assert pixels.shape == (0, 64, 64) and ok.shape == (0,)
+
+
+class TestNativeJpeg:
+    def test_roundtrip_psnr(self):
+        img = (phantom_slice(128, 128, seed=3) * 255).clip(0, 255).astype(np.uint8)
+        data = native.encode_jpeg_gray(img, quality=90)
+        assert data[:2] == b"\xff\xd8" and data[-2:] == b"\xff\xd9"
+
+        from PIL import Image
+        import io
+
+        dec = np.asarray(Image.open(io.BytesIO(data)).convert("L"), np.float64)
+        mse = np.mean((dec - img.astype(np.float64)) ** 2)
+        psnr = 10 * np.log10(255.0**2 / max(mse, 1e-9))
+        assert psnr > 30.0, f"PSNR {psnr:.1f} dB too low"
+
+    def test_non_multiple_of_8_dims(self):
+        img = np.linspace(0, 255, 61 * 45).reshape(61, 45).astype(np.uint8)
+        data = native.encode_jpeg_gray(img, quality=75)
+        from PIL import Image
+        import io
+
+        dec = Image.open(io.BytesIO(data))
+        assert dec.size == (45, 61)
+
+    def test_quality_orders_size(self):
+        rng = np.random.default_rng(0)
+        img = rng.integers(0, 255, (96, 96)).astype(np.uint8)
+        lo = native.encode_jpeg_gray(img, quality=20)
+        hi = native.encode_jpeg_gray(img, quality=95)
+        assert len(lo) < len(hi)
+
+    def test_flat_image(self):
+        img = np.full((40, 40), 128, np.uint8)
+        data = native.encode_jpeg_gray(img, quality=90)
+        from PIL import Image
+        import io
+
+        dec = np.asarray(Image.open(io.BytesIO(data)).convert("L"))
+        assert np.abs(dec.astype(int) - 128).max() <= 2
+
+    def test_rejects_bad_input(self):
+        with pytest.raises(ValueError):
+            native.encode_jpeg_gray(np.zeros((4, 4), np.float32))
+
+
+class TestNativeRunnerIntegration:
+    def test_parallel_native_equals_python_decode(self, tmp_path):
+        """The C++ batch decoder must be bit-identical to the Python path."""
+        import hashlib
+
+        from nm03_capstone_project_tpu.cli.runner import CohortProcessor
+        from nm03_capstone_project_tpu.config import BatchConfig, PipelineConfig
+        from nm03_capstone_project_tpu.data.synthetic import write_synthetic_cohort
+
+        cfg = PipelineConfig(canvas=128, render_size=128)
+        root = tmp_path / "cohort"
+        write_synthetic_cohort(root, n_patients=1, n_slices=4, height=128, width=120)
+
+        def digest(out_root):
+            h = hashlib.sha256()
+            for p in sorted(out_root.rglob("*.jpg")):
+                h.update(p.name.encode())
+                h.update(p.read_bytes())
+            return h.hexdigest()
+
+        nat = CohortProcessor(
+            root, tmp_path / "nat", cfg=cfg,
+            batch_cfg=BatchConfig(batch_size=3, io_workers=2, use_native=True),
+            mode="parallel",
+        )
+        assert nat.process_all_patients().succeeded_slices == 4
+        py = CohortProcessor(
+            root, tmp_path / "py", cfg=cfg,
+            batch_cfg=BatchConfig(batch_size=3, io_workers=2, use_native=False),
+            mode="parallel",
+        )
+        assert py.process_all_patients().succeeded_slices == 4
+        assert digest(tmp_path / "nat") == digest(tmp_path / "py")
