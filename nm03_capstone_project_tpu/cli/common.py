@@ -63,6 +63,12 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
         help="write a timing/success results JSON (in-tree replacement for the "
         "reference's out-of-tree hyperfine artifacts)",
     )
+    parser.add_argument(
+        "--profile-dir",
+        default=None,
+        help="capture a jax.profiler trace here (view with tensorboard or "
+        "Perfetto; in-tree replacement for the reference's perf/Hotspot use)",
+    )
 
 
 def add_pipeline_args(parser: argparse.ArgumentParser) -> None:

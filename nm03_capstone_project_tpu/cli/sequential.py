@@ -49,6 +49,8 @@ def run(args: argparse.Namespace, mode: str) -> int:
         prefetch_depth=getattr(args, "prefetch_depth", BatchConfig.prefetch_depth),
         use_native=not getattr(args, "no_native", False),
     )
+    from nm03_capstone_project_tpu.utils.profiling import profile_trace
+
     try:
         base = common.resolve_base_path(args, tmp_root=Path(args.output))
         proc = CohortProcessor(
@@ -59,7 +61,8 @@ def run(args: argparse.Namespace, mode: str) -> int:
             mode=mode,
             resume=args.resume,
         )
-        summary = proc.process_all_patients()
+        with profile_trace(getattr(args, "profile_dir", None)):
+            summary = proc.process_all_patients()
         if args.results_json:
             write_results_json(
                 args.results_json,

@@ -17,8 +17,10 @@ Erosion                :func:`morphology.erode`                       create(3)
 (seed-point logic)     :func:`seeds.seed_mask`                        test_pipeline.cpp:79-106
 =====================  =============================================  =========================
 
-Also carried as an optional op (declared in the reference's header but never
-instantiated — FAST_directives.hpp:13): :func:`elementwise.binary_threshold`.
+Also carried as optional ops (declared in the reference's header but never
+instantiated): :func:`elementwise.binary_threshold` (BinaryThresholding,
+FAST_directives.hpp:13) and :mod:`regionprops` (RegionProperties,
+FAST_directives.hpp:24).
 """
 
 from nm03_capstone_project_tpu.ops.elementwise import (  # noqa: F401
@@ -34,6 +36,10 @@ from nm03_capstone_project_tpu.ops.median import (  # noqa: F401
 from nm03_capstone_project_tpu.ops.morphology import dilate, erode  # noqa: F401
 from nm03_capstone_project_tpu.ops.neighborhood import extend_edges  # noqa: F401
 from nm03_capstone_project_tpu.ops.region_growing import region_grow  # noqa: F401
+from nm03_capstone_project_tpu.ops.regionprops import (  # noqa: F401
+    connected_components,
+    region_properties,
+)
 from nm03_capstone_project_tpu.ops.seeds import seed_mask  # noqa: F401
 from nm03_capstone_project_tpu.ops.sharpen import gaussian_blur, sharpen  # noqa: F401
 from nm03_capstone_project_tpu.ops.volume import (  # noqa: F401

@@ -1,0 +1,157 @@
+"""Connected components + region properties.
+
+TPU-native equivalent of FAST ``RegionProperties`` — declared in the
+reference's API surface (FAST_directives.hpp:24) but never instantiated, so
+carried here as an optional op per SURVEY.md section 2.2.
+
+Connected-component labeling is a poor fit for sequential union-find; on TPU
+it is a *fixpoint of min-label propagation*: every foreground pixel starts
+with its linear index as label, each step takes the minimum over its
+(4- or 8-connected) neighborhood, and the fixpoint assigns every component
+the smallest linear index it contains. Same lax.while_loop-of-fori_loop
+shape as ops.region_growing (amortized convergence checks), fully jittable
+and vmappable.
+
+Per-region statistics are masked reductions into fixed-size slots
+(jit-friendly static shapes): ``region_properties`` ranks components by area
+and returns the top ``max_regions``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def _neighbor_min(lab: jax.Array, connectivity: int) -> jax.Array:
+    """Min label over the 3x3 cross (4-conn) or full 3x3 (8-conn) window."""
+    shifts_4 = [(0, 0), (1, 0), (-1, 0), (0, 1), (0, -1)]
+    shifts_8 = shifts_4 + [(1, 1), (1, -1), (-1, 1), (-1, -1)]
+    shifts = shifts_4 if connectivity == 4 else shifts_8
+    big = jnp.iinfo(lab.dtype).max
+    out = lab
+    for dy, dx in shifts[1:]:
+        shifted = jnp.roll(lab, (dy, dx), axis=(-2, -1))
+        # rolled-in wrap rows/cols must not connect opposite edges
+        if dy == 1:
+            shifted = shifted.at[..., 0, :].set(big)
+        elif dy == -1:
+            shifted = shifted.at[..., -1, :].set(big)
+        if dx == 1:
+            shifted = shifted.at[..., :, 0].set(big)
+        elif dx == -1:
+            shifted = shifted.at[..., :, -1].set(big)
+        out = jnp.minimum(out, shifted)
+    return out
+
+
+def connected_components(
+    mask: jax.Array,
+    connectivity: int = 4,
+    block_iters: int = 16,
+    max_iters: int | None = None,
+) -> jax.Array:
+    """Label connected components of a boolean mask.
+
+    Returns int32 labels shaped like ``mask``: 0 for background, and for
+    each component the (1-based) smallest linear index it contains. Labels
+    are unique per component but not consecutive; see
+    :func:`region_properties` for ranked per-region statistics.
+
+    ``max_iters`` defaults to h*w — an upper bound on any propagation path
+    (e.g. a serpentine component), so the fixpoint always converges unless
+    explicitly capped lower.
+    """
+    if connectivity not in (4, 8):
+        raise ValueError(f"connectivity must be 4 or 8, got {connectivity}")
+    m = mask.astype(bool)
+    h, w = m.shape[-2], m.shape[-1]
+    if max_iters is None:
+        max_iters = h * w
+    big = jnp.iinfo(jnp.int32).max
+    idx = (jnp.arange(h * w, dtype=jnp.int32) + 1).reshape(h, w)
+    idx = jnp.broadcast_to(idx, m.shape)
+    lab = jnp.where(m, idx, big)
+
+    def block(lab):
+        def step(_, l):
+            prop = _neighbor_min(l, connectivity)
+            return jnp.where(m, prop, big)
+
+        return jax.lax.fori_loop(0, block_iters, step, lab)
+
+    def cond(state):
+        lab, prev, it = state
+        return (it < max_iters) & jnp.any(lab != prev)
+
+    def body(state):
+        lab, _, it = state
+        return block(lab), lab, it + block_iters
+
+    lab, _, _ = jax.lax.while_loop(cond, body, (block(lab), lab, 0))
+    return jnp.where(m, lab, 0).astype(jnp.int32)
+
+
+def region_properties(
+    mask: jax.Array,
+    connectivity: int = 4,
+    max_regions: int = 8,
+) -> Dict[str, jax.Array]:
+    """Area / centroid / bbox of the ``max_regions`` largest components.
+
+    All outputs have static shapes (jit/vmap-friendly). Slots beyond the
+    number of actual components have area 0 and -1 elsewhere.
+
+    Returns dict of arrays, each with leading dim ``max_regions``:
+      area      — pixel count, int32, descending
+      centroid  — (y, x) float32 mean position
+      bbox      — (y0, x0, y1, x1) int32 inclusive bounds
+      label     — the component's label in :func:`connected_components`
+    """
+    if mask.ndim != 2:
+        raise ValueError(
+            f"region_properties expects a single (H, W) mask, got "
+            f"{mask.shape}; use jax.vmap for batches"
+        )
+    labels = connected_components(mask, connectivity)
+    h, w = labels.shape[-2], labels.shape[-1]
+    flat = labels.reshape(-1)
+
+    # rank distinct labels by area: count occurrences of every linear-index
+    # label via a length-(h*w+1) bincount (static shape), then top-k
+    counts = jnp.zeros(h * w + 1, jnp.int32).at[flat].add(1)
+    counts = counts.at[0].set(0)  # background doesn't rank
+    area, top_labels = jax.lax.top_k(counts, max_regions)
+    valid = area > 0
+    top_labels = jnp.where(valid, top_labels, -1)
+
+    ys = jnp.arange(h, dtype=jnp.float32)[:, None]
+    xs = jnp.arange(w, dtype=jnp.float32)[None, :]
+
+    def props(label, a, v):
+        m = labels == label
+        af = jnp.maximum(a, 1).astype(jnp.float32)
+        cy = jnp.sum(jnp.where(m, ys, 0.0)) / af
+        cx = jnp.sum(jnp.where(m, xs, 0.0)) / af
+        yi = jnp.where(m, ys, jnp.inf)
+        xi = jnp.where(m, xs, jnp.inf)
+        ya = jnp.where(m, ys, -jnp.inf)
+        xa = jnp.where(m, xs, -jnp.inf)
+        bbox = jnp.stack(
+            [jnp.min(yi), jnp.min(xi), jnp.max(ya), jnp.max(xa)]
+        ).astype(jnp.int32)
+        centroid = jnp.stack([cy, cx])
+        return (
+            jnp.where(v, centroid, -1.0),
+            jnp.where(v, bbox, -1),
+        )
+
+    centroid, bbox = jax.vmap(props)(top_labels, area, valid)
+    return {
+        "area": area,
+        "centroid": centroid,
+        "bbox": bbox,
+        "label": top_labels,
+    }

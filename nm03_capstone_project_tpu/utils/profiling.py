@@ -1,0 +1,43 @@
+"""In-tree profiling harness.
+
+The reference profiles *outside* the repo with perf + Hotspot
+(reference README.md:93-95). The TPU-native equivalent per SURVEY.md
+section 5 is ``jax.profiler``: traces viewable in TensorBoard/Perfetto,
+captured in-tree via ``--profile-dir`` on any driver, plus named trace
+annotations so pipeline stages show up in the timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from pathlib import Path
+
+from nm03_capstone_project_tpu.utils.reporter import get_logger
+
+_log = get_logger("profiling")
+
+
+@contextlib.contextmanager
+def profile_trace(trace_dir: str | None):
+    """Capture a jax.profiler trace into ``trace_dir`` (no-op when None).
+
+    View with ``tensorboard --logdir <dir>`` or upload the .perfetto
+    trace to ui.perfetto.dev.
+    """
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    Path(trace_dir).mkdir(parents=True, exist_ok=True)
+    _log.info("capturing profiler trace to %s", trace_dir)
+    with jax.profiler.trace(str(trace_dir)):
+        yield
+    _log.info("profiler trace written to %s", trace_dir)
+
+
+def annotate(name: str):
+    """Named region that appears on the profiler timeline (host + device)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -35,9 +35,12 @@ class Timer:
 
     @contextlib.contextmanager
     def section(self, name: str, tree=None):
+        from nm03_capstone_project_tpu.utils.profiling import annotate
+
         t0 = time.perf_counter()
         try:
-            yield
+            with annotate(name):  # stage shows up on the profiler timeline
+                yield
         finally:
             if tree is not None:
                 sync(tree)

@@ -159,3 +159,20 @@ def test_manifest_atomicity(tmp_path):
     (tmp_path / "manifest.json").write_text("{not json")
     m3 = Manifest.load_or_create(tmp_path)
     assert m3.data == {}
+
+
+def test_profile_dir_captures_trace(cohort, tmp_path):
+    from nm03_capstone_project_tpu.cli import sequential
+
+    rc = sequential.main(
+        [
+            "--synthetic", "1", "--synthetic-slices", "2",
+            "--canvas", "128", "--render-size", "128",
+            "--output", str(tmp_path / "o"),
+            "--profile-dir", str(tmp_path / "trace"),
+            "--device", "cpu",
+        ]
+    )
+    assert rc == 0
+    # jax.profiler writes plugins/profile/<ts>/*.xplane.pb under the dir
+    assert any((tmp_path / "trace").rglob("*.xplane.pb"))

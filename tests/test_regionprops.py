@@ -1,0 +1,112 @@
+"""connected_components / region_properties vs the scipy.ndimage oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy import ndimage
+
+from nm03_capstone_project_tpu.ops.regionprops import (
+    connected_components,
+    region_properties,
+)
+
+
+def _random_mask(rng, h=48, w=40, p=0.35):
+    return rng.random((h, w)) < p
+
+
+def _canonical(labels: np.ndarray) -> np.ndarray:
+    """Relabel to consecutive ints in first-occurrence order for comparison."""
+    out = np.zeros_like(labels)
+    nxt = 1
+    seen = {}
+    for v in labels.ravel():
+        if v != 0 and v not in seen:
+            seen[v] = nxt
+            nxt += 1
+    for v, k in seen.items():
+        out[labels == v] = k
+    return out
+
+
+@pytest.mark.parametrize("connectivity", [4, 8])
+def test_components_match_scipy(rng, connectivity):
+    structure = (
+        ndimage.generate_binary_structure(2, 1)
+        if connectivity == 4
+        else ndimage.generate_binary_structure(2, 2)
+    )
+    for seed in range(3):
+        m = _random_mask(np.random.default_rng(seed))
+        ours = np.asarray(connected_components(jnp.asarray(m), connectivity))
+        ref, _ = ndimage.label(m, structure=structure)
+        assert (ours > 0).sum() == (ref > 0).sum()
+        np.testing.assert_array_equal(_canonical(ours), _canonical(ref))
+
+
+def test_components_no_wraparound():
+    # a component touching the left edge must not join one touching the right
+    m = np.zeros((8, 8), bool)
+    m[:, 0] = True
+    m[:, -1] = True
+    lab = np.asarray(connected_components(jnp.asarray(m)))
+    assert len(np.unique(lab[lab > 0])) == 2
+
+
+def test_components_empty_and_full():
+    assert np.asarray(connected_components(jnp.zeros((16, 16), bool))).sum() == 0
+    full = np.asarray(connected_components(jnp.ones((16, 16), bool)))
+    assert len(np.unique(full)) == 1  # one component, label 1
+
+
+def test_region_properties_ranked_areas(rng):
+    m = np.zeros((64, 64), bool)
+    m[2:6, 2:6] = True        # area 16
+    m[20:30, 20:40] = True    # area 200
+    m[50:53, 50:52] = True    # area 6
+    props = jax.jit(lambda x: region_properties(x, max_regions=4))(jnp.asarray(m))
+    area = np.asarray(props["area"])
+    assert list(area) == [200, 16, 6, 0]
+    # largest region centroid and bbox
+    np.testing.assert_allclose(np.asarray(props["centroid"])[0], [24.5, 29.5])
+    np.testing.assert_array_equal(np.asarray(props["bbox"])[0], [20, 20, 29, 39])
+    # empty slot is -1-filled
+    assert np.asarray(props["label"])[3] == -1
+    np.testing.assert_array_equal(np.asarray(props["bbox"])[3], [-1, -1, -1, -1])
+
+
+def test_region_properties_matches_scipy_on_random(rng):
+    m = _random_mask(np.random.default_rng(7), p=0.3)
+    props = region_properties(jnp.asarray(m), max_regions=5)
+    ref, n = ndimage.label(m, structure=ndimage.generate_binary_structure(2, 1))
+    sizes = np.sort(ndimage.sum_labels(np.ones_like(ref), ref, range(1, n + 1)))[::-1]
+    ours = np.asarray(props["area"])
+    expect = sizes[:5].astype(int)
+    np.testing.assert_array_equal(ours[: len(expect)], expect)
+
+
+def test_serpentine_component_converges():
+    # one snake-shaped component whose propagation path is ~h*w long;
+    # the default max_iters (h*w) must fully converge it to one label
+    h, w = 24, 24
+    m = np.zeros((h, w), bool)
+    for r in range(0, h, 2):
+        m[r, :] = True
+        if r + 1 < h:
+            m[r + 1, -1 if (r // 2) % 2 == 0 else 0] = True
+    lab = np.asarray(connected_components(jnp.asarray(m)))
+    assert len(np.unique(lab[lab > 0])) == 1
+
+
+def test_region_properties_rejects_batched_mask():
+    with pytest.raises(ValueError, match="vmap"):
+        region_properties(jnp.zeros((2, 8, 8), bool))
+
+
+def test_region_properties_vmaps():
+    m = np.zeros((2, 16, 16), bool)
+    m[0, 2:6, 2:6] = True
+    m[1, 1:3, 1:9] = True
+    props = jax.vmap(lambda x: region_properties(x, max_regions=2))(jnp.asarray(m))
+    np.testing.assert_array_equal(np.asarray(props["area"]), [[16, 0], [16, 0]])
