@@ -80,6 +80,29 @@ class PipelineConfig:
     # Defaults False until the caller knows it's on a TPU backend.
     use_pallas: bool = False
 
+    def __post_init__(self):
+        # Fail at construction (CLI parse time), not deep inside a traced op.
+        if self.median_window < 1 or self.median_window % 2 == 0:
+            raise ValueError(
+                f"median_window must be odd and >= 1, got {self.median_window}"
+            )
+        if self.sharpen_kernel < 1 or self.sharpen_kernel % 2 == 0:
+            raise ValueError(
+                f"sharpen_kernel must be odd and >= 1, got {self.sharpen_kernel}"
+            )
+        if self.morph_size < 1 or self.morph_size % 2 == 0:
+            raise ValueError(
+                f"morph_size must be odd and >= 1, got {self.morph_size}"
+            )
+        if not self.grow_low <= self.grow_high:
+            raise ValueError(
+                f"grow band is empty: [{self.grow_low}, {self.grow_high}]"
+            )
+        if self.canvas < 1:
+            raise ValueError(f"canvas must be positive, got {self.canvas}")
+        if self.grow_block_iters < 1 or self.grow_max_iters < 1:
+            raise ValueError("grow iteration counts must be positive")
+
     @property
     def canvas_hw(self) -> Tuple[int, int]:
         return (self.canvas, self.canvas)

@@ -37,7 +37,10 @@ def extend_edges(x: jax.Array, dims: jax.Array) -> jax.Array:
 
 
 def shifted_stack(
-    x: jax.Array, offsets: List[Tuple[int, int]], pad_mode: str = "edge"
+    x: jax.Array,
+    offsets: List[Tuple[int, int]],
+    pad_mode: str = "edge",
+    constant_values=0,
 ) -> jax.Array:
     """Stack shifted views of ``x`` along a new leading axis.
 
@@ -45,11 +48,16 @@ def shifted_stack(
     [k, ..., r, c] == x_padded[..., r + dr + R, c + dc + C] where R, C are the
     max absolute offsets. Used to materialize k*k windows for median /
     morphology / convolution-style ops; XLA fuses the stack away.
+    ``constant_values`` applies only with ``pad_mode='constant'`` (e.g. a
+    +inf/maxval border for min-propagation).
     """
     max_r = max(abs(dr) for dr, _ in offsets)
     max_c = max(abs(dc) for _, dc in offsets)
     pad_widths = [(0, 0)] * (x.ndim - 2) + [(max_r, max_r), (max_c, max_c)]
-    xp = jnp.pad(x, pad_widths, mode=pad_mode)
+    if pad_mode == "constant":
+        xp = jnp.pad(x, pad_widths, mode="constant", constant_values=constant_values)
+    else:
+        xp = jnp.pad(x, pad_widths, mode=pad_mode)
     h, w = x.shape[-2], x.shape[-1]
     views = [
         jax.lax.dynamic_slice_in_dim(

@@ -24,27 +24,21 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 
+from nm03_capstone_project_tpu.ops.neighborhood import (
+    footprint_offsets,
+    shifted_stack,
+)
+
 
 def _neighbor_min(lab: jax.Array, connectivity: int) -> jax.Array:
     """Min label over the 3x3 cross (4-conn) or full 3x3 (8-conn) window."""
-    shifts_4 = [(0, 0), (1, 0), (-1, 0), (0, 1), (0, -1)]
-    shifts_8 = shifts_4 + [(1, 1), (1, -1), (-1, 1), (-1, -1)]
-    shifts = shifts_4 if connectivity == 4 else shifts_8
     big = jnp.iinfo(lab.dtype).max
-    out = lab
-    for dy, dx in shifts[1:]:
-        shifted = jnp.roll(lab, (dy, dx), axis=(-2, -1))
-        # rolled-in wrap rows/cols must not connect opposite edges
-        if dy == 1:
-            shifted = shifted.at[..., 0, :].set(big)
-        elif dy == -1:
-            shifted = shifted.at[..., -1, :].set(big)
-        if dx == 1:
-            shifted = shifted.at[..., :, 0].set(big)
-        elif dx == -1:
-            shifted = shifted.at[..., :, -1].set(big)
-        out = jnp.minimum(out, shifted)
-    return out
+    offs = footprint_offsets(3, "cross" if connectivity == 4 else "box")
+    # maxval border: the out-of-canvas padding never wins the min, so
+    # opposite edges cannot connect
+    return shifted_stack(
+        lab, offs, pad_mode="constant", constant_values=big
+    ).min(axis=0)
 
 
 def connected_components(
@@ -123,7 +117,11 @@ def region_properties(
     # label via a length-(h*w+1) bincount (static shape), then top-k
     counts = jnp.zeros(h * w + 1, jnp.int32).at[flat].add(1)
     counts = counts.at[0].set(0)  # background doesn't rank
-    area, top_labels = jax.lax.top_k(counts, max_regions)
+    k = min(max_regions, h * w + 1)  # top_k caps at the candidate count
+    area, top_labels = jax.lax.top_k(counts, k)
+    if k < max_regions:
+        area = jnp.pad(area, (0, max_regions - k))
+        top_labels = jnp.pad(top_labels, (0, max_regions - k))
     valid = area > 0
     top_labels = jnp.where(valid, top_labels, -1)
 

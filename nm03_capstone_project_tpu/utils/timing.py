@@ -20,10 +20,30 @@ from typing import Dict, List
 
 
 def sync(tree) -> None:
-    """Block until every array in the pytree is computed (honest timing)."""
+    """Block until every array in the pytree is computed (honest timing).
+
+    ``block_until_ready`` alone is not trustworthy on every backend: on the
+    tunneled TPU platform it returns before execution finishes (bench.py
+    measured a flat 0.02 ms regardless of problem size). A device_get is the
+    only universal synchronization, so on non-CPU backends this additionally
+    fetches one element per array — a tiny slice enqueued after the producer
+    on the same FIFO stream, whose arrival proves the producer ran.
+    """
     import jax
 
-    jax.block_until_ready(tree)
+    leaves = [
+        leaf
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if isinstance(leaf, jax.Array) and leaf.size
+    ]
+    jax.block_until_ready(leaves)
+    probes = [
+        leaf[(0,) * leaf.ndim]  # true 1-element slice, no O(n) reshape
+        for leaf in leaves
+        if leaf.devices() and next(iter(leaf.devices())).platform != "cpu"
+    ]
+    if probes:
+        jax.device_get(probes)
 
 
 @dataclass
