@@ -82,17 +82,30 @@ def run(args: argparse.Namespace) -> int:
         )
 
     exports = {
-        "original_image": render_gray(stages["original_image"], dims, cfg.render_size),
-        "preprocessed_image": render_gray(
-            stages["preprocessed_image"], dims, cfg.render_size
-        ),
-        "segmentation": seg_render(stages["segmentation"]),
-        "erosion_result": seg_render(stages["erosion_result"]),
-        "final_dilated_result": seg_render(stages["final_dilated_result"]),
+        name: np.asarray(img)  # one device->host transfer per stage
+        for name, img in {
+            "original_image": render_gray(
+                stages["original_image"], dims, cfg.render_size
+            ),
+            "preprocessed_image": render_gray(
+                stages["preprocessed_image"], dims, cfg.render_size
+            ),
+            "segmentation": seg_render(stages["segmentation"]),
+            "erosion_result": seg_render(stages["erosion_result"]),
+            "final_dilated_result": seg_render(stages["final_dilated_result"]),
+        }.items()
     }
     for name, img in exports.items():
-        save_jpeg(np.asarray(img), f"{args.output}/{name}.jpg")
+        save_jpeg(img, f"{args.output}/{name}.jpg")
         print(f"exported {args.output}/{name}.jpg")
+
+    # the 5-pane window (MultiViewWindow, test_pipeline.cpp:148-158), as a
+    # composed strip a headless run can still eyeball
+    from nm03_capstone_project_tpu.render.contact_sheet import contact_sheet
+
+    sheet = contact_sheet(list(exports.values()), labels=list(exports))
+    save_jpeg(sheet, f"{args.output}/pipeline_panel.jpg")
+    print(f"exported {args.output}/pipeline_panel.jpg")
     return 0
 
 

@@ -6,6 +6,12 @@ from nm03_capstone_project_tpu.data.dicomlite import (  # noqa: F401
     read_dicom,
     write_dicom,
 )
+from nm03_capstone_project_tpu.data.imageio import (  # noqa: F401
+    read_image,
+    read_metaimage,
+    write_image,
+    write_metaimage,
+)
 from nm03_capstone_project_tpu.data.discovery import (  # noqa: F401
     extract_file_number,
     find_patient_dirs,

@@ -41,6 +41,38 @@ def _neighbor_min(lab: jax.Array, connectivity: int) -> jax.Array:
     ).min(axis=0)
 
 
+def _minmax_box(m: jax.Array, ys: jax.Array, xs: jax.Array) -> jax.Array:
+    """(y0, x0, y1, x1) of True pixels via masked min/max reductions over the
+    trailing two axes; garbage (inf-derived) where ``m`` is all-False —
+    callers mask that case out."""
+    return jnp.stack(
+        [
+            jnp.min(jnp.where(m, ys, jnp.inf), axis=(-2, -1)),
+            jnp.min(jnp.where(m, xs, jnp.inf), axis=(-2, -1)),
+            jnp.max(jnp.where(m, ys, -jnp.inf), axis=(-2, -1)),
+            jnp.max(jnp.where(m, xs, -jnp.inf), axis=(-2, -1)),
+        ],
+        axis=-1,
+    )
+
+
+def bounding_box(mask: jax.Array) -> jax.Array:
+    """(y0, x0, y1, x1) inclusive bounds of ALL foreground pixels; -1s if empty.
+
+    TPU-native equivalent of FAST ``BoundingBox`` (declared in the
+    reference's API surface, FAST_directives.hpp:2, never instantiated) —
+    the whole-mask box, as opposed to :func:`region_properties` which boxes
+    each component separately. jit/vmap-friendly (static output shape).
+    """
+    m = mask.astype(bool)
+    h, w = m.shape[-2], m.shape[-1]
+    ys = jnp.arange(h, dtype=jnp.float32)[:, None]
+    xs = jnp.arange(w, dtype=jnp.float32)[None, :]
+    any_fg = jnp.any(m, axis=(-2, -1))
+    box = _minmax_box(m, ys, xs)
+    return jnp.where(any_fg[..., None], box, -1.0).astype(jnp.int32)
+
+
 def connected_components(
     mask: jax.Array,
     connectivity: int = 4,
@@ -133,13 +165,7 @@ def region_properties(
         af = jnp.maximum(a, 1).astype(jnp.float32)
         cy = jnp.sum(jnp.where(m, ys, 0.0)) / af
         cx = jnp.sum(jnp.where(m, xs, 0.0)) / af
-        yi = jnp.where(m, ys, jnp.inf)
-        xi = jnp.where(m, xs, jnp.inf)
-        ya = jnp.where(m, ys, -jnp.inf)
-        xa = jnp.where(m, xs, -jnp.inf)
-        bbox = jnp.stack(
-            [jnp.min(yi), jnp.min(xi), jnp.max(ya), jnp.max(xa)]
-        ).astype(jnp.int32)
+        bbox = _minmax_box(m, ys, xs).astype(jnp.int32)
         centroid = jnp.stack([cy, cx])
         return (
             jnp.where(v, centroid, -1.0),

@@ -1,5 +1,6 @@
 """Render + export: the TPU-native visualization stack."""
 
+from nm03_capstone_project_tpu.render.contact_sheet import contact_sheet  # noqa: F401
 from nm03_capstone_project_tpu.render.export import (  # noqa: F401
     clean_directory,
     export_pairs,

@@ -1,0 +1,42 @@
+"""Contact-sheet composition (render.contact_sheet) — the headless
+MultiViewWindow (reference src/test/test_pipeline.cpp:148-158)."""
+
+import numpy as np
+import pytest
+
+from nm03_capstone_project_tpu.render.contact_sheet import contact_sheet
+
+
+def _panel(v, hw=(64, 64)):
+    return np.full(hw, np.uint8(v), np.uint8)
+
+
+class TestContactSheet:
+    def test_five_pane_geometry(self):
+        # the reference window: 5 panes, ~450 px each, black background
+        sheet = contact_sheet([_panel(i * 40) for i in range(5)], pane_size=450, pad=10)
+        assert sheet.shape == (470, 5 * 450 + 6 * 10)
+        assert sheet.dtype == np.uint8
+        assert sheet[0, 0] == 0  # padding stays background-black
+
+    def test_panes_land_in_order(self):
+        sheet = contact_sheet([_panel(10), _panel(200)], pane_size=8, pad=2)
+        assert sheet[6, 6] == 10  # first cell
+        assert sheet[6, 2 + 8 + 2 + 4] == 200  # second cell
+
+    def test_resizes_mixed_sizes(self):
+        sheet = contact_sheet(
+            [_panel(7, (32, 32)), _panel(9, (128, 256))], pane_size=16, pad=1
+        )
+        assert sheet.shape == (18, 2 * 16 + 3)
+        assert sheet[8, 8] == 7 and sheet[8, 1 + 16 + 1 + 8] == 9
+
+    def test_rejects_empty_and_bad_dtype(self):
+        with pytest.raises(ValueError, match="at least one"):
+            contact_sheet([])
+        with pytest.raises(ValueError, match="uint8"):
+            contact_sheet([np.zeros((4, 4), np.float32)])
+
+    def test_rejects_label_mismatch(self):
+        with pytest.raises(ValueError, match="labels"):
+            contact_sheet([_panel(1)], labels=["a", "b"])

@@ -1,0 +1,103 @@
+"""Generic image + MetaImage IO (data.imageio).
+
+Covers the reference's declared-but-uninstantiated importer/exporter surface
+(FAST_directives.hpp:27-31): round-trips, MetaIO header conventions
+(fastest-first DimSize, spacing order), compression, and malformed-input
+rejection.
+"""
+
+import numpy as np
+import pytest
+
+from nm03_capstone_project_tpu.data.imageio import (
+    read_image,
+    read_metaimage,
+    write_image,
+    write_metaimage,
+)
+
+
+class TestGenericImage:
+    def test_png_roundtrip_exact(self, tmp_path):
+        img = np.arange(48, dtype=np.uint8).reshape(6, 8) * 5
+        p = tmp_path / "a.png"
+        write_image(img, p)
+        back = read_image(p)
+        assert back.dtype == np.float32
+        np.testing.assert_array_equal(back, img.astype(np.float32))
+
+    def test_jpeg_roundtrip_close(self, tmp_path):
+        rng = np.random.default_rng(0)
+        img = rng.integers(0, 255, (32, 32), np.uint8)
+        p = tmp_path / "a.jpg"
+        write_image(img, p)
+        back = read_image(p)
+        assert np.abs(back - img).mean() < 20  # lossy but in the ballpark
+
+    def test_rgb_reads_as_luminance(self, tmp_path):
+        img = np.zeros((4, 4, 3), np.uint8)
+        img[..., 0] = 255  # pure red
+        p = tmp_path / "rgb.png"
+        write_image(img, p)
+        back = read_image(p)
+        assert back.shape == (4, 4)
+        assert 50 < back.mean() < 100  # ITU-R luma of red ~76
+
+    def test_rejects_non_uint8(self, tmp_path):
+        with pytest.raises(ValueError, match="uint8"):
+            write_image(np.zeros((4, 4), np.float32), tmp_path / "x.png")
+
+
+class TestMetaImage:
+    @pytest.mark.parametrize("dtype", [np.uint8, np.int16, np.uint16, np.float32])
+    def test_roundtrip_2d(self, tmp_path, dtype):
+        rng = np.random.default_rng(1)
+        arr = rng.integers(0, 100, (5, 7)).astype(dtype)
+        write_metaimage(arr, tmp_path / "s.mhd", spacing=(2.0, 0.5))
+        back, spacing = read_metaimage(tmp_path / "s.mhd")
+        np.testing.assert_array_equal(back, arr)
+        assert back.dtype == np.dtype(dtype)
+        assert spacing == (2.0, 0.5)
+
+    def test_roundtrip_3d_compressed(self, tmp_path):
+        rng = np.random.default_rng(2)
+        vol = rng.normal(size=(4, 6, 8)).astype(np.float32)
+        write_metaimage(vol, tmp_path / "v.mhd", compressed=True)
+        assert (tmp_path / "v.zraw").exists()
+        back, spacing = read_metaimage(tmp_path / "v.mhd")
+        np.testing.assert_array_equal(back, vol)
+        assert spacing == (1.0, 1.0, 1.0)
+
+    def test_dimsize_is_fastest_first(self, tmp_path):
+        # MetaIO convention: DimSize lists x y z; our array axes are (z, y, x)
+        write_metaimage(np.zeros((2, 3, 4), np.uint8), tmp_path / "d.mhd")
+        header = (tmp_path / "d.mhd").read_text()
+        assert "DimSize = 4 3 2" in header
+
+    def test_rejects_size_mismatch(self, tmp_path):
+        write_metaimage(np.zeros((4, 4), np.uint8), tmp_path / "m.mhd")
+        raw = tmp_path / "m.raw"
+        raw.write_bytes(raw.read_bytes()[:-1])
+        with pytest.raises(ValueError, match="bytes"):
+            read_metaimage(tmp_path / "m.mhd")
+
+    def test_rejects_missing_field(self, tmp_path):
+        (tmp_path / "bad.mhd").write_text("ObjectType = Image\nNDims = 2\n")
+        with pytest.raises(ValueError, match="missing"):
+            read_metaimage(tmp_path / "bad.mhd")
+
+    def test_rejects_unsupported_dtype(self, tmp_path):
+        with pytest.raises(ValueError, match="dtype"):
+            write_metaimage(np.zeros((2, 2), np.complex64), tmp_path / "c.mhd")
+
+    def test_rejects_4d(self, tmp_path):
+        with pytest.raises(ValueError, match="2D/3D"):
+            write_metaimage(np.zeros((2, 2, 2, 2), np.uint8), tmp_path / "q.mhd")
+
+    def test_mask_export_volume_pipeline_shape(self, tmp_path):
+        # the practical use: persist a segmentation volume for ITK-SNAP et al.
+        mask = (np.random.default_rng(3).random((3, 16, 16)) > 0.7).astype(np.uint8)
+        write_metaimage(mask, tmp_path / "mask", spacing=(5.0, 1.0, 1.0))
+        back, spacing = read_metaimage(tmp_path / "mask.mhd")
+        np.testing.assert_array_equal(back, mask)
+        assert spacing == (5.0, 1.0, 1.0)

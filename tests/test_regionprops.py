@@ -110,3 +110,37 @@ def test_region_properties_vmaps():
     m[1, 1:3, 1:9] = True
     props = jax.vmap(lambda x: region_properties(x, max_regions=2))(jnp.asarray(m))
     np.testing.assert_array_equal(np.asarray(props["area"]), [[16, 0], [16, 0]])
+
+
+class TestBoundingBox:
+    def test_matches_scipy_objects(self, rng):
+        from nm03_capstone_project_tpu.ops.regionprops import bounding_box
+
+        m = _random_mask(rng)
+        box = np.asarray(bounding_box(jnp.asarray(m)))
+        (sl_y, sl_x), = ndimage.find_objects(m.astype(np.int32))
+        assert tuple(box) == (
+            sl_y.start, sl_x.start, sl_y.stop - 1, sl_x.stop - 1
+        )
+
+    def test_empty_mask_is_sentinel(self):
+        from nm03_capstone_project_tpu.ops.regionprops import bounding_box
+
+        box = np.asarray(bounding_box(jnp.zeros((8, 8), bool)))
+        np.testing.assert_array_equal(box, [-1, -1, -1, -1])
+
+    def test_vmaps_over_batch(self, rng):
+        from nm03_capstone_project_tpu.ops.regionprops import bounding_box
+
+        batch = np.stack([_random_mask(rng) for _ in range(3)])
+        boxes = np.asarray(jax.vmap(bounding_box)(jnp.asarray(batch)))
+        assert boxes.shape == (3, 4)
+        for m, b in zip(batch, boxes):
+            single = np.asarray(bounding_box(jnp.asarray(m)))
+            np.testing.assert_array_equal(b, single)
+
+    def test_tiny_regionprops_mask_smaller_than_max_regions(self):
+        # regression: top_k used to require max_regions <= h*w+1
+        r = region_properties(jnp.ones((2, 3), bool), max_regions=8)
+        assert int(r["area"][0]) == 6
+        assert int((r["area"] > 0).sum()) == 1
