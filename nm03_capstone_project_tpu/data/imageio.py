@@ -45,7 +45,7 @@ def write_image(image: np.ndarray, path: str | os.PathLike) -> None:
     arr = np.asarray(image)
     if arr.dtype != np.uint8:
         raise ValueError(f"expected uint8 image, got {arr.dtype}")
-    if arr.ndim not in (2, 3):
+    if arr.ndim not in (2, 3) or (arr.ndim == 3 and arr.shape[-1] != 3):
         raise ValueError(f"expected (H, W) or (H, W, 3), got {arr.shape}")
     from PIL import Image
 

@@ -1,0 +1,23 @@
+"""Learned model family: pipeline-distilled segmentation networks.
+
+The reference has no trainable models — its whole compute is the classical
+operator chain. This package is the framework's learned-capability analog:
+a U-Net student distilled from that chain (the teacher), with single-chip
+and mesh-sharded (data x tensor parallel) training steps.
+"""
+
+from nm03_capstone_project_tpu.models.train import (  # noqa: F401
+    distill_batch,
+    fit,
+    make_optimizer,
+    make_sharded_train_step,
+    prepare_student_inputs,
+    segmentation_loss,
+    train_step,
+)
+from nm03_capstone_project_tpu.models.unet import (  # noqa: F401
+    apply_unet,
+    init_unet,
+    param_shardings,
+    predict_mask,
+)

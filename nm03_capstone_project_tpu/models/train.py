@@ -1,0 +1,193 @@
+"""Distillation training for the segmentation U-Net.
+
+The teacher is the classical pipeline (pipeline.slice_pipeline.process_batch
+— the reference's exact operator chain); the student is models.unet. Labels
+therefore cost nothing: any cohort, synthetic or real, self-labels by
+running the teacher once, which is the TPU-native answer to "the reference
+has no training data pipeline".
+
+The train step is one fused jit program: forward (MXU convs), loss
+(BCE-with-logits + soft Dice, both mask-weighted to the slice's true
+extent), backward, and an Adam update via optax. Sharded training runs the
+same step over a ('data', 'model') mesh: batches split on 'data' (the
+reference's OpenMP axis), parameters split on output channels over 'model'
+(tensor parallelism); GSPMD inserts the gradient psums over ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from nm03_capstone_project_tpu.config import PipelineConfig
+from nm03_capstone_project_tpu.core.image import valid_mask
+from nm03_capstone_project_tpu.models.unet import apply_unet, param_shardings
+
+Params = Dict[str, Any]
+
+
+def make_optimizer(
+    lr: float = 1e-3, weight_decay: float = 1e-4, total_steps: Optional[int] = None
+):
+    """Clipped AdamW; with ``total_steps`` the lr follows warmup->cosine.
+
+    Distillation on small batches oscillates under constant lr (the loss was
+    observed bouncing 0.5 <-> 1.3 at 3e-3); the 5% linear warmup + cosine
+    decay stabilizes the endgame where the mask threshold (logit 0) lives.
+    """
+    if total_steps:
+        warmup = max(1, total_steps // 20)
+        schedule = optax.warmup_cosine_decay_schedule(
+            0.0, lr, warmup, total_steps, end_value=lr * 0.01
+        )
+    else:
+        schedule = lr
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(schedule, weight_decay=weight_decay),
+    )
+
+
+def segmentation_loss(
+    logits: jax.Array, labels: jax.Array, dims: jax.Array
+) -> jax.Array:
+    """BCE + soft-Dice, restricted to each slice's valid region.
+
+    ``labels`` is the teacher's uint8 mask, ``dims`` the (B, 2) true extents;
+    canvas padding must not teach the student anything, so both terms are
+    weighted by the validity mask.
+    """
+    canvas_hw = (logits.shape[-2], logits.shape[-1])
+    w = valid_mask(dims, canvas_hw).astype(jnp.float32)
+    y = labels.astype(jnp.float32)
+    bce = optax.sigmoid_binary_cross_entropy(logits, y)
+    bce = (bce * w).sum() / jnp.maximum(w.sum(), 1.0)
+    p = jax.nn.sigmoid(logits) * w
+    inter = (p * y).sum(axis=(-2, -1))
+    denom = p.sum(axis=(-2, -1)) + (y * w).sum(axis=(-2, -1))
+    dice = 1.0 - (2.0 * inter + 1.0) / (denom + 1.0)
+    return bce + dice.mean()
+
+
+@functools.partial(jax.jit, static_argnames=("tx", "compute_dtype"))
+def train_step(
+    params: Params,
+    opt_state,
+    pixels: jax.Array,
+    labels: jax.Array,
+    dims: jax.Array,
+    *,
+    tx,
+    compute_dtype=jnp.float32,
+) -> Tuple[Params, Any, jax.Array]:
+    """One SGD step; returns (params, opt_state, loss). jit-compiled."""
+
+    def loss_fn(p):
+        logits = apply_unet(p, pixels, compute_dtype)
+        return segmentation_loss(logits, labels, dims)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+
+def make_sharded_train_step(mesh, params: Params, tx, compute_dtype=jnp.bfloat16):
+    """jit the train step over a ('data', 'model') mesh.
+
+    Returns (step_fn, place_params) where ``place_params`` device_puts a host
+    param pytree into its tensor-parallel layout. Batch arrays shard on
+    'data'; optimizer state follows the parameters' shardings (optax states
+    mirror the param pytree structure leaf-for-leaf).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    p_shard = param_shardings(params, mesh)
+    batch_shard = NamedSharding(mesh, P("data"))
+
+    # structure-only trace: no host compute, just the opt-state pytree shape.
+    # param_shardings works on ShapeDtypeStruct leaves too, so adamw's mu/nu
+    # (which copy the param pytree leaf-for-leaf) land on the same devices as
+    # their params by construction.
+    opt_template = jax.eval_shape(tx.init, params)
+    o_shard = param_shardings(opt_template, mesh)
+
+    def step(params, opt_state, pixels, labels, dims):
+        def loss_fn(p):
+            logits = apply_unet(p, pixels, compute_dtype)
+            return segmentation_loss(logits, labels, dims)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    step_fn = jax.jit(
+        step,
+        in_shardings=(p_shard, o_shard, batch_shard, batch_shard, batch_shard),
+        out_shardings=(p_shard, o_shard, NamedSharding(mesh, P())),
+    )
+
+    def place_params(host_params):
+        return jax.device_put(host_params, p_shard)
+
+    return step_fn, place_params
+
+
+def prepare_student_inputs(
+    pixels: jax.Array, cfg: Optional[PipelineConfig] = None
+) -> jax.Array:
+    """Normalize + clip raw DICOM-scale intensities for the student.
+
+    The pipeline's two cheap elementwise front stages (the reference's
+    IntensityNormalization + IntensityClipping contract) map intensities
+    into ~[0.68, 2.5] — O(1) activations for the network. At deployment the
+    student consumes this and replaces everything downstream of it (the
+    7x7 median, sharpening, region-growing fixpoint and morphology — all
+    the expensive stages).
+    """
+    from nm03_capstone_project_tpu.ops.elementwise import clip_intensity, normalize
+
+    cfg = cfg or PipelineConfig()
+    x = normalize(
+        pixels, cfg.norm_low, cfg.norm_high, cfg.norm_intensity_min, cfg.norm_intensity_max
+    )
+    return clip_intensity(x, cfg.clip_low, cfg.clip_high)
+
+
+def distill_batch(
+    pixels: jax.Array, dims: jax.Array, cfg: Optional[PipelineConfig] = None
+) -> jax.Array:
+    """Teacher labels: run the classical pipeline, return its uint8 masks."""
+    from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_batch
+
+    cfg = cfg or PipelineConfig()
+    return process_batch(pixels, dims, cfg)["mask"]
+
+
+def fit(
+    params: Params,
+    pixels,
+    labels,
+    dims,
+    steps: int = 50,
+    lr: float = 1e-3,
+    compute_dtype=jnp.float32,
+):
+    """Small in-memory training loop (tests / single-chip fine-tuning).
+
+    Returns (params, list of losses). Multi-chip training drives
+    :func:`make_sharded_train_step` directly.
+    """
+    tx = make_optimizer(lr, total_steps=steps)
+    opt_state = tx.init(params)
+    losses = []
+    for _ in range(steps):
+        params, opt_state, loss = train_step(
+            params, opt_state, pixels, labels, dims, tx=tx, compute_dtype=compute_dtype
+        )
+        losses.append(float(loss))
+    return params, losses

@@ -1,0 +1,135 @@
+"""Learned model family: U-Net forward, distillation training, sharded step.
+
+The multi-device test runs the SAME train step over a ('data', 'model') mesh
+on the 8-virtual-device CPU backend and checks it agrees with the unsharded
+step — the formalization of "sharding must not change the math".
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nm03_capstone_project_tpu.config import PipelineConfig
+from nm03_capstone_project_tpu.core import pad_to_canvas
+from nm03_capstone_project_tpu.data.synthetic import phantom_series
+from nm03_capstone_project_tpu.models import (
+    apply_unet,
+    distill_batch,
+    fit,
+    init_unet,
+    make_optimizer,
+    make_sharded_train_step,
+    predict_mask,
+    prepare_student_inputs,
+    train_step,
+)
+from nm03_capstone_project_tpu.parallel import make_mesh
+
+CFG = PipelineConfig(canvas=64, grow_block_iters=8, grow_max_iters=128)
+
+
+def _batch(n=4, seed=3):
+    series = phantom_series(n, 64, 64, seed=seed)
+    batch = pad_to_canvas(series, CFG.canvas_hw)
+    return jnp.asarray(batch.pixels), jnp.asarray(batch.dims)
+
+
+def _student_batch(n=4, seed=3):
+    px, dims = _batch(n, seed)
+    return prepare_student_inputs(px, CFG), distill_batch(px, dims, CFG), dims
+
+
+class TestForward:
+    def test_logit_shapes_and_dtype(self):
+        params = init_unet(jax.random.PRNGKey(0), base=8)
+        px, _ = _batch(2)
+        logits = apply_unet(params, px, jnp.float32)
+        assert logits.shape == (2, 64, 64)
+        assert logits.dtype == jnp.float32
+
+    def test_bfloat16_compute_path_traces(self):
+        params = init_unet(jax.random.PRNGKey(0), base=8)
+        px, _ = _batch(2)
+        logits = jax.jit(lambda p, x: apply_unet(p, x, jnp.bfloat16))(params, px)
+        assert logits.dtype == jnp.float32  # logits cast back for the loss
+        assert np.isfinite(np.asarray(logits)).all()
+
+    def test_mask_contract_is_uint8(self):
+        params = init_unet(jax.random.PRNGKey(0), base=8)
+        px, _ = _batch(1)
+        m = predict_mask(params, px, jnp.float32)
+        assert m.dtype == jnp.uint8 and set(np.unique(np.asarray(m))) <= {0, 1}
+
+    def test_rejects_unaligned_base(self):
+        with pytest.raises(ValueError, match="multiple of 8"):
+            init_unet(jax.random.PRNGKey(0), base=12)
+
+
+class TestDistillation:
+    def test_teacher_labels_come_from_pipeline(self):
+        px, dims = _batch(3)
+        labels = distill_batch(px, dims, CFG)
+        assert labels.shape == (3, 64, 64) and labels.dtype == jnp.uint8
+
+    def test_prepared_inputs_are_order_one(self):
+        px, _ = _batch(2)
+        x = np.asarray(prepare_student_inputs(px, CFG))
+        assert x.min() >= CFG.clip_low - 1e-6 and x.max() <= CFG.clip_high
+
+    def test_loss_decreases(self):
+        x, labels, dims = _student_batch(4)
+        params = init_unet(jax.random.PRNGKey(1), base=8)
+        _, losses = fit(params, x, labels, dims, steps=30, lr=3e-3)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+    def test_student_learns_the_lesion(self):
+        x, labels, dims = _student_batch(6, seed=9)
+        params = init_unet(jax.random.PRNGKey(2), base=8)
+        params, _ = fit(params, x, labels, dims, steps=150, lr=3e-3)
+        pred = np.asarray(predict_mask(params, x, jnp.float32))
+        truth = np.asarray(labels)
+        inter = (pred & truth).sum()
+        union = (pred | truth).sum()
+        assert union > 0 and inter / union > 0.6, f"IoU {inter}/{union}"
+
+
+class TestShardedTraining:
+    def test_dp_tp_step_matches_unsharded(self):
+        n_dev = len(jax.devices())
+        if n_dev < 8:
+            pytest.skip("needs the 8-virtual-device CPU mesh")
+        mesh = make_mesh(8, axis_names=("data", "model"), axis_sizes=(4, 2))
+        x, labels, dims = _student_batch(8)
+        params = init_unet(jax.random.PRNGKey(4), base=8)
+        tx = make_optimizer(1e-3)
+
+        step_fn, place = make_sharded_train_step(
+            mesh, params, tx, compute_dtype=jnp.float32
+        )
+        sp = place(params)
+        s_opt = tx.init(sp)  # inherits the params' shardings leaf-for-leaf
+        new_sp, _, loss_sharded = step_fn(sp, s_opt, x, labels, dims)
+
+        opt0 = tx.init(params)
+        new_p, _, loss_plain = train_step(
+            params, opt0, x, labels, dims, tx=tx, compute_dtype=jnp.float32
+        )
+        assert np.allclose(float(loss_sharded), float(loss_plain), rtol=1e-5)
+        flat_s = jax.tree_util.tree_leaves(new_sp)
+        flat_p = jax.tree_util.tree_leaves(new_p)
+        for a, b in zip(flat_s, flat_p):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+    def test_kernels_actually_sharded_on_model_axis(self):
+        n_dev = len(jax.devices())
+        if n_dev < 8:
+            pytest.skip("needs the 8-virtual-device CPU mesh")
+        mesh = make_mesh(8, axis_names=("data", "model"), axis_sizes=(4, 2))
+        params = init_unet(jax.random.PRNGKey(5), base=8)
+        from nm03_capstone_project_tpu.models import param_shardings
+
+        shards = param_shardings(params, mesh)
+        head_spec = shards["head"]["w"].spec
+        assert tuple(head_spec) == (None, None, None, "model")
