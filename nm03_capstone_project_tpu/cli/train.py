@@ -1,0 +1,209 @@
+"""Distillation training driver for the learned model family.
+
+No reference counterpart exists — the reference is inference-only
+(SURVEY.md section 5 lists training/checkpointing as absent) — so this
+driver rounds out the framework: it reads a cohort exactly like the batch
+drivers (same discovery contract, same synthetic option), labels it by
+running the classical pipeline as teacher, trains the U-Net student, reports
+student-vs-teacher IoU, and writes an orbax checkpoint a later run can
+``--restore`` to fine-tune or ``--eval-only`` to score.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from nm03_capstone_project_tpu.cli import common
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nm03-train", description=__doc__.strip().splitlines()[0]
+    )
+    p.add_argument("--output", default="out-train", help="checkpoint/results root")
+    # the batch drivers' flags minus the ones training has no use for
+    # (--resume is the manifest's concept, --no-native the decode path's)
+    p.add_argument(
+        "--base-path",
+        default=None,
+        help="cohort root (defaults to $NM03_DATA_PATH/"
+        f"{common.DEFAULT_COHORT_SUBPATH}); ignored with --synthetic",
+    )
+    p.add_argument(
+        "--synthetic", type=int, default=0, metavar="N",
+        help="generate an N-patient synthetic cohort instead of reading real data",
+    )
+    p.add_argument(
+        "--synthetic-slices", type=int, default=8, help="slices per synthetic patient"
+    )
+    p.add_argument(
+        "--device", choices=["auto", "tpu", "cpu"], default="auto",
+        help="compute backend (cpu uses the host XLA backend)",
+    )
+    p.add_argument("--verbose", action="store_true", help="enable INFO logging")
+    p.add_argument(
+        "--results-json", default=None, help="write a training-results JSON"
+    )
+    p.add_argument(
+        "--profile-dir", default=None,
+        help="capture a jax.profiler trace of the training loop here",
+    )
+    common.add_pipeline_args(p)
+    t = p.add_argument_group("training")
+    t.add_argument("--steps", type=int, default=300)
+    t.add_argument("--lr", type=float, default=3e-3)
+    t.add_argument("--base-channels", type=int, default=16)
+    t.add_argument("--seed", type=int, default=0)
+    t.add_argument(
+        "--max-slices", type=int, default=256, help="cap on training slices loaded"
+    )
+    t.add_argument("--restore", default=None, help="checkpoint to continue from")
+    t.add_argument(
+        "--eval-only",
+        action="store_true",
+        help="skip training; just score --restore against the teacher",
+    )
+    t.add_argument(
+        "--bf16", action="store_true", help="bfloat16 compute (TPU-native precision)"
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    common.apply_device_env(args.device)
+    try:
+        return run(args)
+    except Exception as e:  # noqa: BLE001
+        print(f"Fatal error: {e}", file=sys.stderr)
+        return 1
+
+
+def _load_cohort(args, cfg):
+    """(pixels, dims) float32/int32 host arrays, padded to the canvas."""
+    import numpy as np
+
+    from nm03_capstone_project_tpu.data.dicomlite import read_dicom
+    from nm03_capstone_project_tpu.data.discovery import (
+        find_patient_dirs,
+        load_dicom_files_for_patient,
+    )
+
+    base = common.resolve_base_path(args, tmp_root=Path(args.output))
+    pixels, dims = [], []
+    for patient_id in find_patient_dirs(base):
+        for f in load_dicom_files_for_patient(base, patient_id):
+            if len(pixels) >= args.max_slices:
+                break
+            try:
+                px = read_dicom(f).pixels
+            except ValueError:
+                continue  # same skip-and-continue contract as the batch drivers
+            h, w = px.shape
+            if h < cfg.min_dim or w < cfg.min_dim or h > cfg.canvas or w > cfg.canvas:
+                continue
+            canvas = np.zeros((cfg.canvas, cfg.canvas), np.float32)
+            canvas[:h, :w] = px
+            pixels.append(canvas)
+            dims.append((h, w))
+    if not pixels:
+        raise SystemExit(f"no usable slices under {base}")
+    return np.stack(pixels), np.asarray(dims, np.int32)
+
+
+def run(args: argparse.Namespace) -> int:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from nm03_capstone_project_tpu.models import (
+        distill_batch,
+        fit,
+        init_unet,
+        predict_mask,
+        prepare_student_inputs,
+    )
+    from nm03_capstone_project_tpu.models.checkpoint import load_params, save_params
+    from nm03_capstone_project_tpu.utils.reporter import configure_reporting
+    from nm03_capstone_project_tpu.utils.timing import write_results_json
+
+    from nm03_capstone_project_tpu.core.image import valid_mask
+    from nm03_capstone_project_tpu.utils.profiling import profile_trace
+
+    configure_reporting(verbose=args.verbose)
+    cfg = common.pipeline_config_from_args(args)
+    if cfg.canvas % 4:
+        raise SystemExit("--canvas must be divisible by 4 (two U-Net poolings)")
+    if args.eval_only and not args.restore:
+        raise SystemExit("--eval-only needs --restore (nothing to score otherwise)")
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+
+    pixels, dims = _load_cohort(args, cfg)
+    print(f"cohort: {pixels.shape[0]} slices at {cfg.canvas}x{cfg.canvas}")
+
+    px = jnp.asarray(pixels)
+    dm = jnp.asarray(dims)
+    print("distilling teacher labels (classical pipeline)...")
+    labels = distill_batch(px, dm, cfg)
+    x = prepare_student_inputs(px, cfg)
+
+    if args.restore:
+        params, meta = load_params(args.restore)
+        print(f"restored checkpoint {args.restore} (meta: {meta})")
+    else:
+        params = init_unet(
+            jax.random.PRNGKey(args.seed), base=args.base_channels
+        )
+
+    losses = []
+    if not args.eval_only:
+        print(f"training {args.steps} steps at lr={args.lr}...")
+        with profile_trace(args.profile_dir):
+            params, losses = fit(
+                params, x, labels, dm, steps=args.steps, lr=args.lr, compute_dtype=dtype
+            )
+        if losses:
+            print(f"loss {losses[0]:.4f} -> {losses[-1]:.4f}")
+
+    # score only where the loss trained the student: canvas padding holds
+    # untrained logits and must not pollute the metric
+    vmask = np.asarray(valid_mask(dm, cfg.canvas_hw)).astype(bool)
+    pred = np.asarray(predict_mask(params, x, dtype)).astype(bool) & vmask
+    truth = np.asarray(labels).astype(bool) & vmask
+    inter = int((pred & truth).sum())
+    union = int((pred | truth).sum())
+    iou = inter / union if union else 1.0
+    print(f"student-vs-teacher IoU over {pred.shape[0]} slices: {iou:.3f}")
+
+    ckpt = Path(args.output) / "checkpoint"
+    if not args.eval_only:
+        save_params(
+            ckpt,
+            params,
+            meta={
+                "base_channels": args.base_channels,
+                "steps": args.steps,
+                "lr": args.lr,
+                "canvas": cfg.canvas,
+                "iou_vs_teacher": iou,
+            },
+        )
+        print(f"checkpoint written to {ckpt}")
+    if args.results_json:
+        write_results_json(
+            args.results_json,
+            {
+                "slices": int(pred.shape[0]),
+                "steps": 0 if args.eval_only else args.steps,
+                "final_loss": losses[-1] if losses else None,
+                "iou_vs_teacher": iou,
+            },
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -6,6 +6,10 @@ a U-Net student distilled from that chain (the teacher), with single-chip
 and mesh-sharded (data x tensor parallel) training steps.
 """
 
+from nm03_capstone_project_tpu.models.checkpoint import (  # noqa: F401
+    load_params,
+    save_params,
+)
 from nm03_capstone_project_tpu.models.train import (  # noqa: F401
     distill_batch,
     fit,

@@ -1,0 +1,57 @@
+"""Model checkpointing via orbax.
+
+The reference has no checkpoint/resume at all — a rerun wipes its outputs
+(``rm -rf`` in setupOutputDirectory, main_sequential.cpp:35-37; SURVEY.md
+section 5). The batch drivers got a resumable manifest (utils.manifest);
+this module is the same story for the learned model family: parameters and
+training metadata survive restarts, and a fine-tune can restore and
+continue. Orbax handles sharded arrays natively, so a checkpoint written
+from a ('data', 'model') mesh restores onto a different topology (with
+replication) or the same one (preserving layouts when a target is given).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, Optional, Tuple
+
+Params = Dict[str, Any]
+
+
+def save_params(
+    path: str | Path, params: Params, meta: Optional[dict] = None
+) -> None:
+    """Write ``params`` (any pytree of arrays) plus a JSON metadata sidecar.
+
+    ``meta`` should carry what's needed to rebuild the model skeleton
+    (base channels, levels, training step count...).
+    """
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    path.parent.mkdir(parents=True, exist_ok=True)
+    # force: a fine-tune run saves back into the checkpoint it restored from
+    ocp.PyTreeCheckpointer().save(path, params, force=True)
+    if meta is not None:
+        (path / "meta.json").write_text(json.dumps(meta, indent=1) + "\n")
+
+
+def load_params(
+    path: str | Path, target: Optional[Params] = None
+) -> Tuple[Params, Optional[dict]]:
+    """Restore (params, meta). ``target`` (a matching pytree, e.g. a fresh
+    ``init_unet`` result) pins dtypes/shardings; without it orbax restores
+    from the recorded layout."""
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    if not path.exists():
+        raise FileNotFoundError(f"no checkpoint at {path}")
+    if target is not None:
+        params = ocp.PyTreeCheckpointer().restore(path, item=target)
+    else:
+        params = ocp.PyTreeCheckpointer().restore(path)
+    meta_file = path / "meta.json"
+    meta = json.loads(meta_file.read_text()) if meta_file.exists() else None
+    return params, meta
