@@ -51,6 +51,33 @@ from nm03_capstone_project_tpu.utils.timing import Timer
 log = get_logger("runner")
 
 
+def decode_and_guard(path: Path, cfg: PipelineConfig) -> Optional[np.ndarray]:
+    """Decode + guard one slice; None signals failure (null-ptr analog).
+
+    The single home of the per-slice containment contract shared by every
+    driver: broad catch on decode (the reference skips unreadable images and
+    continues, main_sequential.cpp:288-294), the min-dimension guard
+    (main_sequential.cpp:189-192), and the canvas-fit guard.
+    """
+    try:
+        s = read_dicom(path)
+    except Exception as e:  # noqa: BLE001 - per-slice containment
+        log.warning("failed to read %s: %s", path.name, e)
+        return None
+    h, w = s.pixels.shape
+    if h < cfg.min_dim or w < cfg.min_dim:
+        # reference: "Image dimensions too small" (main_sequential.cpp:189-192)
+        log.warning("image dimensions too small: %dx%d (%s)", w, h, path.name)
+        return None
+    if h > cfg.canvas or w > cfg.canvas:
+        log.warning(
+            "slice %s (%dx%d) exceeds canvas %d; raise --canvas",
+            path.name, w, h, cfg.canvas,
+        )
+        return None
+    return s.pixels
+
+
 def _native_available() -> bool:
     from nm03_capstone_project_tpu import native
 
@@ -175,23 +202,7 @@ class CohortProcessor:
 
     def _read_slice(self, path: Path) -> Optional[np.ndarray]:
         """Decode + guard one slice; None signals failure (null-ptr analog)."""
-        try:
-            s = read_dicom(path)
-        except Exception as e:  # noqa: BLE001 - per-slice containment
-            log.warning("failed to read %s: %s", path.name, e)
-            return None
-        h, w = s.pixels.shape
-        if h < self.cfg.min_dim or w < self.cfg.min_dim:
-            # reference: "Image dimensions too small" (main_sequential.cpp:189-192)
-            log.warning("image dimensions too small: %dx%d (%s)", w, h, path.name)
-            return None
-        if h > self.cfg.canvas or w > self.cfg.canvas:
-            log.warning(
-                "slice %s (%dx%d) exceeds canvas %d; raise --canvas",
-                path.name, w, h, self.cfg.canvas,
-            )
-            return None
-        return s.pixels
+        return decode_and_guard(path, self.cfg)
 
     # -- patient processing ------------------------------------------------
 
