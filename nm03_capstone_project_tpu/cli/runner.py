@@ -90,23 +90,11 @@ def _compiled_slice_fn(cfg: PipelineConfig):
     import jax
 
     from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
-    from nm03_capstone_project_tpu.render.render import (
-        render_gray,
-        render_segmentation,
-    )
+    from nm03_capstone_project_tpu.render.render import render_pair
 
     def f(pixels, dims):
         out = process_slice(pixels, dims, cfg)
-        orig = render_gray(out["original"], dims, cfg.render_size)
-        proc = render_segmentation(
-            out["mask"],
-            dims,
-            cfg.render_size,
-            cfg.overlay_opacity,
-            cfg.overlay_border_opacity,
-            cfg.overlay_border_radius,
-        )
-        return orig, proc
+        return render_pair(out["original"], out["mask"], dims, cfg)
 
     return jax.jit(f)
 
@@ -117,25 +105,16 @@ def _compiled_batch_fn(cfg: PipelineConfig):
     import jax
 
     from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
-    from nm03_capstone_project_tpu.render.render import (
-        render_gray,
-        render_segmentation,
-    )
+    from nm03_capstone_project_tpu.render.render import render_pair
 
     def one(pixels, dims):
         out = process_slice(pixels, dims, cfg)
-        orig = render_gray(out["original"], dims, cfg.render_size)
-        proc = render_segmentation(
-            out["mask"],
-            dims,
-            cfg.render_size,
-            cfg.overlay_opacity,
-            cfg.overlay_border_opacity,
-            cfg.overlay_border_radius,
-        )
-        return orig, proc
+        return render_pair(out["original"], out["mask"], dims, cfg)
 
-    return jax.jit(jax.vmap(one))
+    # donate the pixel stack: the raw canvas batch is dead after the pipeline
+    # reads it, so XLA may reuse its HBM for intermediates (the render output
+    # is a different shape, but fusion scratch benefits)
+    return jax.jit(jax.vmap(one), donate_argnums=(0,))
 
 
 @dataclass

@@ -99,21 +99,13 @@ def _compiled_volume_fn(cfg):
     import jax
 
     from nm03_capstone_project_tpu.pipeline.volume_pipeline import process_volume
-    from nm03_capstone_project_tpu.render.render import render_gray, render_segmentation
+    from nm03_capstone_project_tpu.render.render import render_pair
 
     def f(vol, dims):
         out = process_volume(vol, dims, cfg)
-        gray = jax.vmap(lambda p: render_gray(p, dims, cfg.render_size))(vol)
-        seg = jax.vmap(
-            lambda m: render_segmentation(
-                m,
-                dims,
-                cfg.render_size,
-                cfg.overlay_opacity,
-                cfg.overlay_border_opacity,
-                cfg.overlay_border_radius,
-            )
-        )(out["mask"])
+        gray, seg = jax.vmap(lambda p, m: render_pair(p, m, dims, cfg))(
+            vol, out["mask"]
+        )
         return out["mask"], gray, seg
 
     return jax.jit(f)
@@ -125,21 +117,10 @@ def _compiled_render_fn(cfg):
     runs through parallel.process_volume_zsharded separately)."""
     import jax
 
-    from nm03_capstone_project_tpu.render.render import render_gray, render_segmentation
+    from nm03_capstone_project_tpu.render.render import render_pair
 
     def f(vol, mask, dims):
-        gray = jax.vmap(lambda p: render_gray(p, dims, cfg.render_size))(vol)
-        seg = jax.vmap(
-            lambda m: render_segmentation(
-                m,
-                dims,
-                cfg.render_size,
-                cfg.overlay_opacity,
-                cfg.overlay_border_opacity,
-                cfg.overlay_border_radius,
-            )
-        )(mask)
-        return gray, seg
+        return jax.vmap(lambda p, m: render_pair(p, m, dims, cfg))(vol, mask)
 
     return jax.jit(f)
 
@@ -152,7 +133,11 @@ def run(args: argparse.Namespace) -> int:
 
     from nm03_capstone_project_tpu.data.discovery import find_patient_dirs
     from nm03_capstone_project_tpu.render.export import clean_directory, export_pairs
-    from nm03_capstone_project_tpu.utils.manifest import STATUS_DONE, Manifest
+    from nm03_capstone_project_tpu.utils.manifest import (
+        STATUS_DONE,
+        STATUS_FAILED,
+        Manifest,
+    )
     from nm03_capstone_project_tpu.utils.profiling import profile_trace
     from nm03_capstone_project_tpu.utils.reporter import configure_reporting
     from nm03_capstone_project_tpu.utils.timing import Timer, write_results_json
@@ -182,13 +167,21 @@ def run(args: argparse.Namespace) -> int:
     with profile_trace(args.profile_dir):
         for pid in patients:
             try:
+                if args.resume:
+                    # stems come from the listing alone — no decode needed to
+                    # decide a patient is already complete
+                    from nm03_capstone_project_tpu.data.discovery import (
+                        load_dicom_files_for_patient,
+                    )
+
+                    listed = [f.stem for f in load_dicom_files_for_patient(base, pid)]
+                    if listed and manifest.patient_done(pid, listed):
+                        print(f"Patient {pid}: already complete, skipping")
+                        ok_patients += 1
+                        continue
                 with timer.section(f"load/{pid}"):
                     vol, dims, stems = _load_volume(base, pid, cfg)
                 depth = vol.shape[0]
-                if args.resume and manifest.patient_done(pid, stems):
-                    print(f"Patient {pid}: already complete, skipping")
-                    ok_patients += 1
-                    continue
                 with timer.section(f"compute/{pid}"):
                     if zshard:
                         from nm03_capstone_project_tpu.parallel import (
@@ -233,7 +226,18 @@ def run(args: argparse.Namespace) -> int:
                         )
 
                         write_metaimage(mask, out_root / pid / "mask.mhd")
-                ok_patients += 1
+                missing = sorted(set(stems) - set(done))
+                for stem in missing:
+                    manifest.record(pid, stem, STATUS_FAILED)
+                if missing:
+                    manifest.flush()
+                    # success is "the JPEG pair exists" (runner contract)
+                    print(
+                        f"Patient {pid}: {len(missing)} slices failed to export",
+                        file=sys.stderr,
+                    )
+                else:
+                    ok_patients += 1
                 results[pid] = {
                     "slices": depth,
                     "exported": len(done),

@@ -10,7 +10,11 @@ inserting the collectives —
 * :mod:`.dp`   — slice/patient data parallelism (zero-communication SPMD).
 * :mod:`.zshard` — sequence-parallel analog: volumes sharded along z with
   ring halo exchange (`ppermute`) per growth step and `psum` convergence.
+* :mod:`.distributed` — multi-host backend: `jax.distributed` init + a
+  global mesh over every host's chips (ICI within a slice, DCN across).
 """
+
+from nm03_capstone_project_tpu.parallel import distributed  # noqa: F401
 
 from nm03_capstone_project_tpu.parallel.dp import process_batch_sharded  # noqa: F401
 from nm03_capstone_project_tpu.parallel.mesh import (  # noqa: F401

@@ -1,0 +1,95 @@
+"""Multi-host initialization: the distributed communication backend.
+
+The reference is strictly single-process shared memory + OpenMP (SURVEY.md
+section 2.3: no NCCL/MPI/Gloo anywhere). The TPU-native equivalent of a
+multi-node backend is ``jax.distributed`` — one Python process per host,
+all chips joined into one global device set, XLA collectives riding ICI
+within a slice and DCN across hosts. Nothing else in this framework changes
+for multi-host: the same ``Mesh``-based code runs over
+``jax.devices()`` whether that is 1 chip or a pod slice; only the mesh
+construction distinguishes local from global devices.
+
+Usage on each host of a multi-host job::
+
+    from nm03_capstone_project_tpu.parallel import distributed
+    distributed.initialize()          # no-op single-host, env-driven multi-host
+    mesh = distributed.global_mesh(("data",))
+    # ... identical pjit/shard_map code as single-host ...
+
+On TPU pods the coordinator address / process count / process id come from
+the TPU runtime and ``initialize()`` needs no arguments; elsewhere they can
+be passed explicitly or via JAX's standard environment variables.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join this process into the multi-host job; returns True if it did.
+
+    Single-process runs (num_processes absent or 1, no coordinator found)
+    are a no-op returning False — so drivers can call this unconditionally.
+    Safe to call twice (second call is a no-op).
+    """
+    global _initialized
+    if _initialized:
+        return True
+    import jax
+
+    try:
+        # With no arguments jax runs its cluster autodetection (TPU-pod
+        # metadata, SLURM, GKE, JAX_COORDINATOR_ADDRESS env...); pre-guarding
+        # on env vars here would defeat it. On a plain single host detection
+        # finds nothing and raises — that is the no-op path.
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (ValueError, RuntimeError):
+        if coordinator_address is not None or num_processes is not None:
+            raise  # an explicit multi-host request must not fail silently
+        return False
+    _initialized = True
+    return True
+
+
+def global_mesh(axis_names: Sequence[str] = ("data",), axis_sizes=None):
+    """Mesh over EVERY device in the job (all hosts), not just local ones.
+
+    Mirrors :func:`nm03_capstone_project_tpu.parallel.make_mesh` but over the
+    global device set, laid out so the trailing mesh axis varies fastest
+    within a host — keeping intra-host neighbors on ICI and crossing DCN only
+    along the leading (typically ``data``) axis.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = jax.devices()  # global across processes after initialize()
+    n = len(devices)
+    if axis_sizes is None:
+        axis_sizes = [n] + [1] * (len(axis_names) - 1)
+    if int(np.prod(axis_sizes)) != n:
+        raise ValueError(f"axis_sizes {axis_sizes} != global device count {n}")
+    return Mesh(np.asarray(devices).reshape(axis_sizes), tuple(axis_names))
+
+
+def process_info() -> dict:
+    """{'process_index', 'process_count', 'local_devices', 'global_devices'}."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

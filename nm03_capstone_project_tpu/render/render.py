@@ -163,3 +163,25 @@ def render_overlay(
     alpha = _mask_alpha(mask, dims, out_size, opacity, border_opacity, border_radius)
     out = gray * (1.0 - alpha) + 255.0 * alpha
     return jnp.clip(out, 0, 255).astype(jnp.uint8)
+
+
+def render_pair(
+    pixels: jax.Array, mask: jax.Array, dims: jax.Array, cfg
+) -> Tuple[jax.Array, jax.Array]:
+    """(grayscale render, segmentation render) for one slice per ``cfg``.
+
+    The single home of the batch drivers' export contract (one `_original`
+    and one `_processed` image per slice, main_sequential.cpp:61-73) so the
+    render parameters are threaded from PipelineConfig in exactly one place;
+    vmap over a leading axis for stacks.
+    """
+    gray = render_gray(pixels, dims, cfg.render_size)
+    seg = render_segmentation(
+        mask,
+        dims,
+        cfg.render_size,
+        cfg.overlay_opacity,
+        cfg.overlay_border_opacity,
+        cfg.overlay_border_radius,
+    )
+    return gray, seg

@@ -135,3 +135,41 @@ class TestCollectiveLowering:
         txt = _compiled_zsharded(meshz, CFG).lower(vol, dims).as_text()
         assert "collective_permute" in txt or "collective-permute" in txt
         assert "all_reduce" in txt or "all-reduce" in txt
+
+
+class TestDistributed:
+    """Multi-host wrapper: single-process behavior (multi-host needs a pod)."""
+
+    def test_initialize_is_noop_single_process(self, monkeypatch):
+        from nm03_capstone_project_tpu.parallel import distributed
+
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        assert distributed.initialize() is False
+
+    def test_global_mesh_covers_all_devices(self):
+        import jax
+
+        from nm03_capstone_project_tpu.parallel import distributed
+
+        n = len(jax.devices())
+        mesh = distributed.global_mesh(("data",))
+        assert mesh.size == n
+        if n % 2 == 0:
+            mesh2 = distributed.global_mesh(("data", "model"), (n // 2, 2))
+            assert mesh2.shape == {"data": n // 2, "model": 2}
+
+    def test_global_mesh_rejects_bad_sizes(self):
+        import jax
+        import pytest as _pytest
+
+        from nm03_capstone_project_tpu.parallel import distributed
+
+        with _pytest.raises(ValueError, match="global device count"):
+            distributed.global_mesh(("data",), (len(jax.devices()) + 1,))
+
+    def test_process_info_single_host(self):
+        from nm03_capstone_project_tpu.parallel import distributed
+
+        info = distributed.process_info()
+        assert info["process_count"] == 1 and info["process_index"] == 0
+        assert info["global_devices"] == info["local_devices"]
