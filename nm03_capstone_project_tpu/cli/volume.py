@@ -66,10 +66,11 @@ def _load_volume(base, patient_id, cfg):
     from nm03_capstone_project_tpu.cli.runner import decode_and_guard
     from nm03_capstone_project_tpu.data.discovery import load_dicom_files_for_patient
 
-    planes, stems, hw = [], [], None
+    planes, stems, skipped, hw = [], [], [], None
     for f in load_dicom_files_for_patient(base, patient_id):
         px = decode_and_guard(f, cfg)
         if px is None:
+            skipped.append(f.stem)
             continue
         h, w = px.shape
         if hw is None:
@@ -79,6 +80,7 @@ def _load_volume(base, patient_id, cfg):
                 f"  skipping {f.name}: {w}x{h} != series {hw[1]}x{hw[0]}",
                 file=sys.stderr,
             )
+            skipped.append(f.stem)
             continue
         canvas = np.zeros((cfg.canvas, cfg.canvas), np.float32)
         canvas[:h, :w] = px
@@ -86,7 +88,7 @@ def _load_volume(base, patient_id, cfg):
         stems.append(f.stem)
     if not planes:
         raise ValueError(f"no usable slices for {patient_id}")
-    return np.stack(planes), np.asarray(hw, np.int32), stems
+    return np.stack(planes), np.asarray(hw, np.int32), stems, skipped
 
 
 @functools.lru_cache(maxsize=4)
@@ -169,18 +171,21 @@ def run(args: argparse.Namespace) -> int:
             try:
                 if args.resume:
                     # stems come from the listing alone — no decode needed to
-                    # decide a patient is already complete
+                    # decide a patient is fully visited (done or recorded bad)
                     from nm03_capstone_project_tpu.data.discovery import (
                         load_dicom_files_for_patient,
                     )
 
                     listed = [f.stem for f in load_dicom_files_for_patient(base, pid)]
-                    if listed and manifest.patient_done(pid, listed):
+                    if listed and manifest.patient_accounted(pid, listed):
                         print(f"Patient {pid}: already complete, skipping")
                         ok_patients += 1
                         continue
                 with timer.section(f"load/{pid}"):
-                    vol, dims, stems = _load_volume(base, pid, cfg)
+                    vol, dims, stems, skipped = _load_volume(base, pid, cfg)
+                for stem in skipped:
+                    # record load-time rejects so --resume can account for them
+                    manifest.record(pid, stem, STATUS_FAILED)
                 depth = vol.shape[0]
                 with timer.section(f"compute/{pid}"):
                     if zshard:

@@ -75,9 +75,9 @@ class PipelineConfig:
     # Region-growing fixpoint: dilations per convergence check and a hard cap.
     grow_block_iters: int = 16
     grow_max_iters: int = 1024
-    # Route the hot ops through the Pallas TPU kernels (ops.pallas_median)
-    # instead of the portable XLA implementations. Defaults False until the
-    # caller knows it's on a TPU backend.
+    # Route the hot ops through the Pallas TPU kernels (ops.pallas_median,
+    # ops.pallas_region_growing) instead of the portable XLA implementations.
+    # Defaults False until the caller knows it's on a TPU backend.
     use_pallas: bool = False
 
     def __post_init__(self):

@@ -1,0 +1,179 @@
+"""Pallas TPU kernel for seeded region growing.
+
+The segmentation fixpoint (FAST ``SeededRegionGrowing::create(0.74f, 0.91f,
+seeds)``, src/test/test_pipeline.cpp:98-108) is the pipeline's other hot op
+besides the median. The portable XLA version (:mod:`.region_growing`) runs
+each dilate-and-mask step as its own fused HBM pass; this kernel instead
+keeps the whole slice resident in VMEM and iterates there — a (H+2, W+2)
+scratch pad holds the region with a zero halo, each step reads the four
+(or eight) neighbor windows as static slices of the pad, maxes them, masks
+with the intensity band, and writes back, so a 256x256 slice pays one HBM
+read (band + seeds) and one write (mask) for the entire fixpoint instead of
+one round-trip per step.
+
+Convergence matches the XLA implementation exactly: ``block_iters`` steps
+per popcount check (the region only grows, so popcount equality is set
+equality), hard-capped at ``max_iters``. The XLA path is the oracle; tests
+assert bit-identical masks in interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _grow_kernel(
+    band_ref,
+    seed_ref,
+    out_ref,
+    scr,
+    *,
+    h: int,
+    w: int,
+    connectivity: int,
+    block_iters: int,
+    max_iters: int,
+):
+    """Fixpoint for one slice; ``scr`` is the (h+2, w+2) haloed region pad."""
+    band = band_ref[0]
+    scr[:, :] = jnp.zeros((h + 2, w + 2), jnp.float32)
+    scr[1 : h + 1, 1 : w + 1] = seed_ref[0] * band
+
+    def run_block(_):
+        def step(_, carry):
+            c = scr[1 : h + 1, 1 : w + 1]
+            up = scr[0:h, 1 : w + 1]
+            dn = scr[2 : h + 2, 1 : w + 1]
+            lf = scr[1 : h + 1, 0:w]
+            rt = scr[1 : h + 1, 2 : w + 2]
+            grown = jnp.maximum(
+                jnp.maximum(jnp.maximum(up, dn), jnp.maximum(lf, rt)), c
+            )
+            if connectivity == 8:
+                ul = scr[0:h, 0:w]
+                ur = scr[0:h, 2 : w + 2]
+                dl = scr[2 : h + 2, 0:w]
+                dr = scr[2 : h + 2, 2 : w + 2]
+                grown = jnp.maximum(
+                    grown, jnp.maximum(jnp.maximum(ul, ur), jnp.maximum(dl, dr))
+                )
+            scr[1 : h + 1, 1 : w + 1] = grown * band
+            return carry
+
+        jax.lax.fori_loop(0, block_iters, step, 0)
+        return jnp.sum(scr[1 : h + 1, 1 : w + 1])
+
+    def cond(state):
+        prev, cur, iters = state
+        return (cur != prev) & (iters < max_iters)
+
+    def body(state):
+        _, cur, iters = state
+        return cur, run_block(0), iters + block_iters
+
+    # mirror region_growing.region_grow: one unconditional block, then
+    # iterate until the popcount stops changing
+    c0 = jnp.sum(scr[1 : h + 1, 1 : w + 1])
+    c1 = run_block(0)
+    jax.lax.while_loop(cond, body, (c0, c1, jnp.int32(block_iters)))
+    out_ref[0] = scr[1 : h + 1, 1 : w + 1]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("connectivity", "block_iters", "max_iters", "interpret"),
+)
+def _grow_pallas_batched(
+    band: jax.Array,
+    seeds: jax.Array,
+    connectivity: int,
+    block_iters: int,
+    max_iters: int,
+    interpret: bool,
+) -> jax.Array:
+    b, h, w = band.shape
+    kernel = functools.partial(
+        _grow_kernel,
+        h=h,
+        w=w,
+        connectivity=connectivity,
+        block_iters=block_iters,
+        max_iters=max_iters,
+    )
+    spec = pl.BlockSpec((1, h, w), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        grid=(b,),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, w), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((h + 2, w + 2), jnp.float32)],
+        interpret=interpret,
+    )(band, seeds)
+
+
+def region_grow_pallas(
+    image: jax.Array,
+    seeds: jax.Array,
+    low: float = 0.74,
+    high: float = 0.91,
+    valid: jax.Array | None = None,
+    connectivity: int = 4,
+    block_iters: int = 16,
+    max_iters: int = 1024,
+    interpret: bool = False,
+) -> jax.Array:
+    """Drop-in Pallas variant of :func:`.region_growing.region_grow`."""
+    if connectivity not in (4, 8):
+        raise ValueError(f"connectivity must be 4 or 8, got {connectivity}")
+    band = (image >= low) & (image <= high)
+    if valid is not None:
+        band = band & valid
+    orig_shape = band.shape
+    bandb = band.reshape((-1,) + band.shape[-2:]).astype(jnp.float32)
+    seedb = (
+        seeds.astype(bool).reshape((-1,) + seeds.shape[-2:]).astype(jnp.float32)
+    )
+    out = _grow_pallas_batched(
+        bandb, seedb, connectivity, block_iters, max_iters, interpret
+    )
+    return out.reshape(orig_shape).astype(jnp.uint8)
+
+
+def grow_dispatch(
+    image,
+    seeds,
+    low,
+    high,
+    valid=None,
+    connectivity: int = 4,
+    block_iters: int = 16,
+    max_iters: int = 1024,
+    use_pallas: bool = False,
+):
+    """Route between the Pallas kernel and the portable XLA implementation.
+
+    Same dispatch contract as :func:`.pallas_median.median_filter`: off-TPU
+    the Pallas request degrades to the XLA path (identical results).
+    """
+    if use_pallas and jax.default_backend() != "cpu":
+        return region_grow_pallas(
+            image, seeds, low, high, valid, connectivity, block_iters, max_iters
+        )
+    from nm03_capstone_project_tpu.ops.region_growing import region_grow
+
+    return region_grow(
+        image,
+        seeds,
+        low,
+        high,
+        valid=valid,
+        connectivity=connectivity,
+        block_iters=block_iters,
+        max_iters=max_iters,
+    )

@@ -54,10 +54,26 @@ def initialize(
             num_processes=num_processes,
             process_id=process_id,
         )
-    except (ValueError, RuntimeError):
+    except (ValueError, RuntimeError) as e:
         if coordinator_address is not None or num_processes is not None:
             raise  # an explicit multi-host request must not fail silently
-        return False
+        msg = str(e).lower()
+        if "must be called before" in msg:
+            # backends already initialized (e.g. a long-lived session calling
+            # this late) — multi-host init is impossible now; warn, don't die
+            from nm03_capstone_project_tpu.utils.reporter import get_logger
+
+            get_logger("distributed").warning(
+                "jax backends already initialized; distributed init skipped"
+            )
+            return False
+        # "nothing to join": jax complains about the undefined coordinator /
+        # process count. Anything else (unreachable coordinator, barrier
+        # timeout, mismatched counts) is a DETECTED cluster failing to join —
+        # silently degrading to single-host would run duplicate workloads.
+        if "coordinator_address" in msg or "num_processes" in msg or "process_id" in msg:
+            return False
+        raise
     _initialized = True
     return True
 

@@ -33,7 +33,7 @@ from nm03_capstone_project_tpu.ops.elementwise import cast_uint8, clip_intensity
 from nm03_capstone_project_tpu.ops.pallas_median import median_filter
 from nm03_capstone_project_tpu.ops.morphology import dilate, erode
 from nm03_capstone_project_tpu.ops.neighborhood import extend_edges
-from nm03_capstone_project_tpu.ops.region_growing import region_grow
+from nm03_capstone_project_tpu.ops.pallas_region_growing import grow_dispatch
 from nm03_capstone_project_tpu.ops.seeds import seed_mask
 from nm03_capstone_project_tpu.ops.sharpen import sharpen
 
@@ -64,7 +64,7 @@ def segment(
     canvas_hw = preprocessed.shape[-2:]
     seeds = seed_mask(dims, canvas_hw)
     valid = valid_mask(dims, canvas_hw)
-    return region_grow(
+    return grow_dispatch(
         preprocessed,
         seeds,
         cfg.grow_low,
@@ -72,6 +72,7 @@ def segment(
         valid=valid,
         block_iters=cfg.grow_block_iters,
         max_iters=cfg.grow_max_iters,
+        use_pallas=cfg.use_pallas,
     )
 
 

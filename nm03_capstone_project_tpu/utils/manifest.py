@@ -48,6 +48,13 @@ class Manifest:
         done = self.data.get(patient_id, {})
         return all(done.get(s) == STATUS_DONE for s in stems) and bool(stems)
 
+    def patient_accounted(self, patient_id: str, stems) -> bool:
+        """Every stem has SOME recorded status (done or failed) — i.e. a
+        prior run fully visited this patient; permanently-bad slices must not
+        force an eternal re-run under --resume."""
+        seen = self.data.get(patient_id, {})
+        return all(s in seen for s in stems) and bool(stems)
+
     def flush(self) -> None:
         """Atomic write (tmp + rename) so a crash never corrupts the manifest."""
         self.path.parent.mkdir(parents=True, exist_ok=True)

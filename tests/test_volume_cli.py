@@ -69,6 +69,23 @@ class TestVolumeCLI:
         text = capsys.readouterr().out
         assert text.count("already complete, skipping") == 2
 
+    def test_resume_accounts_for_permanently_bad_slices(self, tmp_path, capsys):
+        # a patient with one unreadable slice must still skip on resume
+        # (regression: listing-stems vs usable-stems mismatch re-ran forever)
+        rc, out = _run(tmp_path)
+        assert rc == 0
+        bad = next((out / "synthetic-cohort-2x4" / "PGBM-0001").rglob("*.dcm"))
+        bad.write_bytes(b"junk")
+        capsys.readouterr()
+        args = [
+            "--synthetic", "2", "--synthetic-slices", "4", "--output", str(out),
+        ]
+        assert volume_cli.main(args) == 0  # re-run visits + records the bad slice
+        capsys.readouterr()
+        assert volume_cli.main(args + ["--resume"]) == 0
+        text = capsys.readouterr().out
+        assert text.count("already complete, skipping") == 2
+
     def test_patient_failure_contained(self, tmp_path):
         rc, out = _run(tmp_path)
         assert rc == 0
