@@ -58,15 +58,20 @@ def _make_batch():
     return pixels, dims
 
 
-def _bench_on(device, pixels, dims, reps) -> float:
-    """Slices/sec of the jitted vmapped pipeline on one device."""
+def _bench_on(device, pixels, dims, reps, use_pallas=False) -> float:
+    """Slices/sec of the jitted vmapped pipeline on one device.
+
+    ``use_pallas`` routes the hot ops (7x7 median, region growing) through
+    the Pallas TPU kernels; lowering failures propagate — the caller decides
+    the fallback.
+    """
     import jax
     import jax.numpy as jnp
 
     from nm03_capstone_project_tpu.config import PipelineConfig
     from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_batch
 
-    cfg = PipelineConfig()
+    cfg = PipelineConfig(use_pallas=use_pallas)
 
     def f(px, dm):
         # Scalar checksum: forces the whole pipeline to run, and fetching it
@@ -81,7 +86,10 @@ def _bench_on(device, pixels, dims, reps) -> float:
 
     t0 = time.perf_counter()
     checksum = int(fn(px, dm))  # device_get = real synchronization
-    _log(f"{device.platform}: compile+first run {time.perf_counter() - t0:.1f}s")
+    _log(
+        f"{device.platform}{' (pallas)' if use_pallas else ''}: "
+        f"compile+first run {time.perf_counter() - t0:.1f}s"
+    )
     if checksum <= 0:
         _log("WARNING: pipeline segmented nothing — benchmark suspect")
 
@@ -99,8 +107,20 @@ def main() -> None:
 
     devices = jax.devices()
     main_dev = devices[0]
+    # pltpu kernels lower only on TPU hardware ("axon" = TPU via tunnel);
+    # never attempt them on GPU/other non-CPU backends
+    on_tpu = main_dev.platform in ("tpu", "axon")
     _log(f"default backend: {main_dev.platform} ({len(devices)} devices)")
-    tput = _bench_on(main_dev, pixels, dims, TPU_REPS)
+    pallas_tput = None
+    if on_tpu:
+        try:
+            pallas_tput = _bench_on(main_dev, pixels, dims, TPU_REPS, use_pallas=True)
+            _log(f"tpu pallas throughput: {pallas_tput:.2f} slices/s")
+        except Exception as e:  # noqa: BLE001 — pallas lowering failure
+            _log(f"pallas path failed, using XLA ops only: {e!r:.500}")
+    tput = _bench_on(main_dev, pixels, dims, TPU_REPS, use_pallas=False)
+    if pallas_tput is not None:
+        tput = max(tput, pallas_tput)  # report the better of the two paths
     _log(f"{main_dev.platform} throughput: {tput:.2f} slices/s")
 
     vs_baseline = 1.0
