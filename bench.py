@@ -97,7 +97,7 @@ def _bench_on(device, pixels, dims, reps, use_pallas=False) -> float:
     results = [fn(px, dm) for _ in range(reps)]  # enqueue, FIFO stream
     int(results[-1])  # one sync: FIFO order implies all earlier reps finished
     elapsed = time.perf_counter() - t0
-    return BATCH * reps / elapsed
+    return BATCH * reps / elapsed, checksum
 
 
 def main() -> None:
@@ -111,16 +111,26 @@ def main() -> None:
     # never attempt them on GPU/other non-CPU backends
     on_tpu = main_dev.platform in ("tpu", "axon")
     _log(f"default backend: {main_dev.platform} ({len(devices)} devices)")
-    pallas_tput = None
+    pallas_tput = pallas_sum = None
     if on_tpu:
         try:
-            pallas_tput = _bench_on(main_dev, pixels, dims, TPU_REPS, use_pallas=True)
+            pallas_tput, pallas_sum = _bench_on(
+                main_dev, pixels, dims, TPU_REPS, use_pallas=True
+            )
             _log(f"tpu pallas throughput: {pallas_tput:.2f} slices/s")
         except Exception as e:  # noqa: BLE001 — pallas lowering failure
             _log(f"pallas path failed, using XLA ops only: {e!r:.500}")
-    tput = _bench_on(main_dev, pixels, dims, TPU_REPS, use_pallas=False)
+    tput, xla_sum = _bench_on(main_dev, pixels, dims, TPU_REPS, use_pallas=False)
     if pallas_tput is not None:
-        tput = max(tput, pallas_tput)  # report the better of the two paths
+        # only a result-identical pallas run may win the headline number —
+        # a miscompiled kernel must not corrupt the benchmark record
+        if pallas_sum == xla_sum:
+            tput = max(tput, pallas_tput)
+        else:
+            _log(
+                f"pallas checksum {pallas_sum} != xla checksum {xla_sum}; "
+                "ignoring pallas throughput"
+            )
     _log(f"{main_dev.platform} throughput: {tput:.2f} slices/s")
 
     vs_baseline = 1.0

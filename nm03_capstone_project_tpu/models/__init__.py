@@ -25,3 +25,9 @@ from nm03_capstone_project_tpu.models.unet import (  # noqa: F401
     param_shardings,
     predict_mask,
 )
+from nm03_capstone_project_tpu.models.unet3d import (  # noqa: F401
+    apply_unet3d,
+    distill_volume,
+    init_unet3d,
+    predict_mask3d,
+)

@@ -59,10 +59,16 @@ def segmentation_loss(
 
     ``labels`` is the teacher's uint8 mask, ``dims`` the (B, 2) true extents;
     canvas padding must not teach the student anything, so both terms are
-    weighted by the validity mask.
+    weighted by the validity mask. Works for slice batches (B, H, W) and
+    volume batches (B, D, H, W) — every plane of a volume shares its series'
+    in-plane extent, so the 2D validity mask broadcasts over depth.
     """
     canvas_hw = (logits.shape[-2], logits.shape[-1])
     w = valid_mask(dims, canvas_hw).astype(jnp.float32)
+    if logits.ndim == w.ndim + 1:  # (B, D, H, W) logits, (B, H, W) mask
+        # materialize the depth axis: w.sum() must count every valid voxel
+        # or the BCE normalizer is off by a factor of D
+        w = jnp.broadcast_to(w[..., None, :, :], logits.shape)
     y = labels.astype(jnp.float32)
     bce = optax.sigmoid_binary_cross_entropy(logits, y)
     bce = (bce * w).sum() / jnp.maximum(w.sum(), 1.0)
@@ -73,7 +79,7 @@ def segmentation_loss(
     return bce + dice.mean()
 
 
-@functools.partial(jax.jit, static_argnames=("tx", "compute_dtype"))
+@functools.partial(jax.jit, static_argnames=("tx", "compute_dtype", "apply_fn"))
 def train_step(
     params: Params,
     opt_state,
@@ -83,11 +89,17 @@ def train_step(
     *,
     tx,
     compute_dtype=jnp.float32,
+    apply_fn=None,
 ) -> Tuple[Params, Any, jax.Array]:
-    """One SGD step; returns (params, opt_state, loss). jit-compiled."""
+    """One SGD step; returns (params, opt_state, loss). jit-compiled.
+
+    ``apply_fn`` selects the model family (default: the 2D U-Net; pass
+    ``unet3d.apply_unet3d`` for volume batches).
+    """
+    apply_fn = apply_fn or apply_unet
 
     def loss_fn(p):
-        logits = apply_unet(p, pixels, compute_dtype)
+        logits = apply_fn(p, pixels, compute_dtype)
         return segmentation_loss(logits, labels, dims)
 
     loss, grads = jax.value_and_grad(loss_fn)(params)
@@ -176,6 +188,7 @@ def fit(
     steps: int = 50,
     lr: float = 1e-3,
     compute_dtype=jnp.float32,
+    apply_fn=None,
 ):
     """Small in-memory training loop (tests / single-chip fine-tuning).
 
@@ -187,7 +200,14 @@ def fit(
     losses = []
     for _ in range(steps):
         params, opt_state, loss = train_step(
-            params, opt_state, pixels, labels, dims, tx=tx, compute_dtype=compute_dtype
+            params,
+            opt_state,
+            pixels,
+            labels,
+            dims,
+            tx=tx,
+            compute_dtype=compute_dtype,
+            apply_fn=apply_fn,
         )
         losses.append(float(loss))
     return params, losses

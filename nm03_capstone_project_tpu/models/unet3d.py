@@ -1,0 +1,133 @@
+"""3D segmentation U-Net — the volumetric member of the learned family.
+
+Student counterpart of the volumetric pipeline
+(:mod:`pipeline.volume_pipeline`): where the 2D student distills the
+per-slice chain, this one distills the 3D teacher (6-connected growing +
+3D morphology), learning through-plane context the 2D model cannot see.
+
+Same TPU-first construction as :mod:`models.unet`: NDHWC layout, 3x3x3
+convs via ``lax.conv_general_dilated`` (MXU), lane-aligned channel widths,
+float32 parameters with a caller-chosen compute dtype, plain nested-dict
+pytrees that :func:`models.unet.param_shardings` shards on output channels
+unchanged. Pooling/upsampling act on (D, H, W) jointly (2x2x2), so the
+volume must have D, H, W divisible by 2**levels.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+def _conv_init(key, k, cin, cout) -> Dict[str, jax.Array]:
+    fan_in = k * k * k * cin
+    w = jax.random.normal(key, (k, k, k, cin, cout), jnp.float32)
+    return {"w": w * jnp.sqrt(2.0 / fan_in), "b": jnp.zeros((cout,), jnp.float32)}
+
+
+def _conv(x, p, dtype):
+    out = jax.lax.conv_general_dilated(
+        x.astype(dtype),
+        p["w"].astype(dtype),
+        window_strides=(1, 1, 1),
+        padding="SAME",
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+    )
+    return out + p["b"].astype(dtype)
+
+
+def _block(x, p, dtype):
+    x = jax.nn.relu(_conv(x, p["c1"], dtype))
+    return jax.nn.relu(_conv(x, p["c2"], dtype))
+
+
+def _pool(x):
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 2, 2, 2, 1), (1, 2, 2, 2, 1), "VALID"
+    )
+
+
+def _upsample(x):
+    n, d, h, w, c = x.shape
+    return jnp.broadcast_to(
+        x[:, :, None, :, None, :, None, :], (n, d, 2, h, 2, w, 2, c)
+    ).reshape(n, 2 * d, 2 * h, 2 * w, c)
+
+
+def init_unet3d(
+    key: jax.Array, base: int = 8, levels: int = 2, in_ch: int = 1
+) -> Params:
+    """Same skeleton as the 2D family; 3x3x3 kernels, base * 2**level widths."""
+    if base % 8:
+        raise ValueError(f"base channels must be a multiple of 8, got {base}")
+    params: Params = {"enc": [], "dec": []}
+    cin = in_ch
+    for lv in range(levels):
+        key, k1, k2 = jax.random.split(key, 3)
+        cout = base * (2**lv)
+        params["enc"].append(
+            {"c1": _conv_init(k1, 3, cin, cout), "c2": _conv_init(k2, 3, cout, cout)}
+        )
+        cin = cout
+    key, k1, k2 = jax.random.split(key, 3)
+    cmid = base * (2**levels)
+    params["mid"] = {
+        "c1": _conv_init(k1, 3, cin, cmid),
+        "c2": _conv_init(k2, 3, cmid, cmid),
+    }
+    cin = cmid
+    for lv in reversed(range(levels)):
+        key, k1, k2 = jax.random.split(key, 3)
+        cout = base * (2**lv)
+        params["dec"].append(
+            {
+                "c1": _conv_init(k1, 3, cin + cout, cout),
+                "c2": _conv_init(k2, 3, cout, cout),
+            }
+        )
+        cin = cout
+    key, kh = jax.random.split(key)
+    params["head"] = _conv_init(kh, 1, cin, 8)  # lane-aligned head, summed
+    return params
+
+
+def apply_unet3d(
+    params: Params, volume: jax.Array, compute_dtype=jnp.bfloat16
+) -> jax.Array:
+    """(B, D, H, W) float volumes -> (B, D, H, W) float32 logits.
+
+    D, H, W must each be divisible by 2**levels.
+    """
+    x = volume[..., None]  # NDHWC
+    skips = []
+    for p in params["enc"]:
+        x = _block(x, p, compute_dtype)
+        skips.append(x)
+        x = _pool(x)
+    x = _block(x, params["mid"], compute_dtype)
+    for p, skip in zip(params["dec"], reversed(skips)):
+        x = _upsample(x)
+        x = jnp.concatenate([x, skip.astype(x.dtype)], axis=-1)
+        x = _block(x, p, compute_dtype)
+    logits8 = _conv(x, params["head"], compute_dtype)
+    return logits8.sum(axis=-1).astype(jnp.float32)
+
+
+def predict_mask3d(
+    params: Params, volume: jax.Array, compute_dtype=jnp.bfloat16
+) -> jax.Array:
+    """uint8 3D mask matching the volumetric pipeline's output contract."""
+    return (apply_unet3d(params, volume, compute_dtype) > 0).astype(jnp.uint8)
+
+
+def distill_volume(volume: jax.Array, dims: jax.Array, cfg=None) -> jax.Array:
+    """Teacher labels from the classical 3D pipeline for one (D, H, W) volume."""
+    from nm03_capstone_project_tpu.config import PipelineConfig
+    from nm03_capstone_project_tpu.pipeline.volume_pipeline import process_volume
+
+    cfg = cfg or PipelineConfig()
+    return process_volume(volume, dims, cfg)["mask"]

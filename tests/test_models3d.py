@@ -1,0 +1,74 @@
+"""3D U-Net family: volumetric forward, distillation from the 3D teacher."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nm03_capstone_project_tpu.config import PipelineConfig
+from nm03_capstone_project_tpu.data.synthetic import phantom_volume
+from nm03_capstone_project_tpu.models import (
+    apply_unet3d,
+    distill_volume,
+    fit,
+    init_unet3d,
+    param_shardings,
+    predict_mask3d,
+    prepare_student_inputs,
+)
+
+CFG = PipelineConfig(canvas=32, grow_block_iters=8, grow_max_iters=64, min_dim=16)
+
+
+def _volume_batch(b=2, d=8, hw=32, seed=0):
+    vols = np.stack(
+        [phantom_volume(n_slices=d, height=hw, width=hw, seed=seed + i) for i in range(b)]
+    ).astype(np.float32)
+    dims = np.full((b, 2), hw, np.int32)
+    return jnp.asarray(vols), jnp.asarray(dims)
+
+
+class TestForward3D:
+    def test_logit_shapes(self):
+        params = init_unet3d(jax.random.PRNGKey(0), base=8)
+        vols, _ = _volume_batch()
+        logits = apply_unet3d(params, vols, jnp.float32)
+        assert logits.shape == vols.shape and logits.dtype == jnp.float32
+
+    def test_mask_contract(self):
+        params = init_unet3d(jax.random.PRNGKey(0), base=8)
+        vols, _ = _volume_batch(b=1)
+        m = predict_mask3d(params, vols, jnp.float32)
+        assert m.dtype == jnp.uint8
+
+    def test_rejects_unaligned_base(self):
+        with pytest.raises(ValueError, match="multiple of 8"):
+            init_unet3d(jax.random.PRNGKey(0), base=4)
+
+    def test_params_shard_on_model_axis(self):
+        from nm03_capstone_project_tpu.parallel import make_mesh
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-virtual-device CPU mesh")
+        mesh = make_mesh(8, axis_names=("data", "model"), axis_sizes=(4, 2))
+        shards = param_shardings(init_unet3d(jax.random.PRNGKey(1), base=8), mesh)
+        assert tuple(shards["head"]["w"].spec) == (None, None, None, None, "model")
+
+
+class TestDistillation3D:
+    def test_teacher_labels_are_3d(self):
+        vols, dims = _volume_batch(b=1)
+        labels = jax.vmap(lambda v, d: distill_volume(v, d, CFG))(vols, dims)
+        assert labels.shape == vols.shape and labels.dtype == jnp.uint8
+        assert int(labels.sum()) > 0
+
+    def test_volume_loss_decreases(self):
+        vols, dims = _volume_batch(b=2)
+        labels = jax.vmap(lambda v, d: distill_volume(v, d, CFG))(vols, dims)
+        x = prepare_student_inputs(vols, CFG)
+        params = init_unet3d(jax.random.PRNGKey(2), base=8)
+        params, losses = fit(
+            params, x, labels, dims, steps=40, lr=3e-3, apply_fn=apply_unet3d
+        )
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.7, losses[::10]
