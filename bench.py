@@ -2,7 +2,7 @@
 
 Prints ONE JSON line:
     {"metric": "slices_per_sec_per_chip", "value": N, "unit": "slices/s",
-     "vs_baseline": R}
+     "vs_baseline": R, "backend": "...", "stages": {...}, ...}
 
 ``value`` is the throughput of the full 7-op pipeline (normalize → clip →
 7x7 vector median → sharpen → seeded region growing → cast → dilate,
@@ -15,21 +15,49 @@ backend — the stand-in for the reference's OpenMP-parallel CPU driver
 (src/parallel/main_parallel.cpp:336; XLA:CPU also uses the host's cores, so
 this is parallel-CPU vs one TPU chip, the north-star ratio in BASELINE.json).
 
-Timing methodology: the output is reduced to a scalar checksum ON DEVICE and
-the scalar is fetched to host — a device_get is the only synchronization that
-is trustworthy on every platform (on the tunneled TPU backend,
-``block_until_ready`` returns before execution finishes and a bare sync costs
-~66 ms of round-trip latency). ``REPS`` executions are enqueued back-to-back
-and synced once; single-device PjRt streams execute FIFO, so fetching each
-result after the loop charges the full compute of all reps to the measured
-window while amortizing the tunnel latency across them.
+Robustness architecture (the round-1 lesson, plus the round-2 discovery that
+killing a worker mid-TPU-claim wedges the tunnel for everyone after): the
+orchestrator process never imports jax. Each measurement runs in a
+subprocess with a hard timeout —
 
-All progress chatter goes to stderr; stdout carries only the JSON line.
+* a cheap PROBE worker (devices + tiny jit) gates the expensive run: the
+  orchestrator retries the probe with backoff until the tunnel answers, so
+  the heavy worker's long timeout is only ever spent on real work, and a
+  wedged tunnel costs a few short probe kills (harmless — a hung
+  ``jax.devices()`` holds no chip claim yet), not a mid-compile kill;
+* the accelerator worker inherits the environment (so the tunneled TPU
+  backend registers), gets ONE long-timeout attempt, and appends each
+  completed section (xla / pallas / stages) to a results file as it goes —
+  a timeout loses only the unfinished section, never the headline;
+* the CPU-baseline worker runs with JAX_PLATFORMS=cpu and the TPU tunnel
+  env scrubbed, so it can never dial (or hang on) the accelerator;
+* whatever happens, the orchestrator emits the JSON line, with a
+  ``backend`` field saying what was actually measured and an ``error``
+  field when a path was lost.
+
+Timing methodology (inside the workers): the output is reduced to a scalar
+checksum ON DEVICE and the scalar is fetched to host — a device_get is the
+only synchronization that is trustworthy on every platform (on the tunneled
+TPU backend, ``block_until_ready`` returns before execution finishes and a
+bare sync costs ~66 ms of round-trip latency). ``reps`` executions are
+enqueued back-to-back and synced once; single-device PjRt streams execute
+FIFO, so fetching the last result charges the full compute of all reps to
+the measured window while amortizing the tunnel latency across them.
+
+The ``stages`` block is the per-stage device-time breakdown (VERDICT round 1
+item 7): each pipeline stage jitted and timed in isolation with the same
+enqueue-then-sync methodology, plus a qualitative bound classification.
+
+All progress chatter goes to stderr; stdout carries only the JSON line
+(workers mark their result line with a sentinel the orchestrator strips).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -37,10 +65,38 @@ BATCH = 32
 CANVAS = 256
 TPU_REPS = 10
 CPU_REPS = 2
+STAGE_REPS = 8
+
+PROBE_TIMEOUT_S = 90
+PROBE_ATTEMPTS = 6
+PROBE_BACKOFF_S = 45
+ACCEL_TIMEOUT_S = 900  # ONE attempt; killing mid-compile wedges the tunnel
+CPU_TIMEOUT_S = 420
+
+_SENTINEL = "@@BENCH_RESULT@@"
+
+# Qualitative bound per stage, justified by the measured ms next to it:
+# elementwise/render stream HBM with trivial FLOPs/byte (memory-bound on the
+# VPU); the 7x7 vector median does a 49-candidate rank-select per pixel
+# (compute-bound on the VPU); region growing is an iterative fixpoint whose
+# cost is sequential sweeps, not bytes (iteration/latency-bound).
+_STAGE_BOUND = {
+    "normalize_clip": "memory (VPU elementwise, HBM-limited)",
+    "median7": "compute (VPU 49-candidate rank-select)",
+    "sharpen": "memory (9-tap separable conv, HBM-limited)",
+    "region_grow": "iteration (sequential fixpoint sweeps)",
+    "cast_dilate": "memory (VPU reduce-window, HBM-limited)",
+    "render": "memory (gather + compositing, HBM-limited)",
+}
 
 
 def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+# --------------------------------------------------------------------------
+# worker mode — the only code paths that import jax
+# --------------------------------------------------------------------------
 
 
 def _make_batch():
@@ -58,8 +114,8 @@ def _make_batch():
     return pixels, dims
 
 
-def _bench_on(device, pixels, dims, reps, use_pallas=False) -> float:
-    """Slices/sec of the jitted vmapped pipeline on one device.
+def _bench_on(device, pixels, dims, reps, use_pallas=False):
+    """(slices/sec, checksum) of the jitted vmapped pipeline on one device.
 
     ``use_pallas`` routes the hot ops (7x7 median, region growing) through
     the Pallas TPU kernels; lowering failures propagate — the caller decides
@@ -100,60 +156,366 @@ def _bench_on(device, pixels, dims, reps, use_pallas=False) -> float:
     return BATCH * reps / elapsed, checksum
 
 
-def main() -> None:
+def _time_stage(fn, args, reps):
+    """Seconds per call: jit, warm up, enqueue ``reps``, one checksum sync."""
     import jax
+    import jax.numpy as jnp
 
-    pixels, dims = _make_batch()
+    def with_checksum(*a):
+        out = fn(*a)
+        leaves = jax.tree_util.tree_leaves(out)
+        return sum(jnp.asarray(leaf).astype(jnp.float32).sum() for leaf in leaves)
 
-    devices = jax.devices()
-    main_dev = devices[0]
-    # pltpu kernels lower only on TPU hardware ("axon" = TPU via tunnel);
-    # never attempt them on GPU/other non-CPU backends
-    on_tpu = main_dev.platform in ("tpu", "axon")
-    _log(f"default backend: {main_dev.platform} ({len(devices)} devices)")
-    pallas_tput = pallas_sum = None
-    if on_tpu:
-        try:
-            pallas_tput, pallas_sum = _bench_on(
-                main_dev, pixels, dims, TPU_REPS, use_pallas=True
-            )
-            _log(f"tpu pallas throughput: {pallas_tput:.2f} slices/s")
-        except Exception as e:  # noqa: BLE001 — pallas lowering failure
-            _log(f"pallas path failed, using XLA ops only: {e!r:.500}")
-    tput, xla_sum = _bench_on(main_dev, pixels, dims, TPU_REPS, use_pallas=False)
-    if pallas_tput is not None:
-        # only a result-identical pallas run may win the headline number —
-        # a miscompiled kernel must not corrupt the benchmark record
-        if pallas_sum == xla_sum:
-            tput = max(tput, pallas_tput)
-        else:
-            _log(
-                f"pallas checksum {pallas_sum} != xla checksum {xla_sum}; "
-                "ignoring pallas throughput"
-            )
-    _log(f"{main_dev.platform} throughput: {tput:.2f} slices/s")
+    jitted = jax.jit(with_checksum)
+    float(jitted(*args))  # compile + warm-up, device_get sync
+    t0 = time.perf_counter()
+    outs = [jitted(*args) for _ in range(reps)]
+    float(outs[-1])  # FIFO stream: last result implies all reps done
+    return (time.perf_counter() - t0) / reps
 
-    vs_baseline = 1.0
-    if main_dev.platform != "cpu":
-        try:
-            cpu_dev = jax.devices("cpu")[0]
-            cpu_tput = _bench_on(cpu_dev, pixels, dims, CPU_REPS)
-            _log(f"cpu baseline throughput: {cpu_tput:.2f} slices/s")
-            vs_baseline = tput / cpu_tput
-        except Exception as e:  # no cpu backend reachable — report raw value
-            _log(f"cpu baseline unavailable: {e}")
 
-    print(
-        json.dumps(
-            {
-                "metric": "slices_per_sec_per_chip",
-                "value": round(tput, 2),
-                "unit": "slices/s",
-                "vs_baseline": round(vs_baseline, 2),
-            }
+def _stage_times(device, pixels, dims, reps):
+    """Per-stage device time (ms per 32-slice batch), stages jitted alone.
+
+    The fused pipeline is faster than the sum (XLA melts the elementwise
+    stages into neighbours); this is the attribution breakdown, not a second
+    throughput claim.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from nm03_capstone_project_tpu.config import PipelineConfig
+    from nm03_capstone_project_tpu.ops.elementwise import (
+        cast_uint8,
+        clip_intensity,
+        normalize,
+    )
+    from nm03_capstone_project_tpu.ops.morphology import dilate
+    from nm03_capstone_project_tpu.ops.neighborhood import extend_edges
+    from nm03_capstone_project_tpu.ops.pallas_median import median_filter
+    from nm03_capstone_project_tpu.ops.sharpen import sharpen
+    from nm03_capstone_project_tpu.core.image import valid_mask
+    from nm03_capstone_project_tpu.pipeline.slice_pipeline import segment
+    from nm03_capstone_project_tpu.render.render import render_pair
+
+    cfg = PipelineConfig()
+    px = jax.device_put(jnp.asarray(pixels), device)
+    dm = jax.device_put(jnp.asarray(dims), device)
+
+    def vm(f):
+        return jax.vmap(f)
+
+    f_norm = vm(
+        lambda p, d: clip_intensity(
+            normalize(
+                extend_edges(p, d),
+                cfg.norm_low,
+                cfg.norm_high,
+                cfg.norm_intensity_min,
+                cfg.norm_intensity_max,
+            ),
+            cfg.clip_low,
+            cfg.clip_high,
         )
     )
+    f_med = vm(lambda p: median_filter(p, cfg.median_window))
+    f_sharp = vm(
+        lambda p: sharpen(p, cfg.sharpen_gain, cfg.sharpen_sigma, cfg.sharpen_kernel)
+    )
+    f_grow = vm(lambda p, d: segment(p, d, cfg))
+    f_post = vm(
+        lambda s, d: dilate(cast_uint8(s), cfg.morph_size)
+        * valid_mask(d, s.shape[-2:]).astype(jnp.uint8)
+    )
+    f_render = vm(lambda p, m, d: render_pair(p, m, d, cfg))
+
+    # materialize each stage's input once (device-resident, off the clock)
+    normed = jax.jit(f_norm)(px, dm)
+    med = jax.jit(f_med)(normed)
+    pre = jax.jit(f_sharp)(med)
+    seg = jax.jit(f_grow)(pre, dm)
+    mask = jax.jit(f_post)(seg, dm)
+
+    stages = {}
+    for name, fn, args in (
+        ("normalize_clip", f_norm, (px, dm)),
+        ("median7", f_med, (normed,)),
+        ("sharpen", f_sharp, (med,)),
+        ("region_grow", f_grow, (pre, dm)),
+        ("cast_dilate", f_post, (seg, dm)),
+        ("render", f_render, (px, mask, dm)),
+    ):
+        ms = _time_stage(fn, args, reps) * 1e3
+        stages[name] = {"ms_per_batch": round(ms, 3), "bound": _STAGE_BOUND[name]}
+        _log(f"stage {name}: {ms:.2f} ms/batch ({_STAGE_BOUND[name]})")
+    total = sum(s["ms_per_batch"] for s in stages.values())
+    for s in stages.values():
+        s["share"] = round(s["ms_per_batch"] / total, 3) if total else 0.0
+    return stages
+
+
+def _pin_platform(platform: str | None):
+    """Pin the backend before jax initializes (belt and braces: env is set by
+    the parent, but a PJRT plugin loaded via sitecustomize may have re-pinned
+    jax.config at interpreter startup — see tests/conftest.py)."""
+    if platform:
+        os.environ["JAX_PLATFORMS"] = platform
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+
+def probe(platform: str | None) -> None:
+    """Tunnel health check: devices + a tiny jit round trip, nothing more."""
+    _pin_platform(platform)
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    x = jax.device_put(jnp.ones((128, 128), jnp.float32), dev)
+    val = float(jax.jit(lambda a: (a @ a).sum())(x))
+    assert val == 128.0 * 128 * 128
+    print(_SENTINEL + json.dumps({"backend": dev.platform}), flush=True)
+
+
+def worker(
+    platform: str | None,
+    reps: int,
+    want_pallas: bool,
+    want_stages: bool,
+    out_path: str | None,
+):
+    """Measure on this process's backend.
+
+    Each completed section is appended to ``out_path`` immediately (one JSON
+    line per section), so a parent-side timeout loses only the section in
+    flight. The merged result also goes to stdout behind a sentinel.
+    """
+    _pin_platform(platform)
+    import jax
+
+    def emit(update: dict):
+        result.update(update)
+        if out_path:
+            with open(out_path, "a") as f:
+                f.write(json.dumps(update) + "\n")
+
+    pixels, dims = _make_batch()
+    devices = jax.devices()
+    dev = devices[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+    _log(f"worker backend: {dev.platform} ({len(devices)} devices)")
+
+    result: dict = {}
+    emit({"backend": dev.platform})
+    tput, xla_sum = _bench_on(dev, pixels, dims, reps, use_pallas=False)
+    emit({"xla_tput": tput, "checksum": xla_sum})
+    _log(f"{dev.platform} XLA throughput: {tput:.2f} slices/s")
+
+    if want_pallas and on_tpu:
+        try:
+            p_tput, p_sum = _bench_on(dev, pixels, dims, reps, use_pallas=True)
+            agrees = p_sum == xla_sum
+            emit({"pallas_tput": p_tput, "pallas_checksum_ok": agrees})
+            _log(
+                f"tpu pallas throughput: {p_tput:.2f} slices/s "
+                f"(checksum {'matches' if agrees else 'MISMATCH — discarded'})"
+            )
+        except Exception as e:  # noqa: BLE001 — pallas lowering failure
+            emit({"pallas_error": f"{e!r:.500}"})
+            _log(f"pallas path failed, XLA ops only: {e!r:.500}")
+
+    if want_stages:
+        try:
+            emit({"stages": _stage_times(dev, pixels, dims, STAGE_REPS)})
+        except Exception as e:  # noqa: BLE001 — never lose the headline number
+            emit({"stages_error": f"{e!r:.500}"})
+            _log(f"stage timing failed: {e!r:.500}")
+
+    print(_SENTINEL + json.dumps(result), flush=True)
+
+
+# --------------------------------------------------------------------------
+# orchestrator — no jax; subprocess workers with hard timeouts
+# --------------------------------------------------------------------------
+
+
+def _spawn(label, extra_args, env_overrides, timeout_s):
+    """Run this file in a subprocess; (rc, stdout) with rc=None on timeout."""
+    env = os.environ.copy()
+    for key, val in env_overrides.items():
+        if val is None:
+            env.pop(key, None)
+        else:
+            env[key] = val
+    cmd = [sys.executable, os.path.abspath(__file__), *extra_args]
+    _log(f"{label}: spawning (timeout {timeout_s}s)")
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s, env=env
+        )
+    except subprocess.TimeoutExpired:
+        _log(f"{label}: timed out after {timeout_s}s")
+        return None, ""
+    for line in proc.stderr.splitlines():
+        print(line, file=sys.stderr, flush=True)
+    if proc.returncode != 0:
+        _log(f"{label}: rc={proc.returncode}; stderr tail: {proc.stderr[-800:]}")
+    return proc.returncode, proc.stdout
+
+
+def _parse_sentinel(stdout: str):
+    for line in stdout.splitlines():
+        if line.startswith(_SENTINEL):
+            return json.loads(line[len(_SENTINEL) :])
+    return None
+
+
+def _probe_until_healthy(env_overrides, label) -> bool:
+    """Short probe attempts with backoff until the backend answers.
+
+    A hung probe holds no chip claim (it never gets past device init), so
+    killing it on timeout cannot wedge the tunnel the way killing a
+    mid-compile heavy worker does.
+    """
+    for attempt in range(1, PROBE_ATTEMPTS + 1):
+        rc, stdout = _spawn(
+            f"{label} probe {attempt}/{PROBE_ATTEMPTS}",
+            ["--probe"],
+            env_overrides,
+            PROBE_TIMEOUT_S,
+        )
+        res = _parse_sentinel(stdout) if rc == 0 else None
+        if res is not None:
+            _log(f"{label} probe ok: backend {res['backend']}")
+            return True
+        if attempt < PROBE_ATTEMPTS:
+            _log(f"{label} probe failed; backing off {PROBE_BACKOFF_S}s")
+            time.sleep(PROBE_BACKOFF_S)
+    return False
+
+
+def _run_measurement(label, worker_args, env_overrides, timeout_s):
+    """One heavy-worker attempt; returns merged partial sections (or None).
+
+    The worker appends each completed section to a temp file, so even a
+    timeout kill returns everything measured up to the kill.
+    """
+    import tempfile
+
+    fd, out_path = tempfile.mkstemp(prefix="bench_sections_", suffix=".jsonl")
+    os.close(fd)
+    try:
+        rc, stdout = _spawn(
+            label, ["--worker", *worker_args, "--out", out_path], env_overrides, timeout_s
+        )
+        full = _parse_sentinel(stdout) if rc == 0 else None
+        if full is not None:
+            return full
+        merged: dict = {}
+        with open(out_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    merged.update(json.loads(line))
+                except json.JSONDecodeError:
+                    # a timeout kill can land mid-write; drop the torn line
+                    _log(f"{label}: dropping torn section line ({len(line)}B)")
+        if merged:
+            _log(f"{label}: recovered partial sections {sorted(merged)}")
+        return merged or None
+    finally:
+        os.unlink(out_path)
+
+
+def main() -> None:
+    # accelerator path: inherit env so the TPU tunnel registers. Gate the one
+    # long-timeout heavy attempt behind cheap probes — never burn the heavy
+    # attempt (or wedge the tunnel by killing it mid-claim) on a dead tunnel.
+    accel = None
+    if _probe_until_healthy({}, "accel"):
+        accel = _run_measurement(
+            "accel measurement",
+            ["--reps", str(TPU_REPS), "--pallas", "--stages"],
+            {},
+            ACCEL_TIMEOUT_S,
+        )
+    # a partial record without the headline number is useless — treat as lost
+    if accel is not None and "xla_tput" not in accel:
+        _log(f"accel sections incomplete ({sorted(accel)}); discarding")
+        accel = None
+
+    # CPU baseline in a scrubbed environment: the baseline process must never
+    # dial (or hang on) the accelerator tunnel
+    cpu = None
+    if accel is None or accel["backend"] != "cpu":
+        cpu = _run_measurement(
+            "cpu baseline",
+            ["--platform", "cpu", "--reps", str(CPU_REPS)],
+            {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": None},
+            CPU_TIMEOUT_S,
+        )
+        if cpu is not None and "xla_tput" not in cpu:
+            cpu = None
+
+    out = {
+        "metric": "slices_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "slices/s",
+        "vs_baseline": 0.0,
+    }
+    if accel is not None:
+        tput = accel["xla_tput"]
+        # only a result-identical pallas run may win the headline number —
+        # a miscompiled kernel must not corrupt the benchmark record
+        if accel.get("pallas_checksum_ok") and accel.get("pallas_tput", 0) > tput:
+            tput = accel["pallas_tput"]
+            out["winning_path"] = "pallas"
+        else:
+            out["winning_path"] = "xla"
+        out["value"] = round(tput, 2)
+        out["backend"] = accel["backend"]
+        if "pallas_tput" in accel:
+            out["pallas_tput"] = round(accel["pallas_tput"], 2)
+            out["pallas_checksum_ok"] = accel["pallas_checksum_ok"]
+        if "stages" in accel:
+            out["stages"] = accel["stages"]
+        if accel["backend"] == "cpu":
+            out["vs_baseline"] = 1.0
+            out["error"] = "no accelerator backend available; measured cpu only"
+        elif cpu is not None:
+            out["cpu_baseline_tput"] = round(cpu["xla_tput"], 2)
+            out["vs_baseline"] = round(tput / cpu["xla_tput"], 2)
+        else:
+            out["vs_baseline"] = 1.0
+            out["error"] = "cpu baseline worker failed; vs_baseline unknown"
+    elif cpu is not None:
+        out["value"] = round(cpu["xla_tput"], 2)
+        out["backend"] = "cpu"
+        out["vs_baseline"] = 1.0
+        out["error"] = "accelerator worker failed; cpu fallback measured"
+    else:
+        out["backend"] = "none"
+        out["error"] = "all measurement workers failed; see stderr"
+
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--worker", action="store_true")
+    parser.add_argument("--probe", action="store_true")
+    parser.add_argument("--platform", default=None)
+    parser.add_argument("--reps", type=int, default=TPU_REPS)
+    parser.add_argument("--pallas", action="store_true")
+    parser.add_argument("--stages", action="store_true")
+    parser.add_argument("--out", default=None)
+    ns = parser.parse_args()
+    if ns.probe:
+        probe(ns.platform)
+    elif ns.worker:
+        worker(ns.platform, ns.reps, ns.pallas, ns.stages, ns.out)
+    else:
+        main()

@@ -85,7 +85,7 @@ def _load_cohort(args, cfg):
     """(pixels, dims) float32/int32 host arrays, padded to the canvas."""
     import numpy as np
 
-    from nm03_capstone_project_tpu.data.dicomlite import read_dicom
+    from nm03_capstone_project_tpu.cli.runner import decode_and_guard
     from nm03_capstone_project_tpu.data.discovery import (
         find_patient_dirs,
         load_dicom_files_for_patient,
@@ -97,13 +97,12 @@ def _load_cohort(args, cfg):
         for f in load_dicom_files_for_patient(base, patient_id):
             if len(pixels) >= args.max_slices:
                 break
-            try:
-                px = read_dicom(f).pixels
-            except ValueError:
-                continue  # same skip-and-continue contract as the batch drivers
-            h, w = px.shape
-            if h < cfg.min_dim or w < cfg.min_dim or h > cfg.canvas or w > cfg.canvas:
+            # the batch drivers' shared containment contract: broad catch on
+            # decode + min-dim + canvas-fit guards, skip-and-continue
+            px = decode_and_guard(f, cfg)
+            if px is None:
                 continue
+            h, w = px.shape
             canvas = np.zeros((cfg.canvas, cfg.canvas), np.float32)
             canvas[:h, :w] = px
             pixels.append(canvas)

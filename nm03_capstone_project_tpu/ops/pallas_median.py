@@ -99,6 +99,16 @@ def vector_median_filter_pallas(
     return out.reshape(orig_shape)
 
 
+def pallas_backend_supported() -> bool:
+    """True iff the default backend can lower ``pltpu`` kernels.
+
+    Only real TPUs qualify: 'tpu', or 'axon' (TPU via tunnel). A GPU (or any
+    other) backend must take the XLA path — attempting Mosaic lowering there
+    crashes at compile time.
+    """
+    return jax.default_backend() in ("tpu", "axon")
+
+
 def median_filter(x: jax.Array, size: int = 7, use_pallas: bool = False) -> jax.Array:
     """Dispatch between the Pallas TPU kernel and the portable XLA path.
 
@@ -106,7 +116,7 @@ def median_filter(x: jax.Array, size: int = 7, use_pallas: bool = False) -> jax.
     implementation (same results), so one PipelineConfig serves tests,
     CPU fallback and TPU runs.
     """
-    if use_pallas and jax.default_backend() != "cpu":
+    if use_pallas and pallas_backend_supported():
         return vector_median_filter_pallas(x, size)
     from nm03_capstone_project_tpu.ops.median import vector_median_filter
 

@@ -161,7 +161,9 @@ def grow_dispatch(
     Same dispatch contract as :func:`.pallas_median.median_filter`: off-TPU
     the Pallas request degrades to the XLA path (identical results).
     """
-    if use_pallas and jax.default_backend() != "cpu":
+    from nm03_capstone_project_tpu.ops.pallas_median import pallas_backend_supported
+
+    if use_pallas and pallas_backend_supported():
         return region_grow_pallas(
             image, seeds, low, high, valid, connectivity, block_iters, max_iters
         )

@@ -27,6 +27,54 @@ from typing import Optional, Sequence
 
 _initialized = False
 
+# Environment signals that a multi-process cluster surrounds this process.
+# Fast path only: jax's own autodetection covers MORE than these (notably
+# GceTpuCluster, which queries the GCE metadata server with no env var at
+# all), so a miss here must still fall through to jax's detectors — it must
+# NOT short-circuit to "single host".
+_CLUSTER_ENV_SIGNALS = (
+    "JAX_COORDINATOR_ADDRESS",  # jax's own override
+    "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",  # multi-slice TPU
+    "TPU_WORKER_HOSTNAMES",  # GKE TPU-pod env
+    "SLURM_STEP_NODELIST",  # SLURM multi-node
+    "OMPI_MCA_orte_hnp_uri",  # Open MPI
+)
+
+
+def _cluster_detected() -> Optional[bool]:
+    """Structural cluster detection; None = could not determine.
+
+    First the env fast path, then jax's own cluster framework (the same
+    detectors ``jax.distributed.initialize()`` consults — including the GCE
+    TPU-pod metadata probe that involves no env var). The private-API access
+    is fenced: if a future jax moves it, we return None and the caller falls
+    back to calling initialize() and classifying its outcome.
+    """
+    import os
+
+    if any(os.environ.get(k) for k in _CLUSTER_ENV_SIGNALS):
+        return True
+    try:
+        from jax._src.clusters.cluster import ClusterEnv
+
+        env_present = any(
+            cluster.is_env_present() for cluster in ClusterEnv._cluster_types
+        )
+        if not env_present:
+            return False
+        # a detector fired; only trust "multi-process cluster" if it can
+        # actually name more than one process
+        for cluster in ClusterEnv._cluster_types:
+            if cluster.is_env_present():
+                try:
+                    return (cluster.get_process_count() or 1) > 1
+                except Exception:  # noqa: BLE001 — detector quirk
+                    return True  # detected but unsized: let jax try to join
+        return False
+    except Exception:  # noqa: BLE001 — private API moved; undetermined
+        return None
+
 
 def initialize(
     coordinator_address: Optional[str] = None,
@@ -35,43 +83,58 @@ def initialize(
 ) -> bool:
     """Join this process into the multi-host job; returns True if it did.
 
-    Single-process runs (num_processes absent or 1, no coordinator found)
-    are a no-op returning False — so drivers can call this unconditionally.
-    Safe to call twice (second call is a no-op).
+    Single-process runs (no explicit arguments and no cluster environment
+    detected) are a no-op returning False — so drivers can call this
+    unconditionally. Safe to call twice (second call is a no-op).
     """
     global _initialized
     if _initialized:
         return True
     import jax
 
+    if jax.distributed.is_initialized():  # someone else already joined us
+        _initialized = True
+        return True
+    explicit = coordinator_address is not None or num_processes is not None
+    detected = None if explicit else _cluster_detected()
+    if not explicit and detected is False:
+        # structurally nothing to join: no arguments, no cluster env signal,
+        # and jax's own detectors (incl. the GCE TPU-pod metadata probe,
+        # which uses no env var) found no multi-process cluster
+        return False
+
     try:
-        # With no arguments jax runs its cluster autodetection (TPU-pod
-        # metadata, SLURM, GKE, JAX_COORDINATOR_ADDRESS env...); pre-guarding
-        # on env vars here would defeat it. On a plain single host detection
-        # finds nothing and raises — that is the no-op path.
+        # jax runs its cluster autodetection (TPU-pod metadata, SLURM, GKE,
+        # JAX_COORDINATOR_ADDRESS env...) for any argument left as None.
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
     except (ValueError, RuntimeError) as e:
-        if coordinator_address is not None or num_processes is not None:
-            raise  # an explicit multi-host request must not fail silently
+        # Explicit requests, and any failure of a DETECTED cluster to join
+        # (unreachable coordinator, barrier timeout, mismatched counts),
+        # must raise: silently degrading to single-host would run duplicate
+        # work. The message checks below are a FALLBACK for the detected /
+        # undetermined cases only (jax rewording them degrades to raising —
+        # loud, never silently wrong).
         msg = str(e).lower()
-        if "must be called before" in msg:
-            # backends already initialized (e.g. a long-lived session calling
-            # this late) — multi-host init is impossible now; warn, don't die
+        if not explicit and "must be called before" in msg:
+            # backends already created (a long-lived session calling this
+            # late) — multi-host init is impossible now; warn, don't die
             from nm03_capstone_project_tpu.utils.reporter import get_logger
 
             get_logger("distributed").warning(
                 "jax backends already initialized; distributed init skipped"
             )
             return False
-        # "nothing to join": jax complains about the undefined coordinator /
-        # process count. Anything else (unreachable coordinator, barrier
-        # timeout, mismatched counts) is a DETECTED cluster failing to join —
-        # silently degrading to single-host would run duplicate workloads.
-        if "coordinator_address" in msg or "num_processes" in msg or "process_id" in msg:
+        if detected is None and (
+            "coordinator_address" in msg
+            or "num_processes" in msg
+            or "process_id" in msg
+        ):
+            # detection was undetermined and jax says it has nothing to
+            # join (undefined coordinator/process count) — single-host no-op
             return False
         raise
     _initialized = True

