@@ -40,16 +40,52 @@ def main(argv=None) -> int:
         return 1
 
 
-def run(args: argparse.Namespace) -> int:
+def stage_renders(padded, dims, cfg) -> dict:
+    """The 5 exported stage renders, keyed by the reference's export names.
+
+    The single home of the test driver's golden-image contract
+    (test_pipeline.cpp:162-179: original + preprocessed as grayscale renders,
+    segmentation / erosion / dilation as white-label renders, all through the
+    512x512 letterbox). The golden regression suite (tests/test_golden.py)
+    pins these exact pixels.
+    """
     import numpy as np
 
-    from nm03_capstone_project_tpu.data.synthetic import phantom_slice
     from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice_stages
-    from nm03_capstone_project_tpu.render.export import clean_directory, save_jpeg
     from nm03_capstone_project_tpu.render.render import (
         render_gray,
         render_segmentation,
     )
+
+    stages = process_slice_stages(padded, dims, cfg)
+
+    def seg_render(m):
+        return render_segmentation(
+            m, dims, cfg.render_size, cfg.overlay_opacity,
+            cfg.overlay_border_opacity, cfg.overlay_border_radius,
+        )
+
+    return {
+        name: np.asarray(img)  # one device->host transfer per stage
+        for name, img in {
+            "original_image": render_gray(
+                stages["original_image"], dims, cfg.render_size
+            ),
+            "preprocessed_image": render_gray(
+                stages["preprocessed_image"], dims, cfg.render_size
+            ),
+            "segmentation": seg_render(stages["segmentation"]),
+            "erosion_result": seg_render(stages["erosion_result"]),
+            "final_dilated_result": seg_render(stages["final_dilated_result"]),
+        }.items()
+    }
+
+
+def run(args: argparse.Namespace) -> int:
+    import numpy as np
+
+    from nm03_capstone_project_tpu.data.synthetic import phantom_slice
+    from nm03_capstone_project_tpu.render.export import clean_directory, save_jpeg
     from nm03_capstone_project_tpu.utils.reporter import configure_reporting
 
     configure_reporting(verbose=args.verbose)
@@ -70,31 +106,10 @@ def run(args: argparse.Namespace) -> int:
     padded[:h, :w] = pixels
     dims = np.asarray([h, w], np.int32)
 
-    stages = process_slice_stages(padded, dims, cfg)
-
     # the reference clean-recreates out-test (test_pipeline.cpp:13-14)
     clean_directory(args.output)
 
-    def seg_render(m):
-        return render_segmentation(
-            m, dims, cfg.render_size, cfg.overlay_opacity,
-            cfg.overlay_border_opacity, cfg.overlay_border_radius,
-        )
-
-    exports = {
-        name: np.asarray(img)  # one device->host transfer per stage
-        for name, img in {
-            "original_image": render_gray(
-                stages["original_image"], dims, cfg.render_size
-            ),
-            "preprocessed_image": render_gray(
-                stages["preprocessed_image"], dims, cfg.render_size
-            ),
-            "segmentation": seg_render(stages["segmentation"]),
-            "erosion_result": seg_render(stages["erosion_result"]),
-            "final_dilated_result": seg_render(stages["final_dilated_result"]),
-        }.items()
-    }
+    exports = stage_renders(padded, dims, cfg)
     for name, img in exports.items():
         save_jpeg(img, f"{args.output}/{name}.jpg")
         print(f"exported {args.output}/{name}.jpg")

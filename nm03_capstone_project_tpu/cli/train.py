@@ -61,6 +61,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
     t.add_argument("--restore", default=None, help="checkpoint to continue from")
     t.add_argument(
+        "--model-3d",
+        action="store_true",
+        help="train the volumetric U-Net (models/unet3d.py) against the 3D "
+        "pipeline teacher instead of the per-slice 2D student",
+    )
+    t.add_argument(
+        "--volume-depth", type=int, default=8,
+        help="slices per training volume with --model-3d (divisible by 4; "
+        "patients with fewer usable slices are skipped)",
+    )
+    t.add_argument(
         "--eval-only",
         action="store_true",
         help="skip training; just score --restore against the teacher",
@@ -112,6 +123,43 @@ def _load_cohort(args, cfg):
     return np.stack(pixels), np.asarray(dims, np.int32)
 
 
+def _load_cohort_volumes(args, cfg):
+    """(volumes, dims): (P, depth, canvas, canvas) float32 + (P, 2) int32.
+
+    One training volume per patient: the first ``--volume-depth`` usable
+    slices in anatomical order, assembled by the volume driver's own loader
+    (one home for the decode/series-uniformity/canvas contract) and
+    truncated to the common depth. Patients with fewer usable slices are
+    skipped and counted, mirroring the batch drivers' accounting.
+    """
+    import numpy as np
+
+    from nm03_capstone_project_tpu.cli.volume import _load_volume
+    from nm03_capstone_project_tpu.data.discovery import find_patient_dirs
+
+    base = common.resolve_base_path(args, tmp_root=Path(args.output))
+    depth = args.volume_depth
+    vols, dims, skipped = [], [], 0
+    for patient_id in find_patient_dirs(base):
+        if len(vols) * depth >= args.max_slices:
+            break
+        try:
+            vol, pdims, _stems, _skips = _load_volume(base, patient_id, cfg)
+        except ValueError:
+            skipped += 1
+            continue
+        if vol.shape[0] < depth:
+            skipped += 1
+            continue
+        vols.append(vol[:depth])
+        dims.append(pdims)
+    if skipped:
+        print(f"skipped {skipped} patients with < {depth} usable slices")
+    if not vols:
+        raise SystemExit(f"no patient under {base} has {depth} usable slices")
+    return np.stack(vols), np.asarray(dims, np.int32)
+
+
 def run(args: argparse.Namespace) -> int:
     import numpy as np
 
@@ -119,10 +167,14 @@ def run(args: argparse.Namespace) -> int:
     import jax.numpy as jnp
 
     from nm03_capstone_project_tpu.models import (
+        apply_unet3d,
         distill_batch,
+        distill_volume,
         fit,
         init_unet,
+        init_unet3d,
         predict_mask,
+        predict_mask3d,
         prepare_student_inputs,
     )
     from nm03_capstone_project_tpu.models.checkpoint import load_params, save_params
@@ -138,31 +190,59 @@ def run(args: argparse.Namespace) -> int:
         raise SystemExit("--canvas must be divisible by 4 (two U-Net poolings)")
     if args.eval_only and not args.restore:
         raise SystemExit("--eval-only needs --restore (nothing to score otherwise)")
+    if args.model_3d and (args.volume_depth <= 0 or args.volume_depth % 4):
+        raise SystemExit(
+            "--volume-depth must be positive and divisible by 4 (two 3D poolings)"
+        )
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
 
-    pixels, dims = _load_cohort(args, cfg)
-    print(f"cohort: {pixels.shape[0]} slices at {cfg.canvas}x{cfg.canvas}")
-
-    px = jnp.asarray(pixels)
-    dm = jnp.asarray(dims)
-    print("distilling teacher labels (classical pipeline)...")
-    labels = distill_batch(px, dm, cfg)
-    x = prepare_student_inputs(px, cfg)
-
+    # restore (and model-dimension sanity) BEFORE the expensive cohort load +
+    # teacher distillation: a mismatched checkpoint must fail in milliseconds
     if args.restore:
         params, meta = load_params(args.restore)
         print(f"restored checkpoint {args.restore} (meta: {meta})")
+        meta = meta or {}
+        if bool(meta.get("model_3d", False)) != args.model_3d:
+            raise SystemExit(
+                f"checkpoint {args.restore} holds a "
+                f"{'3D' if meta.get('model_3d') else '2D'} model; pass "
+                f"{'--model-3d' if meta.get('model_3d') else 'no --model-3d'}"
+            )
+    elif args.model_3d:
+        params = init_unet3d(jax.random.PRNGKey(args.seed), base=args.base_channels)
     else:
-        params = init_unet(
-            jax.random.PRNGKey(args.seed), base=args.base_channels
-        )
+        params = init_unet(jax.random.PRNGKey(args.seed), base=args.base_channels)
 
+    if args.model_3d:
+        volumes, dims = _load_cohort_volumes(args, cfg)
+        print(
+            f"cohort: {volumes.shape[0]} volumes of {args.volume_depth} x "
+            f"{cfg.canvas}x{cfg.canvas}"
+        )
+        px = jnp.asarray(volumes)
+        dm = jnp.asarray(dims)
+        print("distilling teacher labels (volumetric pipeline)...")
+        # per-volume teacher: 6-connected 3D growing + 3D morphology
+        labels = jnp.stack(
+            [distill_volume(v, d, cfg) for v, d in zip(px, dm)]
+        )
+    else:
+        pixels, dims = _load_cohort(args, cfg)
+        print(f"cohort: {pixels.shape[0]} slices at {cfg.canvas}x{cfg.canvas}")
+        px = jnp.asarray(pixels)
+        dm = jnp.asarray(dims)
+        print("distilling teacher labels (classical pipeline)...")
+        labels = distill_batch(px, dm, cfg)
+    x = prepare_student_inputs(px, cfg)
+
+    apply_fn = apply_unet3d if args.model_3d else None  # None = 2D default
     losses = []
     if not args.eval_only:
         print(f"training {args.steps} steps at lr={args.lr}...")
         with profile_trace(args.profile_dir):
             params, losses = fit(
-                params, x, labels, dm, steps=args.steps, lr=args.lr, compute_dtype=dtype
+                params, x, labels, dm, steps=args.steps, lr=args.lr,
+                compute_dtype=dtype, apply_fn=apply_fn,
             )
         if losses:
             print(f"loss {losses[0]:.4f} -> {losses[-1]:.4f}")
@@ -170,12 +250,17 @@ def run(args: argparse.Namespace) -> int:
     # score only where the loss trained the student: canvas padding holds
     # untrained logits and must not pollute the metric
     vmask = np.asarray(valid_mask(dm, cfg.canvas_hw)).astype(bool)
-    pred = np.asarray(predict_mask(params, x, dtype)).astype(bool) & vmask
+    if args.model_3d:
+        vmask = np.broadcast_to(vmask[:, None, :, :], px.shape)
+        pred = np.asarray(predict_mask3d(params, x, dtype)).astype(bool) & vmask
+    else:
+        pred = np.asarray(predict_mask(params, x, dtype)).astype(bool) & vmask
     truth = np.asarray(labels).astype(bool) & vmask
     inter = int((pred & truth).sum())
     union = int((pred | truth).sum())
     iou = inter / union if union else 1.0
-    print(f"student-vs-teacher IoU over {pred.shape[0]} slices: {iou:.3f}")
+    unit = "volumes" if args.model_3d else "slices"
+    print(f"student-vs-teacher IoU over {pred.shape[0]} {unit}: {iou:.3f}")
 
     ckpt = Path(args.output) / "checkpoint"
     if not args.eval_only:
@@ -187,6 +272,7 @@ def run(args: argparse.Namespace) -> int:
                 "steps": args.steps,
                 "lr": args.lr,
                 "canvas": cfg.canvas,
+                "model_3d": args.model_3d,
                 "iou_vs_teacher": iou,
             },
         )
@@ -195,7 +281,8 @@ def run(args: argparse.Namespace) -> int:
         write_results_json(
             args.results_json,
             {
-                "slices": int(pred.shape[0]),
+                unit: int(pred.shape[0]),
+                "model": "unet3d" if args.model_3d else "unet2d",
                 "steps": 0 if args.eval_only else args.steps,
                 "final_loss": losses[-1] if losses else None,
                 "iou_vs_teacher": iou,

@@ -6,6 +6,12 @@ one 2D slice per file, never a 3D volume). The reference delegates parsing to
 FAST/DCMTK; this framework ships its own single-file implementation of the
 subset the pipeline needs:
 
+Support envelope (parity note vs the reference: FAST sits on DCMTK, which
+additionally decodes compressed/encapsulated transfer syntaxes; the T1+C
+Brain-Tumor-Progression cohort the reference processes is uncompressed
+explicit-VR little endian, so the envelope below covers the reference's
+actual workload):
+
 * Part-10 files (128-byte preamble + ``DICM``) and bare data sets.
 * Explicit and implicit VR little endian transfer syntaxes
   (1.2.840.10008.1.2.1 / 1.2.840.10008.1.2), uncompressed pixel data.
@@ -13,6 +19,16 @@ subset the pipeline needs:
   RescaleSlope/Intercept applied — yielding float32 intensities.
 * Sequence (SQ) elements are skipped structurally (defined and undefined
   length), so real-world headers parse even though their content is unused.
+
+NOT supported — every rejection raises :class:`DicomParseError` with a
+message naming the remedy (tests/test_data.py covers each branch):
+
+* big endian (1.2.840.10008.1.2.2) and all compressed transfer syntaxes
+  (JPEG/JPEG-LS/JPEG2000/RLE, 1.2.840.10008.1.2.4.* / .5) — transcode to
+  explicit VR little endian first (``gdcmconv --raw`` or DCMTK
+  ``dcmdjpeg``/``dcmconv +te``);
+* encapsulated PixelData (undefined length), color images
+  (SamplesPerPixel != 1), BitsAllocated outside {8, 16}.
 
 The writer emits valid explicit-VR-LE Part-10 files and exists so tests and
 the ``--synthetic`` CLI mode can materialize cohorts that round-trip through
@@ -137,7 +153,9 @@ def _parse_dataset(
         if (group, elem) == (0x7FE0, 0x0010):
             if length == 0xFFFFFFFF:
                 raise DicomParseError(
-                    "encapsulated (compressed) PixelData is not supported"
+                    "encapsulated (compressed) PixelData is not supported; "
+                    "transcode to uncompressed explicit VR little endian "
+                    "first (gdcmconv --raw, or dcmdjpeg/dcmconv +te)"
                 )
             pixel_data = r.buf[r.pos : r.pos + length] if want_pixels else None
             r.pos += length
@@ -219,7 +237,20 @@ def read_dicom(path: str | os.PathLike) -> DicomSlice:
     elif raw[:4] == b"DICM":
         body = raw[4:]
     if transfer_syntax not in (EXPLICIT_VR_LE, IMPLICIT_VR_LE):
-        raise DicomParseError(f"unsupported transfer syntax: {transfer_syntax}")
+        kind = (
+            "big endian"
+            if transfer_syntax == "1.2.840.10008.1.2.2"
+            else "compressed"
+            if transfer_syntax.startswith("1.2.840.10008.1.2.4")
+            or transfer_syntax == "1.2.840.10008.1.2.5"
+            else "unrecognized"
+        )
+        raise DicomParseError(
+            f"unsupported ({kind}) transfer syntax {transfer_syntax}: only "
+            f"uncompressed little endian ({EXPLICIT_VR_LE} / {IMPLICIT_VR_LE}) "
+            "is supported; transcode first (gdcmconv --raw, or DCMTK "
+            "dcmdjpeg/dcmconv +te)"
+        )
 
     explicit = transfer_syntax == EXPLICIT_VR_LE
     try:
@@ -235,7 +266,10 @@ def read_dicom(path: str | os.PathLike) -> DicomSlice:
     signed = _meta_int(meta, (0x0028, 0x0103), 0) == 1
     samples = _meta_int(meta, (0x0028, 0x0002), 1)
     if samples != 1:
-        raise DicomParseError(f"only monochrome supported, SamplesPerPixel={samples}")
+        raise DicomParseError(
+            f"only monochrome supported, SamplesPerPixel={samples}; convert "
+            "color/multi-sample images to grayscale before import"
+        )
     if bits == 16:
         dtype = np.dtype("<i2") if signed else np.dtype("<u2")
     elif bits == 8:

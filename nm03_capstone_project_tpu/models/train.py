@@ -41,8 +41,10 @@ def make_optimizer(
     """
     if total_steps:
         warmup = max(1, total_steps // 20)
+        # optax requires a positive cosine phase (decay_steps > warmup);
+        # 1-2 step runs (smoke tests) would otherwise hit decay_steps=0
         schedule = optax.warmup_cosine_decay_schedule(
-            0.0, lr, warmup, total_steps, end_value=lr * 0.01
+            0.0, lr, warmup, max(total_steps, warmup + 1), end_value=lr * 0.01
         )
     else:
         schedule = lr

@@ -1,0 +1,53 @@
+"""Regenerate the committed golden stage renders.
+
+Run DELIBERATELY (never from CI) when the renderer contract changes on
+purpose:
+
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python tests/golden/make_goldens.py
+
+Each .npz holds the 5 stage renders of one fixed phantom slice through the
+test-pipeline contract (src/test/test_pipeline.cpp:162-179), produced by
+:func:`nm03_capstone_project_tpu.cli.test_pipeline.stage_renders` — the
+exact function the CLI exports through. tests/test_golden.py asserts today's
+pixels still match.
+"""
+
+import pathlib
+import sys
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).parents[2]))  # repo root
+
+GOLDEN_DIR = pathlib.Path(__file__).parent
+SEEDS = (17, 3, 11)  # 17 = the CLI's default phantom (test_pipeline.py)
+CANVAS = 256
+
+
+def compute_renders(seed: int) -> dict:
+    from nm03_capstone_project_tpu.cli.test_pipeline import stage_renders
+    from nm03_capstone_project_tpu.config import PipelineConfig
+    from nm03_capstone_project_tpu.data.synthetic import phantom_slice
+
+    cfg = PipelineConfig(canvas=CANVAS)
+    # lesion size keyed to the seed so each golden pins a DIFFERENT mask
+    # geometry (identical masks would triple-count one case)
+    radius = {17: 0.10, 3: 0.13, 11: 0.16}.get(seed, 0.12)
+    pixels = phantom_slice(CANVAS, CANVAS, seed=seed, lesion_radius=radius)
+    dims = np.asarray([CANVAS, CANVAS], np.int32)
+    return stage_renders(pixels.astype(np.float32), dims, cfg)
+
+
+def main() -> int:
+    for seed in SEEDS:
+        renders = compute_renders(seed)
+        out = GOLDEN_DIR / f"stage_renders_seed{seed}.npz"
+        np.savez_compressed(out, **renders)
+        sizes = {k: int(v.sum()) for k, v in renders.items()}
+        print(f"wrote {out.name}: checksums {sizes}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

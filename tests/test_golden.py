@@ -1,0 +1,68 @@
+"""Golden-image regression over the 5 exported pipeline stages.
+
+Formalizes the reference's golden-eyeball contract: its test driver exports
+five stage JPEGs for a human to inspect (src/test/test_pipeline.cpp:162-179),
+which means any renderer or pipeline regression is invisible to an automated
+run. Here the same five renders for fixed phantom slices are committed as
+arrays (tests/golden/*.npz, produced by tests/golden/make_goldens.py) and
+pinned: a change to windowing, letterboxing, overlay opacity, border banding,
+or any pipeline stage shifts pixels and fails loudly.
+
+Tolerance: renders are uint8; tiny float drift across jax/XLA versions may
+move a value by a count or two at gradient pixels, so we allow per-pixel
+|diff| <= 3 and mean |diff| <= 0.1 — a real regression (different window,
+shifted letterbox, changed opacity) moves whole regions by tens of counts.
+"""
+
+import importlib.util
+import pathlib
+
+import numpy as np
+import pytest
+
+GOLDEN_DIR = pathlib.Path(__file__).parent / "golden"
+_spec = importlib.util.spec_from_file_location(
+    "make_goldens", GOLDEN_DIR / "make_goldens.py"
+)
+make_goldens = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(make_goldens)
+SEEDS, compute_renders = make_goldens.SEEDS, make_goldens.compute_renders
+STAGE_NAMES = (
+    "original_image",
+    "preprocessed_image",
+    "segmentation",
+    "erosion_result",
+    "final_dilated_result",
+)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+class TestGoldenStages:
+    def test_stage_renders_match_goldens(self, seed):
+        path = GOLDEN_DIR / f"stage_renders_seed{seed}.npz"
+        golden = np.load(path)
+        assert set(golden.files) == set(STAGE_NAMES)
+        got = compute_renders(seed)
+        for name in STAGE_NAMES:
+            want = golden[name]
+            have = got[name]
+            assert have.shape == want.shape and have.dtype == want.dtype, name
+            diff = np.abs(have.astype(np.int16) - want.astype(np.int16))
+            assert diff.max() <= 3, (
+                f"{name} seed {seed}: max pixel diff {diff.max()} "
+                f"at {np.unravel_index(diff.argmax(), diff.shape)}"
+            )
+            assert diff.mean() <= 0.1, (
+                f"{name} seed {seed}: mean pixel diff {diff.mean():.3f}"
+            )
+
+    def test_goldens_are_nontrivial(self, seed):
+        # a golden of zeros would pass any diff test; require every stage to
+        # carry real signal (the phantom lesion is segmented and rendered)
+        golden = np.load(GOLDEN_DIR / f"stage_renders_seed{seed}.npz")
+        for name in STAGE_NAMES:
+            assert golden[name].sum() > 0, f"{name} golden is blank"
+        # the dilated mask strictly contains the segmentation's fill area
+        seg = (golden["segmentation"] > 0).sum()
+        dil = (golden["final_dilated_result"] > 0).sum()
+        assert dil > seg > 0

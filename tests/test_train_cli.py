@@ -83,3 +83,77 @@ class TestTrainCLI:
             train_cli.main(
                 ["--synthetic", "1", "--output", str(tmp_path), "--canvas", "254"]
             )
+
+
+class TestTrainCLI3D:
+    """--model-3d: volumetric distillation end to end (VERDICT r1 weak #7)."""
+
+    def test_train_3d_then_eval_only(self, tmp_path, capsys):
+        out = tmp_path / "out-train3d"
+        rc = train_cli.main(
+            [
+                "--synthetic", "1",
+                "--synthetic-slices", "4",
+                "--output", str(out),
+                "--model-3d",
+                "--volume-depth", "4",
+                "--steps", "2",
+                "--base-channels", "8",
+                "--max-slices", "4",
+                "--results-json", str(out / "train3d.json"),
+            ]
+        )
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "volumetric pipeline" in text and "checkpoint written" in text
+        payload = json.loads((out / "train3d.json").read_text())
+        assert payload["model"] == "unet3d"
+        assert payload["volumes"] == 1 and payload["steps"] == 2
+        assert np.isfinite(payload["final_loss"])
+        assert 0.0 <= payload["iou_vs_teacher"] <= 1.0
+
+        rc = train_cli.main(
+            [
+                "--synthetic", "1",
+                "--synthetic-slices", "4",
+                "--output", str(out),
+                "--model-3d",
+                "--volume-depth", "4",
+                "--restore", str(out / "checkpoint"),
+                "--eval-only",
+                "--max-slices", "4",
+            ]
+        )
+        assert rc == 0
+        assert "IoU over 1 volumes" in capsys.readouterr().out
+
+    def test_dimension_checkpoint_mismatch_rejected(self, tmp_path, capsys):
+        # a 2D checkpoint must not silently feed the 3D model (and vice versa)
+        out = tmp_path / "out2d"
+        rc = train_cli.main(
+            [
+                "--synthetic", "1", "--synthetic-slices", "4",
+                "--output", str(out), "--steps", "1",
+                "--base-channels", "8", "--max-slices", "2",
+            ]
+        )
+        assert rc == 0
+        capsys.readouterr()
+        with pytest.raises(SystemExit, match="holds a 2D model"):
+            train_cli.main(
+                [
+                    "--synthetic", "1", "--synthetic-slices", "4",
+                    "--output", str(out), "--model-3d", "--volume-depth", "4",
+                    "--restore", str(out / "checkpoint"), "--eval-only",
+                    "--max-slices", "4",
+                ]
+            )
+
+    def test_rejects_bad_volume_depth(self, tmp_path):
+        with pytest.raises(SystemExit, match="volume-depth"):
+            train_cli.main(
+                [
+                    "--synthetic", "1", "--output", str(tmp_path),
+                    "--model-3d", "--volume-depth", "6",
+                ]
+            )
