@@ -95,6 +95,15 @@ def add_pipeline_args(parser: argparse.ArgumentParser) -> None:
         action="store_true",
         help="route hot ops through the Pallas TPU kernels",
     )
+    g.add_argument(
+        "--grow-algorithm",
+        choices=["dilate", "jump"],
+        default=d.grow_algorithm,
+        help="2D region-growing convergence schedule: one-ring dilation "
+        "fixpoint or O(log) pointer-jumping label merge (identical masks "
+        "whenever dilate converges within its iteration cap; not combinable "
+        "with --use-pallas; 2D drivers only)",
+    )
 
 
 def pipeline_config_from_args(args: argparse.Namespace) -> PipelineConfig:
@@ -116,6 +125,7 @@ def pipeline_config_from_args(args: argparse.Namespace) -> PipelineConfig:
         render_size=args.render_size,
         canvas=args.canvas,
         use_pallas=args.use_pallas,
+        grow_algorithm=args.grow_algorithm,
     )
 
 

@@ -257,6 +257,14 @@ class CohortProcessor:
         bs = self.batch_cfg.batch_size
         ok, failed = 0, []
         batches = [files[i : i + bs] for i in range(0, len(files), bs)]
+
+        def pad_target(n: int) -> int:
+            # Lane-friendly bucketing: pad each batch up to the next multiple
+            # of 8 (capped at batch_size) instead of always to batch_size.
+            # A cohort of 8-slice patients under the reference's bs=25 would
+            # otherwise compute 3x dead lanes; buckets keep recompiles
+            # bounded (at most bs/8 shapes) while never padding past 7 lanes.
+            return min(bs, ((n + 7) // 8) * 8)
         export_futures = []
         expected_stems: List[str] = []
         use_native = self.batch_cfg.use_native and _native_available()
@@ -271,7 +279,9 @@ class CohortProcessor:
                         # one future per batch: the C++ thread pool decodes
                         # + pads the whole batch (csrc nm03_load_batch)
                         decode_futures[idx] = io_pool.submit(
-                            self._decode_batch_native, batches[idx], bs
+                            self._decode_batch_native,
+                            batches[idx],
+                            pad_target(len(batches[idx])),
                         )
                     else:
                         decode_futures[idx] = [
@@ -298,7 +308,9 @@ class CohortProcessor:
                     if not good:
                         yield {"stems": [], "bad": bad, "pixels": None, "dims": None}
                         continue
-                    padded, dims = self._pad_stack([p for _, p in good], pad_to=bs)
+                    padded, dims = self._pad_stack(
+                        [p for _, p in good], pad_to=pad_target(len(batch_files))
+                    )
                     yield {
                         "stems": [s for s, _ in good],
                         "bad": bad,

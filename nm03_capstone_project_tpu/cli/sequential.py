@@ -64,10 +64,13 @@ def run(args: argparse.Namespace, mode: str) -> int:
         with profile_trace(getattr(args, "profile_dir", None)):
             summary = proc.process_all_patients()
         if args.results_json:
+            import jax
+
             write_results_json(
                 args.results_json,
                 {
                     "mode": mode,
+                    "backend": jax.devices()[0].platform,  # provenance
                     "summary": summary.as_dict(),
                     "timing_s": proc.timer.report(),
                 },

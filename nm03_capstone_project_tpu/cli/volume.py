@@ -147,6 +147,15 @@ def run(args: argparse.Namespace) -> int:
     configure_reporting(verbose=args.verbose)
     common.apply_native_flag(args)
     cfg = common.pipeline_config_from_args(args)
+    if cfg.grow_algorithm != "dilate":
+        # the shared flag group offers --grow-algorithm, but the volumetric
+        # pipeline has only the 3D dilation fixpoint — don't let a user
+        # benchmark "jump" timings that were secretly dilate
+        print(
+            "warning: --grow-algorithm applies to the 2D drivers only; "
+            "the volume pipeline always runs the 3D dilation fixpoint",
+            file=sys.stderr,
+        )
     base = common.resolve_base_path(args, tmp_root=Path(args.output))
     out_root = Path(args.output)
     manifest = Manifest.load_or_create(out_root) if args.resume else Manifest(out_root)
@@ -254,10 +263,13 @@ def run(args: argparse.Namespace) -> int:
     print("\n=== All Processing Completed ===\n")
     print(f"Successfully processed {ok_patients}/{len(patients)} patients.")
     if args.results_json:
+        import jax
+
         write_results_json(
             args.results_json,
             {
                 "mode": "volume",
+                "backend": jax.devices()[0].platform,  # provenance
                 "z_sharded": bool(zshard),
                 "patients": results,
                 "timings_s": timer.report(),

@@ -75,6 +75,16 @@ class PipelineConfig:
     # Region-growing fixpoint: dilations per convergence check and a hard cap.
     grow_block_iters: int = 16
     grow_max_iters: int = 1024
+    # Convergence schedule for the 2D fill: "dilate" = one-ring-per-step
+    # fixpoint (sequential depth = region diameter, truncated at
+    # grow_max_iters); "jump" = pointer-jumping label merge, O(log diameter)
+    # rounds (ops.region_growing.region_grow_jump) — for latency-bound
+    # accelerators. Identical masks whenever the dilate path converges within
+    # its cap (always, for clinical-shaped regions; a >grow_max_iters
+    # serpentine path truncates dilate but not jump). 2D drivers only; the
+    # volume pipeline always runs the 3D fixpoint. Mutually exclusive with
+    # use_pallas (the Pallas grow kernel implements the dilate schedule).
+    grow_algorithm: str = "dilate"
     # Route the hot ops through the Pallas TPU kernels (ops.pallas_median,
     # ops.pallas_region_growing) instead of the portable XLA implementations.
     # Defaults False until the caller knows it's on a TPU backend.
@@ -102,6 +112,17 @@ class PipelineConfig:
             raise ValueError(f"canvas must be positive, got {self.canvas}")
         if self.grow_block_iters < 1 or self.grow_max_iters < 1:
             raise ValueError("grow iteration counts must be positive")
+        if self.grow_algorithm not in ("dilate", "jump"):
+            raise ValueError(
+                f"grow_algorithm must be 'dilate' or 'jump', got "
+                f"{self.grow_algorithm!r}"
+            )
+        if self.grow_algorithm == "jump" and self.use_pallas:
+            raise ValueError(
+                "grow_algorithm='jump' and use_pallas are mutually exclusive: "
+                "the Pallas grow kernel implements the dilate schedule, so the "
+                "jump request would be silently ignored on TPU — pick one"
+            )
 
     @property
     def canvas_hw(self) -> Tuple[int, int]:

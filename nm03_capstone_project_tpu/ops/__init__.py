@@ -32,13 +32,17 @@ from nm03_capstone_project_tpu.ops.elementwise import (  # noqa: F401
 from nm03_capstone_project_tpu.ops.median import (  # noqa: F401
     vector_median_filter,
     vector_median_filter_multichannel,
+    vector_median_filter_sort,
 )
 from nm03_capstone_project_tpu.ops.morphology import dilate, erode  # noqa: F401
 from nm03_capstone_project_tpu.ops.neighborhood import extend_edges  # noqa: F401
 from nm03_capstone_project_tpu.ops.pallas_region_growing import (  # noqa: F401
     region_grow_pallas,
 )
-from nm03_capstone_project_tpu.ops.region_growing import region_grow  # noqa: F401
+from nm03_capstone_project_tpu.ops.region_growing import (  # noqa: F401
+    region_grow,
+    region_grow_jump,
+)
 from nm03_capstone_project_tpu.ops.regionprops import (  # noqa: F401
     bounding_box,
     connected_components,

@@ -6,13 +6,31 @@ edge-preserving denoise stage and one of the two hot per-pixel kernels.
 
 The vector median of a window is the sample minimizing the summed L1 distance
 to all other samples; for single-channel data that minimizer is exactly the
-scalar median sample, so the scalar path computes a median-of-k^2. Two
+scalar median sample, so the scalar path computes a median-of-k^2. Three
 implementations share the contract:
 
-* :func:`vector_median_filter` — portable XLA version (sort over the
-  materialized window stack), used on CPU and as the oracle.
-* ``ops.pallas_median`` (Pallas TPU kernel, rank-selection without a sort,
+* :func:`vector_median_filter` — the default XLA path: **column-presorted
+  Batcher merge network**. The k vertical neighbors are sorted ONCE per
+  column with a sorting network (shared by all k horizontal windows that
+  read that column — the classic amortization of fast 2D median filters),
+  then the k sorted runs are merged with Batcher odd-even merge networks
+  and the rank-k²//2 element is taken. Runs are padded to powers of two
+  with +inf sentinels that are folded away in Python (a compare-exchange
+  against +inf is a no-op or a swap), so the emitted XLA graph contains
+  only real min/max pairs — several-fold fewer than sorting the full k²
+  window stack, and XLA dead-code-eliminates the pairs that cannot reach
+  the median output.
+* :func:`vector_median_filter_sort` — the straightforward sort-the-window
+  implementation; kept as the readable in-repo oracle (SciPy is the
+  external one).
+* ``ops.pallas_median`` (Pallas TPU kernel, pairwise rank selection,
   VMEM-resident tiles) — selected via ``PipelineConfig.use_pallas``.
+
+All three are bit-identical on real data. (Pathological caveat shared with
+any min/max network: NaNs are unordered and -0.0/+0.0 compare equal, so
+windows containing those may differ bitwise from a total-order sort; the
+pipeline's median consumes clipped intensities in [0.68, 4000], where
+neither occurs.)
 
 Boundary handling is clamp-to-edge, matching the OpenCL sampler addressing
 the reference inherits.
@@ -20,18 +38,122 @@ the reference inherits.
 
 from __future__ import annotations
 
+from typing import List, Optional, Tuple
+
 import jax
 import jax.numpy as jnp
 
 from nm03_capstone_project_tpu.ops.neighborhood import shifted_stack, window_offsets
 
+_PAD = None  # Python-level +inf sentinel; folded before any op is emitted
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _oddeven_merge_pairs(lo: int, n: int, r: int, pairs: List[Tuple[int, int]]):
+    """Batcher odd-even merge: positions [lo, lo+n) hold two sorted halves."""
+    step = 2 * r
+    if step < n:
+        _oddeven_merge_pairs(lo, n, step, pairs)
+        _oddeven_merge_pairs(lo + r, n, step, pairs)
+        for i in range(lo + r, lo + n - r, step):
+            pairs.append((i, i + r))
+    else:
+        pairs.append((lo, lo + r))
+
+
+def _oddeven_sort_pairs(lo: int, n: int, pairs: List[Tuple[int, int]]):
+    """Batcher odd-even mergesort network for positions [lo, lo+n), n = 2^m."""
+    if n > 1:
+        m = n // 2
+        _oddeven_sort_pairs(lo, m, pairs)
+        _oddeven_sort_pairs(lo + m, m, pairs)
+        _oddeven_merge_pairs(lo, n, 1, pairs)
+
+
+def _apply_pairs(vals: List[Optional[jax.Array]], pairs) -> None:
+    """Run compare-exchanges in place, folding the +inf sentinel in Python.
+
+    CE(a, b) -> (min, max). With b = +inf it is a no-op; with a = +inf it is
+    a pure swap; only real-real pairs emit jnp.minimum/jnp.maximum.
+    """
+    for i, j in pairs:
+        a, b = vals[i], vals[j]
+        if b is _PAD:
+            continue
+        if a is _PAD:
+            vals[i], vals[j] = b, _PAD
+            continue
+        vals[i] = jnp.minimum(a, b)
+        vals[j] = jnp.maximum(a, b)
+
+
+def _sort_network(vals: List[jax.Array]) -> List[jax.Array]:
+    """Sort a small list of arrays elementwise with a Batcher network."""
+    n = len(vals)
+    p = _next_pow2(n)
+    padded: List[Optional[jax.Array]] = list(vals) + [_PAD] * (p - n)
+    pairs: List[Tuple[int, int]] = []
+    _oddeven_sort_pairs(0, p, pairs)
+    _apply_pairs(padded, pairs)
+    assert all(v is not _PAD for v in padded[:n])
+    return padded[:n]  # ascending; pads sorted to the tail
+
 
 def vector_median_filter(x: jax.Array, size: int = 7) -> jax.Array:
-    """Median over a size x size clamp-to-edge window (XLA reference path).
+    """Median over a size x size clamp-to-edge window (fast XLA path).
 
-    ``x`` is (..., H, W) float; returns the same shape/dtype. The median of an
-    odd k*k window equals the vector median (L1) for scalar samples.
+    ``x`` is (..., H, W) float; returns the same shape/dtype. The median of
+    an odd k*k window equals the vector median (L1) for scalar samples.
     """
+    if size % 2 != 1:
+        raise ValueError(f"median window must be odd, got {size}")
+    if size == 1:
+        return x
+    k = size
+    r = k // 2
+
+    # 1) vertical sort, shared across the k horizontal windows per column:
+    #    row-shifted full-width views -> k sorted arrays (16 CEs for k=7)
+    rows = shifted_stack(x, [(dr, 0) for dr in range(-r, k - r)], pad_mode="edge")
+    sorted_rows = _sort_network([rows[i] for i in range(k)])
+
+    # 2) the k*k window samples as k sorted runs: column-shift each sorted
+    #    array; run dc holds the vertically-sorted column at offset dc
+    def colshift(a: jax.Array, dc: int) -> jax.Array:
+        pw = [(0, 0)] * (a.ndim - 1) + [(r, r)]
+        ap = jnp.pad(a, pw, mode="edge")
+        return jax.lax.dynamic_slice_in_dim(ap, r + dc, a.shape[-1], axis=-1)
+
+    p_run = _next_pow2(k)  # slots per run, +inf padded
+    n_runs = _next_pow2(k)  # number of runs, all-+inf runs appended
+    vals: List[Optional[jax.Array]] = []
+    for dc in range(-r, k - r):
+        vals.extend(colshift(a, dc) for a in sorted_rows)
+        vals.extend([_PAD] * (p_run - k))
+    vals.extend([_PAD] * ((n_runs - k) * p_run))
+
+    # 3) Batcher merge tree over the sorted runs; take rank k*k // 2
+    width = p_run
+    total = p_run * n_runs
+    while width < total:
+        pairs = []
+        for lo in range(0, total, 2 * width):
+            _oddeven_merge_pairs(lo, 2 * width, 1, pairs)
+        _apply_pairs(vals, pairs)
+        width *= 2
+    med = vals[(k * k) // 2]
+    assert med is not _PAD
+    return med
+
+
+def vector_median_filter_sort(x: jax.Array, size: int = 7) -> jax.Array:
+    """Median via materialize-and-sort (the readable in-repo oracle)."""
     if size % 2 != 1:
         raise ValueError(f"median window must be odd, got {size}")
     stack = shifted_stack(x, window_offsets(size), pad_mode="edge")

@@ -155,17 +155,30 @@ def grow_dispatch(
     block_iters: int = 16,
     max_iters: int = 1024,
     use_pallas: bool = False,
+    algorithm: str = "dilate",
 ):
-    """Route between the Pallas kernel and the portable XLA implementation.
+    """Route between the Pallas kernel and the portable XLA implementations.
 
     Same dispatch contract as :func:`.pallas_median.median_filter`: off-TPU
     the Pallas request degrades to the XLA path (identical results).
+    ``algorithm`` selects the XLA convergence schedule — "dilate" (one-ring
+    fixpoint) or "jump" (pointer-jumping label merge, O(log) rounds);
+    identical masks whenever both converge within their caps, see
+    :mod:`.region_growing`. PipelineConfig rejects jump+use_pallas (the
+    Pallas kernel implements the dilate schedule and would silently win
+    here).
     """
     from nm03_capstone_project_tpu.ops.pallas_median import pallas_backend_supported
 
     if use_pallas and pallas_backend_supported():
         return region_grow_pallas(
             image, seeds, low, high, valid, connectivity, block_iters, max_iters
+        )
+    if algorithm == "jump":
+        from nm03_capstone_project_tpu.ops.region_growing import region_grow_jump
+
+        return region_grow_jump(
+            image, seeds, low, high, valid=valid, connectivity=connectivity
         )
     from nm03_capstone_project_tpu.ops.region_growing import region_grow
 

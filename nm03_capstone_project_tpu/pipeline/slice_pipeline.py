@@ -73,6 +73,7 @@ def segment(
         block_iters=cfg.grow_block_iters,
         max_iters=cfg.grow_max_iters,
         use_pallas=cfg.use_pallas,
+        algorithm=cfg.grow_algorithm,
     )
 
 

@@ -7,6 +7,7 @@ from nm03_capstone_project_tpu.ops import (
     sharpen,
     vector_median_filter,
     vector_median_filter_multichannel,
+    vector_median_filter_sort,
 )
 
 
@@ -31,6 +32,60 @@ def test_median_batched(rng):
         np.testing.assert_allclose(
             out[i], ndi.median_filter(x[i], size=5, mode="nearest"), atol=1e-6
         )
+
+
+class TestNetworkMedian:
+    """The column-presorted Batcher network path vs the sort oracle.
+
+    Bit-identical equality (not allclose): both paths only MOVE input values
+    — no arithmetic — so any deviation is an algorithmic bug, not float
+    noise.
+    """
+
+    def test_bit_identical_to_sort_oracle(self, rng):
+        for size in (3, 5, 7, 9):
+            for shape in ((33, 47), (8, 8), (7, 7)):
+                x = rng.random(shape).astype(np.float32)
+                got = np.asarray(vector_median_filter(x, size))
+                want = np.asarray(vector_median_filter_sort(x, size))
+                np.testing.assert_array_equal(got, want, err_msg=f"{size} {shape}")
+
+    def test_heavy_ties(self, rng):
+        # quantized values force many equal samples through the network
+        for size in (3, 5, 7):
+            x = rng.integers(0, 4, (40, 40)).astype(np.float32)
+            np.testing.assert_array_equal(
+                np.asarray(vector_median_filter(x, size)),
+                np.asarray(vector_median_filter_sort(x, size)),
+            )
+
+    def test_batched_and_size1(self, rng):
+        x = rng.random((3, 24, 24)).astype(np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(vector_median_filter(x, 7)),
+            np.asarray(vector_median_filter_sort(x, 7)),
+        )
+        np.testing.assert_array_equal(np.asarray(vector_median_filter(x, 1)), x)
+
+    def test_batcher_networks_sort_correctly(self, rng):
+        # 0-1 principle: a comparator network sorts all inputs iff it sorts
+        # all 0-1 inputs; exhaustive for the small vertical-sort widths
+        import itertools
+
+        from nm03_capstone_project_tpu.ops.median import (
+            _apply_pairs,
+            _oddeven_sort_pairs,
+        )
+        import jax.numpy as jnp
+
+        for n in (2, 4, 8, 16):
+            pairs = []
+            _oddeven_sort_pairs(0, n, pairs)
+            for bits in itertools.product((0.0, 1.0), repeat=n):
+                vals = [jnp.float32(b) for b in bits]
+                _apply_pairs(vals, pairs)
+                out = [float(v) for v in vals]
+                assert out == sorted(bits), f"n={n} bits={bits}"
 
 
 def test_vector_median_scalar_channel_agrees(rng):

@@ -1,8 +1,9 @@
 import jax
 import numpy as np
+import pytest
 import scipy.ndimage as ndi
 
-from nm03_capstone_project_tpu.ops import region_grow
+from nm03_capstone_project_tpu.ops import region_grow, region_grow_jump
 
 
 def oracle_region_grow(image, seeds, low, high, connectivity=4):
@@ -100,3 +101,107 @@ def test_region_grow_8_connectivity():
     out8 = np.asarray(region_grow(img, seeds, 0.74, 0.91, connectivity=8))
     assert out4.sum() == 1
     assert out8.sum() == 3
+
+
+class TestJumpAlgorithm:
+    """region_grow_jump: O(log) pointer-jumping schedule, identical sets."""
+
+    def test_matches_scipy_oracle_random(self, rng):
+        for trial in range(5):
+            img = ndi.gaussian_filter(
+                rng.random((48, 48)).astype(np.float32), sigma=2.0
+            )
+            seeds = np.zeros((48, 48), bool)
+            seeds[24, 24] = True
+            seeds[10, 35] = True
+            out = np.asarray(region_grow_jump(img, seeds, 0.45, 0.6))
+            expected = oracle_region_grow(img, seeds, 0.45, 0.6)
+            np.testing.assert_array_equal(out, expected, err_msg=f"trial {trial}")
+
+    def test_snake_path_converges_logarithmically(self):
+        # the adversarial case for the dilation fixpoint: a 24x24 boustrophedon
+        # needs ~500 one-ring steps; the jump schedule must still reach the
+        # exact fixpoint (and does so in O(log) rounds by construction)
+        img = np.zeros((24, 24), np.float32)
+        for i in range(24):
+            if i % 2 == 0:
+                img[i, :23] = 0.8
+            else:
+                img[i, 1:] = 0.8
+        seeds = np.zeros((24, 24), bool)
+        seeds[0, 0] = True
+        out = np.asarray(region_grow_jump(img, seeds, 0.74, 0.91))
+        np.testing.assert_array_equal(out, oracle_region_grow(img, seeds, 0.74, 0.91))
+        assert out.sum() == (img > 0).sum()
+
+    @pytest.mark.parametrize("connectivity", [4, 8])
+    def test_bit_identical_to_dilate_path(self, rng, connectivity):
+        for trial in range(3):
+            img = ndi.gaussian_filter(
+                rng.random((40, 40)).astype(np.float32), sigma=1.5
+            )
+            seeds = np.zeros((40, 40), bool)
+            seeds[20, 20] = seeds[5, 30] = seeds[33, 7] = True
+            a = np.asarray(
+                region_grow(img, seeds, 0.45, 0.6, connectivity=connectivity)
+            )
+            b = np.asarray(
+                region_grow_jump(img, seeds, 0.45, 0.6, connectivity=connectivity)
+            )
+            np.testing.assert_array_equal(a, b, err_msg=f"trial {trial}")
+
+    def test_valid_mask_and_dead_seed(self):
+        img = np.full((16, 16), 0.8, np.float32)
+        seeds = np.zeros((16, 16), bool)
+        seeds[4, 4] = True
+        valid = np.zeros((16, 16), bool)
+        valid[:8, :8] = True
+        out = np.asarray(region_grow_jump(img, seeds, 0.74, 0.91, valid=valid))
+        assert out[:8, :8].all() and out[8:, :].sum() == 0 and out[:, 8:].sum() == 0
+        dead = np.asarray(
+            region_grow_jump(np.full((16, 16), 0.5, np.float32), seeds, 0.74, 0.91)
+        )
+        assert dead.sum() == 0
+
+    def test_vmap_matches_per_slice(self, rng):
+        imgs = ndi.gaussian_filter(
+            rng.random((4, 32, 32)), sigma=1.5, axes=(1, 2)
+        ).astype(np.float32)
+        seeds = np.zeros((4, 32, 32), bool)
+        seeds[:, 16, 16] = True
+        f = jax.vmap(lambda i, s: region_grow_jump(i, s, 0.45, 0.6))
+        out = np.asarray(f(imgs, seeds))
+        for i in range(4):
+            np.testing.assert_array_equal(
+                out[i], np.asarray(region_grow_jump(imgs[i], seeds[i], 0.45, 0.6))
+            )
+
+    def test_rejects_batched_input(self):
+        with pytest.raises(ValueError, match="per-slice"):
+            region_grow_jump(
+                np.zeros((2, 8, 8), np.float32), np.zeros((2, 8, 8), bool), 0.0, 1.0
+            )
+
+    def test_jump_plus_pallas_rejected_at_config(self):
+        from nm03_capstone_project_tpu.config import PipelineConfig
+
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            PipelineConfig(grow_algorithm="jump", use_pallas=True)
+
+    def test_pipeline_with_jump_matches_default(self):
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from nm03_capstone_project_tpu.config import PipelineConfig
+        from nm03_capstone_project_tpu.data.synthetic import phantom_slice
+        from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
+
+        cfg = PipelineConfig(grow_block_iters=8, grow_max_iters=512)
+        cfg_jump = dataclasses.replace(cfg, grow_algorithm="jump")
+        x = jnp.asarray(phantom_slice(96, 96, seed=5))
+        dims = jnp.asarray([96, 96], np.int32)
+        a = process_slice(x, dims, cfg)
+        b = process_slice(x, dims, cfg_jump)
+        np.testing.assert_array_equal(np.asarray(a["mask"]), np.asarray(b["mask"]))
+        assert np.asarray(a["mask"]).sum() > 0
