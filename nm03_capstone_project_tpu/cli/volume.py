@@ -147,15 +147,6 @@ def run(args: argparse.Namespace) -> int:
     configure_reporting(verbose=args.verbose)
     common.apply_native_flag(args)
     cfg = common.pipeline_config_from_args(args)
-    if cfg.grow_algorithm != "dilate":
-        # the shared flag group offers --grow-algorithm, but the volumetric
-        # pipeline has only the 3D dilation fixpoint — don't let a user
-        # benchmark "jump" timings that were secretly dilate
-        print(
-            "warning: --grow-algorithm applies to the 2D drivers only; "
-            "the volume pipeline always runs the 3D dilation fixpoint",
-            file=sys.stderr,
-        )
     base = common.resolve_base_path(args, tmp_root=Path(args.output))
     out_root = Path(args.output)
     manifest = Manifest.load_or_create(out_root) if args.resume else Manifest(out_root)
@@ -164,6 +155,15 @@ def run(args: argparse.Namespace) -> int:
     zshard = args.z_shard and n_dev > 1
     if args.z_shard and n_dev == 1:
         print("--z-shard ignored: single device", file=sys.stderr)
+    if cfg.grow_algorithm != "dilate" and zshard:
+        # the z-sharded decomposition implements only the halo-exchange
+        # dilation fixpoint — don't let a user benchmark "jump" timings that
+        # were secretly dilate (single-device volumes honor the flag)
+        print(
+            "warning: --grow-algorithm jump applies to single-device volumes; "
+            "the z-sharded path always runs the halo-exchange dilation fixpoint",
+            file=sys.stderr,
+        )
     mesh = None
     if zshard:
         from nm03_capstone_project_tpu.parallel import make_mesh

@@ -81,9 +81,10 @@ class PipelineConfig:
     # rounds (ops.region_growing.region_grow_jump) — for latency-bound
     # accelerators. Identical masks whenever the dilate path converges within
     # its cap (always, for clinical-shaped regions; a >grow_max_iters
-    # serpentine path truncates dilate but not jump). 2D drivers only; the
-    # volume pipeline always runs the 3D fixpoint. Mutually exclusive with
-    # use_pallas (the Pallas grow kernel implements the dilate schedule).
+    # serpentine path truncates dilate but not jump). Honored by the 2D
+    # drivers and single-device volumes (region_grow_jump_3d); the z-sharded
+    # volume path implements only the halo-exchange fixpoint. Mutually
+    # exclusive with use_pallas (the Pallas grow kernel is dilate-schedule).
     grow_algorithm: str = "dilate"
     # Route the hot ops through the Pallas TPU kernels (ops.pallas_median,
     # ops.pallas_region_growing) instead of the portable XLA implementations.

@@ -54,4 +54,5 @@ from nm03_capstone_project_tpu.ops.volume import (  # noqa: F401
     dilate3d,
     erode3d,
     region_grow_3d,
+    region_grow_jump_3d,
 )

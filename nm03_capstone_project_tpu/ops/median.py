@@ -105,6 +105,37 @@ def _sort_network(vals: List[jax.Array]) -> List[jax.Array]:
     return padded[:n]  # ascending; pads sorted to the tail
 
 
+def _merge_runs_take_median(sorted_rows: List[jax.Array], k: int, colslice):
+    """Rank-k²//2 of the k*k window given k vertically-sorted row arrays.
+
+    ``colslice(a, j)`` extracts the j-th (0-based) horizontal window column
+    from a sorted row array — the only step that differs between the XLA
+    path (edge-padded dynamic slice) and the Pallas kernel (static slice of
+    the already-padded VMEM band). Shared so the two paths cannot drift
+    apart: runs are +inf-padded to powers of two (folded in Python by
+    :func:`_apply_pairs`) and merged with a Batcher odd-even merge tree.
+    """
+    p_run = _next_pow2(k)  # slots per run, +inf padded
+    n_runs = _next_pow2(k)  # number of runs, all-+inf runs appended
+    vals: List[Optional[jax.Array]] = []
+    for j in range(k):
+        vals.extend(colslice(a, j) for a in sorted_rows)
+        vals.extend([_PAD] * (p_run - k))
+    vals.extend([_PAD] * ((n_runs - k) * p_run))
+
+    width = p_run
+    total = p_run * n_runs
+    while width < total:
+        pairs: List[Tuple[int, int]] = []
+        for lo in range(0, total, 2 * width):
+            _oddeven_merge_pairs(lo, 2 * width, 1, pairs)
+        _apply_pairs(vals, pairs)
+        width *= 2
+    med = vals[(k * k) // 2]
+    assert med is not _PAD
+    return med
+
+
 def vector_median_filter(x: jax.Array, size: int = 7) -> jax.Array:
     """Median over a size x size clamp-to-edge window (fast XLA path).
 
@@ -118,38 +149,17 @@ def vector_median_filter(x: jax.Array, size: int = 7) -> jax.Array:
     k = size
     r = k // 2
 
-    # 1) vertical sort, shared across the k horizontal windows per column:
-    #    row-shifted full-width views -> k sorted arrays (16 CEs for k=7)
+    # vertical sort, shared across the k horizontal windows per column:
+    # row-shifted full-width views -> k sorted arrays (16 CEs for k=7)
     rows = shifted_stack(x, [(dr, 0) for dr in range(-r, k - r)], pad_mode="edge")
     sorted_rows = _sort_network([rows[i] for i in range(k)])
 
-    # 2) the k*k window samples as k sorted runs: column-shift each sorted
-    #    array; run dc holds the vertically-sorted column at offset dc
-    def colshift(a: jax.Array, dc: int) -> jax.Array:
+    def colslice(a: jax.Array, j: int) -> jax.Array:
         pw = [(0, 0)] * (a.ndim - 1) + [(r, r)]
         ap = jnp.pad(a, pw, mode="edge")
-        return jax.lax.dynamic_slice_in_dim(ap, r + dc, a.shape[-1], axis=-1)
+        return jax.lax.dynamic_slice_in_dim(ap, j, a.shape[-1], axis=-1)
 
-    p_run = _next_pow2(k)  # slots per run, +inf padded
-    n_runs = _next_pow2(k)  # number of runs, all-+inf runs appended
-    vals: List[Optional[jax.Array]] = []
-    for dc in range(-r, k - r):
-        vals.extend(colshift(a, dc) for a in sorted_rows)
-        vals.extend([_PAD] * (p_run - k))
-    vals.extend([_PAD] * ((n_runs - k) * p_run))
-
-    # 3) Batcher merge tree over the sorted runs; take rank k*k // 2
-    width = p_run
-    total = p_run * n_runs
-    while width < total:
-        pairs = []
-        for lo in range(0, total, 2 * width):
-            _oddeven_merge_pairs(lo, 2 * width, 1, pairs)
-        _apply_pairs(vals, pairs)
-        width *= 2
-    med = vals[(k * k) // 2]
-    assert med is not _PAD
-    return med
+    return _merge_runs_take_median(sorted_rows, k, colslice)
 
 
 def vector_median_filter_sort(x: jax.Array, size: int = 7) -> jax.Array:

@@ -158,3 +158,105 @@ def region_grow_3d(
         cond, body, (grow_block(region0), region0.sum(), jnp.int32(block_iters))
     )
     return region.astype(jnp.uint8)
+
+
+def _shift3d(a: jax.Array, off, fill) -> jax.Array:
+    """``a`` shifted by (dz, dy, dx); vacated voxels take ``fill``."""
+    out = a
+    for axis, d in zip((-3, -2, -1), off):
+        if d == 0:
+            continue
+        pad = [(0, 0)] * a.ndim
+        pad[axis] = (max(-d, 0), max(d, 0))
+        out = jnp.pad(out, pad, mode="constant", constant_values=fill)
+        out = jax.lax.slice_in_dim(
+            out, max(d, 0), max(d, 0) + a.shape[axis], axis=axis
+        )
+    return out
+
+
+def region_grow_jump_3d(
+    volume: jax.Array,
+    seeds: jax.Array,
+    low: float = 0.74,
+    high: float = 0.91,
+    valid: jax.Array | None = None,
+    connectivity: int = 6,
+    max_rounds: int = 256,
+    jumps_per_round: int = 2,
+) -> jax.Array:
+    """3D flood fill in O(log diameter) rounds via pointer-jumping label merge.
+
+    Volumetric twin of :func:`ops.region_growing.region_grow_jump` — same set
+    semantics as :func:`region_grow_3d` (identical masks whenever the dilate
+    schedule converges within its cap), with O(log) sequential depth instead
+    of one 6/26-connected shell per step. One (D, H, W) volume; vmap for
+    batches.
+    """
+    if volume.ndim != 3:
+        raise ValueError(
+            f"region_grow_jump_3d is per-volume (3D); got shape {volume.shape}"
+            " — vmap over leading axes instead"
+        )
+    band = (volume >= low) & (volume <= high)
+    if valid is not None:
+        band = band & valid
+    d, h, w = volume.shape
+    n = d * h * w
+    sentinel = jnp.int32(n)
+    ids = jnp.arange(n, dtype=jnp.int32).reshape(d, h, w)
+    labels0 = jnp.where(band, ids, sentinel)
+
+    if connectivity == 6:
+        offsets = [
+            (1, 0, 0), (-1, 0, 0), (0, 1, 0), (0, -1, 0), (0, 0, 1), (0, 0, -1),
+        ]
+    elif connectivity == 26:
+        offsets = [
+            (dz, dy, dx)
+            for dz in (-1, 0, 1)
+            for dy in (-1, 0, 1)
+            for dx in (-1, 0, 1)
+            if (dz, dy, dx) != (0, 0, 0)
+        ]
+    else:
+        raise ValueError(f"connectivity must be 6 or 26, got {connectivity}")
+
+    def neighbor_min(labels):
+        m = labels
+        for off in offsets:
+            m = jnp.minimum(m, _shift3d(labels, off, n))
+        return jnp.where(band, m, sentinel)
+
+    def jump(labels):
+        flat = jnp.concatenate([labels.ravel(), jnp.array([n], jnp.int32)])
+        return jnp.where(band, flat[labels], sentinel)
+
+    def round_(labels):
+        labels = neighbor_min(labels)
+        for _ in range(jumps_per_round):
+            labels = jump(labels)
+        return labels
+
+    def cond(state):
+        prev, cur, it = state
+        return jnp.any(prev != cur) & (it < max_rounds)
+
+    def body(state):
+        _, cur, it = state
+        return cur, round_(cur), it + 1
+
+    _, labels, _ = jax.lax.while_loop(
+        cond, body, (labels0, round_(labels0), jnp.int32(1))
+    )
+
+    seed_labels = jnp.where(seeds.astype(bool) & band, labels, sentinel)
+    marked = (
+        jnp.zeros((n + 1,), jnp.bool_)
+        .at[seed_labels.ravel()]
+        .set(True)
+        .at[n]
+        .set(False)
+    )
+    region = band & marked[labels]
+    return region.astype(jnp.uint8)

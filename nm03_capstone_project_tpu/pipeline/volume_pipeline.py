@@ -24,7 +24,11 @@ from nm03_capstone_project_tpu.config import DEFAULT_CONFIG, PipelineConfig
 from nm03_capstone_project_tpu.core.image import valid_mask
 from nm03_capstone_project_tpu.ops.elementwise import cast_uint8
 from nm03_capstone_project_tpu.ops.seeds import seed_mask
-from nm03_capstone_project_tpu.ops.volume import dilate3d, region_grow_3d
+from nm03_capstone_project_tpu.ops.volume import (
+    dilate3d,
+    region_grow_3d,
+    region_grow_jump_3d,
+)
 from nm03_capstone_project_tpu.pipeline.slice_pipeline import preprocess
 
 
@@ -57,15 +61,20 @@ def process_volume(
     seeds = jnp.broadcast_to(seeds2d, (d,) + seeds2d.shape)
     valid = jnp.broadcast_to(valid2d, (d,) + valid2d.shape)
 
-    seg = region_grow_3d(
-        pre,
-        seeds,
-        cfg.grow_low,
-        cfg.grow_high,
-        valid=valid,
-        block_iters=cfg.grow_block_iters,
-        max_iters=cfg.grow_max_iters,
-    )
+    if cfg.grow_algorithm == "jump":
+        seg = region_grow_jump_3d(
+            pre, seeds, cfg.grow_low, cfg.grow_high, valid=valid
+        )
+    else:
+        seg = region_grow_3d(
+            pre,
+            seeds,
+            cfg.grow_low,
+            cfg.grow_high,
+            valid=valid,
+            block_iters=cfg.grow_block_iters,
+            max_iters=cfg.grow_max_iters,
+        )
     mask = dilate3d(cast_uint8(seg), cfg.morph_size)
     mask = mask * valid.astype(mask.dtype)
     return {"original": volume, "mask": mask}

@@ -130,6 +130,77 @@ class TestRegionGrow3D:
         assert got[:, 3:, :].sum() == 0 and got[:, :, 3:].sum() == 0
 
 
+class TestRegionGrowJump3D:
+    """3D pointer-jumping schedule: same sets as the dilate fixpoint."""
+
+    @pytest.mark.parametrize("connectivity", [6, 26])
+    def test_matches_oracle_and_dilate(self, rng, connectivity):
+        from nm03_capstone_project_tpu.ops import region_grow_jump_3d
+
+        vol = rng.random((8, 16, 16)).astype(np.float32)
+        seeds = np.zeros_like(vol, dtype=bool)
+        seeds[4, 8, 8] = True
+        seeds[2, 3, 12] = True
+        got = np.asarray(
+            region_grow_jump_3d(
+                jnp.asarray(vol), jnp.asarray(seeds), 0.4, 0.9,
+                connectivity=connectivity,
+            )
+        )
+        np.testing.assert_array_equal(
+            got, _oracle_region_grow(vol, seeds, 0.4, 0.9, connectivity)
+        )
+
+    def test_helix_path_through_z(self):
+        # a path winding through all three axes: worst case for one-shell
+        # growth, routine for the O(log) schedule
+        vol = np.zeros((6, 10, 10), np.float32)
+        for z in range(6):
+            if z % 2 == 0:
+                vol[z, z % 10, :9] = 0.5
+            else:
+                vol[z, z % 10, 8] = 0.5
+                vol[z, (z + 1) % 10, 8] = 0.5
+            if z + 1 < 6:  # connect to next slice
+                vol[z, (z + 1) % 10, 0 if z % 2 else 8] = 0.5
+                vol[z + 1, (z + 1) % 10, 0 if z % 2 else 8] = 0.5
+        seeds = np.zeros_like(vol, dtype=bool)
+        seeds[0, 0, 0] = True
+        from nm03_capstone_project_tpu.ops import region_grow_jump_3d
+
+        got = np.asarray(
+            region_grow_jump_3d(jnp.asarray(vol), jnp.asarray(seeds), 0.4, 0.6)
+        )
+        np.testing.assert_array_equal(got, _oracle_region_grow(vol, seeds, 0.4, 0.6, 6))
+
+    def test_volume_pipeline_with_jump_matches_default(self):
+        import dataclasses
+
+        from nm03_capstone_project_tpu.config import PipelineConfig
+        from nm03_capstone_project_tpu.data.synthetic import phantom_volume
+        from nm03_capstone_project_tpu.pipeline.volume_pipeline import process_volume
+
+        cfg = PipelineConfig(grow_block_iters=8, grow_max_iters=512)
+        cfg_jump = dataclasses.replace(cfg, grow_algorithm="jump")
+        vol = jnp.asarray(phantom_volume(n_slices=8, height=48, width=48, seed=2))
+        dims = jnp.asarray([48, 48], np.int32)
+        a = process_volume(vol, dims, cfg)
+        b = process_volume(vol, dims, cfg_jump)
+        np.testing.assert_array_equal(np.asarray(a["mask"]), np.asarray(b["mask"]))
+        assert np.asarray(a["mask"]).sum() > 0
+
+    def test_rejects_batched_input(self):
+        from nm03_capstone_project_tpu.ops import region_grow_jump_3d
+
+        with pytest.raises(ValueError, match="per-volume"):
+            region_grow_jump_3d(
+                np.zeros((2, 4, 8, 8), np.float32),
+                np.zeros((2, 4, 8, 8), bool),
+                0.0,
+                1.0,
+            )
+
+
 class TestVolumePipeline:
     def test_phantom_lesion_segmented_as_one_body(self):
         from nm03_capstone_project_tpu.pipeline.volume_pipeline import process_volume
