@@ -387,8 +387,14 @@ def _probe_until_healthy(env_overrides, label) -> bool:
 
     A hung probe holds no chip claim (it never gets past device init), so
     killing it on timeout cannot wedge the tunnel the way killing a
-    mid-compile heavy worker does.
+    mid-compile heavy worker does. Two failure modes get different budgets:
+    a FAST error (rc != 0, e.g. "Unable to initialize backend") is often
+    transient and worth the full retry schedule, but a probe TIMEOUT means
+    the tunnel is wedged — observed to persist for hours — so two
+    consecutive timeouts end the vigil instead of burning the whole
+    benchmark window on a dead tunnel.
     """
+    consecutive_timeouts = 0
     for attempt in range(1, PROBE_ATTEMPTS + 1):
         rc, stdout = _spawn(
             f"{label} probe {attempt}/{PROBE_ATTEMPTS}",
@@ -400,6 +406,10 @@ def _probe_until_healthy(env_overrides, label) -> bool:
         if res is not None:
             _log(f"{label} probe ok: backend {res['backend']}")
             return True
+        consecutive_timeouts = consecutive_timeouts + 1 if rc is None else 0
+        if consecutive_timeouts >= 2:
+            _log(f"{label}: two probe timeouts — tunnel wedged, giving up")
+            return False
         if attempt < PROBE_ATTEMPTS:
             _log(f"{label} probe failed; backing off {PROBE_BACKOFF_S}s")
             time.sleep(PROBE_BACKOFF_S)

@@ -8,6 +8,33 @@ from nm03_capstone_project_tpu.render.render import (
 )
 
 
+def test_matmul_and_gather_samplers_agree(monkeypatch):
+    """The TPU (MXU matmul) and CPU (gather) resample paths must agree.
+
+    Masks (nearest, one-hot) must be EXACT; grayscale (bilinear) may differ
+    by one 8-bit count at isolated pixels from lerp reassociation.
+    """
+    from nm03_capstone_project_tpu.render import render as rr
+
+    rng = np.random.default_rng(7)
+    px = np.zeros((128, 128), np.float32)
+    px[:100, :80] = rng.random((100, 80)).astype(np.float32) * 900
+    mask = np.zeros((128, 128), np.uint8)
+    mask[20:60, 10:50] = 1
+    dims = np.asarray([100, 80], np.int32)
+
+    monkeypatch.setattr(rr, "_mxu_backend", lambda: False)  # force gather
+    gather_gray = np.asarray(render_gray(px, dims, 256))
+    gather_seg = np.asarray(render_segmentation(mask, dims, 256))
+    monkeypatch.setattr(rr, "_mxu_backend", lambda: True)
+    matmul_gray = np.asarray(render_gray(px, dims, 256))
+    matmul_seg = np.asarray(render_segmentation(mask, dims, 256))
+
+    np.testing.assert_array_equal(matmul_seg, gather_seg)
+    diff = np.abs(matmul_gray.astype(np.int16) - gather_gray.astype(np.int16))
+    assert diff.max() <= 1, f"max bilinear path divergence {diff.max()}"
+
+
 def test_render_gray_letterbox_geometry():
     # wide slice: 100x200 -> scaled to 256x128 region centered vertically
     img = np.full((100, 200), 500.0, np.float32)
