@@ -314,7 +314,9 @@ def worker(
     pixels, dims = _make_batch()
     devices = jax.devices()
     dev = devices[0]
-    on_tpu = dev.platform in ("tpu", "axon")
+    from nm03_capstone_project_tpu.core.backend import _TPU_PLATFORMS
+
+    on_tpu = dev.platform in _TPU_PLATFORMS
     _log(f"worker backend: {dev.platform} ({len(devices)} devices)")
 
     result: dict = {}

@@ -1,4 +1,5 @@
-"""Core containers and shape policy."""
+"""Core containers, shape policy, and backend identity."""
 
+from nm03_capstone_project_tpu.core.backend import is_tpu_backend  # noqa: F401
 from nm03_capstone_project_tpu.core.image import SliceBatch, valid_mask  # noqa: F401
 from nm03_capstone_project_tpu.core.padding import pad_to_canvas  # noqa: F401

@@ -1,0 +1,20 @@
+"""Backend identity — the single home of the TPU platform allowlist.
+
+Several dispatch sites pick an implementation by whether the default backend
+is a real TPU (Pallas kernel lowering, MXU-vs-gather resampling). The
+platform names live HERE exactly once: 'tpu', plus 'axon' (TPU behind the
+development tunnel). A GPU or CPU backend must never pass this check —
+Mosaic lowering crashes there, and the matmul render formulation loses to
+the gather one.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_TPU_PLATFORMS = ("tpu", "axon")
+
+
+def is_tpu_backend() -> bool:
+    """True iff the default jax backend is a real TPU (incl. tunneled)."""
+    return jax.default_backend() in _TPU_PLATFORMS

@@ -101,11 +101,13 @@ def vector_median_filter_pallas(
 def pallas_backend_supported() -> bool:
     """True iff the default backend can lower ``pltpu`` kernels.
 
-    Only real TPUs qualify: 'tpu', or 'axon' (TPU via tunnel). A GPU (or any
-    other) backend must take the XLA path — attempting Mosaic lowering there
-    crashes at compile time.
+    Only real TPUs qualify (core.backend holds the single platform
+    allowlist). A GPU (or any other) backend must take the XLA path —
+    attempting Mosaic lowering there crashes at compile time.
     """
-    return jax.default_backend() in ("tpu", "axon")
+    from nm03_capstone_project_tpu.core.backend import is_tpu_backend
+
+    return is_tpu_backend()
 
 
 def median_filter(x: jax.Array, size: int = 7, use_pallas: bool = False) -> jax.Array:

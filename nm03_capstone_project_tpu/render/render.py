@@ -95,7 +95,9 @@ def _nearest_weights(src: jax.Array, n: int, extent: jax.Array) -> jax.Array:
 
 
 def _mxu_backend() -> bool:
-    return jax.default_backend() in ("tpu", "axon")
+    from nm03_capstone_project_tpu.core.backend import is_tpu_backend
+
+    return is_tpu_backend()
 
 
 def _resample(img: jax.Array, ry: jax.Array, cx: jax.Array) -> jax.Array:
