@@ -26,13 +26,15 @@ the resample has two equivalent formulations selected per backend:
   slow path on a TPU, matmuls are the MXU's native operation
   (``precision='highest'`` keeps the f32 weights exact, same guard as
   ops.sharpen);
-* elsewhere, the classic per-pixel gather (outer product of the 1D
-  coordinates), which measures ~25% faster than the dense matmuls on the
-  CPU backend.
+* elsewhere, a separable two-stage gather (lerp rows, then columns), which
+  measures faster than the dense matmuls on the CPU backend.
 
-Both produce the same renders (the bilinear lerp is associativity-reordered
-between them, so isolated pixels may differ by one 8-bit count — within the
-golden suite's tolerance; the nearest/mask path is exact either way).
+Both formulations share the rows-then-columns lerp structure, so they agree
+to the last bit everywhere except clamped-edge pixels, where the matmul
+folds the two interpolation weights into one matrix entry ((1-f)+f rounds
+once) while the gather adds two products — an ulp-level divergence of at
+most one 8-bit count, within the golden suite's tolerance. The nearest/mask
+path is exact on both.
 """
 
 from __future__ import annotations
@@ -114,20 +116,18 @@ def _sample_bilinear(img: jax.Array, src_y, src_x, dims) -> jax.Array:
         ry = _bilinear_weights(src_y, img.shape[-2], dims[..., 0])
         cx = _bilinear_weights(src_x, img.shape[-1], dims[..., 1])
         return _resample(img.astype(jnp.float32), ry, cx)
+    # separable two-stage gather: lerp rows first (small row gathers), then
+    # columns — same rows-then-columns structure as the matmul path (bitwise
+    # equal away from clamped edges; see module docstring for the edge case)
     h, w = dims[..., 0], dims[..., 1]
     y0 = jnp.clip(jnp.floor(src_y).astype(jnp.int32), 0, h - 1)
-    x0 = jnp.clip(jnp.floor(src_x).astype(jnp.int32), 0, w - 1)
     y1 = jnp.clip(y0 + 1, 0, h - 1)
-    x1 = jnp.clip(x0 + 1, 0, w - 1)
     fy = jnp.clip(src_y - y0.astype(jnp.float32), 0.0, 1.0)[:, None]
+    x0 = jnp.clip(jnp.floor(src_x).astype(jnp.int32), 0, w - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
     fx = jnp.clip(src_x - x0.astype(jnp.float32), 0.0, 1.0)[None, :]
-
-    def at(yy, xx):
-        return img[yy[:, None], xx[None, :]]
-
-    top = at(y0, x0) * (1 - fx) + at(y0, x1) * fx
-    bot = at(y1, x0) * (1 - fx) + at(y1, x1) * fx
-    return top * (1 - fy) + bot * fy
+    rows = img[y0, :] * (1 - fy) + img[y1, :] * fy  # (out, W_canvas)
+    return rows[:, x0] * (1 - fx) + rows[:, x1] * fx
 
 
 def _sample_nearest(img: jax.Array, src_y, src_x, dims) -> jax.Array:
@@ -139,7 +139,7 @@ def _sample_nearest(img: jax.Array, src_y, src_x, dims) -> jax.Array:
     h, w = dims[..., 0], dims[..., 1]
     yy = jnp.clip(jnp.round(src_y).astype(jnp.int32), 0, h - 1)
     xx = jnp.clip(jnp.round(src_x).astype(jnp.int32), 0, w - 1)
-    return img[yy[:, None], xx[None, :]]
+    return img[yy, :][:, xx]  # two cheap 1D gathers, not one 2D gather
 
 
 def render_gray(
