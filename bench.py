@@ -83,7 +83,7 @@ _SENTINEL = "@@BENCH_RESULT@@"
 _STAGE_BOUND = {
     "normalize_clip": "memory (VPU elementwise, HBM-limited)",
     "median7": "compute (VPU Batcher-merge network, column presort)",
-    "sharpen": "memory (9-tap separable conv, HBM-limited)",
+    "sharpen": "memory (9-tap shifted-add sweeps, HBM-limited)",
     "region_grow": "iteration (sequential one-ring fixpoint sweeps)",
     "region_grow_jump": "iteration (O(log) pointer-jumping schedule)",
     "cast_dilate": "memory (VPU reduce-window, HBM-limited)",
@@ -474,9 +474,12 @@ def main() -> None:
     # dial (or hang on) the accelerator tunnel
     cpu = None
     if accel is None or accel["backend"] != "cpu":
+        # when the accelerator record is lost, let the fallback at least
+        # carry the per-stage breakdown so the round's JSON stays diagnosable
+        extra = ["--stages"] if accel is None else []
         cpu = _run_measurement(
             "cpu baseline",
-            ["--platform", "cpu", "--reps", str(CPU_REPS)],
+            ["--platform", "cpu", "--reps", str(CPU_REPS), *extra],
             {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": None},
             CPU_TIMEOUT_S,
         )
@@ -518,6 +521,8 @@ def main() -> None:
         out["value"] = round(cpu["xla_tput"], 2)
         out["backend"] = "cpu"
         out["vs_baseline"] = 1.0
+        if "stages" in cpu:
+            out["stages"] = cpu["stages"]
         out["error"] = "accelerator worker failed; cpu fallback measured"
     else:
         out["backend"] = "none"

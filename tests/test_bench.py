@@ -111,17 +111,24 @@ class TestOrchestrator:
         assert out["winning_path"] == "xla"
 
     def test_accel_lost_falls_back_to_cpu_record(self, monkeypatch, capsys):
-        out, _ = self._run_main(
+        out, calls = self._run_main(
             monkeypatch,
             capsys,
             accel=None,
-            cpu={"backend": "cpu", "xla_tput": 9.0, "checksum": 7},
+            cpu={
+                "backend": "cpu",
+                "xla_tput": 9.0,
+                "checksum": 7,
+                "stages": {"median7": {"ms_per_batch": 1.0}},
+            },
             probe_ok=False,
         )
         assert out["backend"] == "cpu"
         assert out["value"] == 9.0
         assert out["vs_baseline"] == 1.0
         assert "error" in out
+        # the fallback record carries the stage breakdown for diagnosability
+        assert out["stages"] == {"median7": {"ms_per_batch": 1.0}}
 
     def test_everything_lost_still_emits_json(self, monkeypatch, capsys):
         out, _ = self._run_main(monkeypatch, capsys, accel=None, cpu=None,
