@@ -367,8 +367,13 @@ def _spawn(label, extra_args, env_overrides, timeout_s):
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=timeout_s, env=env
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
         _log(f"{label}: timed out after {timeout_s}s")
+        partial = e.stderr or b""
+        if partial:
+            if isinstance(partial, bytes):
+                partial = partial.decode(errors="replace")
+            _log(f"{label}: stderr before kill: {partial[-800:]}")
         return None, ""
     for line in proc.stderr.splitlines():
         print(line, file=sys.stderr, flush=True)

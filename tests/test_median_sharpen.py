@@ -69,23 +69,54 @@ class TestNetworkMedian:
 
     def test_batcher_networks_sort_correctly(self, rng):
         # 0-1 principle: a comparator network sorts all inputs iff it sorts
-        # all 0-1 inputs; exhaustive for the small vertical-sort widths
-        import itertools
-
+        # all 0-1 inputs; exhaustive for the small vertical-sort widths.
+        # Vectorized: lane c of every value array holds 0-1 case c, so one
+        # network pass checks all 2^n cases at once.
         from nm03_capstone_project_tpu.ops.median import (
             _apply_pairs,
             _oddeven_sort_pairs,
         )
-        import jax.numpy as jnp
 
         for n in (2, 4, 8, 16):
             pairs = []
             _oddeven_sort_pairs(0, n, pairs)
-            for bits in itertools.product((0.0, 1.0), repeat=n):
-                vals = [jnp.float32(b) for b in bits]
-                _apply_pairs(vals, pairs)
-                out = [float(v) for v in vals]
-                assert out == sorted(bits), f"n={n} bits={bits}"
+            cases = ((np.arange(2**n)[None, :] >> np.arange(n)[:, None]) & 1)
+            vals = [cases[i].astype(np.float32) for i in range(n)]
+            _apply_pairs(vals, pairs)
+            out = np.stack(vals)
+            want = np.sort(cases.astype(np.float32), axis=0)
+            np.testing.assert_array_equal(out, want, err_msg=f"sort n={n}")
+
+    def test_batcher_merge_networks_exhaustive(self):
+        # every merge width the median's run-merge trees can emit — 4/8 for
+        # the small kernels (k=3: p_run=4, total=16), up to 64 for k=7/9 —
+        # over all (n/2+1)^2 sorted-0-1-half combinations: the exhaustive
+        # 0-1 check specialised to merging, one vectorized pass per width
+        from nm03_capstone_project_tpu.ops.median import (
+            _apply_pairs,
+            _oddeven_merge_pairs,
+        )
+
+        for total in (4, 8, 16, 32, 64):
+            half = total // 2
+            pairs = []
+            _oddeven_merge_pairs(0, total, 1, pairs)
+            # case (i, j) = sorted half with i ones || sorted half with j ones
+            ones_a = np.arange(half + 1)[:, None]
+            ones_b = np.arange(half + 1)[None, :]
+            shape2d = (half + 1, half + 1)
+            cases = []
+            for pos in range(total):
+                if pos < half:
+                    lane = np.broadcast_to(pos >= (half - ones_a), shape2d)
+                else:
+                    lane = np.broadcast_to((pos - half) >= (half - ones_b), shape2d)
+                cases.append(lane.astype(np.float32).ravel())
+            vals = list(cases)
+            _apply_pairs(vals, pairs)
+            out = np.stack(vals)
+            want = np.sort(np.stack(cases), axis=0)
+            np.testing.assert_array_equal(out, want, err_msg=f"merge n={total}")
 
 
 def test_vector_median_scalar_channel_agrees(rng):
