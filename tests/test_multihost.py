@@ -1,0 +1,144 @@
+"""Multi-host distributed backend, exercised with REAL separate processes.
+
+The reference is strictly single-process shared memory + OpenMP (SURVEY.md
+section 2.3). This framework's distributed backend is ``jax.distributed``
+over XLA collectives; these tests validate it the way a pod would use it —
+two OS processes, each owning 4 virtual CPU devices, joined through a
+coordinator into one 8-device job — rather than only asserting the
+single-process no-op. Each worker runs the framework's own entry points
+(``distributed.initialize`` with explicit args, ``distributed.global_mesh``,
+``process_batch_sharded``) and the parent asserts both workers saw the
+global device set and produced the single-device-identical result.
+"""
+
+import socket
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+_REPO = Path(__file__).parents[1]
+
+_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    from nm03_capstone_project_tpu.parallel import distributed
+    joined = distributed.initialize(
+        coordinator_address=f"127.0.0.1:{{port}}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    assert joined, "explicit multi-process initialize must join"
+    info = distributed.process_info()
+    assert info["process_count"] == nproc, info
+    assert info["global_devices"] == 4 * nproc, info
+
+    import numpy as np
+    import jax.numpy as jnp
+    from nm03_capstone_project_tpu.config import PipelineConfig
+    from nm03_capstone_project_tpu.data.synthetic import phantom_slice
+    from nm03_capstone_project_tpu.parallel import process_batch_sharded
+    from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_batch
+
+    cfg = PipelineConfig(grow_block_iters=8, grow_max_iters=256)
+    b = info["global_devices"]
+    pixels = np.stack(
+        [phantom_slice(64, 64, seed=i, lesion_radius=0.14) for i in range(b)]
+    ).astype(np.float32)
+    dims = np.full((b, 2), 64, np.int32)
+
+    mesh = distributed.global_mesh(("data",))
+    assert mesh.size == 4 * nproc
+    out = process_batch_sharded(jnp.asarray(pixels), jnp.asarray(dims), cfg, mesh)
+    # allgather the full global mask (shards live on BOTH processes) and
+    # require voxel-exact equality with the local unsharded reference — a
+    # popcount-preserving sharding bug must not pass
+    from jax.experimental import multihost_utils
+
+    got = np.asarray(multihost_utils.process_allgather(out["mask"], tiled=True))
+    want = np.asarray(process_batch(pixels, dims, cfg)["mask"])
+    assert got.shape == want.shape and (got == want).all()
+    total = int(got.sum())
+    assert total > 0
+    print(f"MHOK {{pid}} {{total}}", flush=True)
+
+    # z-sharded volume across BOTH processes: the ppermute halo exchange and
+    # psum convergence cross the process boundary (the DCN-riding pattern)
+    from nm03_capstone_project_tpu.data.synthetic import phantom_volume
+    from nm03_capstone_project_tpu.parallel import process_volume_zsharded
+    from nm03_capstone_project_tpu.pipeline.volume_pipeline import process_volume
+
+    meshz = distributed.global_mesh(("z",))
+    vol = phantom_volume(n_slices=2 * mesh.size, height=64, width=64, seed=0)
+    vdims = jnp.asarray([64, 64], jnp.int32)
+    vout = process_volume_zsharded(jnp.asarray(vol), vdims, cfg, meshz)
+    zgot = np.asarray(multihost_utils.process_allgather(vout["mask"], tiled=True))
+    zwant = np.asarray(process_volume(jnp.asarray(vol), vdims, cfg)["mask"])
+    assert zgot.shape == zwant.shape and (zgot == zwant).all()
+    ztotal = int(zgot.sum())
+    assert ztotal > 0
+    print(f"ZSOK {{pid}} {{ztotal}}", flush=True)
+    """
+).format(repo=str(_REPO))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestMultiProcess:
+    def test_two_process_job_runs_sharded_pipeline(self, tmp_path):
+        script = tmp_path / "mh_worker.py"
+        script.write_text(_WORKER)
+        port = _free_port()
+        nproc = 2
+        # output to FILES, not pipes: pipe backpressure between two workers
+        # blocked in a collective would deadlock a sequential communicate()
+        logs = [
+            (open(tmp_path / f"w{pid}.out", "w+"), open(tmp_path / f"w{pid}.err", "w+"))
+            for pid in range(nproc)
+        ]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(script), str(pid), str(nproc), str(port)],
+                stdout=logs[pid][0],
+                stderr=logs[pid][1],
+                text=True,
+            )
+            for pid in range(nproc)
+        ]
+        outs = []
+        try:
+            for pid, p in enumerate(procs):
+                rc = p.wait(timeout=300)
+                err = (tmp_path / f"w{pid}.err").read_text()
+                assert rc == 0, f"worker {pid} failed:\n{err[-2000:]}"
+                outs.append((tmp_path / f"w{pid}.out").read_text())
+        finally:
+            for p in procs:  # a failed/odd sibling must not outlive the test
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+            for fo, fe in logs:
+                fo.close()
+                fe.close()
+        for marker in ("MHOK", "ZSOK"):
+            sums = set()
+            for pid, out in enumerate(outs):
+                lines = [l for l in out.splitlines() if l.startswith(marker)]
+                assert lines, f"worker {pid} missing {marker} line: {out!r}"
+                _, got_pid, total = lines[0].split()
+                assert int(got_pid) == pid
+                sums.add(int(total))
+            # both processes converged on the same correct, nonzero result
+            assert len(sums) == 1 and sums.pop() > 0, marker
