@@ -63,9 +63,9 @@ import time
 
 BATCH = 32
 CANVAS = 256
-TPU_REPS = 10
+TPU_REPS = 40
 CPU_REPS = 2
-STAGE_REPS = 8
+STAGE_REPS = 48
 
 PROBE_TIMEOUT_S = 90
 PROBE_ATTEMPTS = 6

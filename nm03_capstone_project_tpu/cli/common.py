@@ -129,6 +129,24 @@ def pipeline_config_from_args(args: argparse.Namespace) -> PipelineConfig:
     )
 
 
+def add_render_stage_arg(parser: argparse.ArgumentParser) -> None:
+    """--render-stage, for the drivers that export JPEG pairs (seq/parallel).
+
+    Deliberately NOT in add_common_args: the volume/train drivers don't go
+    through the pair-export path, and an advertised-but-ignored flag is worse
+    than an absent one.
+    """
+    parser.add_argument(
+        "--render-stage",
+        choices=["host", "device"],
+        default=BatchConfig.render_stage,
+        help="where the 512x512 export renders are computed: 'host' fetches "
+        "only the mask from the device and renders in the IO pool (default; "
+        "~24x less host<->device traffic per slice), 'device' renders inside "
+        "the jit (the canonical render.render_pair path)",
+    )
+
+
 def add_batch_args(parser: argparse.ArgumentParser) -> None:
     d = BatchConfig()
     parser.add_argument(
@@ -158,6 +176,31 @@ def apply_device_env(device: str) -> None:
     if device == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+
+def enable_compile_cache() -> None:
+    """Point jax at a persistent compilation cache (CPU backend only by default).
+
+    The fused pipeline costs seconds to compile — most of a small cohort's
+    device time for a cold CLI invocation; the cache makes repeat runs (and
+    the reference-style sequential-vs-parallel comparison, which compiles the
+    same program twice) warm-start. Auto-enabled only when the backend is
+    pinned to cpu: asking the tunneled remote-TPU backend to serialize
+    executables for the cache wedged it (observed: first jit compile never
+    returned and the hung claim blocked the chip). NM03_COMPILE_CACHE=<dir>
+    forces it on anyway; =0 disables everywhere.
+    """
+    cache = os.environ.get("NM03_COMPILE_CACHE", "")
+    if cache == "0":
+        return
+    if not cache:
+        if os.environ.get("JAX_PLATFORMS") != "cpu":
+            return
+        cache = str(Path(__file__).resolve().parents[2] / ".xla_cache")
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 def resolve_base_path(args: argparse.Namespace, tmp_root: Path | None = None) -> Path:

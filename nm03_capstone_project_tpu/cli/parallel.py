@@ -25,6 +25,7 @@ def build_parser() -> argparse.ArgumentParser:
     common.add_common_args(p)
     common.add_pipeline_args(p)
     common.add_batch_args(p)
+    common.add_render_stage_arg(p)
     return p
 
 

@@ -22,6 +22,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output", default="out-sequential", help="output root directory")
     common.add_common_args(p)
     common.add_pipeline_args(p)
+    common.add_render_stage_arg(p)
     return p
 
 
@@ -42,12 +43,14 @@ def run(args: argparse.Namespace, mode: str) -> int:
 
     configure_reporting(verbose=args.verbose)
     common.apply_native_flag(args)
+    common.enable_compile_cache()
     cfg = common.pipeline_config_from_args(args)
     batch_cfg = BatchConfig(
         batch_size=getattr(args, "batch_size", BatchConfig.batch_size),
         io_workers=getattr(args, "io_workers", BatchConfig.io_workers),
         prefetch_depth=getattr(args, "prefetch_depth", BatchConfig.prefetch_depth),
         use_native=not getattr(args, "no_native", False),
+        render_stage=getattr(args, "render_stage", BatchConfig.render_stage),
     )
     from nm03_capstone_project_tpu.utils.profiling import profile_trace
 
@@ -61,8 +64,12 @@ def run(args: argparse.Namespace, mode: str) -> int:
             mode=mode,
             resume=args.resume,
         )
+        import time
+
+        t0 = time.perf_counter()
         with profile_trace(getattr(args, "profile_dir", None)):
             summary = proc.process_all_patients()
+        wall_s = time.perf_counter() - t0
         if args.results_json:
             import jax
 
@@ -72,6 +79,10 @@ def run(args: argparse.Namespace, mode: str) -> int:
                     "mode": mode,
                     "backend": jax.devices()[0].platform,  # provenance
                     "summary": summary.as_dict(),
+                    # wall_s is the number to compare across drivers/modes:
+                    # in the parallel driver device compute overlaps the
+                    # export wait, so per-section times don't partition it
+                    "wall_s": round(wall_s, 3),
                     "timing_s": proc.timer.report(),
                 },
             )

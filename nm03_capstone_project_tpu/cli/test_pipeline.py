@@ -25,9 +25,48 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--device", choices=["auto", "tpu", "cpu"], default="auto", help="compute backend"
     )
+    p.add_argument(
+        "--show",
+        action="store_true",
+        help="display the 5 stage panes in a blocking window (the reference's "
+        "MultiViewWindow::run(), test_pipeline.cpp:148-158); requires a display",
+    )
     p.add_argument("--verbose", action="store_true")
     common.add_pipeline_args(p)
     return p
+
+
+def show_panel(exports: dict) -> bool:
+    """Blocking 5-pane viewer mirroring MultiViewWindow (test_pipeline.cpp:148-158).
+
+    One matplotlib window, 5 panes side by side on a black background (the
+    reference's 2300x450 layout, Color::Black()); ``run()``-style blocking
+    until the user closes it. Returns False (with a warning) when no GUI
+    backend is usable, so headless runs degrade to the exported panel JPEG.
+    """
+    import os
+
+    try:
+        if not (os.environ.get("DISPLAY") or os.environ.get("WAYLAND_DISPLAY")):
+            raise RuntimeError("no display available")
+        import matplotlib.pyplot as plt
+
+        fig, axes = plt.subplots(
+            1, len(exports), figsize=(23, 4.5), facecolor="black"
+        )
+        for ax, (name, img) in zip(axes, exports.items()):
+            ax.imshow(img, cmap="gray" if img.ndim == 2 else None)
+            ax.set_title(name, color="white", fontsize=9)
+            ax.set_facecolor("black")
+            ax.axis("off")
+        fig.tight_layout()
+        plt.show()  # blocking, like multiWindow->run()
+        plt.close(fig)
+        return True
+    except Exception as e:  # noqa: BLE001 — headless/backend failure
+        print(f"--show unavailable ({e!r}); see the exported pipeline_panel.jpg",
+              file=sys.stderr)
+        return False
 
 
 def main(argv=None) -> int:
@@ -89,6 +128,7 @@ def run(args: argparse.Namespace) -> int:
     from nm03_capstone_project_tpu.utils.reporter import configure_reporting
 
     configure_reporting(verbose=args.verbose)
+    common.enable_compile_cache()
     common.apply_native_flag(args)
     cfg = common.pipeline_config_from_args(args)
 
@@ -121,6 +161,9 @@ def run(args: argparse.Namespace) -> int:
     sheet = contact_sheet(list(exports.values()), labels=list(exports))
     save_jpeg(sheet, f"{args.output}/pipeline_panel.jpg")
     print(f"exported {args.output}/pipeline_panel.jpg")
+
+    if args.show:
+        show_panel(exports)
     return 0
 
 

@@ -185,6 +185,7 @@ def run(args: argparse.Namespace) -> int:
     from nm03_capstone_project_tpu.utils.profiling import profile_trace
 
     configure_reporting(verbose=args.verbose)
+    common.enable_compile_cache()
     cfg = common.pipeline_config_from_args(args)
     if cfg.canvas % 4:
         raise SystemExit("--canvas must be divisible by 4 (two U-Net poolings)")

@@ -145,6 +145,7 @@ def run(args: argparse.Namespace) -> int:
     from nm03_capstone_project_tpu.utils.timing import Timer, write_results_json
 
     configure_reporting(verbose=args.verbose)
+    common.enable_compile_cache()
     common.apply_native_flag(args)
     cfg = common.pipeline_config_from_args(args)
     base = common.resolve_base_path(args, tmp_root=Path(args.output))

@@ -144,6 +144,18 @@ class BatchConfig:
     prefetch_depth: int = 2  # host->device double buffering
     io_workers: int = 8  # DICOM decode thread pool
     use_native: bool = True  # C++ batch decoder (csrc/) when buildable
+    # 'host': device returns only the mask (65 KB/slice) and the 512x512
+    # export renders are computed host-side in the IO pool — the default,
+    # since shipping two rendered canvases (~1.5 MB/slice) back through the
+    # host<->device link dominated cohort wall-clock on the tunneled chip.
+    # 'device': render inside the jit (render.render_pair), the v1 behavior.
+    render_stage: str = "host"
+
+    def __post_init__(self):
+        if self.render_stage not in ("host", "device"):
+            raise ValueError(
+                f"render_stage must be 'host' or 'device', got {self.render_stage!r}"
+            )
 
 
 DEFAULT_CONFIG = PipelineConfig()

@@ -46,36 +46,66 @@ def save_jpeg(image: np.ndarray, path: str | os.PathLike, quality: int = 90) -> 
     Image.fromarray(arr, mode="L").save(path, quality=quality)
 
 
-def export_pairs(
-    items: Sequence[Tuple[str, np.ndarray, np.ndarray]],
-    out_dir: str | os.PathLike,
-    max_workers: int = 8,
-) -> List[str]:
-    """Write (stem, original, processed) triples as JPEG pairs concurrently.
+def _write_pair(out: Path, stem: str, orig: np.ndarray, proc: np.ndarray) -> str:
+    save_jpeg(orig, out / f"{stem}_original.jpg")
+    save_jpeg(proc, out / f"{stem}_processed.jpg")
+    return stem
 
-    Returns the stems successfully written; encoding failures are contained
-    per slice (the reference's catch-and-continue at the export stage,
-    main_sequential.cpp:267-271).
+
+def _export_many(write_one, items: Sequence, out_dir, max_workers: int) -> List[str]:
+    """Concurrent per-slice export with containment; the shared scaffold.
+
+    ``write_one(item) -> stem`` runs per slice on a thread pool; failures are
+    contained and logged per slice (the reference's catch-and-continue at the
+    export stage, main_sequential.cpp:267-271). Returns sorted stems written.
     """
-    out = Path(out_dir)
-    out.mkdir(parents=True, exist_ok=True)
+    Path(out_dir).mkdir(parents=True, exist_ok=True)
     done: List[str] = []
-
-    def write_one(stem: str, orig: np.ndarray, proc: np.ndarray) -> Optional[str]:
-        save_jpeg(orig, out / f"{stem}_original.jpg")
-        save_jpeg(proc, out / f"{stem}_processed.jpg")
-        return stem
-
     with cf.ThreadPoolExecutor(max_workers=max_workers) as pool:
-        futures = {
-            pool.submit(write_one, stem, o, p): stem for stem, o, p in items
-        }
+        futures = {pool.submit(write_one, item): item[0] for item in items}
         for fut in cf.as_completed(futures):
             try:
                 done.append(fut.result())
             except Exception as e:  # noqa: BLE001 - per-slice containment
                 _log.warning("export failed for %s: %s", futures[fut], e)
     return sorted(done)
+
+
+def export_pairs(
+    items: Sequence[Tuple[str, np.ndarray, np.ndarray]],
+    out_dir: str | os.PathLike,
+    max_workers: int = 8,
+) -> List[str]:
+    """Write (stem, original, processed) triples as JPEG pairs concurrently."""
+    out = Path(out_dir)
+    return _export_many(
+        lambda it: _write_pair(out, it[0], it[1], it[2]), items, out, max_workers
+    )
+
+
+def render_export_pairs(
+    items: Sequence[Tuple[str, np.ndarray, np.ndarray, np.ndarray]],
+    out_dir: str | os.PathLike,
+    cfg,
+    max_workers: int = 8,
+) -> List[str]:
+    """Render host-side, then write the JPEG pair, per (stem, pixels, mask, dims).
+
+    The batch drivers' default export path: only the 65 KB mask crossed back
+    from the device (see render.host_render); the 512x512 renders are computed
+    here, in the same thread pool that JPEG-encodes them, overlapped with the
+    next batch's device compute.
+    """
+    from nm03_capstone_project_tpu.render.host_render import host_render_pair
+
+    out = Path(out_dir)
+
+    def write_one(item):
+        stem, pixels, mask, dims = item
+        gray, seg = host_render_pair(pixels, mask, dims, cfg)
+        return _write_pair(out, stem, gray, seg)
+
+    return _export_many(write_one, items, out, max_workers)
 
 
 def clean_directory(path: str | os.PathLike) -> None:

@@ -1,0 +1,122 @@
+"""Host renderer vs device renderer agreement, and the dual-mode drivers.
+
+The batch drivers default to host-side export rendering (render.host_render)
+so only the mask crosses the host<->device link; these tests pin that the
+host path reproduces the canonical device renderer (render.render) — exactly
+for the nearest-sampled segmentation render, and to within one 8-bit count
+for the bilinear grayscale render (XLA may contract the lerp into FMAs) —
+and that both driver modes produce complete, mutually consistent exports.
+"""
+
+import numpy as np
+import pytest
+
+from nm03_capstone_project_tpu.cli.runner import CohortProcessor
+from nm03_capstone_project_tpu.config import BatchConfig, PipelineConfig
+from nm03_capstone_project_tpu.data.synthetic import phantom_slice, write_synthetic_cohort
+from nm03_capstone_project_tpu.render.host_render import (
+    host_render_gray,
+    host_render_pair,
+    host_render_segmentation,
+)
+from nm03_capstone_project_tpu.render.render import (
+    render_gray,
+    render_pair,
+    render_segmentation,
+)
+
+CFG = PipelineConfig(canvas=128, render_size=128)
+
+
+def _slice_on_canvas(h, w, canvas=128, seed=3):
+    px = phantom_slice(h, w, seed=seed, lesion_radius=0.18)
+    padded = np.zeros((canvas, canvas), np.float32)
+    padded[:h, :w] = px
+    dims = np.asarray([h, w], np.int32)
+    mask = np.zeros((canvas, canvas), np.uint8)
+    mask[h // 3 : h // 2, w // 3 : w // 2] = 1
+    return padded, mask, dims
+
+
+@pytest.mark.parametrize("hw", [(128, 128), (100, 73), (64, 128), (101, 101)])
+def test_host_matches_device_segmentation_exactly(hw):
+    padded, mask, dims = _slice_on_canvas(*hw)
+    dev = np.asarray(render_segmentation(mask, dims, 128, 0.6, 1.0, 2))
+    host = host_render_segmentation(mask, dims, 128, 0.6, 1.0, 2)
+    np.testing.assert_array_equal(dev, host)
+
+
+@pytest.mark.parametrize("hw", [(128, 128), (100, 73), (64, 128), (101, 101)])
+def test_host_matches_device_gray_within_one_count(hw):
+    padded, _, dims = _slice_on_canvas(*hw)
+    dev = np.asarray(render_gray(padded, dims, 128)).astype(np.int16)
+    host = host_render_gray(padded, dims, 128).astype(np.int16)
+    diff = np.abs(dev - host)
+    assert diff.max() <= 1
+    # rounding disagreements are isolated interpolated pixels, not drift
+    assert (diff > 0).mean() < 0.01
+
+
+def test_host_pair_matches_device_pair():
+    padded, mask, dims = _slice_on_canvas(100, 73)
+    dg, ds = (np.asarray(a) for a in render_pair(padded, mask, dims, CFG))
+    hg, hs = host_render_pair(padded, mask, dims, CFG)
+    np.testing.assert_array_equal(ds, hs)
+    assert np.abs(dg.astype(np.int16) - hg.astype(np.int16)).max() <= 1
+
+
+def test_render_stage_validated():
+    with pytest.raises(ValueError, match="render_stage"):
+        BatchConfig(render_stage="gpu")
+
+
+class TestDriverModes:
+    @pytest.fixture(scope="class")
+    def cohort(self, tmp_path_factory):
+        root = tmp_path_factory.mktemp("hr_cohort")
+        write_synthetic_cohort(root, n_patients=1, n_slices=4, height=128, width=120)
+        return root
+
+    def test_both_render_stages_export_full_cohort(self, cohort, tmp_path):
+        results = {}
+        for stage in ("host", "device"):
+            out = tmp_path / stage
+            proc = CohortProcessor(
+                cohort,
+                out,
+                cfg=CFG,
+                batch_cfg=BatchConfig(batch_size=3, io_workers=2, render_stage=stage),
+                mode="parallel",
+            )
+            summary = proc.process_all_patients()
+            assert summary.succeeded_slices == 4, stage
+            jpgs = sorted(p.name for p in out.rglob("*.jpg"))
+            assert len(jpgs) == 8, stage
+            results[stage] = jpgs
+        assert results["host"] == results["device"]  # same file set
+
+    def test_sequential_equals_parallel_on_host_path(self, cohort, tmp_path):
+        import hashlib
+
+        def digest(root):
+            h = hashlib.sha256()
+            for p in sorted(root.rglob("*.jpg")):
+                h.update(p.name.encode())
+                h.update(p.read_bytes())
+            return h.hexdigest()
+
+        outs = {}
+        for mode in ("sequential", "parallel"):
+            out = tmp_path / mode
+            proc = CohortProcessor(
+                cohort,
+                out,
+                cfg=CFG,
+                batch_cfg=BatchConfig(
+                    batch_size=3, io_workers=2, render_stage="host"
+                ),
+                mode=mode,
+            )
+            proc.process_all_patients()
+            outs[mode] = digest(out)
+        assert outs["sequential"] == outs["parallel"]

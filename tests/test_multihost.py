@@ -96,42 +96,71 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _run_job(script, tmp_path, nproc, port, attempt):
+    """Spawn the nproc workers; (rcs, outs, errs) once all exit or time out."""
+    # output to FILES, not pipes: pipe backpressure between two workers
+    # blocked in a collective would deadlock a sequential communicate()
+    logs = [
+        (
+            open(tmp_path / f"a{attempt}_w{pid}.out", "w+"),
+            open(tmp_path / f"a{attempt}_w{pid}.err", "w+"),
+        )
+        for pid in range(nproc)
+    ]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid), str(nproc), str(port)],
+            stdout=logs[pid][0],
+            stderr=logs[pid][1],
+            text=True,
+        )
+        for pid in range(nproc)
+    ]
+    rcs, outs, errs = [], [], []
+    try:
+        for pid, p in enumerate(procs):
+            try:
+                rcs.append(p.wait(timeout=300))
+            except subprocess.TimeoutExpired:
+                rcs.append(None)
+            outs.append((tmp_path / f"a{attempt}_w{pid}.out").read_text())
+            errs.append((tmp_path / f"a{attempt}_w{pid}.err").read_text())
+    finally:
+        for p in procs:  # a failed/odd sibling must not outlive the test
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        for fo, fe in logs:
+            fo.close()
+            fe.close()
+    return rcs, outs, errs
+
+
 class TestMultiProcess:
     def test_two_process_job_runs_sharded_pipeline(self, tmp_path):
         script = tmp_path / "mh_worker.py"
         script.write_text(_WORKER)
-        port = _free_port()
         nproc = 2
-        # output to FILES, not pipes: pipe backpressure between two workers
-        # blocked in a collective would deadlock a sequential communicate()
-        logs = [
-            (open(tmp_path / f"w{pid}.out", "w+"), open(tmp_path / f"w{pid}.err", "w+"))
-            for pid in range(nproc)
-        ]
-        procs = [
-            subprocess.Popen(
-                [sys.executable, str(script), str(pid), str(nproc), str(port)],
-                stdout=logs[pid][0],
-                stderr=logs[pid][1],
-                text=True,
+        # _free_port closes the socket before the coordinator binds it, so a
+        # concurrent process can steal the port in between; a bind failure is
+        # detected on worker 0 and retried with a fresh port instead of
+        # flaking the test
+        for attempt in range(3):
+            port = _free_port()
+            rcs, outs, errs = _run_job(script, tmp_path, nproc, port, attempt)
+            err0 = errs[0].lower()
+            bind_lost = rcs[0] not in (0, None) and (
+                "address already in use" in err0
+                or "failed to bind" in err0
+                or "bind failed" in err0
             )
-            for pid in range(nproc)
-        ]
-        outs = []
-        try:
-            for pid, p in enumerate(procs):
-                rc = p.wait(timeout=300)
-                err = (tmp_path / f"w{pid}.err").read_text()
-                assert rc == 0, f"worker {pid} failed:\n{err[-2000:]}"
-                outs.append((tmp_path / f"w{pid}.out").read_text())
-        finally:
-            for p in procs:  # a failed/odd sibling must not outlive the test
-                if p.poll() is None:
-                    p.kill()
-                    p.wait()
-            for fo, fe in logs:
-                fo.close()
-                fe.close()
+            if bind_lost and attempt < 2:
+                continue
+            for pid in range(nproc):
+                assert rcs[pid] == 0, (
+                    f"worker {pid} rc={rcs[pid]}:\n{errs[pid][-2000:]}"
+                )
+            break
         for marker in ("MHOK", "ZSOK"):
             sums = set()
             for pid, out in enumerate(outs):
