@@ -47,7 +47,11 @@ def show_panel(exports: dict) -> bool:
     import os
 
     try:
-        if not (os.environ.get("DISPLAY") or os.environ.get("WAYLAND_DISPLAY")):
+        # only Linux signals a display via these vars; macOS/Windows GUI
+        # backends work without them — there, let matplotlib try
+        if sys.platform.startswith("linux") and not (
+            os.environ.get("DISPLAY") or os.environ.get("WAYLAND_DISPLAY")
+        ):
             raise RuntimeError("no display available")
         import matplotlib.pyplot as plt
 

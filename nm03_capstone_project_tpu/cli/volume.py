@@ -41,6 +41,7 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="also write each patient's 3D mask as MetaImage (<patient>/mask.mhd)",
     )
+    common.add_render_stage_arg(p)
     return p
 
 
@@ -111,6 +112,17 @@ def _compiled_volume_fn(cfg):
         return out["mask"], gray, seg
 
     return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=4)
+def _compiled_volume_mask_fn(cfg):
+    """Mask-only volume pipeline: the host-render path fetches 65 KB/plane
+    instead of two rendered canvases (~1.5 MB/plane) through the link."""
+    import jax
+
+    from nm03_capstone_project_tpu.pipeline.volume_pipeline import process_volume
+
+    return jax.jit(lambda vol, dims: process_volume(vol, dims, cfg)["mask"])
 
 
 @functools.lru_cache(maxsize=4)
@@ -197,7 +209,11 @@ def run(args: argparse.Namespace) -> int:
                     # record load-time rejects so --resume can account for them
                     manifest.record(pid, stem, STATUS_FAILED)
                 depth = vol.shape[0]
+                host_render = (
+                    getattr(args, "render_stage", "host") == "host"
+                )
                 with timer.section(f"compute/{pid}"):
+                    gray = seg = None
                     if zshard:
                         from nm03_capstone_project_tpu.parallel import (
                             process_volume_zsharded,
@@ -215,23 +231,43 @@ def run(args: argparse.Namespace) -> int:
                         )
                         vol = vol[:depth]
                         maskj = out["mask"][:depth]
-                        grayj, segj = _compiled_render_fn(cfg)(
-                            jnp.asarray(vol), maskj, jnp.asarray(dims)
+                        if not host_render:
+                            grayj, segj = _compiled_render_fn(cfg)(
+                                jnp.asarray(vol), maskj, jnp.asarray(dims)
+                            )
+                    elif host_render:
+                        maskj = _compiled_volume_mask_fn(cfg)(
+                            jnp.asarray(vol), jnp.asarray(dims)
                         )
                     else:
                         maskj, grayj, segj = _compiled_volume_fn(cfg)(
                             jnp.asarray(vol), jnp.asarray(dims)
                         )
                     mask = np.asarray(maskj)
-                    gray = np.asarray(grayj)
-                    seg = np.asarray(segj)
+                    if not host_render:
+                        gray = np.asarray(grayj)
+                        seg = np.asarray(segj)
                 with timer.section(f"export/{pid}"):
                     if not args.resume:
                         clean_directory(out_root / pid)
-                    done = export_pairs(
-                        [(stems[i], gray[i], seg[i]) for i in range(depth)],
-                        out_root / pid,
-                    )
+                    if host_render:
+                        from nm03_capstone_project_tpu.render.export import (
+                            render_export_pairs,
+                        )
+
+                        done = render_export_pairs(
+                            [
+                                (stems[i], vol[i], mask[i], dims)
+                                for i in range(depth)
+                            ],
+                            out_root / pid,
+                            cfg,
+                        )
+                    else:
+                        done = export_pairs(
+                            [(stems[i], gray[i], seg[i]) for i in range(depth)],
+                            out_root / pid,
+                        )
                     for stem in done:
                         manifest.record(pid, stem, STATUS_DONE)
                     manifest.flush()
