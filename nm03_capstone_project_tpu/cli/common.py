@@ -130,11 +130,12 @@ def pipeline_config_from_args(args: argparse.Namespace) -> PipelineConfig:
 
 
 def add_render_stage_arg(parser: argparse.ArgumentParser) -> None:
-    """--render-stage, for the drivers that export JPEG pairs (seq/parallel).
+    """--render-stage, for the drivers that export JPEG pairs
+    (sequential / parallel / volume).
 
-    Deliberately NOT in add_common_args: the volume/train drivers don't go
-    through the pair-export path, and an advertised-but-ignored flag is worse
-    than an absent one.
+    Deliberately NOT in add_common_args: the train driver doesn't go through
+    the pair-export path, and an advertised-but-ignored flag is worse than an
+    absent one — any driver adding this flag must honor it.
     """
     parser.add_argument(
         "--render-stage",

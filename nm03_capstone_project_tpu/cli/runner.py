@@ -298,12 +298,44 @@ class CohortProcessor:
     def _run_parallel(
         self, patient_id: str, out_dir: Path, files: List[Path]
     ) -> Tuple[int, List[str]]:
+        import jax
+
         host_render = self.batch_cfg.render_stage == "host"
-        fn = (
-            _compiled_batch_mask_fn(self.cfg)
-            if host_render
-            else _compiled_batch_fn(self.cfg)
-        )
+        # Every visible device joins a ('data',) mesh and the batch axis is
+        # sharded across it — the pod-scale form of the reference's OpenMP
+        # batch loop (SURVEY.md section 2.3 DP row). One device degenerates
+        # to the plain vmapped program.
+        n_dev = len(jax.devices())
+        mesh = None
+        if n_dev > 1:
+            from nm03_capstone_project_tpu.parallel import make_mesh
+
+            mesh = make_mesh(n_dev, axis_names=("data",))
+
+        if mesh is not None:
+            from nm03_capstone_project_tpu.parallel.dp import process_batch_sharded
+
+            if host_render:
+
+                def fn(px, dm):
+                    return process_batch_sharded(
+                        px, dm, self.cfg, mesh, mask_only=True
+                    )["mask"]
+
+            else:
+
+                def fn(px, dm):
+                    out = process_batch_sharded(
+                        px, dm, self.cfg, mesh, with_render=True
+                    )
+                    return out["original"], out["mask"]
+
+        else:
+            fn = (
+                _compiled_batch_mask_fn(self.cfg)
+                if host_render
+                else _compiled_batch_fn(self.cfg)
+            )
         bs = self.batch_cfg.batch_size
         ok, failed = 0, []
         batches = [files[i : i + bs] for i in range(0, len(files), bs)]
@@ -314,7 +346,15 @@ class CohortProcessor:
             # A cohort of 8-slice patients under the reference's bs=25 would
             # otherwise compute 3x dead lanes; buckets keep recompiles
             # bounded (at most bs/8 shapes) while never padding past 7 lanes.
-            return min(bs, ((n + 7) // 8) * 8)
+            # A mesh additionally needs the batch to divide its data axis
+            # (only there may the cap round past bs; the single-device cap
+            # stays exactly bs so full batches carry zero dead lanes).
+            if mesh is None:
+                return min(bs, ((n + 7) // 8) * 8)
+            import math
+
+            m = math.lcm(8, n_dev)
+            return min(((n + m - 1) // m) * m, ((max(bs, m) + m - 1) // m) * m)
         export_futures = []
         expected_stems: List[str] = []
         use_native = self.batch_cfg.use_native and _native_available()
@@ -368,16 +408,23 @@ class CohortProcessor:
                         "dims": dims,
                     }
 
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                batch_sharding = NamedSharding(mesh, PartitionSpec("data"))
+            else:
+                batch_sharding = None
+
             def to_device(item):
                 # move only the compute inputs; the host copy of the pixel
-                # stack stays behind for the host-render export path
-                import jax
-
+                # stack stays behind for the host-render export path. With a
+                # mesh the host->device copy is already batch-sharded, so
+                # each device receives only its shard.
                 if item.get("pixels") is None:
                     return item
                 out = dict(item)
-                out["pixels"] = jax.device_put(out["pixels"])
-                out["dims"] = jax.device_put(out["dims"])
+                out["pixels"] = jax.device_put(out["pixels"], batch_sharding)
+                out["dims"] = jax.device_put(out["dims"], batch_sharding)
                 return out
 
             def with_host_refs(gen):

@@ -26,10 +26,26 @@ from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
 
 
 @functools.lru_cache(maxsize=8)
-def _compiled_sharded_batch(mesh: Mesh, cfg: PipelineConfig, with_render: bool):
+def _compiled_sharded_batch(
+    mesh: Mesh, cfg: PipelineConfig, with_render: bool, mask_only: bool = False
+):
     """jit of the vmapped pipeline with batch-axis in/out shardings."""
     shard3 = NamedSharding(mesh, P("data", None, None))
     shard2 = NamedSharding(mesh, P("data", None))
+
+    if mask_only:
+        # the host-render drivers fetch nothing but the mask: don't emit the
+        # original-canvas passthrough as a program output, and donate the
+        # input stack's HBM (the host keeps its own copy for rendering)
+        def mask_fn(pixels, dims):
+            return process_slice(pixels, dims, cfg)["mask"]
+
+        return jax.jit(
+            jax.vmap(mask_fn),
+            in_shardings=(shard3, shard2),
+            out_shardings=shard3,
+            donate_argnums=(0,),
+        )
 
     if with_render:
         from nm03_capstone_project_tpu.render.render import (
@@ -68,6 +84,7 @@ def process_batch_sharded(
     cfg: PipelineConfig = DEFAULT_CONFIG,
     mesh: Optional[Mesh] = None,
     with_render: bool = False,
+    mask_only: bool = False,
 ) -> Dict[str, jax.Array]:
     """Run a (B, H, W) slice batch data-parallel across the mesh.
 
@@ -80,9 +97,16 @@ def process_batch_sharded(
       mesh: a mesh with a ``data`` axis (default: all devices).
       with_render: additionally produce the 512x512 rendered pair on-device
         (the reference's export stage, main_sequential.cpp:254-265).
+      mask_only: return {'mask'} only, with the pixel stack DONATED — the
+        host-render export path; mutually exclusive with ``with_render``.
     """
+    if mask_only and with_render:
+        raise ValueError("mask_only and with_render are mutually exclusive")
     if mesh is None:
         from nm03_capstone_project_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh()
-    return _compiled_sharded_batch(mesh, cfg, with_render)(pixels, dims)
+    compiled = _compiled_sharded_batch(mesh, cfg, with_render, mask_only)
+    if mask_only:
+        return {"mask": compiled(pixels, dims)}
+    return compiled(pixels, dims)
