@@ -337,6 +337,14 @@ class CohortProcessor:
                 else _compiled_batch_fn(self.cfg)
             )
         bs = self.batch_cfg.batch_size
+        if mesh is not None:
+            # slice batches at a mesh-aligned size: full batches then pad to
+            # exactly themselves (zero dead lanes), and every batch divides
+            # the data axis
+            import math
+
+            m = math.lcm(8, n_dev)
+            bs = max(m, (bs // m) * m)
         ok, failed = 0, []
         batches = [files[i : i + bs] for i in range(0, len(files), bs)]
 
@@ -346,15 +354,11 @@ class CohortProcessor:
             # A cohort of 8-slice patients under the reference's bs=25 would
             # otherwise compute 3x dead lanes; buckets keep recompiles
             # bounded (at most bs/8 shapes) while never padding past 7 lanes.
-            # A mesh additionally needs the batch to divide its data axis
-            # (only there may the cap round past bs; the single-device cap
-            # stays exactly bs so full batches carry zero dead lanes).
-            if mesh is None:
-                return min(bs, ((n + 7) // 8) * 8)
-            import math
-
-            m = math.lcm(8, n_dev)
-            return min(((n + m - 1) // m) * m, ((max(bs, m) + m - 1) // m) * m)
+            # With a mesh the bucket is lcm(8, n_dev), so every padded batch
+            # divides the data axis; the cap at bs stays correct in both
+            # cases because mesh-mode bs is itself a multiple of the bucket.
+            bucket = 8 if mesh is None else math.lcm(8, n_dev)
+            return min(bs, ((n + bucket - 1) // bucket) * bucket)
         export_futures = []
         expected_stems: List[str] = []
         use_native = self.batch_cfg.use_native and _native_available()

@@ -239,12 +239,36 @@ def run(args: argparse.Namespace) -> int:
     apply_fn = apply_unet3d if args.model_3d else None  # None = 2D default
     losses = []
     if not args.eval_only:
-        print(f"training {args.steps} steps at lr={args.lr}...")
+        n_dev = len(jax.devices())
         with profile_trace(args.profile_dir):
-            params, losses = fit(
-                params, x, labels, dm, steps=args.steps, lr=args.lr,
-                compute_dtype=dtype, apply_fn=apply_fn,
-            )
+            if n_dev > 1 and not args.model_3d:
+                # dp x tp over every visible device: batch on 'data',
+                # parameters split on output channels over 'model' (the
+                # sharded step the multi-chip dryrun validates). The 3D
+                # student stays single-device for now.
+                from nm03_capstone_project_tpu.models import fit_sharded
+                from nm03_capstone_project_tpu.parallel import make_mesh
+
+                tp = 2 if n_dev % 2 == 0 else 1
+                mesh = make_mesh(
+                    n_dev,
+                    axis_names=("data", "model"),
+                    axis_sizes=(n_dev // tp, tp),
+                )
+                print(
+                    f"training {args.steps} steps at lr={args.lr} on "
+                    f"{n_dev} devices (dp={n_dev // tp} x tp={tp})..."
+                )
+                params, losses = fit_sharded(
+                    params, x, labels, dm, mesh,
+                    steps=args.steps, lr=args.lr, compute_dtype=dtype,
+                )
+            else:
+                print(f"training {args.steps} steps at lr={args.lr}...")
+                params, losses = fit(
+                    params, x, labels, dm, steps=args.steps, lr=args.lr,
+                    compute_dtype=dtype, apply_fn=apply_fn,
+                )
         if losses:
             print(f"loss {losses[0]:.4f} -> {losses[-1]:.4f}")
 

@@ -13,6 +13,7 @@ from nm03_capstone_project_tpu.models.checkpoint import (  # noqa: F401
 from nm03_capstone_project_tpu.models.train import (  # noqa: F401
     distill_batch,
     fit,
+    fit_sharded,
     make_optimizer,
     make_sharded_train_step,
     prepare_student_inputs,

@@ -213,3 +213,45 @@ def fit(
         )
         losses.append(float(loss))
     return params, losses
+
+
+def fit_sharded(
+    params: Params,
+    pixels,
+    labels,
+    dims,
+    mesh,
+    steps: int = 50,
+    lr: float = 1e-3,
+    compute_dtype=jnp.float32,
+):
+    """Multi-device dp x tp training loop (2D student).
+
+    Same contract as :func:`fit` but the batch shards over the mesh's
+    ``data`` axis and parameters split over ``model``
+    (:func:`make_sharded_train_step`). The batch is padded to a multiple of
+    the data-axis size by WRAPPING real slices — repeats only reweight the
+    mean loss slightly, where degenerate filler slices would add spurious
+    dice terms (segmentation_loss averages dice over batch rows). Returns
+    host-resident params so checkpointing is layout-independent.
+    """
+    import numpy as np
+
+    dp = mesh.shape["data"]
+    b = pixels.shape[0]
+    if b % dp:
+        idx = np.arange(((b + dp - 1) // dp) * dp) % b
+        pixels = jnp.asarray(np.asarray(pixels)[idx])
+        labels = jnp.asarray(np.asarray(labels)[idx])
+        dims = jnp.asarray(np.asarray(dims)[idx])
+    tx = make_optimizer(lr, total_steps=steps)
+    step_fn, place_params = make_sharded_train_step(
+        mesh, params, tx, compute_dtype=compute_dtype
+    )
+    params = place_params(params)
+    opt_state = tx.init(params)
+    losses = []
+    for _ in range(steps):
+        params, opt_state, loss = step_fn(params, opt_state, pixels, labels, dims)
+        losses.append(float(loss))
+    return jax.device_get(params), losses
