@@ -210,12 +210,20 @@ def resolve_base_path(args: argparse.Namespace, tmp_root: Path | None = None) ->
         from nm03_capstone_project_tpu.data.synthetic import write_synthetic_cohort
 
         # key the directory by its parameters so changing --synthetic /
-        # --synthetic-slices regenerates instead of reusing a stale cohort
-        name = f"synthetic-cohort-{args.synthetic}x{args.synthetic_slices}"
+        # --synthetic-slices / --canvas regenerates instead of reusing a
+        # stale cohort. Slices are sized to fit the canvas: the generator's
+        # 256px default under a smaller --canvas would fail the size guard
+        # for every slice, a silently empty run.
+        size = min(256, int(getattr(args, "canvas", 256)))
+        name = f"synthetic-cohort-{args.synthetic}x{args.synthetic_slices}-{size}"
         root = (tmp_root or Path(args.output)) / name
         if not (root.exists() and any(root.iterdir())):
             write_synthetic_cohort(
-                root, n_patients=args.synthetic, n_slices=args.synthetic_slices
+                root,
+                n_patients=args.synthetic,
+                n_slices=args.synthetic_slices,
+                height=size,
+                width=size,
             )
         return root
     if args.base_path:

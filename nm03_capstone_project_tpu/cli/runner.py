@@ -191,19 +191,57 @@ class CohortProcessor:
         batch_cfg: BatchConfig = BatchConfig(),
         mode: str = "sequential",
         resume: bool = False,
+        process_rank: int = 0,
+        process_count: int = 1,
     ):
         if mode not in ("sequential", "parallel"):
             raise ValueError(f"unknown mode: {mode}")
+        if not 0 <= process_rank < process_count:
+            raise ValueError(
+                f"process_rank {process_rank} outside [0, {process_count})"
+            )
         self.base_path = Path(base_path)
         self.out_root = Path(out_root)
         self.cfg = cfg
         self.batch_cfg = batch_cfg
         self.mode = mode
         self.resume = resume
+        # multi-process job: this process owns patients[rank::count] and its
+        # own manifest file (shared out_root assumed to be a shared fs)
+        self.process_rank = process_rank
+        self.process_count = process_count
         self.timer = Timer()
         self.out_root.mkdir(parents=True, exist_ok=True)
+        manifest_name = (
+            "manifest.json"
+            if process_count == 1
+            else f"manifest.rank{process_rank}.json"
+        )
+        if resume:
+            # manifests are keyed by rank, and the round-robin shard depends
+            # on the process count — resuming under a different topology
+            # reassigns patients to ranks whose manifests never saw them, so
+            # done work is silently redone. Warn; correctness is unaffected.
+            prior_ranks = len(list(self.out_root.glob("manifest.rank*.json")))
+            prior_single = (self.out_root / "manifest.json").exists()
+            if process_count > 1 and (prior_single or prior_ranks not in (0, process_count)):
+                log.warning(
+                    "resuming with %d processes but prior manifests suggest a "
+                    "different topology (%s) — patients may be reprocessed",
+                    process_count,
+                    f"{prior_ranks} rank manifests" if prior_ranks else "single-process run",
+                )
+            elif process_count == 1 and prior_ranks:
+                log.warning(
+                    "resuming single-process over a %d-rank output tree — "
+                    "prior rank manifests are ignored and patients will be "
+                    "reprocessed",
+                    prior_ranks,
+                )
         self.manifest = (
-            Manifest.load_or_create(self.out_root) if resume else Manifest(self.out_root)
+            Manifest.load_or_create(self.out_root, manifest_name)
+            if resume
+            else Manifest(self.out_root, manifest_name)
         )
 
     # -- data loading ------------------------------------------------------
@@ -301,16 +339,19 @@ class CohortProcessor:
         import jax
 
         host_render = self.batch_cfg.render_stage == "host"
-        # Every visible device joins a ('data',) mesh and the batch axis is
+        # Every LOCAL device joins a ('data',) mesh and the batch axis is
         # sharded across it — the pod-scale form of the reference's OpenMP
         # batch loop (SURVEY.md section 2.3 DP row). One device degenerates
-        # to the plain vmapped program.
-        n_dev = len(jax.devices())
+        # to the plain vmapped program. Local, not global: in a multi-process
+        # job each rank owns disjoint patients, so its programs touch only
+        # its own chips and nothing rides DCN except the final summary.
+        local = jax.local_devices()
+        n_dev = len(local)
         mesh = None
         if n_dev > 1:
             from nm03_capstone_project_tpu.parallel import make_mesh
 
-            mesh = make_mesh(n_dev, axis_names=("data",))
+            mesh = make_mesh(axis_names=("data",), devices=local)
 
         if mesh is not None:
             from nm03_capstone_project_tpu.parallel.dp import process_batch_sharded
@@ -570,6 +611,14 @@ class CohortProcessor:
         print(f"\n=== Starting {mode_name} Processing for All Patients ===\n")
         patients = find_patient_dirs(self.base_path)
         print(f"Found {len(patients)} patient directories.")
+        if self.process_count > 1:
+            # deterministic round-robin shard: discovery sorts patients, so
+            # every rank computes the same split with no communication
+            patients = patients[self.process_rank :: self.process_count]
+            print(
+                f"process {self.process_rank}/{self.process_count}: "
+                f"{len(patients)} patients assigned"
+            )
         summary = RunSummary()
         if not patients:
             print("No patient directories found. Exiting.")

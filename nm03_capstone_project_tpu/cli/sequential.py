@@ -55,7 +55,45 @@ def run(args: argparse.Namespace, mode: str) -> int:
     from nm03_capstone_project_tpu.utils.profiling import profile_trace
 
     try:
-        base = common.resolve_base_path(args, tmp_root=Path(args.output))
+        rank, world = 0, 1
+        if getattr(args, "distributed", False):
+            from nm03_capstone_project_tpu.parallel import distributed
+
+            distributed.initialize(
+                coordinator_address=getattr(args, "coordinator_address", None),
+                num_processes=getattr(args, "num_processes", None),
+                process_id=getattr(args, "process_id", None),
+            )
+            info = distributed.process_info()
+            rank, world = info["process_index"], info["process_count"]
+            want = getattr(args, "num_processes", None)
+            if want and want > 1 and world == 1:
+                # an explicitly requested multi-process job that joined
+                # nothing must not silently have every worker process the
+                # whole cohort into the same tree
+                raise RuntimeError(
+                    f"--distributed --num-processes {want} requested but this "
+                    "process joined no cluster (world=1); check the "
+                    "coordinator address / process ids"
+                )
+            if world == 1:
+                print(
+                    "--distributed: no cluster detected; running single-process",
+                    file=sys.stderr,
+                )
+
+        if world > 1 and args.synthetic > 0:
+            # only rank 0 generates the shared synthetic cohort; a barrier
+            # keeps other ranks from listing a half-written tree
+            from jax.experimental import multihost_utils
+
+            if rank == 0:
+                base = common.resolve_base_path(args, tmp_root=Path(args.output))
+            multihost_utils.sync_global_devices("nm03 synthetic cohort ready")
+            if rank != 0:
+                base = common.resolve_base_path(args, tmp_root=Path(args.output))
+        else:
+            base = common.resolve_base_path(args, tmp_root=Path(args.output))
         proc = CohortProcessor(
             base,
             args.output,
@@ -63,6 +101,8 @@ def run(args: argparse.Namespace, mode: str) -> int:
             batch_cfg=batch_cfg,
             mode=mode,
             resume=args.resume,
+            process_rank=rank,
+            process_count=world,
         )
         import time
 
@@ -70,22 +110,71 @@ def run(args: argparse.Namespace, mode: str) -> int:
         with profile_trace(getattr(args, "profile_dir", None)):
             summary = proc.process_all_patients()
         wall_s = time.perf_counter() - t0
-        if args.results_json:
+
+        cluster = None
+        if world > 1:
+            # the one DCN crossing of the whole run: allgather each rank's
+            # success counters so rank 0 can report the cohort-wide totals
+            # (the reference's end-of-run accounting, main_parallel.cpp:349).
+            # If a rank died before reaching this collective the others block
+            # here until the coordinator's missed-heartbeat handling fails
+            # the job — the standard SPMD failure mode, preferred over
+            # skipping the aggregate and reporting partial totals as global.
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            counts = np.asarray(
+                [
+                    summary.patients_ok,
+                    len(summary.patients),
+                    summary.succeeded_slices,
+                    summary.total_slices,
+                ],
+                np.int32,
+            )
+            gathered = np.asarray(
+                multihost_utils.process_allgather(counts)
+            ).reshape(world, 4)
+            cluster = {
+                "patients_ok": int(gathered[:, 0].sum()),
+                "patients_total": int(gathered[:, 1].sum()),
+                "slices_ok": int(gathered[:, 2].sum()),
+                "slices_total": int(gathered[:, 3].sum()),
+                "per_process": {
+                    str(r): {
+                        "patients_ok": int(gathered[r, 0]),
+                        "patients_total": int(gathered[r, 1]),
+                        "slices_ok": int(gathered[r, 2]),
+                        "slices_total": int(gathered[r, 3]),
+                    }
+                    for r in range(world)
+                },
+            }
+            if rank == 0:
+                print(
+                    f"\nCluster totals: {cluster['patients_ok']}/"
+                    f"{cluster['patients_total']} patients, "
+                    f"{cluster['slices_ok']}/{cluster['slices_total']} slices "
+                    f"across {world} processes."
+                )
+
+        if args.results_json and rank == 0:
             import jax
 
-            write_results_json(
-                args.results_json,
-                {
-                    "mode": mode,
-                    "backend": jax.devices()[0].platform,  # provenance
-                    "summary": summary.as_dict(),
-                    # wall_s is the number to compare across drivers/modes:
-                    # in the parallel driver device compute overlaps the
-                    # export wait, so per-section times don't partition it
-                    "wall_s": round(wall_s, 3),
-                    "timing_s": proc.timer.report(),
-                },
-            )
+            record = {
+                "mode": mode,
+                "backend": jax.devices()[0].platform,  # provenance
+                "summary": summary.as_dict(),
+                # wall_s is the number to compare across drivers/modes:
+                # in the parallel driver device compute overlaps the
+                # export wait, so per-section times don't partition it
+                "wall_s": round(wall_s, 3),
+                "timing_s": proc.timer.report(),
+            }
+            if cluster is not None:
+                record["cluster"] = cluster  # rank 0's summary/timing above
+                record["process_count"] = world
+            write_results_json(args.results_json, record)
         return 0
     except Exception as e:  # noqa: BLE001 - reference: fatal-error catch in main
         print(f"Fatal error: {e}", file=sys.stderr)

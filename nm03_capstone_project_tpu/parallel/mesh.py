@@ -29,16 +29,19 @@ def make_mesh(
     n_devices: Optional[int] = None,
     axis_names: Sequence[str] = ("data",),
     axis_sizes: Optional[Sequence[int]] = None,
+    devices: Optional[Sequence] = None,
 ) -> Mesh:
-    """Build a mesh over the first ``n_devices`` devices.
+    """Build a mesh over the first ``n_devices`` of ``devices``.
 
     Args:
-      n_devices: number of devices to use (default: all available).
+      n_devices: number of devices to use (default: all in ``devices``).
       axis_names: mesh axis names, e.g. ("data",) or ("data", "z").
       axis_sizes: sizes per axis; must multiply to n_devices. Defaults to all
         devices on the first axis.
+      devices: the device pool (default ``jax.devices()``; pass
+        ``jax.local_devices()`` for a per-process mesh in a multi-host job).
     """
-    devices = jax.devices()
+    devices = list(jax.devices() if devices is None else devices)
     n = len(devices) if n_devices is None else n_devices
     if n > len(devices):
         raise ValueError(f"requested {n} devices, only {len(devices)} available")

@@ -1,0 +1,107 @@
+"""Multi-process cohort processing through the REAL parallel driver.
+
+Extends tests/test_multihost.py's pattern to the flagship CLI path: two OS
+processes (4 virtual CPU devices each) join one jax.distributed job, split a
+shared synthetic cohort round-robin, process their patients on their local
+device meshes, and allgather the summary over the (simulated) DCN. Asserts
+the partition is disjoint+complete, every JPEG pair exists, and rank 0's
+results JSON carries the cluster-wide totals.
+"""
+
+import json
+import textwrap
+from pathlib import Path
+
+from tests.test_multihost import run_job_with_port_retry
+
+_REPO = Path(__file__).parents[1]
+
+_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    cohort, outdir = sys.argv[4], sys.argv[5]
+
+    from nm03_capstone_project_tpu.cli import parallel
+
+    if cohort == "@synthetic":
+        cohort_args = ["--synthetic", "3", "--synthetic-slices", "4"]
+    else:
+        cohort_args = ["--base-path", cohort]
+    rc = parallel.main([
+        *cohort_args,
+        "--output", outdir,
+        "--results-json", os.path.join(outdir, "results.json"),
+        "--distributed",
+        "--coordinator-address", f"127.0.0.1:{{port}}",
+        "--num-processes", str(nproc),
+        "--process-id", str(pid),
+        "--canvas", "128", "--render-size", "128",
+    ])
+    assert rc == 0, f"driver rc={{rc}}"
+    print(f"DCOK {{pid}}", flush=True)
+    """
+).format(repo=str(_REPO))
+
+
+class TestDistributedCohort:
+    def test_two_process_cohort_partitions_and_aggregates(self, tmp_path):
+        from nm03_capstone_project_tpu.data.synthetic import write_synthetic_cohort
+
+        cohort = tmp_path / "cohort"
+        write_synthetic_cohort(
+            cohort, n_patients=3, n_slices=4, height=128, width=120
+        )
+        outdir = tmp_path / "out"
+        script = tmp_path / "dc_worker.py"
+        script.write_text(_WORKER)
+        nproc = 2
+        outs = run_job_with_port_retry(
+            script, tmp_path, nproc, extra_args=[str(cohort), str(outdir)]
+        )
+        for pid in range(nproc):
+            assert f"DCOK {pid}" in outs[pid]
+
+        # every patient exported by exactly one process; all pairs present
+        patients = sorted(p.name for p in outdir.iterdir() if p.name.startswith("PGBM"))
+        assert len(patients) == 3
+        for p in patients:
+            jpgs = sorted((outdir / p).glob("*.jpg"))
+            assert len(jpgs) == 8, (p, jpgs)
+
+        # rank manifests are disjoint and together cover the cohort
+        m0 = json.loads((outdir / "manifest.rank0.json").read_text())
+        m1 = json.loads((outdir / "manifest.rank1.json").read_text())
+        assert set(m0) & set(m1) == set()
+        assert sorted(set(m0) | set(m1)) == patients
+
+        # rank 0 wrote the aggregated record
+        rec = json.loads((outdir / "results.json").read_text())
+        assert rec["process_count"] == 2
+        assert rec["cluster"]["patients_ok"] == 3
+        assert rec["cluster"]["slices_ok"] == 12
+        # per-process split is 2 + 1 patients
+        per = rec["cluster"]["per_process"]
+        assert sorted(v["patients_total"] for v in per.values()) == [1, 2]
+
+    def test_synthetic_cohort_generated_once_behind_barrier(self, tmp_path):
+        # rank 0 generates the shared synthetic cohort; rank 1 must wait at
+        # the barrier instead of listing a half-written tree
+        outdir = tmp_path / "out"
+        script = tmp_path / "dc_worker.py"
+        script.write_text(_WORKER)
+        outs = run_job_with_port_retry(
+            script, tmp_path, 2, extra_args=["@synthetic", str(outdir)]
+        )
+        for pid in range(2):
+            assert f"DCOK {pid}" in outs[pid]
+        rec = json.loads((outdir / "results.json").read_text())
+        assert rec["cluster"]["patients_ok"] == 3
+        assert rec["cluster"]["slices_ok"] == 12

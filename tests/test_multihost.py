@@ -96,7 +96,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_job(script, tmp_path, nproc, port, attempt):
+def _run_job(script, tmp_path, nproc, port, attempt, extra_args=()):
     """Spawn the nproc workers; (rcs, outs, errs) once all exit or time out."""
     # output to FILES, not pipes: pipe backpressure between two workers
     # blocked in a collective would deadlock a sequential communicate()
@@ -109,7 +109,7 @@ def _run_job(script, tmp_path, nproc, port, attempt):
     ]
     procs = [
         subprocess.Popen(
-            [sys.executable, str(script), str(pid), str(nproc), str(port)],
+            [sys.executable, str(script), str(pid), str(nproc), str(port), *extra_args],
             stdout=logs[pid][0],
             stderr=logs[pid][1],
             text=True,
@@ -136,31 +136,40 @@ def _run_job(script, tmp_path, nproc, port, attempt):
     return rcs, outs, errs
 
 
+def run_job_with_port_retry(script, tmp_path, nproc, extra_args=(), attempts=3):
+    """Run an nproc job, retrying with a fresh port on coordinator bind loss.
+
+    _free_port closes the socket before the coordinator binds it, so a
+    concurrent process can steal the port in between; a bind failure detected
+    on worker 0 is retried instead of flaking the test. Asserts all workers
+    exit 0 and returns their stdouts.
+    """
+    outs = []
+    for attempt in range(attempts):
+        port = _free_port()
+        rcs, outs, errs = _run_job(
+            script, tmp_path, nproc, port, attempt, extra_args=extra_args
+        )
+        err0 = errs[0].lower()
+        bind_lost = rcs[0] not in (0, None) and (
+            "address already in use" in err0
+            or "failed to bind" in err0
+            or "bind failed" in err0
+        )
+        if bind_lost and attempt < attempts - 1:
+            continue
+        for pid in range(nproc):
+            assert rcs[pid] == 0, f"worker {pid} rc={rcs[pid]}:\n{errs[pid][-2000:]}"
+        break
+    return outs
+
+
 class TestMultiProcess:
     def test_two_process_job_runs_sharded_pipeline(self, tmp_path):
         script = tmp_path / "mh_worker.py"
         script.write_text(_WORKER)
         nproc = 2
-        # _free_port closes the socket before the coordinator binds it, so a
-        # concurrent process can steal the port in between; a bind failure is
-        # detected on worker 0 and retried with a fresh port instead of
-        # flaking the test
-        for attempt in range(3):
-            port = _free_port()
-            rcs, outs, errs = _run_job(script, tmp_path, nproc, port, attempt)
-            err0 = errs[0].lower()
-            bind_lost = rcs[0] not in (0, None) and (
-                "address already in use" in err0
-                or "failed to bind" in err0
-                or "bind failed" in err0
-            )
-            if bind_lost and attempt < 2:
-                continue
-            for pid in range(nproc):
-                assert rcs[pid] == 0, (
-                    f"worker {pid} rc={rcs[pid]}:\n{errs[pid][-2000:]}"
-                )
-            break
+        outs = run_job_with_port_retry(script, tmp_path, nproc)
         for marker in ("MHOK", "ZSOK"):
             sums = set()
             for pid, out in enumerate(outs):
