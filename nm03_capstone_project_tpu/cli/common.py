@@ -161,6 +161,131 @@ def add_batch_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--prefetch-depth", type=int, default=d.prefetch_depth)
 
 
+def add_distributed_args(parser: argparse.ArgumentParser, extra_help: str = "") -> None:
+    """The multi-host job flags (drivers that support --distributed)."""
+    d = parser.add_argument_group(
+        "distributed",
+        "multi-host cohort processing: one process per host. " + extra_help,
+    )
+    d.add_argument(
+        "--distributed",
+        action="store_true",
+        help="join a jax.distributed job (autodetects the coordinator on TPU "
+        "pods/SLURM/GKE; pass the explicit flags elsewhere)",
+    )
+    d.add_argument("--coordinator-address", default=None, metavar="HOST:PORT")
+    d.add_argument("--num-processes", type=int, default=None)
+    d.add_argument("--process-id", type=int, default=None)
+
+
+def init_distributed(args: argparse.Namespace) -> tuple[int, int]:
+    """Join the cluster per the --distributed flags; (rank, world).
+
+    An explicitly requested multi-process job that joined nothing is a hard
+    error — every worker silently processing the whole cohort into the same
+    tree is the worst failure mode a cluster launcher can hand back.
+    """
+    if not getattr(args, "distributed", False):
+        return 0, 1
+    import sys
+
+    from nm03_capstone_project_tpu.parallel import distributed
+
+    distributed.initialize(
+        coordinator_address=getattr(args, "coordinator_address", None),
+        num_processes=getattr(args, "num_processes", None),
+        process_id=getattr(args, "process_id", None),
+    )
+    info = distributed.process_info()
+    rank, world = info["process_index"], info["process_count"]
+    want = getattr(args, "num_processes", None)
+    if want and want > 1 and world == 1:
+        raise RuntimeError(
+            f"--distributed --num-processes {want} requested but this process "
+            "joined no cluster (world=1); check the coordinator address / "
+            "process ids"
+        )
+    if world == 1:
+        print(
+            "--distributed: no cluster detected; running single-process",
+            file=sys.stderr,
+        )
+    return rank, world
+
+
+def resolve_base_path_sync(
+    args: argparse.Namespace, rank: int, world: int, tmp_root: Path | None = None
+) -> Path:
+    """resolve_base_path, with rank 0 generating any synthetic cohort behind
+    a barrier so other ranks never list a half-written tree."""
+    if world > 1 and args.synthetic > 0:
+        from jax.experimental import multihost_utils
+
+        base = None
+        if rank == 0:
+            base = resolve_base_path(args, tmp_root=tmp_root)
+        multihost_utils.sync_global_devices("nm03 synthetic cohort ready")
+        if rank != 0:
+            base = resolve_base_path(args, tmp_root=tmp_root)
+        return base
+    return resolve_base_path(args, tmp_root=tmp_root)
+
+
+def shard_patients(patients: list, rank: int, world: int) -> list:
+    """Deterministic round-robin patient shard (discovery sorts the list, so
+    every rank computes the same split with no communication)."""
+    if world <= 1:
+        return patients
+    mine = patients[rank::world]
+    print(f"process {rank}/{world}: {len(mine)} patients assigned")
+    return mine
+
+
+def allgather_cluster_counts(counts: "dict[str, int]", world: int) -> dict:
+    """Allgather each rank's counters; cluster totals + per-process rows.
+
+    The one DCN crossing of a patient-sharded multi-host run (the
+    reference's end-of-run accounting, main_parallel.cpp:349). All ranks
+    must call this (it is a collective).
+    """
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    keys = sorted(counts)
+    local = np.asarray([counts[k] for k in keys], np.int32)
+    gathered = np.asarray(multihost_utils.process_allgather(local)).reshape(
+        world, len(keys)
+    )
+    out = {k: int(gathered[:, i].sum()) for i, k in enumerate(keys)}
+    out["per_process"] = {
+        str(r): {k: int(gathered[r, i]) for i, k in enumerate(keys)}
+        for r in range(world)
+    }
+    return out
+
+
+def warn_resume_topology(out_root: Path, process_count: int, warn) -> None:
+    """Warn when --resume runs under a different process count than the
+    manifests on disk: the round-robin shard reassigns patients to ranks
+    whose manifests never saw them, so done work is redone (correctness is
+    unaffected)."""
+    prior_ranks = len(list(Path(out_root).glob("manifest.rank*.json")))
+    prior_single = (Path(out_root) / "manifest.json").exists()
+    if process_count > 1 and (prior_single or prior_ranks not in (0, process_count)):
+        warn(
+            "resuming with %d processes but prior manifests suggest a "
+            "different topology (%s) — patients may be reprocessed",
+            process_count,
+            f"{prior_ranks} rank manifests" if prior_ranks else "single-process run",
+        )
+    elif process_count == 1 and prior_ranks:
+        warn(
+            "resuming single-process over a %d-rank output tree — prior rank "
+            "manifests are ignored and patients will be reprocessed",
+            prior_ranks,
+        )
+
+
 def apply_native_flag(args: argparse.Namespace) -> None:
     """--no-native disables the whole C++ layer (decode AND JPEG encode)."""
     if getattr(args, "no_native", False):

@@ -26,21 +26,11 @@ def build_parser() -> argparse.ArgumentParser:
     common.add_pipeline_args(p)
     common.add_batch_args(p)
     common.add_render_stage_arg(p)
-    d = p.add_argument_group(
-        "distributed",
-        "multi-host cohort processing: one process per host, patients "
-        "round-robin sharded across processes, each on its local devices; "
-        "only the final summary crosses hosts",
+    common.add_distributed_args(
+        p,
+        "Patients are round-robin sharded across processes, each on its "
+        "local devices; only the final summary crosses hosts.",
     )
-    d.add_argument(
-        "--distributed",
-        action="store_true",
-        help="join a jax.distributed job (autodetects the coordinator on TPU "
-        "pods/SLURM/GKE; pass the explicit flags elsewhere)",
-    )
-    d.add_argument("--coordinator-address", default=None, metavar="HOST:PORT")
-    d.add_argument("--num-processes", type=int, default=None)
-    d.add_argument("--process-id", type=int, default=None)
     return p
 
 

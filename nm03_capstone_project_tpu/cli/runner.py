@@ -218,26 +218,9 @@ class CohortProcessor:
             else f"manifest.rank{process_rank}.json"
         )
         if resume:
-            # manifests are keyed by rank, and the round-robin shard depends
-            # on the process count — resuming under a different topology
-            # reassigns patients to ranks whose manifests never saw them, so
-            # done work is silently redone. Warn; correctness is unaffected.
-            prior_ranks = len(list(self.out_root.glob("manifest.rank*.json")))
-            prior_single = (self.out_root / "manifest.json").exists()
-            if process_count > 1 and (prior_single or prior_ranks not in (0, process_count)):
-                log.warning(
-                    "resuming with %d processes but prior manifests suggest a "
-                    "different topology (%s) — patients may be reprocessed",
-                    process_count,
-                    f"{prior_ranks} rank manifests" if prior_ranks else "single-process run",
-                )
-            elif process_count == 1 and prior_ranks:
-                log.warning(
-                    "resuming single-process over a %d-rank output tree — "
-                    "prior rank manifests are ignored and patients will be "
-                    "reprocessed",
-                    prior_ranks,
-                )
+            from nm03_capstone_project_tpu.cli.common import warn_resume_topology
+
+            warn_resume_topology(self.out_root, process_count, log.warning)
         self.manifest = (
             Manifest.load_or_create(self.out_root, manifest_name)
             if resume
@@ -611,14 +594,9 @@ class CohortProcessor:
         print(f"\n=== Starting {mode_name} Processing for All Patients ===\n")
         patients = find_patient_dirs(self.base_path)
         print(f"Found {len(patients)} patient directories.")
-        if self.process_count > 1:
-            # deterministic round-robin shard: discovery sorts patients, so
-            # every rank computes the same split with no communication
-            patients = patients[self.process_rank :: self.process_count]
-            print(
-                f"process {self.process_rank}/{self.process_count}: "
-                f"{len(patients)} patients assigned"
-            )
+        from nm03_capstone_project_tpu.cli.common import shard_patients
+
+        patients = shard_patients(patients, self.process_rank, self.process_count)
         summary = RunSummary()
         if not patients:
             print("No patient directories found. Exiting.")

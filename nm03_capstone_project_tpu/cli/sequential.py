@@ -55,45 +55,10 @@ def run(args: argparse.Namespace, mode: str) -> int:
     from nm03_capstone_project_tpu.utils.profiling import profile_trace
 
     try:
-        rank, world = 0, 1
-        if getattr(args, "distributed", False):
-            from nm03_capstone_project_tpu.parallel import distributed
-
-            distributed.initialize(
-                coordinator_address=getattr(args, "coordinator_address", None),
-                num_processes=getattr(args, "num_processes", None),
-                process_id=getattr(args, "process_id", None),
-            )
-            info = distributed.process_info()
-            rank, world = info["process_index"], info["process_count"]
-            want = getattr(args, "num_processes", None)
-            if want and want > 1 and world == 1:
-                # an explicitly requested multi-process job that joined
-                # nothing must not silently have every worker process the
-                # whole cohort into the same tree
-                raise RuntimeError(
-                    f"--distributed --num-processes {want} requested but this "
-                    "process joined no cluster (world=1); check the "
-                    "coordinator address / process ids"
-                )
-            if world == 1:
-                print(
-                    "--distributed: no cluster detected; running single-process",
-                    file=sys.stderr,
-                )
-
-        if world > 1 and args.synthetic > 0:
-            # only rank 0 generates the shared synthetic cohort; a barrier
-            # keeps other ranks from listing a half-written tree
-            from jax.experimental import multihost_utils
-
-            if rank == 0:
-                base = common.resolve_base_path(args, tmp_root=Path(args.output))
-            multihost_utils.sync_global_devices("nm03 synthetic cohort ready")
-            if rank != 0:
-                base = common.resolve_base_path(args, tmp_root=Path(args.output))
-        else:
-            base = common.resolve_base_path(args, tmp_root=Path(args.output))
+        rank, world = common.init_distributed(args)
+        base = common.resolve_base_path_sync(
+            args, rank, world, tmp_root=Path(args.output)
+        )
         proc = CohortProcessor(
             base,
             args.output,
@@ -113,43 +78,19 @@ def run(args: argparse.Namespace, mode: str) -> int:
 
         cluster = None
         if world > 1:
-            # the one DCN crossing of the whole run: allgather each rank's
-            # success counters so rank 0 can report the cohort-wide totals
-            # (the reference's end-of-run accounting, main_parallel.cpp:349).
-            # If a rank died before reaching this collective the others block
-            # here until the coordinator's missed-heartbeat handling fails
-            # the job — the standard SPMD failure mode, preferred over
-            # skipping the aggregate and reporting partial totals as global.
-            import numpy as np
-            from jax.experimental import multihost_utils
-
-            counts = np.asarray(
-                [
-                    summary.patients_ok,
-                    len(summary.patients),
-                    summary.succeeded_slices,
-                    summary.total_slices,
-                ],
-                np.int32,
-            )
-            gathered = np.asarray(
-                multihost_utils.process_allgather(counts)
-            ).reshape(world, 4)
-            cluster = {
-                "patients_ok": int(gathered[:, 0].sum()),
-                "patients_total": int(gathered[:, 1].sum()),
-                "slices_ok": int(gathered[:, 2].sum()),
-                "slices_total": int(gathered[:, 3].sum()),
-                "per_process": {
-                    str(r): {
-                        "patients_ok": int(gathered[r, 0]),
-                        "patients_total": int(gathered[r, 1]),
-                        "slices_ok": int(gathered[r, 2]),
-                        "slices_total": int(gathered[r, 3]),
-                    }
-                    for r in range(world)
+            # the one DCN crossing of the whole run (a collective: if a rank
+            # died earlier the others block here until the coordinator's
+            # missed-heartbeat handling fails the job — the standard SPMD
+            # failure mode, preferred over reporting partial totals as global)
+            cluster = common.allgather_cluster_counts(
+                {
+                    "patients_ok": summary.patients_ok,
+                    "patients_total": len(summary.patients),
+                    "slices_ok": summary.succeeded_slices,
+                    "slices_total": summary.total_slices,
                 },
-            }
+                world,
+            )
             if rank == 0:
                 print(
                     f"\nCluster totals: {cluster['patients_ok']}/"

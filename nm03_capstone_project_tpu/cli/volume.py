@@ -42,6 +42,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="also write each patient's 3D mask as MetaImage (<patient>/mask.mhd)",
     )
     common.add_render_stage_arg(p)
+    common.add_distributed_args(
+        p,
+        "Without --z-shard, patients are round-robin sharded across "
+        "processes on their local devices. WITH --z-shard, every process "
+        "cooperates on every volume: the z axis spans the GLOBAL device set "
+        "and the halo exchange rides DCN between hosts (the long-sequence "
+        "mode); rank 0 exports.",
+    )
     return p
 
 
@@ -160,11 +168,29 @@ def run(args: argparse.Namespace) -> int:
     common.enable_compile_cache()
     common.apply_native_flag(args)
     cfg = common.pipeline_config_from_args(args)
-    base = common.resolve_base_path(args, tmp_root=Path(args.output))
+    rank, world = common.init_distributed(args)
+    base = common.resolve_base_path_sync(args, rank, world, tmp_root=Path(args.output))
     out_root = Path(args.output)
-    manifest = Manifest.load_or_create(out_root) if args.resume else Manifest(out_root)
 
-    n_dev = len(jax.devices())
+    # two multi-process layouts (see --distributed help): with --z-shard the
+    # whole job cooperates volume-by-volume over the GLOBAL device set (rank
+    # 0 exports and keeps the manifest); without it, patients shard across
+    # ranks, each on its local devices, like the batch drivers
+    global_zshard = args.z_shard and world > 1
+    patient_sharded = world > 1 and not global_zshard
+    i_export = rank == 0 or patient_sharded
+
+    manifest_name = (
+        f"manifest.rank{rank}.json" if patient_sharded else "manifest.json"
+    )
+    manifest = (
+        Manifest.load_or_create(out_root, manifest_name)
+        if args.resume
+        else Manifest(out_root, manifest_name)
+    )
+
+    devices = jax.devices() if global_zshard else jax.local_devices()
+    n_dev = len(devices)
     zshard = args.z_shard and n_dev > 1
     if args.z_shard and n_dev == 1:
         print("--z-shard ignored: single device", file=sys.stderr)
@@ -181,30 +207,80 @@ def run(args: argparse.Namespace) -> int:
     if zshard:
         from nm03_capstone_project_tpu.parallel import make_mesh
 
-        mesh = make_mesh(n_dev, axis_names=("z",))
-        print(f"z-sharding volumes over {n_dev} devices")
+        mesh = make_mesh(n_dev, axis_names=("z",), devices=devices)
+        print(
+            f"z-sharding volumes over {n_dev} "
+            f"{'global' if global_zshard else 'local'} devices"
+        )
 
     timer = Timer()
     patients = find_patient_dirs(base)
+    if patient_sharded:
+        patients = common.shard_patients(patients, rank, world)
     print(f"=== Volumetric processing: {len(patients)} patients ===")
+
+    def _bcast_flag(flag: bool) -> bool:
+        """Collective: rank 0's decision, everywhere."""
+        from jax.experimental import multihost_utils
+
+        return bool(
+            np.asarray(
+                multihost_utils.broadcast_one_to_all(np.asarray([flag], np.int32))
+            )[0]
+        )
+
+    def _all_ranks_ok(ok: bool) -> bool:
+        """Collective: True iff every rank reports ok."""
+        from jax.experimental import multihost_utils
+
+        return bool(
+            np.asarray(
+                multihost_utils.process_allgather(np.asarray([ok], np.int32))
+            ).all()
+        )
+
     ok_patients, results = 0, {}
     with profile_trace(args.profile_dir):
         for pid in patients:
             try:
+                # In global z-shard mode every branch below must be taken
+                # IDENTICALLY on every rank — a rank that skips a patient
+                # while another enters its collectives deadlocks the job. So
+                # the resume decision is rank 0's, broadcast (per-rank
+                # manifests may differ if out_root is not truly shared), and
+                # a load failure on ANY rank fails the patient on ALL ranks.
+                skip = False
                 if args.resume:
-                    # stems come from the listing alone — no decode needed to
-                    # decide a patient is fully visited (done or recorded bad)
-                    from nm03_capstone_project_tpu.data.discovery import (
-                        load_dicom_files_for_patient,
-                    )
+                    if rank == 0 or not global_zshard:
+                        # stems come from the listing alone — no decode
+                        # needed to decide a patient is fully visited
+                        from nm03_capstone_project_tpu.data.discovery import (
+                            load_dicom_files_for_patient,
+                        )
 
-                    listed = [f.stem for f in load_dicom_files_for_patient(base, pid)]
-                    if listed and manifest.patient_accounted(pid, listed):
-                        print(f"Patient {pid}: already complete, skipping")
-                        ok_patients += 1
-                        continue
-                with timer.section(f"load/{pid}"):
-                    vol, dims, stems, skipped = _load_volume(base, pid, cfg)
+                        listed = [
+                            f.stem for f in load_dicom_files_for_patient(base, pid)
+                        ]
+                        skip = bool(listed and manifest.patient_accounted(pid, listed))
+                    if global_zshard:
+                        skip = _bcast_flag(skip)
+                if skip:
+                    print(f"Patient {pid}: already complete, skipping")
+                    ok_patients += 1
+                    continue
+
+                load_error = None
+                try:
+                    with timer.section(f"load/{pid}"):
+                        vol, dims, stems, skipped = _load_volume(base, pid, cfg)
+                except Exception as e:  # noqa: BLE001 — judged collectively
+                    load_error = e
+                if global_zshard and not _all_ranks_ok(load_error is None):
+                    raise load_error or RuntimeError(
+                        f"{pid}: load failed on another rank"
+                    )
+                if load_error is not None:
+                    raise load_error
                 for stem in skipped:
                     # record load-time rejects so --resume can account for them
                     manifest.record(pid, stem, STATUS_FAILED)
@@ -230,8 +306,25 @@ def run(args: argparse.Namespace) -> int:
                             jnp.asarray(vol), jnp.asarray(dims), cfg, mesh
                         )
                         vol = vol[:depth]
-                        maskj = out["mask"][:depth]
-                        if not host_render:
+                        if global_zshard:
+                            # the mask is a GLOBAL array (shards on every
+                            # host); gather it — a direct np.asarray of a
+                            # non-addressable array would fail
+                            from jax.experimental import multihost_utils
+
+                            mask = np.asarray(
+                                multihost_utils.process_allgather(
+                                    out["mask"], tiled=True
+                                )
+                            )[:depth]
+                            maskj = jnp.asarray(mask)
+                        else:
+                            maskj = out["mask"][:depth]
+                            mask = np.asarray(maskj)
+                        if not host_render and i_export:
+                            # render is per-rank local math — only the
+                            # exporting rank computes it (the collective part
+                            # of this patient, the mask gather, is done)
                             grayj, segj = _compiled_render_fn(cfg)(
                                 jnp.asarray(vol), maskj, jnp.asarray(dims)
                             )
@@ -239,14 +332,21 @@ def run(args: argparse.Namespace) -> int:
                         maskj = _compiled_volume_mask_fn(cfg)(
                             jnp.asarray(vol), jnp.asarray(dims)
                         )
+                        mask = np.asarray(maskj)
                     else:
                         maskj, grayj, segj = _compiled_volume_fn(cfg)(
                             jnp.asarray(vol), jnp.asarray(dims)
                         )
-                    mask = np.asarray(maskj)
-                    if not host_render:
+                        mask = np.asarray(maskj)
+                    if not host_render and i_export:
                         gray = np.asarray(grayj)
                         seg = np.asarray(segj)
+                if not i_export:
+                    # global z-shard, rank != 0: compute was cooperative but
+                    # rank 0 owns the export/manifest; count and move on
+                    ok_patients += 1
+                    results[pid] = {"slices": depth, "mask_voxels": int(mask.sum())}
+                    continue
                 with timer.section(f"export/{pid}"):
                     if not args.resume:
                         clean_directory(out_root / pid)
@@ -299,19 +399,32 @@ def run(args: argparse.Namespace) -> int:
                 print(f"Patient {pid} failed: {e}", file=sys.stderr)
     print("\n=== All Processing Completed ===\n")
     print(f"Successfully processed {ok_patients}/{len(patients)} patients.")
-    if args.results_json:
+    cluster = None
+    if patient_sharded:
+        # same single DCN crossing as the batch drivers: cohort-wide totals
+        cluster = common.allgather_cluster_counts(
+            {"patients_ok": ok_patients, "patients_total": len(patients)}, world
+        )
+        if rank == 0:
+            print(
+                f"Cluster totals: {cluster['patients_ok']}/"
+                f"{cluster['patients_total']} patients across {world} processes."
+            )
+    if args.results_json and rank == 0:
         import jax
 
-        write_results_json(
-            args.results_json,
-            {
-                "mode": "volume",
-                "backend": jax.devices()[0].platform,  # provenance
-                "z_sharded": bool(zshard),
-                "patients": results,
-                "timings_s": timer.report(),
-            },
-        )
+        record = {
+            "mode": "volume",
+            "backend": jax.devices()[0].platform,  # provenance
+            "z_sharded": bool(zshard),
+            "z_global": bool(global_zshard),
+            "patients": results,
+            "timings_s": timer.report(),
+        }
+        if cluster is not None:
+            record["cluster"] = cluster
+            record["process_count"] = world
+        write_results_json(args.results_json, record)
     return 0 if ok_patients == len(patients) else 1
 
 

@@ -51,6 +51,38 @@ _WORKER = textwrap.dedent(
 ).format(repo=str(_REPO))
 
 
+_VOL_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    cohort, outdir = sys.argv[4], sys.argv[5]
+
+    from nm03_capstone_project_tpu.cli import volume
+
+    rc = volume.main([
+        "--base-path", cohort,
+        "--output", outdir,
+        "--results-json", os.path.join(outdir, "results.json"),
+        "--z-shard",
+        "--distributed",
+        "--coordinator-address", f"127.0.0.1:{{port}}",
+        "--num-processes", str(nproc),
+        "--process-id", str(pid),
+        "--canvas", "128", "--render-size", "128",
+    ])
+    assert rc == 0, f"volume driver rc={{rc}}"
+    print(f"VGOK {{pid}}", flush=True)
+    """
+).format(repo=str(_REPO))
+
+
 class TestDistributedCohort:
     def test_two_process_cohort_partitions_and_aggregates(self, tmp_path):
         from nm03_capstone_project_tpu.data.synthetic import write_synthetic_cohort
@@ -90,6 +122,32 @@ class TestDistributedCohort:
         # per-process split is 2 + 1 patients
         per = rec["cluster"]["per_process"]
         assert sorted(v["patients_total"] for v in per.values()) == [1, 2]
+
+    def test_volume_global_zshard_spans_both_processes(self, tmp_path):
+        # --z-shard --distributed: every volume's z axis spans the GLOBAL
+        # 8-device set (4 per process) and the halo exchange crosses the
+        # process boundary; rank 0 exports
+        from nm03_capstone_project_tpu.data.synthetic import write_synthetic_cohort
+
+        cohort = tmp_path / "cohort"
+        write_synthetic_cohort(
+            cohort, n_patients=2, n_slices=4, height=128, width=120
+        )
+        outdir = tmp_path / "out"
+        script = tmp_path / "vg_worker.py"
+        script.write_text(_VOL_WORKER)
+        outs = run_job_with_port_retry(
+            script, tmp_path, 2, extra_args=[str(cohort), str(outdir)]
+        )
+        for pid in range(2):
+            assert f"VGOK {pid}" in outs[pid]
+        # rank 0 exported every patient's full pair set exactly once
+        for p in ("PGBM-0001", "PGBM-0002"):
+            assert len(sorted((outdir / p).glob("*.jpg"))) == 8, p
+        rec = json.loads((outdir / "results.json").read_text())
+        assert rec["z_sharded"] is True and rec["z_global"] is True
+        assert len(rec["patients"]) == 2
+        assert all(v["mask_voxels"] > 0 for v in rec["patients"].values())
 
     def test_synthetic_cohort_generated_once_behind_barrier(self, tmp_path):
         # rank 0 generates the shared synthetic cohort; rank 1 must wait at

@@ -74,7 +74,7 @@ class TestVolumeCLI:
         # (regression: listing-stems vs usable-stems mismatch re-ran forever)
         rc, out = _run(tmp_path)
         assert rc == 0
-        bad = next((out / "synthetic-cohort-2x4" / "PGBM-0001").rglob("*.dcm"))
+        bad = next((out / "synthetic-cohort-2x4-256" / "PGBM-0001").rglob("*.dcm"))
         bad.write_bytes(b"junk")
         capsys.readouterr()
         args = [
@@ -90,7 +90,7 @@ class TestVolumeCLI:
         rc, out = _run(tmp_path)
         assert rc == 0
         # wreck one patient's series entirely: every slice unreadable
-        for f in (out / "synthetic-cohort-2x4" / "PGBM-0001").rglob("*.dcm"):
+        for f in (out / "synthetic-cohort-2x4-256" / "PGBM-0001").rglob("*.dcm"):
             f.write_bytes(b"junk")
         rc = volume_cli.main(
             [
