@@ -305,24 +305,20 @@ def apply_device_env(device: str) -> None:
 
 
 def enable_compile_cache() -> None:
-    """Point jax at a persistent compilation cache (CPU backend only by default).
+    """Point jax at a persistent compilation cache — OPT-IN via
+    NM03_COMPILE_CACHE=<dir>.
 
-    The fused pipeline costs seconds to compile — most of a small cohort's
-    device time for a cold CLI invocation; the cache makes repeat runs (and
-    the reference-style sequential-vs-parallel comparison, which compiles the
-    same program twice) warm-start. Auto-enabled only when the backend is
-    pinned to cpu: asking the tunneled remote-TPU backend to serialize
-    executables for the cache wedged it (observed: first jit compile never
-    returned and the hung claim blocked the chip). NM03_COMPILE_CACHE=<dir>
-    forces it on anyway; =0 disables everywhere.
+    The fused pipeline costs seconds to compile, so a cache warm-starts
+    repeat CLI runs — but it is opt-in because both accelerator and CPU
+    backends misbehaved with it on this infrastructure: asking the tunneled
+    remote-TPU backend to serialize executables wedged the tunnel (first jit
+    compile never returned, hung claim blocked the chip), and XLA:CPU AOT
+    cache entries reloaded under a different detected feature set warn of
+    possible SIGILL. Set NM03_COMPILE_CACHE=<dir> to enable deliberately.
     """
     cache = os.environ.get("NM03_COMPILE_CACHE", "")
-    if cache == "0":
+    if not cache or cache == "0":
         return
-    if not cache:
-        if os.environ.get("JAX_PLATFORMS") != "cpu":
-            return
-        cache = str(Path(__file__).resolve().parents[2] / ".xla_cache")
     import jax
 
     jax.config.update("jax_compilation_cache_dir", cache)

@@ -51,6 +51,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="capture a jax.profiler trace of the training loop here",
     )
     common.add_pipeline_args(p)
+    common.add_distributed_args(
+        p,
+        "Training shards slices across processes (teacher distillation "
+        "scales linearly); gradients psum over the global data axis every "
+        "step; rank 0 writes the checkpoint. 2D student only.",
+    )
     t = p.add_argument_group("training")
     t.add_argument("--steps", type=int, default=300)
     t.add_argument("--lr", type=float, default=3e-3)
@@ -92,7 +98,7 @@ def main(argv=None) -> int:
         return 1
 
 
-def _load_cohort(args, cfg):
+def _load_cohort(args, cfg, rank=0, world=1):
     """(pixels, dims) float32/int32 host arrays, padded to the canvas."""
     import numpy as np
 
@@ -102,7 +108,7 @@ def _load_cohort(args, cfg):
         load_dicom_files_for_patient,
     )
 
-    base = common.resolve_base_path(args, tmp_root=Path(args.output))
+    base = common.resolve_base_path_sync(args, rank, world, tmp_root=Path(args.output))
     pixels, dims = [], []
     for patient_id in find_patient_dirs(base):
         for f in load_dicom_files_for_patient(base, patient_id):
@@ -123,7 +129,7 @@ def _load_cohort(args, cfg):
     return np.stack(pixels), np.asarray(dims, np.int32)
 
 
-def _load_cohort_volumes(args, cfg):
+def _load_cohort_volumes(args, cfg, rank=0, world=1):
     """(volumes, dims): (P, depth, canvas, canvas) float32 + (P, 2) int32.
 
     One training volume per patient: the first ``--volume-depth`` usable
@@ -137,7 +143,7 @@ def _load_cohort_volumes(args, cfg):
     from nm03_capstone_project_tpu.cli.volume import _load_volume
     from nm03_capstone_project_tpu.data.discovery import find_patient_dirs
 
-    base = common.resolve_base_path(args, tmp_root=Path(args.output))
+    base = common.resolve_base_path_sync(args, rank, world, tmp_root=Path(args.output))
     depth = args.volume_depth
     vols, dims, skipped = [], [], 0
     for patient_id in find_patient_dirs(base):
@@ -185,8 +191,11 @@ def run(args: argparse.Namespace) -> int:
     from nm03_capstone_project_tpu.utils.profiling import profile_trace
 
     configure_reporting(verbose=args.verbose)
+    rank, world = common.init_distributed(args)
     common.enable_compile_cache()
     cfg = common.pipeline_config_from_args(args)
+    if world > 1 and args.model_3d:
+        raise SystemExit("--distributed training supports the 2D student only")
     if cfg.canvas % 4:
         raise SystemExit("--canvas must be divisible by 4 (two U-Net poolings)")
     if args.eval_only and not args.restore:
@@ -215,7 +224,7 @@ def run(args: argparse.Namespace) -> int:
         params = init_unet(jax.random.PRNGKey(args.seed), base=args.base_channels)
 
     if args.model_3d:
-        volumes, dims = _load_cohort_volumes(args, cfg)
+        volumes, dims = _load_cohort_volumes(args, cfg, rank, world)
         print(
             f"cohort: {volumes.shape[0]} volumes of {args.volume_depth} x "
             f"{cfg.canvas}x{cfg.canvas}"
@@ -228,8 +237,18 @@ def run(args: argparse.Namespace) -> int:
             [distill_volume(v, d, cfg) for v, d in zip(px, dm)]
         )
     else:
-        pixels, dims = _load_cohort(args, cfg)
+        pixels, dims = _load_cohort(args, cfg, rank, world)
         print(f"cohort: {pixels.shape[0]} slices at {cfg.canvas}x{cfg.canvas}")
+        if world > 1:
+            # shard slices BEFORE distillation: teacher labeling is the
+            # expensive part and scales linearly with hosts this way
+            pixels, dims = pixels[rank::world], dims[rank::world]
+            if pixels.shape[0] == 0:
+                raise SystemExit(
+                    f"rank {rank}: no slices after sharding — cohort smaller "
+                    "than the process count"
+                )
+            print(f"process {rank}/{world}: {pixels.shape[0]} slices assigned")
         px = jnp.asarray(pixels)
         dm = jnp.asarray(dims)
         print("distilling teacher labels (classical pipeline)...")
@@ -241,7 +260,38 @@ def run(args: argparse.Namespace) -> int:
     if not args.eval_only:
         n_dev = len(jax.devices())
         with profile_trace(args.profile_dir):
-            if n_dev > 1 and not args.model_3d:
+            if world > 1:
+                # multi-host data parallelism: every host contributes its
+                # local shard to one global batch; gradients psum over the
+                # global data axis (tp stays 1 — tensor parallelism across
+                # DCN would put an all-reduce on the slow links)
+                from jax.experimental import multihost_utils
+
+                from nm03_capstone_project_tpu.models import (
+                    fit_distributed,
+                    pad_local_shard,
+                )
+
+                ldev = len(jax.local_devices())
+                counts = np.asarray(
+                    multihost_utils.process_allgather(
+                        np.asarray([x.shape[0]], np.int32)
+                    )
+                )
+                per_rank = -(-int(counts.max()) // ldev) * ldev
+                x_l, lb_l, dm_l = pad_local_shard(
+                    np.asarray(x), np.asarray(labels), np.asarray(dm), per_rank
+                )
+                print(
+                    f"training {args.steps} steps at lr={args.lr} over "
+                    f"{world} hosts x {ldev} devices "
+                    f"(global batch {world * per_rank})..."
+                )
+                params, losses = fit_distributed(
+                    params, x_l, lb_l, dm_l,
+                    steps=args.steps, lr=args.lr, compute_dtype=dtype,
+                )
+            elif n_dev > 1 and not args.model_3d:
                 # dp x tp over every visible device: batch on 'data',
                 # parameters split on output channels over 'model' (the
                 # sharded step the multi-chip dryrun validates). The 3D
@@ -283,12 +333,25 @@ def run(args: argparse.Namespace) -> int:
     truth = np.asarray(labels).astype(bool) & vmask
     inter = int((pred & truth).sum())
     union = int((pred | truth).sum())
+    n_scored = int(pred.shape[0])
+    if world > 1:
+        # each rank scored its own (unpadded) shard; one allgather gives the
+        # cohort-wide IoU every rank agrees on
+        agg = common.allgather_cluster_counts(
+            {"inter": inter, "union": union, "n": n_scored}, world
+        )
+        inter, union, n_scored = agg["inter"], agg["union"], agg["n"]
     iou = inter / union if union else 1.0
     unit = "volumes" if args.model_3d else "slices"
-    print(f"student-vs-teacher IoU over {pred.shape[0]} {unit}: {iou:.3f}")
+    if rank == 0:
+        print(f"student-vs-teacher IoU over {n_scored} {unit}: {iou:.3f}")
 
     ckpt = Path(args.output) / "checkpoint"
     if not args.eval_only:
+        # every rank enters the save together: orbax checkpointing is a
+        # collective in a multiprocess job (its internal barrier would hang
+        # rank 0 if the others had already exited); the write itself lands
+        # once (params are replicated)
         save_params(
             ckpt,
             params,
@@ -299,14 +362,19 @@ def run(args: argparse.Namespace) -> int:
                 "canvas": cfg.canvas,
                 "model_3d": args.model_3d,
                 "iou_vs_teacher": iou,
-            },
+            }
+            if rank == 0
+            else None,
         )
-        print(f"checkpoint written to {ckpt}")
+        if rank == 0:
+            print(f"checkpoint written to {ckpt}")
+    if rank != 0:
+        return 0
     if args.results_json:
         write_results_json(
             args.results_json,
             {
-                unit: int(pred.shape[0]),
+                unit: n_scored,
                 "model": "unet3d" if args.model_3d else "unet2d",
                 "steps": 0 if args.eval_only else args.steps,
                 "final_loss": losses[-1] if losses else None,

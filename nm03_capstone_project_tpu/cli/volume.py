@@ -183,6 +183,12 @@ def run(args: argparse.Namespace) -> int:
     manifest_name = (
         f"manifest.rank{rank}.json" if patient_sharded else "manifest.json"
     )
+    if args.resume:
+        common.warn_resume_topology(
+            out_root, world if patient_sharded else 1, lambda m, *a: print(
+                "warning: " + (m % a), file=sys.stderr
+            )
+        )
     manifest = (
         Manifest.load_or_create(out_root, manifest_name)
         if args.resume
@@ -253,15 +259,28 @@ def run(args: argparse.Namespace) -> int:
                 if args.resume:
                     if rank == 0 or not global_zshard:
                         # stems come from the listing alone — no decode
-                        # needed to decide a patient is fully visited
-                        from nm03_capstone_project_tpu.data.discovery import (
-                            load_dicom_files_for_patient,
-                        )
+                        # needed to decide a patient is fully visited. In
+                        # global mode the listing is inside its own guard:
+                        # an exception here on rank 0 must not skip the
+                        # broadcast below, or every later collective would
+                        # pair with the wrong patient
+                        try:
+                            from nm03_capstone_project_tpu.data.discovery import (
+                                load_dicom_files_for_patient,
+                            )
 
-                        listed = [
-                            f.stem for f in load_dicom_files_for_patient(base, pid)
-                        ]
-                        skip = bool(listed and manifest.patient_accounted(pid, listed))
+                            listed = [
+                                f.stem
+                                for f in load_dicom_files_for_patient(base, pid)
+                            ]
+                            skip = bool(
+                                listed and manifest.patient_accounted(pid, listed)
+                            )
+                        except Exception:  # noqa: BLE001
+                            if not global_zshard:
+                                raise
+                            # fall through with skip=False: the load step
+                            # below will fail collectively and uniformly
                     if global_zshard:
                         skip = _bcast_flag(skip)
                 if skip:

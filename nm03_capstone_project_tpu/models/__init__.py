@@ -13,7 +13,9 @@ from nm03_capstone_project_tpu.models.checkpoint import (  # noqa: F401
 from nm03_capstone_project_tpu.models.train import (  # noqa: F401
     distill_batch,
     fit,
+    fit_distributed,
     fit_sharded,
+    pad_local_shard,
     make_optimizer,
     make_sharded_train_step,
     prepare_student_inputs,

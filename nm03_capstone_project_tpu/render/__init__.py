@@ -4,10 +4,17 @@ from nm03_capstone_project_tpu.render.contact_sheet import contact_sheet  # noqa
 from nm03_capstone_project_tpu.render.export import (  # noqa: F401
     clean_directory,
     export_pairs,
+    render_export_pairs,
     save_jpeg,
+)
+from nm03_capstone_project_tpu.render.host_render import (  # noqa: F401
+    host_render_gray,
+    host_render_pair,
+    host_render_segmentation,
 )
 from nm03_capstone_project_tpu.render.render import (  # noqa: F401
     render_gray,
     render_overlay,
+    render_pair,
     render_segmentation,
 )

@@ -83,6 +83,38 @@ _VOL_WORKER = textwrap.dedent(
 ).format(repo=str(_REPO))
 
 
+_TRAIN_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    cohort, outdir = sys.argv[4], sys.argv[5]
+
+    from nm03_capstone_project_tpu.cli import train
+
+    rc = train.main([
+        "--base-path", cohort,
+        "--output", outdir,
+        "--results-json", os.path.join(outdir, "train.json"),
+        "--distributed",
+        "--coordinator-address", f"127.0.0.1:{{port}}",
+        "--num-processes", str(nproc),
+        "--process-id", str(pid),
+        "--canvas", "128",
+        "--steps", "12", "--base-channels", "8",
+    ])
+    assert rc == 0, f"train driver rc={{rc}}"
+    print(f"TROK {{pid}}", flush=True)
+    """
+).format(repo=str(_REPO))
+
+
 class TestDistributedCohort:
     def test_two_process_cohort_partitions_and_aggregates(self, tmp_path):
         from nm03_capstone_project_tpu.data.synthetic import write_synthetic_cohort
@@ -148,6 +180,30 @@ class TestDistributedCohort:
         assert rec["z_sharded"] is True and rec["z_global"] is True
         assert len(rec["patients"]) == 2
         assert all(v["mask_voxels"] > 0 for v in rec["patients"].values())
+
+    def test_distributed_training_across_two_processes(self, tmp_path):
+        # dp training over 2 hosts x 4 devices: shards distilled locally,
+        # one global batch, gradients psummed over the global data axis,
+        # rank 0 writes the checkpoint + aggregated IoU
+        from nm03_capstone_project_tpu.data.synthetic import write_synthetic_cohort
+
+        cohort = tmp_path / "cohort"
+        write_synthetic_cohort(
+            cohort, n_patients=2, n_slices=5, height=128, width=120
+        )
+        outdir = tmp_path / "out"
+        script = tmp_path / "tr_worker.py"
+        script.write_text(_TRAIN_WORKER)
+        outs = run_job_with_port_retry(
+            script, tmp_path, 2, extra_args=[str(cohort), str(outdir)]
+        )
+        for pid in range(2):
+            assert f"TROK {pid}" in outs[pid]
+        assert (outdir / "checkpoint").exists()
+        rec = json.loads((outdir / "train.json").read_text())
+        assert rec["slices"] == 10  # both ranks' shards scored + aggregated
+        assert rec["final_loss"] is not None
+        assert 0.0 <= rec["iou_vs_teacher"] <= 1.0
 
     def test_synthetic_cohort_generated_once_behind_barrier(self, tmp_path):
         # rank 0 generates the shared synthetic cohort; rank 1 must wait at

@@ -120,7 +120,7 @@ def _run_job(script, tmp_path, nproc, port, attempt, extra_args=()):
     try:
         for pid, p in enumerate(procs):
             try:
-                rcs.append(p.wait(timeout=300))
+                rcs.append(p.wait(timeout=600))
             except subprocess.TimeoutExpired:
                 rcs.append(None)
             outs.append((tmp_path / f"a{attempt}_w{pid}.out").read_text())
