@@ -240,14 +240,17 @@ def run(args: argparse.Namespace) -> int:
         pixels, dims = _load_cohort(args, cfg, rank, world)
         print(f"cohort: {pixels.shape[0]} slices at {cfg.canvas}x{cfg.canvas}")
         if world > 1:
+            # every rank loaded the identical cohort, so this check is
+            # UNIFORM — raising on only the empty-shard ranks would strand
+            # the others at the next collective until the heartbeat timeout
+            if pixels.shape[0] < world:
+                raise SystemExit(
+                    f"cohort has {pixels.shape[0]} usable slices < "
+                    f"{world} processes — shrink the job or grow the cohort"
+                )
             # shard slices BEFORE distillation: teacher labeling is the
             # expensive part and scales linearly with hosts this way
             pixels, dims = pixels[rank::world], dims[rank::world]
-            if pixels.shape[0] == 0:
-                raise SystemExit(
-                    f"rank {rank}: no slices after sharding — cohort smaller "
-                    "than the process count"
-                )
             print(f"process {rank}/{world}: {pixels.shape[0]} slices assigned")
         px = jnp.asarray(pixels)
         dm = jnp.asarray(dims)

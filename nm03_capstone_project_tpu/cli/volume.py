@@ -183,7 +183,9 @@ def run(args: argparse.Namespace) -> int:
     manifest_name = (
         f"manifest.rank{rank}.json" if patient_sharded else "manifest.json"
     )
-    if args.resume:
+    if args.resume and rank == 0:
+        # rank 0 only: all ranks see the same shared out_root, and one
+        # warning in the merged job log is enough
         common.warn_resume_topology(
             out_root, world if patient_sharded else 1, lambda m, *a: print(
                 "warning: " + (m % a), file=sys.stderr

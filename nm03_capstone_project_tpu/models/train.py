@@ -235,15 +235,11 @@ def fit_sharded(
     dice terms (segmentation_loss averages dice over batch rows). Returns
     host-resident params so checkpointing is layout-independent.
     """
-    import numpy as np
-
     dp = mesh.shape["data"]
     b = pixels.shape[0]
     if b % dp:
-        idx = np.arange(((b + dp - 1) // dp) * dp) % b
-        pixels = jnp.asarray(np.asarray(pixels)[idx])
-        labels = jnp.asarray(np.asarray(labels)[idx])
-        dims = jnp.asarray(np.asarray(dims)[idx])
+        target = ((b + dp - 1) // dp) * dp
+        pixels, labels, dims = pad_local_shard(pixels, labels, dims, target)
     tx = make_optimizer(lr, total_steps=steps)
     step_fn, place_params = make_sharded_train_step(
         mesh, params, tx, compute_dtype=compute_dtype

@@ -1,0 +1,53 @@
+#!/bin/bash
+# One-shot TPU revalidation: run after the accelerator tunnel recovers.
+#
+# Refreshes every chip-measured artifact with the CURRENT code:
+#   1. bench.py            -> results/bench_tpu_<date>.json (headline + stages)
+#   2. nm03-sequential     -> results/results_sequential.json (wall_s record)
+#   3. nm03-parallel       -> results/results_parallel.json
+#   4. nm03-volume         -> results/results_volume.json (3D path on chip)
+#
+# Everything is sequenced (one chip; concurrent runs would contend) and each
+# step tolerates failure so a mid-run tunnel wedge still leaves the earlier
+# artifacts on disk. Run from the repo root.
+set -u
+cd "$(dirname "$0")/.."
+STAMP=$(date -u +%Y%m%d)
+
+echo "== probe =="
+timeout 90 python bench.py --probe || { echo "tunnel not healthy; aborting"; exit 1; }
+
+echo "== bench =="
+timeout 1800 python bench.py > "results/bench_tpu_${STAMP}.json" 2>bench_stderr.log \
+  && cat "results/bench_tpu_${STAMP}.json" \
+  || echo "bench failed; see bench_stderr.log"
+
+echo "== sequential cohort =="
+timeout 1500 python -m nm03_capstone_project_tpu.cli.sequential \
+  --synthetic 20 --synthetic-slices 22 --output /tmp/tpu-out-seq \
+  --results-json results/results_sequential.json >/tmp/tpu-seq.log 2>&1 \
+  || echo "sequential failed; see /tmp/tpu-seq.log"
+
+echo "== parallel cohort =="
+timeout 1200 python -m nm03_capstone_project_tpu.cli.parallel \
+  --synthetic 20 --synthetic-slices 22 --output /tmp/tpu-out-par \
+  --results-json results/results_parallel.json >/tmp/tpu-par.log 2>&1 \
+  || echo "parallel failed; see /tmp/tpu-par.log"
+
+echo "== volume driver =="
+timeout 1200 python -m nm03_capstone_project_tpu.cli.volume \
+  --synthetic 4 --synthetic-slices 8 --output /tmp/tpu-out-vol --export-mhd \
+  --results-json results/results_volume.json >/tmp/tpu-vol.log 2>&1 \
+  || echo "volume failed; see /tmp/tpu-vol.log"
+
+echo "== summary =="
+python - <<'EOF'
+import json, pathlib
+for f in sorted(pathlib.Path("results").glob("*.json")):
+    try:
+        d = json.loads(f.read_text())
+    except Exception as e:
+        print(f.name, "unreadable:", e); continue
+    keys = {k: d[k] for k in ("backend", "value", "vs_baseline", "wall_s", "mode") if k in d}
+    print(f.name, keys)
+EOF
