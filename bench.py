@@ -14,6 +14,9 @@ vmapped over a 256x256 slice batch on ONE device of the default jax backend
 backend — the stand-in for the reference's OpenMP-parallel CPU driver
 (src/parallel/main_parallel.cpp:336; XLA:CPU also uses the host's cores, so
 this is parallel-CPU vs one TPU chip, the north-star ratio in BASELINE.json).
+The accelerator sweeps batch sizes (ACCEL_BATCH_SWEEP) and the best
+slices/s wins; the CPU baseline then runs at the SAME winning batch so the
+ratio stays program-for-program.
 
 Robustness architecture (the round-1 lesson, plus the round-2 discovery that
 killing a worker mid-TPU-claim wedges the tunnel for everyone after): the
@@ -62,6 +65,11 @@ import sys
 import time
 
 BATCH = 32
+# the accelerator worker sweeps these and reports the best slices/s — batch
+# size is free to choose when the metric is throughput, and bigger batches
+# amortize dispatch/sync better on the chip; the CPU baseline then reruns
+# at the winning size so vs_baseline stays a same-program ratio
+ACCEL_BATCH_SWEEP = (32, 128)
 CANVAS = 256
 TPU_REPS = 40
 CPU_REPS = 2
@@ -100,7 +108,7 @@ def _log(msg: str) -> None:
 # --------------------------------------------------------------------------
 
 
-def _make_batch():
+def _make_batch(batch: int = BATCH):
     import numpy as np
 
     from nm03_capstone_project_tpu.data.synthetic import phantom_slice
@@ -108,10 +116,10 @@ def _make_batch():
     pixels = np.stack(
         [
             phantom_slice(CANVAS, CANVAS, seed=i, lesion_radius=0.12 + 0.002 * i)
-            for i in range(BATCH)
+            for i in range(batch)
         ]
     ).astype(np.float32)
-    dims = np.full((BATCH, 2), CANVAS, np.int32)
+    dims = np.full((batch, 2), CANVAS, np.int32)
     return pixels, dims
 
 
@@ -154,7 +162,7 @@ def _bench_on(device, pixels, dims, reps, use_pallas=False):
     results = [fn(px, dm) for _ in range(reps)]  # enqueue, FIFO stream
     int(results[-1])  # one sync: FIFO order implies all earlier reps finished
     elapsed = time.perf_counter() - t0
-    return BATCH * reps / elapsed, checksum
+    return pixels.shape[0] * reps / elapsed, checksum
 
 
 def _time_stage(fn, args, reps):
@@ -295,13 +303,19 @@ def worker(
     want_pallas: bool,
     want_stages: bool,
     out_path: str | None,
+    batches: tuple | None = None,
 ):
     """Measure on this process's backend.
 
-    Each completed section is appended to ``out_path`` immediately (one JSON
-    line per section), so a parent-side timeout loses only the section in
-    flight. The merged result also goes to stdout behind a sentinel.
+    ``batches`` is swept on the XLA path and the best slices/s wins (batch
+    size is a free choice when the metric is throughput); the Pallas path
+    and its checksum comparison run at the winning batch. Each completed
+    section is appended to ``out_path`` immediately (one JSON line per
+    section), so a parent-side timeout loses only the section in flight.
+    The merged result also goes to stdout behind a sentinel.
     """
+    if batches is None:
+        batches = (BATCH,)  # resolved at call time: tests monkeypatch BATCH
     _pin_platform(platform)
     import jax
 
@@ -311,7 +325,6 @@ def worker(
             with open(out_path, "a") as f:
                 f.write(json.dumps(update) + "\n")
 
-    pixels, dims = _make_batch()
     devices = jax.devices()
     dev = devices[0]
     from nm03_capstone_project_tpu.core.backend import _TPU_PLATFORMS
@@ -321,9 +334,26 @@ def worker(
 
     result: dict = {}
     emit({"backend": dev.platform})
-    tput, xla_sum = _bench_on(dev, pixels, dims, reps, use_pallas=False)
-    emit({"xla_tput": tput, "checksum": xla_sum})
-    _log(f"{dev.platform} XLA throughput: {tput:.2f} slices/s")
+    by_batch: dict = {}
+    best = None  # (tput, batch, checksum, pixels, dims)
+    for b in batches:
+        pixels, dims = _make_batch(b)
+        tput, xla_sum = _bench_on(dev, pixels, dims, reps, use_pallas=False)
+        by_batch[str(b)] = round(tput, 2)
+        _log(f"{dev.platform} XLA throughput @batch={b}: {tput:.2f} slices/s")
+        if best is None or tput > best[0]:
+            best = (tput, b, xla_sum, pixels, dims)
+        # checkpoint progress after every batch size — a timeout keeps the
+        # sizes measured so far
+        emit(
+            {
+                "xla_tput": best[0],
+                "xla_batch": best[1],
+                "checksum": best[2],
+                "xla_by_batch": dict(by_batch),
+            }
+        )
+    tput, batch, xla_sum, pixels, dims = best
 
     if want_pallas and on_tpu:
         try:
@@ -331,7 +361,7 @@ def worker(
             agrees = p_sum == xla_sum
             emit({"pallas_tput": p_tput, "pallas_checksum_ok": agrees})
             _log(
-                f"tpu pallas throughput: {p_tput:.2f} slices/s "
+                f"tpu pallas throughput @batch={batch}: {p_tput:.2f} slices/s "
                 f"(checksum {'matches' if agrees else 'MISMATCH — discarded'})"
             )
         except Exception as e:  # noqa: BLE001 — pallas lowering failure
@@ -340,7 +370,10 @@ def worker(
 
     if want_stages:
         try:
-            emit({"stages": _stage_times(dev, pixels, dims, STAGE_REPS)})
+            # stage attribution stays at the reference batch (32) so the
+            # breakdown is comparable across rounds
+            s_pixels, s_dims = _make_batch(BATCH)
+            emit({"stages": _stage_times(dev, s_pixels, s_dims, STAGE_REPS)})
         except Exception as e:  # noqa: BLE001 — never lose the headline number
             emit({"stages_error": f"{e!r:.500}"})
             _log(f"stage timing failed: {e!r:.500}")
@@ -466,7 +499,14 @@ def main() -> None:
     if _probe_until_healthy({}, "accel"):
         accel = _run_measurement(
             "accel measurement",
-            ["--reps", str(TPU_REPS), "--pallas", "--stages"],
+            [
+                "--reps",
+                str(TPU_REPS),
+                "--pallas",
+                "--stages",
+                "--batches",
+                ",".join(str(b) for b in ACCEL_BATCH_SWEEP),
+            ],
             {},
             ACCEL_TIMEOUT_S,
         )
@@ -476,15 +516,26 @@ def main() -> None:
         accel = None
 
     # CPU baseline in a scrubbed environment: the baseline process must never
-    # dial (or hang on) the accelerator tunnel
+    # dial (or hang on) the accelerator tunnel. It runs at the SAME batch
+    # size that won the accelerator sweep so vs_baseline stays a
+    # same-program ratio.
     cpu = None
     if accel is None or accel["backend"] != "cpu":
         # when the accelerator record is lost, let the fallback at least
         # carry the per-stage breakdown so the round's JSON stays diagnosable
         extra = ["--stages"] if accel is None else []
+        cpu_batch = accel.get("xla_batch", BATCH) if accel else BATCH
         cpu = _run_measurement(
             "cpu baseline",
-            ["--platform", "cpu", "--reps", str(CPU_REPS), *extra],
+            [
+                "--platform",
+                "cpu",
+                "--reps",
+                str(CPU_REPS),
+                "--batches",
+                str(cpu_batch),
+                *extra,
+            ],
             {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": None},
             CPU_TIMEOUT_S,
         )
@@ -508,6 +559,10 @@ def main() -> None:
             out["winning_path"] = "xla"
         out["value"] = round(tput, 2)
         out["backend"] = accel["backend"]
+        if "xla_batch" in accel:
+            out["batch"] = accel["xla_batch"]
+        if "xla_by_batch" in accel:
+            out["xla_by_batch"] = accel["xla_by_batch"]
         if "pallas_tput" in accel:
             out["pallas_tput"] = round(accel["pallas_tput"], 2)
             out["pallas_checksum_ok"] = accel["pallas_checksum_ok"]
@@ -545,10 +600,18 @@ if __name__ == "__main__":
     parser.add_argument("--pallas", action="store_true")
     parser.add_argument("--stages", action="store_true")
     parser.add_argument("--out", default=None)
+    parser.add_argument("--batches", default=str(BATCH), help="comma list to sweep")
     ns = parser.parse_args()
     if ns.probe:
         probe(ns.platform)
     elif ns.worker:
-        worker(ns.platform, ns.reps, ns.pallas, ns.stages, ns.out)
+        worker(
+            ns.platform,
+            ns.reps,
+            ns.pallas,
+            ns.stages,
+            ns.out,
+            tuple(int(b) for b in ns.batches.split(",")),
+        )
     else:
         main()
