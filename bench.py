@@ -108,11 +108,13 @@ def _log(msg: str) -> None:
 # --------------------------------------------------------------------------
 
 
-def _make_batch(batch: int = BATCH):
+def _make_batch(batch: int | None = None):
     import numpy as np
 
     from nm03_capstone_project_tpu.data.synthetic import phantom_slice
 
+    if batch is None:
+        batch = BATCH  # resolved at call time: tests monkeypatch BATCH
     pixels = np.stack(
         [
             phantom_slice(CANVAS, CANVAS, seed=i, lesion_radius=0.12 + 0.002 * i)

@@ -36,7 +36,11 @@ from nm03_capstone_project_tpu.ops.median import (  # noqa: F401
 )
 from nm03_capstone_project_tpu.ops.morphology import dilate, erode  # noqa: F401
 from nm03_capstone_project_tpu.ops.neighborhood import extend_edges  # noqa: F401
+from nm03_capstone_project_tpu.ops.pallas_median import (  # noqa: F401
+    median_filter,
+)
 from nm03_capstone_project_tpu.ops.pallas_region_growing import (  # noqa: F401
+    grow_dispatch,
     region_grow_pallas,
 )
 from nm03_capstone_project_tpu.ops.region_growing import (  # noqa: F401

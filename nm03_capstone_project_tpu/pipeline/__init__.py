@@ -8,3 +8,6 @@ from nm03_capstone_project_tpu.pipeline.slice_pipeline import (  # noqa: F401
     process_slice_stages,
     segment,
 )
+from nm03_capstone_project_tpu.pipeline.volume_pipeline import (  # noqa: F401
+    process_volume,
+)

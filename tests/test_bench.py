@@ -50,6 +50,23 @@ class TestWorker:
         bench.probe("cpu")
         assert _emitted(capsys)["backend"] == "cpu"
 
+    def test_batch_sweep_keeps_the_best(self, monkeypatch, capsys, tmp_path):
+        monkeypatch.setattr(bench, "CANVAS", 64)
+        out = tmp_path / "sections.jsonl"
+        bench.worker(
+            "cpu",
+            reps=1,
+            want_pallas=False,
+            want_stages=False,
+            out_path=str(out),
+            batches=(2, 4),
+        )
+        res = _emitted(capsys)
+        assert set(res["xla_by_batch"]) == {"2", "4"}
+        assert res["xla_batch"] in (2, 4)
+        # by_batch entries are rounded for the record; the winner is not
+        assert round(res["xla_tput"], 2) == max(res["xla_by_batch"].values())
+
 
 class TestOrchestrator:
     def _run_main(self, monkeypatch, capsys, accel, cpu, probe_ok=True):
@@ -76,6 +93,30 @@ class TestOrchestrator:
         assert out["vs_baseline"] == pytest.approx(12.5)
         assert out["backend"] == "tpu"
         assert "error" not in out
+
+    def test_cpu_baseline_reruns_at_the_winning_batch(self, monkeypatch, capsys):
+        # same-program ratio: the accel sweep winner's batch size is what
+        # the cpu baseline must measure
+        calls = {}
+
+        def fake_measure(label, worker_args, env_overrides, timeout_s):
+            calls[label] = list(worker_args)
+            if "accel" in label:
+                return {
+                    "backend": "tpu",
+                    "xla_tput": 100.0,
+                    "xla_batch": 128,
+                    "checksum": 7,
+                }
+            return {"backend": "cpu", "xla_tput": 8.0, "checksum": 7}
+
+        monkeypatch.setattr(bench, "_probe_until_healthy", lambda *a: True)
+        monkeypatch.setattr(bench, "_run_measurement", fake_measure)
+        bench.main()
+        out = _emitted(capsys)
+        cpu_args = calls["cpu baseline"]
+        assert cpu_args[cpu_args.index("--batches") + 1] == "128"
+        assert out["batch"] == 128
 
     def test_pallas_wins_only_with_matching_checksum(self, monkeypatch, capsys):
         out, _ = self._run_main(
