@@ -1,0 +1,111 @@
+"""Property-based kernel-vs-oracle tests (hypothesis).
+
+The fixed-case oracle tests (test_median_sharpen, test_morphology, ...)
+pin known inputs; these throw randomized shapes, dims, and data at the same
+contracts so shape-edge and clamp-edge bugs can't hide between the
+hand-picked cases. Sizes are kept small and example counts modest: every
+distinct shape costs a jit compile on the CPU backend.
+"""
+
+import numpy as np
+import scipy.ndimage as ndi
+from hypothesis import given, settings, strategies as st
+
+from nm03_capstone_project_tpu.ops.elementwise import clip_intensity, normalize
+from nm03_capstone_project_tpu.ops.median import vector_median_filter
+from nm03_capstone_project_tpu.ops.morphology import dilate, erode
+from nm03_capstone_project_tpu.ops.neighborhood import extend_edges
+
+CANVAS = 32  # one static shape -> one compile, shared by all examples
+
+_dims = st.tuples(
+    st.integers(min_value=1, max_value=CANVAS),
+    st.integers(min_value=1, max_value=CANVAS),
+)
+
+
+def _random_canvas(data, h, w):
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31 - 1)))
+    px = np.zeros((CANVAS, CANVAS), np.float32)
+    px[:h, :w] = rng.normal(size=(h, w)).astype(np.float32)
+    return px
+
+
+@settings(max_examples=30, deadline=None)
+@given(data=st.data(), hw=_dims)
+def test_extend_edges_matches_bruteforce_clamp(data, hw):
+    h, w = hw
+    px = _random_canvas(data, h, w)
+    out = np.asarray(extend_edges(px, np.asarray([h, w], np.int32)))
+    rows = np.minimum(np.arange(CANVAS), h - 1)
+    cols = np.minimum(np.arange(CANVAS), w - 1)
+    want = px[np.ix_(rows, cols)]
+    np.testing.assert_array_equal(out, want)
+
+
+@settings(max_examples=15, deadline=None)
+@given(data=st.data(), window=st.sampled_from([3, 5, 7]))
+def test_median_matches_scipy_on_full_canvas(data, window):
+    px = _random_canvas(data, CANVAS, CANVAS)
+    got = np.asarray(vector_median_filter(px, window))
+    # ops pad with edge replication; scipy 'nearest' is the same contract
+    want = ndi.median_filter(px, size=window, mode="nearest")
+    np.testing.assert_allclose(got, want, rtol=0, atol=0)
+
+
+@settings(max_examples=15, deadline=None)
+@given(
+    data=st.data(),
+    size=st.sampled_from([3, 5]),
+    shape=st.sampled_from(["cross", "box"]),
+    op=st.sampled_from(["dilate", "erode"]),
+)
+def test_morphology_matches_scipy_binary(data, size, shape, op):
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31 - 1)))
+    mask = (rng.random((CANVAS, CANVAS)) < 0.3).astype(np.uint8)
+    fn = dilate if op == "dilate" else erode
+    got = np.asarray(fn(mask, size, shape)).astype(bool)
+    if shape == "box":
+        structure = np.ones((size, size), bool)
+    else:  # cross: city-block radius size//2
+        r = size // 2
+        yy, xx = np.mgrid[-r : r + 1, -r : r + 1]
+        structure = (np.abs(yy) + np.abs(xx)) <= r
+    sfn = ndi.binary_dilation if op == "dilate" else ndi.binary_erosion
+    # outside-image counts as background for both ops (ops/morphology.py)
+    want = sfn(mask.astype(bool), structure=structure, border_value=0)
+    np.testing.assert_array_equal(got, want)
+
+
+@settings(max_examples=10, deadline=None)
+@given(data=st.data())
+def test_region_growing_is_exact_seeded_flood_fill(data):
+    # the SeededRegionGrowing contract: exactly the band-valued pixels
+    # 4-connected to a seed through the band (no more, no less)
+    from nm03_capstone_project_tpu.ops.region_growing import region_grow
+
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31 - 1)))
+    px = rng.random((CANVAS, CANVAS)).astype(np.float32)
+    seeds = np.zeros((CANVAS, CANVAS), bool)
+    for _ in range(data.draw(st.integers(1, 4))):
+        seeds[rng.integers(0, CANVAS), rng.integers(0, CANVAS)] = True
+    lo, hi = 0.3, 0.8
+    got = np.asarray(region_grow(px, seeds, lo, hi)).astype(bool)
+
+    band = (px >= lo) & (px <= hi)
+    labels, _ = ndi.label(band, structure=ndi.generate_binary_structure(2, 1))
+    seed_labels = set(np.unique(labels[seeds & band])) - {0}
+    want = np.isin(labels, sorted(seed_labels)) & band
+    np.testing.assert_array_equal(got, want)
+
+
+@settings(max_examples=30, deadline=None)
+@given(data=st.data(), hw=_dims)
+def test_normalize_clip_stay_in_declared_range(data, hw):
+    h, w = hw
+    px = np.abs(_random_canvas(data, h, w)) * 5000.0
+    out = np.asarray(
+        clip_intensity(normalize(px, 0.5, 2.5, 0.0, 10000.0), 0.68, 4000.0)
+    )
+    assert np.isfinite(out).all()
+    assert out.min() >= 0.68 - 1e-6 and out.max() <= 4000.0 + 1e-6
