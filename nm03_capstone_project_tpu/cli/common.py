@@ -148,6 +148,52 @@ def add_render_stage_arg(parser: argparse.ArgumentParser) -> None:
     )
 
 
+def add_model_arg(parser: argparse.ArgumentParser) -> None:
+    """--model, for the 2D batch drivers that can deploy the student."""
+    parser.add_argument(
+        "--model",
+        default=None,
+        metavar="CKPT",
+        help="run the distilled 2D U-Net student from this checkpoint "
+        "(written by nm03-train) instead of the classical pipeline — the "
+        "deployment the distillation exists for: the network replaces "
+        "everything downstream of normalize+clip",
+    )
+
+
+def load_model_checkpoint(args: argparse.Namespace, cfg):
+    """Load + validate the --model checkpoint; None when the flag is unset."""
+    if not getattr(args, "model", None):
+        return None
+    from nm03_capstone_project_tpu.models.checkpoint import load_params
+
+    params, meta = load_params(args.model)
+    meta = meta or {}
+    if meta.get("model_3d"):
+        raise SystemExit(
+            f"--model {args.model} holds the 3D student; the batch drivers "
+            "deploy the 2D one"
+        )
+    ck = meta.get("canvas")
+    if ck and int(ck) != cfg.canvas:
+        raise SystemExit(
+            f"--model was trained at canvas {ck}; pass --canvas {ck}"
+        )
+    # the student only works on the input distribution it was trained on:
+    # normalize+clip constants are part of the model, not free flags
+    want_norm = [cfg.norm_low, cfg.norm_high, cfg.norm_intensity_min, cfg.norm_intensity_max]
+    want_clip = [cfg.clip_low, cfg.clip_high]
+    for key, want in (("norm", want_norm), ("clip", want_clip)):
+        got = meta.get(key)
+        if got is not None and [float(v) for v in got] != [float(v) for v in want]:
+            raise SystemExit(
+                f"--model was trained with {key} constants {got}; this run "
+                f"uses {want} — the student's input space must match its "
+                "training (drop the conflicting flags or retrain)"
+            )
+    return params
+
+
 def add_batch_args(parser: argparse.ArgumentParser) -> None:
     d = BatchConfig()
     parser.add_argument(

@@ -128,6 +128,25 @@ def _compiled_batch_mask_fn(cfg: PipelineConfig):
     return jax.jit(jax.vmap(one), donate_argnums=(0,))
 
 
+def _student_batch_mask(params, pixels, dims, cfg):
+    """The distilled U-Net standing in for everything downstream of
+    normalize+clip (models/train.py prepare_student_inputs): (B, H, W)
+    pixels -> (B, H, W) uint8 mask, canvas padding zeroed (the student's
+    logits there are untrained). Compute runs bf16 on TPU (the model's
+    mixed-precision design — the output is a >0 threshold, insensitive to
+    the mantissa) and f32 elsewhere."""
+    import jax.numpy as jnp
+
+    from nm03_capstone_project_tpu.core.backend import is_tpu_backend
+    from nm03_capstone_project_tpu.core.image import valid_mask
+    from nm03_capstone_project_tpu.models import predict_mask, prepare_student_inputs
+
+    dtype = jnp.bfloat16 if is_tpu_backend() else jnp.float32
+    x = prepare_student_inputs(pixels, cfg)
+    mask = predict_mask(params, x, dtype)
+    return mask * valid_mask(dims, pixels.shape[-2:]).astype(mask.dtype)
+
+
 @functools.lru_cache(maxsize=8)
 def _compiled_batch_fn(cfg: PipelineConfig):
     """jit of vmapped pipeline + render over a fixed-size slice stack."""
@@ -193,6 +212,7 @@ class CohortProcessor:
         resume: bool = False,
         process_rank: int = 0,
         process_count: int = 1,
+        model_params=None,
     ):
         if mode not in ("sequential", "parallel"):
             raise ValueError(f"unknown mode: {mode}")
@@ -210,6 +230,10 @@ class CohortProcessor:
         # own manifest file (shared out_root assumed to be a shared fs)
         self.process_rank = process_rank
         self.process_count = process_count
+        # a trained student checkpoint (2D U-Net host pytree) replaces the
+        # classical pipeline's compute when given (--model)
+        self.model_params = model_params
+        self._student_fns: dict = {}
         self.timer = Timer()
         self.out_root.mkdir(parents=True, exist_ok=True)
         manifest_name = (
@@ -232,6 +256,53 @@ class CohortProcessor:
     def _read_slice(self, path: Path) -> Optional[np.ndarray]:
         """Decode + guard one slice; None signals failure (null-ptr analog)."""
         return decode_and_guard(path, self.cfg)
+
+    # -- student deployment ------------------------------------------------
+
+    def _student_fn(self, batched: bool, mesh, host_render: bool):
+        """Jitted student-model stand-in for the pipeline fns, cached per
+        (shape-of-use) so each compiles once per processor."""
+        key = (batched, mesh is not None, host_render)
+        if key in self._student_fns:
+            return self._student_fns[key]
+        import jax
+
+        cfg = self.cfg
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            params = jax.device_put(
+                self.model_params, NamedSharding(mesh, PartitionSpec())
+            )
+        else:
+            params = jax.device_put(self.model_params)
+
+        if host_render:
+
+            def core(px, dm):
+                return _student_batch_mask(params, px, dm, cfg)
+
+        else:
+            from nm03_capstone_project_tpu.render.render import render_pair
+
+            def core(px, dm):
+                mask = _student_batch_mask(params, px, dm, cfg)
+                return jax.vmap(lambda p, m, d: render_pair(p, m, d, cfg))(
+                    px, mask, dm
+                )
+
+        if batched:
+            # host-render keeps its own pixel copy on the host, so the
+            # device stack is dead after the student reads it — donate,
+            # matching the classical batched fns (the render path still
+            # reads px after the mask, so it cannot donate)
+            fn = jax.jit(core, donate_argnums=(0,) if host_render else ())
+        else:
+            fn = jax.jit(lambda px, dm: jax.tree.map(
+                lambda a: a[0], core(px[None], dm[None])
+            ))
+        self._student_fns[key] = fn
+        return fn
 
     # -- patient processing ------------------------------------------------
 
@@ -274,11 +345,12 @@ class CohortProcessor:
         self, patient_id: str, out_dir: Path, files: List[Path]
     ) -> Tuple[int, List[str]]:
         host_render = self.batch_cfg.render_stage == "host"
-        fn = (
-            _compiled_slice_mask_fn(self.cfg)
-            if host_render
-            else _compiled_slice_fn(self.cfg)
-        )
+        if self.model_params is not None:
+            fn = self._student_fn(batched=False, mesh=None, host_render=host_render)
+        elif host_render:
+            fn = _compiled_slice_mask_fn(self.cfg)
+        else:
+            fn = _compiled_slice_fn(self.cfg)
         ok, failed = 0, []
         for f in files:
             stem = f.stem
@@ -336,7 +408,9 @@ class CohortProcessor:
 
             mesh = make_mesh(axis_names=("data",), devices=local)
 
-        if mesh is not None:
+        if self.model_params is not None:
+            fn = self._student_fn(batched=True, mesh=mesh, host_render=host_render)
+        elif mesh is not None:
             from nm03_capstone_project_tpu.parallel.dp import process_batch_sharded
 
             if host_render:

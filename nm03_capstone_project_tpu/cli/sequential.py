@@ -23,6 +23,7 @@ def build_parser() -> argparse.ArgumentParser:
     common.add_common_args(p)
     common.add_pipeline_args(p)
     common.add_render_stage_arg(p)
+    common.add_model_arg(p)
     return p
 
 
@@ -59,6 +60,7 @@ def run(args: argparse.Namespace, mode: str) -> int:
         base = common.resolve_base_path_sync(
             args, rank, world, tmp_root=Path(args.output)
         )
+        model_params = common.load_model_checkpoint(args, cfg)
         proc = CohortProcessor(
             base,
             args.output,
@@ -68,6 +70,7 @@ def run(args: argparse.Namespace, mode: str) -> int:
             resume=args.resume,
             process_rank=rank,
             process_count=world,
+            model_params=model_params,
         )
         import time
 

@@ -363,6 +363,15 @@ def run(args: argparse.Namespace) -> int:
                 "steps": args.steps,
                 "lr": args.lr,
                 "canvas": cfg.canvas,
+                # the student's input space: deployment must reproduce the
+                # exact normalize+clip the network was trained behind
+                "norm": [
+                    cfg.norm_low,
+                    cfg.norm_high,
+                    cfg.norm_intensity_min,
+                    cfg.norm_intensity_max,
+                ],
+                "clip": [cfg.clip_low, cfg.clip_high],
                 "model_3d": args.model_3d,
                 "iou_vs_teacher": iou,
             }

@@ -1,0 +1,119 @@
+"""Deploying the distilled student through the batch drivers (--model).
+
+The point of distillation is replacing the classical pipeline's expensive
+stages at deployment; these tests close that loop: train a small student on
+a phantom cohort, write the orbax checkpoint, and run BOTH batch drivers
+with --model, asserting the export contract holds and the student's masks
+land where the teacher's do.
+"""
+
+import numpy as np
+import pytest
+
+from nm03_capstone_project_tpu.cli.runner import CohortProcessor
+from nm03_capstone_project_tpu.config import BatchConfig, PipelineConfig
+from nm03_capstone_project_tpu.data.synthetic import write_synthetic_cohort
+
+CFG = PipelineConfig(canvas=64, render_size=64, min_dim=32)
+
+
+@pytest.fixture(scope="module")
+def cohort(tmp_path_factory):
+    root = tmp_path_factory.mktemp("deploy_cohort")
+    write_synthetic_cohort(root, n_patients=2, n_slices=4, height=64, width=60)
+    return root
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory, cohort):
+    """A quickly trained student checkpoint over the same cohort."""
+    import jax
+
+    from nm03_capstone_project_tpu.cli.runner import decode_and_guard
+    from nm03_capstone_project_tpu.data.discovery import (
+        find_patient_dirs,
+        load_dicom_files_for_patient,
+    )
+    from nm03_capstone_project_tpu.models import (
+        distill_batch,
+        fit,
+        init_unet,
+        prepare_student_inputs,
+    )
+    from nm03_capstone_project_tpu.models.checkpoint import save_params
+
+    pixels, dims = [], []
+    for pid in find_patient_dirs(cohort):
+        for f in load_dicom_files_for_patient(cohort, pid):
+            px = decode_and_guard(f, CFG)
+            canvas = np.zeros((CFG.canvas, CFG.canvas), np.float32)
+            canvas[: px.shape[0], : px.shape[1]] = px
+            pixels.append(canvas)
+            dims.append(px.shape)
+    px = np.stack(pixels)
+    dm = np.asarray(dims, np.int32)
+    labels = distill_batch(px, dm, CFG)
+    x = prepare_student_inputs(px, CFG)
+    params = init_unet(jax.random.PRNGKey(0), base=8)
+    params, losses = fit(params, x, labels, dm, steps=200, lr=3e-3)
+    assert losses[-1] < losses[0]
+    ckpt = tmp_path_factory.mktemp("ckpt") / "checkpoint"
+    save_params(ckpt, params, meta={"canvas": CFG.canvas, "model_3d": False})
+    return ckpt
+
+
+def _load(ckpt):
+    from nm03_capstone_project_tpu.models.checkpoint import load_params
+
+    params, _ = load_params(ckpt)
+    return params
+
+
+@pytest.mark.parametrize("mode", ["sequential", "parallel"])
+def test_driver_deploys_student(cohort, checkpoint, tmp_path, mode):
+    proc = CohortProcessor(
+        cohort,
+        tmp_path / mode,
+        cfg=CFG,
+        batch_cfg=BatchConfig(batch_size=3, io_workers=2),
+        mode=mode,
+        model_params=_load(checkpoint),
+    )
+    summary = proc.process_all_patients()
+    assert summary.succeeded_slices == 8
+    jpgs = list((tmp_path / mode).rglob("*.jpg"))
+    assert len(jpgs) == 16  # the full pair-export contract, student compute
+
+
+def test_student_masks_overlap_teacher(cohort, checkpoint, tmp_path):
+    """The deployed student finds the lesions the teacher finds (IoU, not
+    bit-equality — it is a learned approximation)."""
+    import jax.numpy as jnp
+
+    from nm03_capstone_project_tpu.cli.runner import (
+        _compiled_batch_mask_fn,
+        _student_batch_mask,
+        decode_and_guard,
+    )
+    from nm03_capstone_project_tpu.data.discovery import (
+        find_patient_dirs,
+        load_dicom_files_for_patient,
+    )
+
+    pid = find_patient_dirs(cohort)[0]
+    slices = []
+    for f in load_dicom_files_for_patient(cohort, pid):
+        px = decode_and_guard(f, CFG)
+        canvas = np.zeros((CFG.canvas, CFG.canvas), np.float32)
+        canvas[: px.shape[0], : px.shape[1]] = px
+        slices.append((canvas, px.shape))
+    px = jnp.asarray(np.stack([c for c, _ in slices]))
+    dm = jnp.asarray(np.asarray([s for _, s in slices], np.int32))
+    teacher = np.asarray(_compiled_batch_mask_fn(CFG)(px, dm)).astype(bool)
+    student = np.asarray(
+        _student_batch_mask(_load(checkpoint), px, dm, CFG)
+    ).astype(bool)
+    union = (teacher | student).sum()
+    assert union > 0
+    iou = (teacher & student).sum() / union
+    assert iou > 0.5, f"student-vs-teacher IoU {iou:.3f}"
