@@ -161,7 +161,7 @@ def add_model_arg(parser: argparse.ArgumentParser) -> None:
     )
 
 
-def load_model_checkpoint(args: argparse.Namespace, cfg):
+def load_model_checkpoint(args: argparse.Namespace, cfg, want_3d: bool = False):
     """Load + validate the --model checkpoint; None when the flag is unset."""
     if not getattr(args, "model", None):
         return None
@@ -169,10 +169,12 @@ def load_model_checkpoint(args: argparse.Namespace, cfg):
 
     params, meta = load_params(args.model)
     meta = meta or {}
-    if meta.get("model_3d"):
+    if bool(meta.get("model_3d")) != want_3d:
+        have = "3D" if meta.get("model_3d") else "2D"
+        need = "3D" if want_3d else "2D"
         raise SystemExit(
-            f"--model {args.model} holds the 3D student; the batch drivers "
-            "deploy the 2D one"
+            f"--model {args.model} holds the {have} student; this driver "
+            f"deploys the {need} one"
         )
     ck = meta.get("canvas")
     if ck and int(ck) != cfg.canvas:

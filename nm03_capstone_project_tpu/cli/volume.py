@@ -42,6 +42,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="also write each patient's 3D mask as MetaImage (<patient>/mask.mhd)",
     )
     common.add_render_stage_arg(p)
+    common.add_model_arg(p)
     common.add_distributed_args(
         p,
         "Without --z-shard, patients are round-robin sharded across "
@@ -122,6 +123,35 @@ def _compiled_volume_fn(cfg):
     return jax.jit(f)
 
 
+def _make_student_volume_fn(model_params, cfg):
+    """Jitted 3D-student stand-in for the volume pipeline.
+
+    Depth pads to the U-Net's pooling multiple inside the jit (static per
+    compiled shape, same caching behavior as the classical volume fn);
+    compute is bf16 on TPU, f32 elsewhere (threshold output)."""
+    import jax
+    import jax.numpy as jnp
+
+    from nm03_capstone_project_tpu.core.backend import is_tpu_backend
+    from nm03_capstone_project_tpu.core.image import valid_mask
+    from nm03_capstone_project_tpu.models import predict_mask3d, prepare_student_inputs
+
+    params = jax.device_put(model_params)
+    dtype = jnp.bfloat16 if is_tpu_backend() else jnp.float32
+    pool_multiple = 2 ** len(model_params["enc"])  # one halving per level
+
+    @jax.jit
+    def f(vol, dims):
+        depth = vol.shape[0]
+        pad = (-depth) % pool_multiple
+        vp = jnp.pad(vol, ((0, pad), (0, 0), (0, 0)))
+        x = prepare_student_inputs(vp, cfg)
+        mask = predict_mask3d(params, x[None], dtype)[0][:depth]
+        return mask * valid_mask(dims, vol.shape[-2:]).astype(mask.dtype)
+
+    return f
+
+
 @functools.lru_cache(maxsize=4)
 def _compiled_volume_mask_fn(cfg):
     """Mask-only volume pipeline: the host-render path fetches 65 KB/plane
@@ -171,6 +201,18 @@ def run(args: argparse.Namespace) -> int:
     rank, world = common.init_distributed(args)
     base = common.resolve_base_path_sync(args, rank, world, tmp_root=Path(args.output))
     out_root = Path(args.output)
+    model_params = common.load_model_checkpoint(args, cfg, want_3d=True)
+    if model_params is not None and args.z_shard:
+        raise SystemExit(
+            "--model with --z-shard is unsupported: the 3D student runs "
+            "whole volumes (drop --z-shard; --distributed patient sharding "
+            "still applies)"
+        )
+    student_fn = (
+        _make_student_volume_fn(model_params, cfg)
+        if model_params is not None
+        else None
+    )
 
     # two multi-process layouts (see --distributed help): with --z-shard the
     # whole job cooperates volume-by-volume over the GLOBAL device set (rank
@@ -311,7 +353,15 @@ def run(args: argparse.Namespace) -> int:
                 )
                 with timer.section(f"compute/{pid}"):
                     gray = seg = None
-                    if zshard:
+                    if student_fn is not None:
+                        volj, dimsj = jnp.asarray(vol), jnp.asarray(dims)
+                        maskj = student_fn(volj, dimsj)
+                        mask = np.asarray(maskj)
+                        if not host_render and i_export:
+                            grayj, segj = _compiled_render_fn(cfg)(
+                                volj, maskj, dimsj
+                            )
+                    elif zshard:
                         from nm03_capstone_project_tpu.parallel import (
                             process_volume_zsharded,
                         )

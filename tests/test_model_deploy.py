@@ -85,6 +85,42 @@ def test_driver_deploys_student(cohort, checkpoint, tmp_path, mode):
     assert len(jpgs) == 16  # the full pair-export contract, student compute
 
 
+def test_volume_driver_deploys_3d_student(tmp_path):
+    """nm03-volume --model runs the 3D student end-to-end (contract only —
+    3D learning quality is covered by the train CLI tests)."""
+    import jax
+
+    from nm03_capstone_project_tpu.cli import volume as volume_cli
+    from nm03_capstone_project_tpu.models import init_unet3d
+    from nm03_capstone_project_tpu.models.checkpoint import save_params
+
+    ckpt = tmp_path / "ckpt3d"
+    params = init_unet3d(jax.random.PRNGKey(1), base=8)
+    save_params(
+        ckpt,
+        params,
+        meta={"canvas": 64, "model_3d": True, "norm": [0.5, 2.5, 0.0, 10000.0],
+              "clip": [0.68, 4000.0]},
+    )
+    out = tmp_path / "out"
+    rc = volume_cli.main([
+        "--synthetic", "2", "--synthetic-slices", "4",
+        "--canvas", "64", "--min-dim", "32", "--render-size", "64",
+        "--model", str(ckpt), "--output", str(out),
+    ])
+    assert rc == 0
+    assert len(list((out / "PGBM-0001").glob("*.jpg"))) == 8
+
+    # the 2D/3D checkpoint cross-check refuses the wrong driver
+    with pytest.raises(SystemExit, match="3D"):
+        from nm03_capstone_project_tpu.cli import parallel
+
+        parallel.main([
+            "--synthetic", "1", "--canvas", "64", "--min-dim", "32",
+            "--model", str(ckpt), "--output", str(tmp_path / "o2"),
+        ])
+
+
 def test_student_masks_overlap_teacher(cohort, checkpoint, tmp_path):
     """The deployed student finds the lesions the teacher finds (IoU, not
     bit-equality — it is a learned approximation)."""
@@ -109,10 +145,12 @@ def test_student_masks_overlap_teacher(cohort, checkpoint, tmp_path):
         slices.append((canvas, px.shape))
     px = jnp.asarray(np.stack([c for c, _ in slices]))
     dm = jnp.asarray(np.asarray([s for _, s in slices], np.int32))
-    teacher = np.asarray(_compiled_batch_mask_fn(CFG)(px, dm)).astype(bool)
+    # student first: the teacher fn DONATES its pixel argument, so it must
+    # be px's last use (donation is honored on TPU/GPU)
     student = np.asarray(
         _student_batch_mask(_load(checkpoint), px, dm, CFG)
     ).astype(bool)
+    teacher = np.asarray(_compiled_batch_mask_fn(CFG)(px, dm)).astype(bool)
     union = (teacher | student).sum()
     assert union > 0
     iou = (teacher & student).sum() / union
