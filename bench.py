@@ -167,6 +167,30 @@ def _bench_on(device, pixels, dims, reps, use_pallas=False):
     return pixels.shape[0] * reps / elapsed, checksum
 
 
+def _bench_student(device, pixels, dims, reps):
+    """slices/s of the deployed 2D student (cli.runner._student_batch_mask)
+    with train-default architecture, same enqueue-then-sync methodology."""
+    import jax
+    import jax.numpy as jnp
+
+    from nm03_capstone_project_tpu.cli.runner import _student_batch_mask
+    from nm03_capstone_project_tpu.config import PipelineConfig
+    from nm03_capstone_project_tpu.models import init_unet
+
+    cfg = PipelineConfig()
+    params = jax.device_put(init_unet(jax.random.PRNGKey(0), base=16), device)
+    px = jax.device_put(jnp.asarray(pixels), device)
+    dm = jax.device_put(jnp.asarray(dims), device)
+    fn = jax.jit(
+        lambda p, d: _student_batch_mask(params, p, d, cfg).astype(jnp.int32).sum()
+    )
+    int(fn(px, dm))  # compile + warm-up sync
+    t0 = time.perf_counter()
+    outs = [fn(px, dm) for _ in range(reps)]
+    int(outs[-1])
+    return pixels.shape[0] * reps / (time.perf_counter() - t0)
+
+
 def _time_stage(fn, args, reps):
     """Seconds per call: jit, warm up, enqueue ``reps``, one checksum sync."""
     import jax
@@ -379,6 +403,16 @@ def worker(
         except Exception as e:  # noqa: BLE001 — never lose the headline number
             emit({"stages_error": f"{e!r:.500}"})
             _log(f"stage timing failed: {e!r:.500}")
+        try:
+            # the deployment path (--model): distilled-student throughput at
+            # the winning batch. Weights don't affect speed, so a fresh init
+            # measures the real path without shipping a checkpoint.
+            s_tput = _bench_student(dev, pixels, dims, reps)
+            emit({"student_tput": round(s_tput, 2)})
+            _log(f"{dev.platform} student throughput: {s_tput:.2f} slices/s")
+        except Exception as e:  # noqa: BLE001
+            emit({"student_error": f"{e!r:.500}"})
+            _log(f"student timing failed: {e!r:.500}")
 
     print(_SENTINEL + json.dumps(result), flush=True)
 
@@ -570,6 +604,8 @@ def main() -> None:
             out["pallas_checksum_ok"] = accel["pallas_checksum_ok"]
         if "stages" in accel:
             out["stages"] = accel["stages"]
+        if "student_tput" in accel:
+            out["student_tput"] = accel["student_tput"]
         if accel["backend"] == "cpu":
             out["vs_baseline"] = 1.0
             out["error"] = "no accelerator backend available; measured cpu only"
@@ -585,6 +621,8 @@ def main() -> None:
         out["vs_baseline"] = 1.0
         if "stages" in cpu:
             out["stages"] = cpu["stages"]
+        if "student_tput" in cpu:
+            out["student_tput"] = cpu["student_tput"]
         out["error"] = "accelerator worker failed; cpu fallback measured"
     else:
         out["backend"] = "none"
