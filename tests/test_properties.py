@@ -91,11 +91,50 @@ def test_region_growing_is_exact_seeded_flood_fill(data):
         seeds[rng.integers(0, CANVAS), rng.integers(0, CANVAS)] = True
     lo, hi = 0.3, 0.8
     got = np.asarray(region_grow(px, seeds, lo, hi)).astype(bool)
+    from tests.test_volume import _oracle_region_grow
 
-    band = (px >= lo) & (px <= hi)
-    labels, _ = ndi.label(band, structure=ndi.generate_binary_structure(2, 1))
-    seed_labels = set(np.unique(labels[seeds & band])) - {0}
-    want = np.isin(labels, sorted(seed_labels)) & band
+    want = _oracle_region_grow(px, seeds, lo, hi).astype(bool)
+    np.testing.assert_array_equal(got, want)
+
+
+@settings(max_examples=8, deadline=None)
+@given(
+    data=st.data(),
+    op=st.sampled_from(["dilate", "erode"]),
+)
+def test_morphology3d_matches_scipy_six_connected(data, op):
+    from nm03_capstone_project_tpu.ops.volume import dilate3d, erode3d
+
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31 - 1)))
+    vol = (rng.random((8, 16, 16)) < 0.3).astype(np.uint8)
+    fn = dilate3d if op == "dilate" else erode3d
+    got = np.asarray(fn(vol, 3, "cross")).astype(bool)
+    structure = ndi.generate_binary_structure(3, 1)  # 6-connectivity
+    sfn = ndi.binary_dilation if op == "dilate" else ndi.binary_erosion
+    want = sfn(vol.astype(bool), structure=structure, border_value=0)
+    np.testing.assert_array_equal(got, want)
+
+
+@settings(max_examples=6, deadline=None)
+@given(data=st.data())
+def test_region_growing_3d_is_exact_seeded_flood_fill(data):
+    # 6-connected flood fill through the band, across slices
+    from nm03_capstone_project_tpu.ops.volume import region_grow_3d
+
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31 - 1)))
+    vol = rng.random((8, 16, 16)).astype(np.float32)
+    seeds = np.zeros_like(vol, bool)
+    for _ in range(data.draw(st.integers(1, 3))):
+        seeds[
+            rng.integers(0, 8), rng.integers(0, 16), rng.integers(0, 16)
+        ] = True
+    lo, hi = 0.3, 0.8
+    got = np.asarray(
+        region_grow_3d(vol, seeds, lo, hi, block_iters=8, max_iters=256)
+    ).astype(bool)
+    from tests.test_volume import _oracle_region_grow
+
+    want = _oracle_region_grow(vol, seeds, lo, hi).astype(bool)
     np.testing.assert_array_equal(got, want)
 
 
