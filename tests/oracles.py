@@ -1,0 +1,23 @@
+"""Shared NumPy/SciPy oracles (not a test module — safe to import from any
+test file without creating a duplicate module instance)."""
+
+import numpy as np
+import scipy.ndimage as ndimage
+
+
+def region_grow_oracle(volume, seeds, low, high, connectivity=None):
+    """Connected components of the band that contain a seed.
+
+    The one home of the seeded flood-fill oracle. ``connectivity`` defaults
+    to one-step (4-connected in 2D, 6-connected in 3D); pass 26 for the
+    full 3D cube.
+    """
+    band = (volume >= low) & (volume <= high)
+    if connectivity == 26:
+        structure = ndimage.generate_binary_structure(3, 3)
+    else:
+        structure = ndimage.generate_binary_structure(volume.ndim, 1)
+    labels, _ = ndimage.label(band, structure=structure)
+    hit = np.unique(labels[seeds & band])
+    hit = hit[hit != 0]
+    return np.isin(labels, hit).astype(np.uint8)

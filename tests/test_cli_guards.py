@@ -1,0 +1,103 @@
+"""Negative-path guards in the CLI plumbing (cli.common)."""
+
+import argparse
+
+import pytest
+
+from nm03_capstone_project_tpu.cli import common
+from nm03_capstone_project_tpu.config import PipelineConfig
+
+
+def _ns(**kw):
+    return argparse.Namespace(**kw)
+
+
+class TestInitDistributed:
+    def test_no_flag_is_single_process(self):
+        assert common.init_distributed(_ns(distributed=False)) == (0, 1)
+
+    def test_explicit_nproc_that_joins_nothing_is_fatal(self, monkeypatch):
+        # every worker silently processing the whole cohort into the same
+        # tree is the worst launcher failure mode — it must be a hard error
+        from nm03_capstone_project_tpu.parallel import distributed
+
+        monkeypatch.setattr(distributed, "initialize", lambda **kw: False)
+        monkeypatch.setattr(
+            distributed,
+            "process_info",
+            lambda: {"process_index": 0, "process_count": 1},
+        )
+        with pytest.raises(RuntimeError, match="joined no cluster"):
+            common.init_distributed(
+                _ns(
+                    distributed=True,
+                    coordinator_address="127.0.0.1:1",
+                    num_processes=2,
+                    process_id=0,
+                )
+            )
+
+    def test_autodetect_miss_degrades_with_warning(self, monkeypatch, capsys):
+        from nm03_capstone_project_tpu.parallel import distributed
+
+        monkeypatch.setattr(distributed, "initialize", lambda **kw: False)
+        monkeypatch.setattr(
+            distributed,
+            "process_info",
+            lambda: {"process_index": 0, "process_count": 1},
+        )
+        rank, world = common.init_distributed(
+            _ns(
+                distributed=True,
+                coordinator_address=None,
+                num_processes=None,
+                process_id=None,
+            )
+        )
+        assert (rank, world) == (0, 1)
+        assert "no cluster detected" in capsys.readouterr().err
+
+
+class TestModelCheckpointGuards:
+    def _ckpt(self, tmp_path, meta):
+        import jax
+
+        from nm03_capstone_project_tpu.models import init_unet
+        from nm03_capstone_project_tpu.models.checkpoint import save_params
+
+        path = tmp_path / "ckpt"
+        save_params(path, init_unet(jax.random.PRNGKey(0), base=8), meta=meta)
+        return path
+
+    def test_norm_clip_mismatch_is_fatal(self, tmp_path):
+        path = self._ckpt(
+            tmp_path,
+            {
+                "canvas": 256,
+                "model_3d": False,
+                "norm": [0.5, 2.5, 0.0, 10000.0],
+                "clip": [0.68, 4000.0],
+            },
+        )
+        cfg = PipelineConfig(clip_high=2000.0)  # deployment flag conflicts
+        with pytest.raises(SystemExit, match="clip constants"):
+            common.load_model_checkpoint(_ns(model=str(path)), cfg)
+
+    def test_matching_meta_loads(self, tmp_path):
+        path = self._ckpt(
+            tmp_path,
+            {
+                "canvas": 256,
+                "model_3d": False,
+                "norm": [0.5, 2.5, 0.0, 10000.0],
+                "clip": [0.68, 4000.0],
+            },
+        )
+        params = common.load_model_checkpoint(_ns(model=str(path)), PipelineConfig())
+        assert params is not None
+
+    def test_metaless_checkpoint_loads_permissively(self, tmp_path):
+        # older checkpoints without meta: no constants to check against
+        path = self._ckpt(tmp_path, None)
+        params = common.load_model_checkpoint(_ns(model=str(path)), PipelineConfig())
+        assert params is not None

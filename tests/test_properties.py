@@ -91,27 +91,9 @@ def test_region_growing_is_exact_seeded_flood_fill(data):
         seeds[rng.integers(0, CANVAS), rng.integers(0, CANVAS)] = True
     lo, hi = 0.3, 0.8
     got = np.asarray(region_grow(px, seeds, lo, hi)).astype(bool)
-    from tests.test_volume import _oracle_region_grow
+    from tests.oracles import region_grow_oracle
 
-    want = _oracle_region_grow(px, seeds, lo, hi).astype(bool)
-    np.testing.assert_array_equal(got, want)
-
-
-@settings(max_examples=8, deadline=None)
-@given(
-    data=st.data(),
-    op=st.sampled_from(["dilate", "erode"]),
-)
-def test_morphology3d_matches_scipy_six_connected(data, op):
-    from nm03_capstone_project_tpu.ops.volume import dilate3d, erode3d
-
-    rng = np.random.default_rng(data.draw(st.integers(0, 2**31 - 1)))
-    vol = (rng.random((8, 16, 16)) < 0.3).astype(np.uint8)
-    fn = dilate3d if op == "dilate" else erode3d
-    got = np.asarray(fn(vol, 3, "cross")).astype(bool)
-    structure = ndi.generate_binary_structure(3, 1)  # 6-connectivity
-    sfn = ndi.binary_dilation if op == "dilate" else ndi.binary_erosion
-    want = sfn(vol.astype(bool), structure=structure, border_value=0)
+    want = region_grow_oracle(px, seeds, lo, hi).astype(bool)
     np.testing.assert_array_equal(got, want)
 
 
@@ -132,9 +114,9 @@ def test_region_growing_3d_is_exact_seeded_flood_fill(data):
     got = np.asarray(
         region_grow_3d(vol, seeds, lo, hi, block_iters=8, max_iters=256)
     ).astype(bool)
-    from tests.test_volume import _oracle_region_grow
+    from tests.oracles import region_grow_oracle
 
-    want = _oracle_region_grow(vol, seeds, lo, hi).astype(bool)
+    want = region_grow_oracle(vol, seeds, lo, hi).astype(bool)
     np.testing.assert_array_equal(got, want)
 
 

@@ -71,22 +71,7 @@ class TestMorphology3D:
             )
 
 
-def _oracle_region_grow(volume, seeds, low, high, connectivity=None):
-    """Connected components of the band that contain a seed.
-
-    The one home of the flood-fill oracle, shared with test_properties.
-    ``connectivity`` defaults to one-step (4-connected in 2D, 6-connected in
-    3D); pass 26 for the full 3D cube.
-    """
-    band = (volume >= low) & (volume <= high)
-    if connectivity == 26:
-        structure = ndimage.generate_binary_structure(3, 3)
-    else:
-        structure = ndimage.generate_binary_structure(volume.ndim, 1)
-    labels, n = ndimage.label(band, structure=structure)
-    hit = np.unique(labels[seeds & band])
-    hit = hit[hit != 0]
-    return np.isin(labels, hit).astype(np.uint8)
+from tests.oracles import region_grow_oracle as _oracle_region_grow  # noqa: E402
 
 
 class TestRegionGrow3D:
