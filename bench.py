@@ -60,6 +60,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -80,6 +81,14 @@ PROBE_ATTEMPTS = 6
 PROBE_BACKOFF_S = 45
 ACCEL_TIMEOUT_S = 900  # ONE attempt; killing mid-compile wedges the tunnel
 CPU_TIMEOUT_S = 420
+# When the initial probe round finds the tunnel wedged, the orchestrator runs
+# the (tunnel-independent) CPU baseline immediately, then keeps re-probing the
+# accelerator at this spacing until the overall budget is spent — the round-2
+# lesson was that giving up after a 3-minute window forfeited the whole
+# round's TPU record while the orchestrator then idled 7 minutes on CPU work.
+PROBE_VIGIL_SPACING_S = 180
+VIGIL_BUDGET_ENV = "NM03_BENCH_VIGIL_BUDGET_S"
+VIGIL_BUDGET_DEFAULT_S = 2400.0  # total wall budget incl. the CPU baseline
 
 _SENTINEL = "@@BENCH_RESULT@@"
 
@@ -96,6 +105,42 @@ _STAGE_BOUND = {
     "region_grow_jump": "iteration (O(log) pointer-jumping schedule)",
     "cast_dilate": "memory (VPU reduce-window, HBM-limited)",
     "render": "memory (gather + compositing, HBM-limited)",
+}
+
+# Minimum algorithmic HBM traffic per stage in bytes, f(batch, canvas,
+# render_size): the data each stage MUST read + write (f32 in/out for the
+# float stages; the cast stage writes u8; render reads f32+u8 and writes two
+# u8 render canvases). Intra-stage temporaries that XLA keeps in
+# registers/VMEM are deliberately excluded — this is the lower bound that
+# makes achieved-GB/s an upper-bound-honest roofline figure (VERDICT r2
+# weak item 3). The iteration-bound growing stages have no static traffic
+# model (sweep count is data-dependent) and carry no roofline entry.
+_STAGE_MIN_BYTES = {
+    "normalize_clip": lambda b, n, r: 2 * b * n * n * 4,
+    "median7": lambda b, n, r: 2 * b * n * n * 4,
+    "sharpen": lambda b, n, r: 2 * b * n * n * 4,
+    "cast_dilate": lambda b, n, r: b * n * n * (4 + 1),
+    "render": lambda b, n, r: b * (n * n * (4 + 1) + 2 * r * r),
+}
+RENDER_SIZE = 512
+# the small batch of the two-point fit that separates per-dispatch overhead
+# (constant vs batch) from true device time (linear in batch)
+STAGE_SMALL_BATCH = 8
+
+# Peak HBM bandwidth (GB/s) by jax device_kind, public spec-sheet numbers;
+# NM03_HBM_PEAK_GBPS overrides. pct_of_hbm_peak is only emitted when the
+# kind is known (or overridden) — never against a guessed denominator.
+_HBM_PEAK_GBPS = {
+    "TPU v2": 700.0,
+    "TPU v3": 900.0,
+    "TPU v4": 1228.0,
+    "TPU v4i": 614.0,
+    "TPU v5e": 819.0,
+    "TPU v5 lite": 819.0,
+    "TPU v5p": 2765.0,
+    "TPU v5": 2765.0,
+    "TPU v6e": 1640.0,
+    "TPU v6 lite": 1640.0,
 }
 
 
@@ -209,12 +254,21 @@ def _time_stage(fn, args, reps):
     return (time.perf_counter() - t0) / reps
 
 
-def _stage_times(device, pixels, dims, reps):
-    """Per-stage device time (ms per 32-slice batch), stages jitted alone.
+def _stage_times(device, reps):
+    """Per-stage breakdown (ms per BATCH-slice batch), stages jitted alone.
 
     The fused pipeline is faster than the sum (XLA melts the elementwise
     stages into neighbours); this is the attribution breakdown, not a second
     throughput claim.
+
+    Each stage is timed at two batch sizes (STAGE_SMALL_BATCH and BATCH) and
+    the constant term is fitted out: the round-2 TPU record showed every
+    stage floored at 1.5-2.4 ms/batch regardless of work — per-dispatch
+    tunnel overhead, not device time (VERDICT r2 weak item 3). ``device_ms``
+    is the batch-linear component (true device time at the reference batch),
+    ``dispatch_floor_ms`` the constant; memory-bound stages additionally get
+    achieved GB/s against their minimum algorithmic traffic and, when the
+    chip's device_kind has a known spec peak, pct_of_hbm_peak.
     """
     import jax
     import jax.numpy as jnp
@@ -237,8 +291,6 @@ def _stage_times(device, pixels, dims, reps):
 
     cfg = PipelineConfig()
     cfg_jump = dataclasses.replace(cfg, grow_algorithm="jump")
-    px = jax.device_put(jnp.asarray(pixels), device)
-    dm = jax.device_put(jnp.asarray(dims), device)
 
     def vm(f):
         return jax.vmap(f)
@@ -268,26 +320,65 @@ def _stage_times(device, pixels, dims, reps):
     )
     f_render = vm(lambda p, m, d: render_pair(p, m, d, cfg))
 
-    # materialize each stage's input once (device-resident, off the clock)
-    normed = jax.jit(f_norm)(px, dm)
-    med = jax.jit(f_med)(normed)
-    pre = jax.jit(f_sharp)(med)
-    seg = jax.jit(f_grow)(pre, dm)
-    mask = jax.jit(f_post)(seg, dm)
+    def stage_args(batch):
+        """Materialize each stage's input on device, off the clock."""
+        pixels, dims = _make_batch(batch)
+        px = jax.device_put(jnp.asarray(pixels), device)
+        dm = jax.device_put(jnp.asarray(dims), device)
+        normed = jax.jit(f_norm)(px, dm)
+        med = jax.jit(f_med)(normed)
+        pre = jax.jit(f_sharp)(med)
+        seg = jax.jit(f_grow)(pre, dm)
+        mask = jax.jit(f_post)(seg, dm)
+        return {
+            "normalize_clip": (px, dm),
+            "median7": (normed,),
+            "sharpen": (med,),
+            "region_grow": (pre, dm),
+            "region_grow_jump": (pre, dm),
+            "cast_dilate": (seg, dm),
+            "render": (px, mask, dm),
+        }
 
+    big = stage_args(BATCH)
+    small = stage_args(STAGE_SMALL_BATCH)
+    kind = getattr(device, "device_kind", "unknown")
+    peak_env = os.environ.get("NM03_HBM_PEAK_GBPS")
+    peak = float(peak_env) if peak_env else _HBM_PEAK_GBPS.get(kind)
+
+    fns = {
+        "normalize_clip": f_norm,
+        "median7": f_med,
+        "sharpen": f_sharp,
+        "region_grow": f_grow,
+        "region_grow_jump": f_grow_jump,
+        "cast_dilate": f_post,
+        "render": f_render,
+    }
     stages = {}
-    for name, fn, args in (
-        ("normalize_clip", f_norm, (px, dm)),
-        ("median7", f_med, (normed,)),
-        ("sharpen", f_sharp, (med,)),
-        ("region_grow", f_grow, (pre, dm)),
-        ("region_grow_jump", f_grow_jump, (pre, dm)),
-        ("cast_dilate", f_post, (seg, dm)),
-        ("render", f_render, (px, mask, dm)),
-    ):
-        ms = _time_stage(fn, args, reps) * 1e3
-        stages[name] = {"ms_per_batch": round(ms, 3), "bound": _STAGE_BOUND[name]}
-        _log(f"stage {name}: {ms:.2f} ms/batch ({_STAGE_BOUND[name]})")
+    for name, fn in fns.items():
+        ms = _time_stage(fn, big[name], reps) * 1e3
+        ms_small = _time_stage(fn, small[name], reps) * 1e3
+        slope = (ms - ms_small) / (BATCH - STAGE_SMALL_BATCH)
+        device_ms = min(max(slope * BATCH, 0.0), ms)
+        entry = {
+            "ms_per_batch": round(ms, 3),
+            "bound": _STAGE_BOUND[name],
+            "device_ms": round(device_ms, 3),
+            "dispatch_floor_ms": round(ms - device_ms, 3),
+        }
+        bytes_fn = _STAGE_MIN_BYTES.get(name)
+        if bytes_fn and device_ms > 0:
+            gbps = bytes_fn(BATCH, CANVAS, RENDER_SIZE) / 1e9 / (device_ms / 1e3)
+            entry["achieved_gbps"] = round(gbps, 1)
+            if peak:
+                entry["pct_of_hbm_peak"] = round(100.0 * gbps / peak, 1)
+        stages[name] = entry
+        _log(
+            f"stage {name}: {ms:.2f} ms/batch (device {device_ms:.2f} + "
+            f"floor {ms - device_ms:.2f}) ({_STAGE_BOUND[name]})"
+            + (f" {entry['achieved_gbps']} GB/s" if "achieved_gbps" in entry else "")
+        )
     # region_grow_jump is an ALTERNATIVE schedule for the region_grow stage,
     # not an additional pipeline stage — keep it out of the share denominator
     total = sum(
@@ -296,7 +387,11 @@ def _stage_times(device, pixels, dims, reps):
     for name, s in stages.items():
         if total and name != "region_grow_jump":
             s["share"] = round(s["ms_per_batch"] / total, 3)
-    return stages
+    return {
+        "device_kind": kind,
+        "hbm_peak_gbps": peak,
+        "stages": stages,
+    }
 
 
 def _pin_platform(platform: str | None):
@@ -380,6 +475,18 @@ def worker(
             }
         )
     tput, batch, xla_sum, pixels, dims = best
+    # honest fused-pipeline roofline anchor: the mask program's minimum HBM
+    # traffic is one f32 read + one u8 write per pixel; at the measured
+    # slices/s that is the achieved end-to-end bandwidth (the pipeline is
+    # compute-dominated by the median network, so expect this far below the
+    # HBM peak — the utilization statement VERDICT r2 asked to make explicit)
+    emit(
+        {
+            "fused_min_traffic_gbps": round(
+                tput * CANVAS * CANVAS * (4 + 1) / 1e9, 2
+            )
+        }
+    )
 
     if want_pallas and on_tpu:
         try:
@@ -398,8 +505,14 @@ def worker(
         try:
             # stage attribution stays at the reference batch (32) so the
             # breakdown is comparable across rounds
-            s_pixels, s_dims = _make_batch(BATCH)
-            emit({"stages": _stage_times(dev, s_pixels, s_dims, STAGE_REPS)})
+            prof = _stage_times(dev, STAGE_REPS)
+            emit(
+                {
+                    "stages": prof["stages"],
+                    "device_kind": prof["device_kind"],
+                    "hbm_peak_gbps": prof["hbm_peak_gbps"],
+                }
+            )
         except Exception as e:  # noqa: BLE001 — never lose the headline number
             emit({"stages_error": f"{e!r:.500}"})
             _log(f"stage timing failed: {e!r:.500}")
@@ -422,8 +535,14 @@ def worker(
 # --------------------------------------------------------------------------
 
 
+# The currently-running worker child, if any — the SIGTERM best-so-far
+# handler must kill it (a hung client HOLDS the chip claim until it dies;
+# orphaning it would wedge the tunnel for whatever runs after us).
+_CURRENT_CHILD: list = []
+
+
 def _spawn(label, extra_args, env_overrides, timeout_s):
-    """Run this file in a subprocess; (rc, stdout) with rc=None on timeout."""
+    """Run this file in a subprocess; (rc, stdout, stderr), rc=None on timeout."""
     env = os.environ.copy()
     for key, val in env_overrides.items():
         if val is None:
@@ -432,23 +551,72 @@ def _spawn(label, extra_args, env_overrides, timeout_s):
             env[key] = val
     cmd = [sys.executable, os.path.abspath(__file__), *extra_args]
     _log(f"{label}: spawning (timeout {timeout_s}s)")
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env
+    )
+    _CURRENT_CHILD.append(proc)
     try:
-        proc = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=timeout_s, env=env
-        )
-    except subprocess.TimeoutExpired as e:
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        _, stderr = proc.communicate()
         _log(f"{label}: timed out after {timeout_s}s")
-        partial = e.stderr or b""
-        if partial:
-            if isinstance(partial, bytes):
-                partial = partial.decode(errors="replace")
-            _log(f"{label}: stderr before kill: {partial[-800:]}")
-        return None, ""
-    for line in proc.stderr.splitlines():
+        if stderr:
+            _log(f"{label}: stderr before kill: {stderr[-800:]}")
+        return None, "", stderr or ""
+    finally:
+        if proc in _CURRENT_CHILD:
+            _CURRENT_CHILD.remove(proc)
+    for line in stderr.splitlines():
         print(line, file=sys.stderr, flush=True)
     if proc.returncode != 0:
-        _log(f"{label}: rc={proc.returncode}; stderr tail: {proc.stderr[-800:]}")
-    return proc.returncode, proc.stdout
+        _log(f"{label}: rc={proc.returncode}; stderr tail: {stderr[-800:]}")
+    return proc.returncode, stdout, stderr
+
+
+def _git_sha() -> str:
+    """Short SHA of HEAD (+ dirty marker) so every benchmark record names the
+    exact code it measured — the round-2 chip artifact went stale against
+    HEAD with nothing in the file to prove it (VERDICT r2 weak item 5).
+
+    Deliberately duplicates utils/timing.py:git_sha: importing the package
+    (even `utils.timing` alone) triggers the package __init__, which imports
+    jax — and the orchestrator process must NEVER import jax, or a wedged
+    tunnel can hang the orchestrator itself at interpreter startup."""
+    cwd = os.path.dirname(os.path.abspath(__file__))
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10, cwd=cwd,
+        ).stdout.strip()
+        dirty = subprocess.run(
+            ["git", "status", "--porcelain"],
+            capture_output=True, text=True, timeout=10, cwd=cwd,
+        ).stdout.strip()
+        return sha + ("-dirty" if dirty else "") if sha else "unknown"
+    except Exception:  # noqa: BLE001 — never let stamping break the bench
+        return "unknown"
+
+
+def _claim_holder_snapshot() -> str:
+    """Best-effort list of processes that could be wedging the tunnel (a hung
+    client HOLDS the chip claim until it dies) — recorded on probe timeout so
+    a lost round is at least diagnosable (VERDICT r2 weak item 2)."""
+    try:
+        ps = subprocess.run(
+            ["ps", "-eo", "pid,etime,args"], capture_output=True, text=True,
+            timeout=10,
+        ).stdout
+        mine = str(os.getpid())
+        lines = [
+            l for l in ps.splitlines()
+            if any(k in l for k in ("jax", "axon", "bench", "python"))
+            and l.strip().split()[0] != mine
+            and "ps -eo" not in l
+        ]
+        return "\n".join(lines[:20])
+    except Exception:  # noqa: BLE001
+        return "unavailable"
 
 
 def _parse_sentinel(stdout: str):
@@ -458,7 +626,34 @@ def _parse_sentinel(stdout: str):
     return None
 
 
-def _probe_until_healthy(env_overrides, label) -> bool:
+# Per-attempt probe diagnostics for the emitted JSON: two rounds of headline
+# numbers were lost to an environment failure the artifacts couldn't diagnose
+# (VERDICT r2 weak item 2). Reset by main(); appended by _probe_once.
+_PROBE_HISTORY: list = []
+
+
+def _probe_once(env_overrides, label, t0) -> bool:
+    """One probe attempt, recorded in _PROBE_HISTORY with rc / duration /
+    stderr tail (and, on a timeout, a snapshot of candidate claim-holders)."""
+    start = time.monotonic()
+    rc, stdout, stderr = _spawn(label, ["--probe"], env_overrides, PROBE_TIMEOUT_S)
+    entry = {
+        "t_offset_s": round(start - t0, 1),
+        "rc": rc,
+        "duration_s": round(time.monotonic() - start, 1),
+    }
+    res = _parse_sentinel(stdout) if rc == 0 else None
+    if res is not None:
+        entry["backend"] = res["backend"]
+    else:
+        entry["stderr_tail"] = (stderr or "")[-400:]
+        if rc is None:  # timeout = wedge; record who might hold the claim
+            entry["claim_holders"] = _claim_holder_snapshot()
+    _PROBE_HISTORY.append(entry)
+    return res is not None
+
+
+def _probe_until_healthy(env_overrides, label, t0=None) -> bool:
     """Short probe attempts with backoff until the backend answers.
 
     A hung probe holds no chip claim (it never gets past device init), so
@@ -467,29 +662,79 @@ def _probe_until_healthy(env_overrides, label) -> bool:
     a FAST error (rc != 0, e.g. "Unable to initialize backend") is often
     transient and worth the full retry schedule, but a probe TIMEOUT means
     the tunnel is wedged — observed to persist for hours — so two
-    consecutive timeouts end the vigil instead of burning the whole
-    benchmark window on a dead tunnel.
+    consecutive timeouts end this INITIAL round quickly. Main() then runs the
+    CPU baseline (tunnel-independent) and hands the remaining budget to
+    _accel_vigil rather than giving up on the round (VERDICT r2 item 1).
     """
+    if t0 is None:
+        t0 = time.monotonic()
     consecutive_timeouts = 0
     for attempt in range(1, PROBE_ATTEMPTS + 1):
-        rc, stdout = _spawn(
-            f"{label} probe {attempt}/{PROBE_ATTEMPTS}",
-            ["--probe"],
-            env_overrides,
-            PROBE_TIMEOUT_S,
+        ok = _probe_once(
+            env_overrides, f"{label} probe {attempt}/{PROBE_ATTEMPTS}", t0
         )
-        res = _parse_sentinel(stdout) if rc == 0 else None
-        if res is not None:
-            _log(f"{label} probe ok: backend {res['backend']}")
+        if ok:
+            _log(f"{label} probe ok: backend {_PROBE_HISTORY[-1]['backend']}")
             return True
+        rc = _PROBE_HISTORY[-1]["rc"]
         consecutive_timeouts = consecutive_timeouts + 1 if rc is None else 0
         if consecutive_timeouts >= 2:
-            _log(f"{label}: two probe timeouts — tunnel wedged, giving up")
+            _log(f"{label}: two probe timeouts — tunnel wedged; "
+                 "deferring to post-baseline vigil")
             return False
         if attempt < PROBE_ATTEMPTS:
             _log(f"{label} probe failed; backing off {PROBE_BACKOFF_S}s")
             time.sleep(PROBE_BACKOFF_S)
     return False
+
+
+def _accel_vigil(env_overrides, t0, deadline) -> bool:
+    """Spaced re-probes until the tunnel answers or the budget is spent.
+
+    Runs AFTER the CPU baseline is banked, so every minute here is a minute
+    that could still win the round's accelerator record — the round-2 bench
+    forfeited its window 3 minutes in and then idled through 7 minutes of
+    CPU work with no re-probe (VERDICT r2 weak item 1).
+    """
+    attempt = 0
+    while True:
+        # probe on loop entry: minutes of CPU-baseline work just elapsed
+        # since the last probe, so sleeping first would idle real budget
+        attempt += 1
+        if _probe_once(env_overrides, f"vigil probe {attempt}", t0):
+            _log(f"vigil: tunnel recovered on re-probe {attempt}")
+            return True
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            _log("vigil: budget exhausted; emitting with what we have")
+            return False
+        wait = min(PROBE_VIGIL_SPACING_S, max(remaining - PROBE_TIMEOUT_S, 1))
+        _log(f"vigil: sleeping {wait:.0f}s ({remaining:.0f}s of budget left)")
+        time.sleep(wait)
+
+
+# (label, sections-path) of the in-flight worker, so the SIGTERM handler can
+# recover sections the worker banked before an external kill.
+_CURRENT_SECTIONS: list = []
+
+
+def _merge_sections(out_path, label) -> dict:
+    """Fold a worker's per-section checkpoint file into one record."""
+    merged: dict = {}
+    try:
+        with open(out_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    merged.update(json.loads(line))
+                except json.JSONDecodeError:
+                    # a timeout kill can land mid-write; drop the torn line
+                    _log(f"{label}: dropping torn section line ({len(line)}B)")
+    except OSError:
+        pass
+    return merged
 
 
 def _run_measurement(label, worker_args, env_overrides, timeout_s):
@@ -502,88 +747,32 @@ def _run_measurement(label, worker_args, env_overrides, timeout_s):
 
     fd, out_path = tempfile.mkstemp(prefix="bench_sections_", suffix=".jsonl")
     os.close(fd)
+    _CURRENT_SECTIONS.append((label, out_path))
     try:
         rc, stdout = _spawn(
             label, ["--worker", *worker_args, "--out", out_path], env_overrides, timeout_s
-        )
+        )[:2]
         full = _parse_sentinel(stdout) if rc == 0 else None
         if full is not None:
             return full
-        merged: dict = {}
-        with open(out_path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    merged.update(json.loads(line))
-                except json.JSONDecodeError:
-                    # a timeout kill can land mid-write; drop the torn line
-                    _log(f"{label}: dropping torn section line ({len(line)}B)")
+        merged = _merge_sections(out_path, label)
         if merged:
             _log(f"{label}: recovered partial sections {sorted(merged)}")
         return merged or None
     finally:
+        _CURRENT_SECTIONS[:] = [s for s in _CURRENT_SECTIONS if s[1] != out_path]
         os.unlink(out_path)
 
 
-def main() -> None:
-    # accelerator path: inherit env so the TPU tunnel registers. Gate the one
-    # long-timeout heavy attempt behind cheap probes — never burn the heavy
-    # attempt (or wedge the tunnel by killing it mid-claim) on a dead tunnel.
-    accel = None
-    if _probe_until_healthy({}, "accel"):
-        accel = _run_measurement(
-            "accel measurement",
-            [
-                "--reps",
-                str(TPU_REPS),
-                "--pallas",
-                "--stages",
-                "--batches",
-                ",".join(str(b) for b in ACCEL_BATCH_SWEEP),
-            ],
-            {},
-            ACCEL_TIMEOUT_S,
-        )
-    # a partial record without the headline number is useless — treat as lost
-    if accel is not None and "xla_tput" not in accel:
-        _log(f"accel sections incomplete ({sorted(accel)}); discarding")
-        accel = None
-
-    # CPU baseline in a scrubbed environment: the baseline process must never
-    # dial (or hang on) the accelerator tunnel. It runs at the SAME batch
-    # size that won the accelerator sweep so vs_baseline stays a
-    # same-program ratio.
-    cpu = None
-    if accel is None or accel["backend"] != "cpu":
-        # when the accelerator record is lost, let the fallback at least
-        # carry the per-stage breakdown so the round's JSON stays diagnosable
-        extra = ["--stages"] if accel is None else []
-        cpu_batch = accel.get("xla_batch", BATCH) if accel else BATCH
-        cpu = _run_measurement(
-            "cpu baseline",
-            [
-                "--platform",
-                "cpu",
-                "--reps",
-                str(CPU_REPS),
-                "--batches",
-                str(cpu_batch),
-                *extra,
-            ],
-            {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": None},
-            CPU_TIMEOUT_S,
-        )
-        if cpu is not None and "xla_tput" not in cpu:
-            cpu = None
-
+def _compose(accel, cpu, meta) -> dict:
+    """Fold the accel/cpu worker records into the one emitted JSON object."""
     out = {
         "metric": "slices_per_sec_per_chip",
         "value": 0.0,
         "unit": "slices/s",
         "vs_baseline": 0.0,
     }
+    out.update(meta)
     if accel is not None:
         tput = accel["xla_tput"]
         # only a result-identical pallas run may win the headline number —
@@ -604,14 +793,22 @@ def main() -> None:
             out["pallas_checksum_ok"] = accel["pallas_checksum_ok"]
         if "stages" in accel:
             out["stages"] = accel["stages"]
+        for key in ("device_kind", "hbm_peak_gbps", "fused_min_traffic_gbps"):
+            if key in accel:
+                out[key] = accel[key]
         if "student_tput" in accel:
             out["student_tput"] = accel["student_tput"]
         if accel["backend"] == "cpu":
             out["vs_baseline"] = 1.0
             out["error"] = "no accelerator backend available; measured cpu only"
         elif cpu is not None:
-            out["cpu_baseline_tput"] = round(cpu["xla_tput"], 2)
-            out["vs_baseline"] = round(tput / cpu["xla_tput"], 2)
+            # same-program ratio: prefer the CPU measurement at the batch
+            # size that won the accelerator sweep (the wedge-first CPU
+            # baseline sweeps all of ACCEL_BATCH_SWEEP up front)
+            base = cpu.get("xla_by_batch", {}).get(str(out.get("batch")))
+            base = base if base else cpu["xla_tput"]
+            out["cpu_baseline_tput"] = round(base, 2)
+            out["vs_baseline"] = round(tput / base, 2)
         else:
             out["vs_baseline"] = 1.0
             out["error"] = "cpu baseline worker failed; vs_baseline unknown"
@@ -619,16 +816,143 @@ def main() -> None:
         out["value"] = round(cpu["xla_tput"], 2)
         out["backend"] = "cpu"
         out["vs_baseline"] = 1.0
+        if "xla_batch" in cpu:
+            out["batch"] = cpu["xla_batch"]
+        if "xla_by_batch" in cpu:
+            out["xla_by_batch"] = cpu["xla_by_batch"]
         if "stages" in cpu:
             out["stages"] = cpu["stages"]
+        for key in ("device_kind", "hbm_peak_gbps", "fused_min_traffic_gbps"):
+            if key in cpu:
+                out[key] = cpu[key]
         if "student_tput" in cpu:
             out["student_tput"] = cpu["student_tput"]
         out["error"] = "accelerator worker failed; cpu fallback measured"
     else:
         out["backend"] = "none"
         out["error"] = "all measurement workers failed; see stderr"
+    return out
 
-    print(json.dumps(out), flush=True)
+
+def _measure_accel():
+    """One long-timeout accelerator attempt; None if the headline is lost."""
+    accel = _run_measurement(
+        "accel measurement",
+        [
+            "--reps",
+            str(TPU_REPS),
+            "--pallas",
+            "--stages",
+            "--batches",
+            ",".join(str(b) for b in ACCEL_BATCH_SWEEP),
+        ],
+        {},
+        ACCEL_TIMEOUT_S,
+    )
+    # a partial record without the headline number is useless — treat as lost
+    if accel is not None and "xla_tput" not in accel:
+        _log(f"accel sections incomplete ({sorted(accel)}); discarding")
+        accel = None
+    return accel
+
+
+_CPU_ENV = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": None}
+
+
+def main() -> None:
+    # Flow (VERDICT r2 item 1): quick accel probe round; on success, one
+    # long-timeout accel attempt. If the tunnel is wedged (or the attempt
+    # lost), bank the tunnel-independent CPU baseline IMMEDIATELY, then keep
+    # re-probing at PROBE_VIGIL_SPACING_S until the overall wall budget
+    # (NM03_BENCH_VIGIL_BUDGET_S, default 40 min) is spent — only then emit.
+    # The orchestrator never imports jax; all measurement is in subprocess
+    # workers with hard timeouts, and probe diagnostics land in the JSON.
+    t0 = time.monotonic()
+    budget_s = float(os.environ.get(VIGIL_BUDGET_ENV, VIGIL_BUDGET_DEFAULT_S))
+    deadline = t0 + budget_s
+    _PROBE_HISTORY.clear()
+    state = {
+        "accel": None,
+        "cpu": None,
+        "meta": {"git_sha": _git_sha(), "probe_history": _PROBE_HISTORY},
+    }
+
+    def _on_term(signum, frame):
+        # an external kill (driver timeout) mid-vigil must not cost the
+        # round its record: emit best-so-far and go down with rc 0. The
+        # in-flight worker is killed too — a hung client HOLDS the chip
+        # claim, so orphaning it would wedge the tunnel for whoever runs
+        # after us — and the sections it banked before the kill are
+        # recovered so a mid-measurement kill still keeps its headline.
+        for proc in list(_CURRENT_CHILD):
+            try:
+                proc.kill()
+            except OSError:
+                pass
+        for label, path in list(_CURRENT_SECTIONS):
+            merged = _merge_sections(path, label)
+            key = "accel" if "accel" in label else "cpu"
+            if merged.get("xla_tput") and state[key] is None:
+                state[key] = merged
+        state["meta"]["terminated"] = "signal mid-run; emitted best-so-far"
+        state["meta"]["elapsed_s"] = round(time.monotonic() - t0, 1)
+        print(json.dumps(_compose(state["accel"], state["cpu"], state["meta"])),
+              flush=True)
+        os._exit(0)
+
+    old_term = signal.signal(signal.SIGTERM, _on_term)
+
+    accel = None
+    if _probe_until_healthy({}, "accel", t0):
+        accel = _measure_accel()
+        state["accel"] = accel
+
+    cpu = None
+    if accel is None:
+        # tunnel wedged or attempt lost — bank the CPU baseline first (it
+        # cannot touch the tunnel), sweeping every accel batch size so the
+        # ratio stays same-program whatever batch later wins on the chip,
+        # and carrying the stage breakdown for diagnosability
+        cpu = _run_measurement(
+            "cpu baseline",
+            [
+                "--platform", "cpu",
+                "--reps", str(CPU_REPS),
+                "--batches", ",".join(str(b) for b in ACCEL_BATCH_SWEEP),
+                "--stages",
+            ],
+            _CPU_ENV,
+            CPU_TIMEOUT_S,
+        )
+        if cpu is not None and "xla_tput" not in cpu:
+            cpu = None
+        state["cpu"] = cpu
+        # now spend whatever budget remains waiting for the tunnel — the
+        # heavy attempt itself is not deadline-capped (real work > budget)
+        if _accel_vigil({}, t0, deadline):
+            accel = _measure_accel()
+            state["accel"] = accel
+    elif accel["backend"] != "cpu":
+        # accel record in hand: CPU baseline at exactly the winning batch
+        cpu = _run_measurement(
+            "cpu baseline",
+            [
+                "--platform", "cpu",
+                "--reps", str(CPU_REPS),
+                "--batches", str(accel.get("xla_batch", BATCH)),
+            ],
+            _CPU_ENV,
+            CPU_TIMEOUT_S,
+        )
+        if cpu is not None and "xla_tput" not in cpu:
+            cpu = None
+        state["cpu"] = cpu
+
+    state["meta"]["elapsed_s"] = round(time.monotonic() - t0, 1)
+    print(json.dumps(_compose(accel, cpu, state["meta"])), flush=True)
+    # only restore AFTER the record is on stdout — restoring first would
+    # reopen the very lost-record window the handler exists to close
+    signal.signal(signal.SIGTERM, old_term)
 
 
 if __name__ == "__main__":

@@ -90,8 +90,33 @@ def timeit_sync(fn, *args, warmup: int = 1, iters: int = 5) -> Dict[str, float]:
     }
 
 
+def git_sha() -> str:
+    """Short SHA of HEAD (+ ``-dirty``) of the repo containing this package.
+
+    Every results artifact carries the SHA it measured: the round-2 chip
+    record went stale against HEAD with nothing in the file to prove which
+    code it timed (VERDICT r2 weak item 5)."""
+    import subprocess
+
+    root = Path(__file__).resolve().parents[2]
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10, cwd=root,
+        ).stdout.strip()
+        dirty = subprocess.run(
+            ["git", "status", "--porcelain"],
+            capture_output=True, text=True, timeout=10, cwd=root,
+        ).stdout.strip()
+        return sha + ("-dirty" if dirty else "") if sha else "unknown"
+    except Exception:  # noqa: BLE001 — stamping must never break a run
+        return "unknown"
+
+
 def write_results_json(path: str, payload: dict) -> None:
-    """The in-tree replacement for the reference's out-of-tree results files."""
+    """The in-tree replacement for the reference's out-of-tree results files
+    (always stamped with the git SHA the numbers were measured at)."""
     p = Path(path)
     p.parent.mkdir(parents=True, exist_ok=True)
+    payload = {**payload, "git_sha": payload.get("git_sha", git_sha())}
     p.write_text(json.dumps(payload, indent=1, sort_keys=True) + "\n")

@@ -18,7 +18,12 @@ echo "== probe =="
 timeout 90 python bench.py --probe || { echo "tunnel not healthy; aborting"; exit 1; }
 
 echo "== bench =="
-timeout 1800 python bench.py > "results/bench_tpu_${STAMP}.json" 2>bench_stderr.log \
+# the probe above already gated on tunnel health, so cap bench's internal
+# wedge-recovery vigil (NM03_BENCH_VIGIL_BUDGET_S) — a mid-run wedge should
+# fail fast here and leave the chip window to the other drivers below.
+# timeout(1) sends SIGTERM, which bench.py catches to emit best-so-far.
+timeout 1800 env NM03_BENCH_VIGIL_BUDGET_S=600 \
+  python bench.py > "results/bench_tpu_${STAMP}.json" 2>bench_stderr.log \
   && cat "results/bench_tpu_${STAMP}.json" \
   || echo "bench failed; see bench_stderr.log"
 
