@@ -300,13 +300,23 @@ def allgather_cluster_counts(counts: "dict[str, int]", world: int) -> dict:
     from jax.experimental import multihost_utils
 
     keys = sorted(counts)
-    local = np.asarray([counts[k] for k in keys], np.int32)
-    gathered = np.asarray(multihost_utils.process_allgather(local)).reshape(
-        world, len(keys)
-    )
-    out = {k: int(gathered[:, i].sum()) for i, k in enumerate(keys)}
+    # Voxel-level counters (train.py passes per-slice inter/union sums, up
+    # to 65,536 per 256x256 slice) overflow int32 past ~33k slices/rank
+    # (ADVICE r2) — and an int64 array does NOT survive the collective,
+    # because jax canonicalizes it back to int32 when x64 is off (always,
+    # here). Transport as two sub-2^31 halves per counter (good to 2^61)
+    # and recombine in int64 on the host.
+    vals = np.asarray([counts[k] for k in keys], np.int64)
+    if (vals < 0).any():
+        raise ValueError(f"counters must be non-negative, got {counts}")
+    halves = np.stack([vals >> 30, vals & ((1 << 30) - 1)]).astype(np.int32)
+    gathered = np.asarray(
+        multihost_utils.process_allgather(halves), np.int64
+    ).reshape(world, 2, len(keys))
+    per_rank = (gathered[:, 0] << 30) | gathered[:, 1]
+    out = {k: int(per_rank[:, i].sum()) for i, k in enumerate(keys)}
     out["per_process"] = {
-        str(r): {k: int(gathered[r, i]) for i, k in enumerate(keys)}
+        str(r): {k: int(per_rank[r, i]) for i, k in enumerate(keys)}
         for r in range(world)
     }
     return out

@@ -24,6 +24,10 @@ def build_parser() -> argparse.ArgumentParser:
     common.add_pipeline_args(p)
     common.add_render_stage_arg(p)
     common.add_model_arg(p)
+    # run() already handles world>1 (patient shard + collective accounting);
+    # without this the advertised `nm03-sequential --distributed` died at
+    # argparse (ADVICE r2)
+    common.add_distributed_args(p)
     return p
 
 

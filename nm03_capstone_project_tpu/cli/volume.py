@@ -352,15 +352,18 @@ def run(args: argparse.Namespace) -> int:
                     getattr(args, "render_stage", "host") == "host"
                 )
                 with timer.section(f"compute/{pid}"):
+                    # The compute section holds only work every rank takes
+                    # identically (incl. the cooperative collectives). The
+                    # exporting rank's device render is DEFERRED into the
+                    # guarded region below: a rank-0-only failure there must
+                    # funnel into the export-outcome collective, or the other
+                    # ranks' collectives pair off-by-one for the rest of the
+                    # run (code-review r3).
                     gray = seg = None
                     if student_fn is not None:
                         volj, dimsj = jnp.asarray(vol), jnp.asarray(dims)
                         maskj = student_fn(volj, dimsj)
                         mask = np.asarray(maskj)
-                        if not host_render and i_export:
-                            grayj, segj = _compiled_render_fn(cfg)(
-                                volj, maskj, dimsj
-                            )
                     elif zshard:
                         from nm03_capstone_project_tpu.parallel import (
                             process_volume_zsharded,
@@ -392,67 +395,95 @@ def run(args: argparse.Namespace) -> int:
                         else:
                             maskj = out["mask"][:depth]
                             mask = np.asarray(maskj)
-                        if not host_render and i_export:
-                            # render is per-rank local math — only the
-                            # exporting rank computes it (the collective part
-                            # of this patient, the mask gather, is done)
-                            grayj, segj = _compiled_render_fn(cfg)(
-                                jnp.asarray(vol), maskj, jnp.asarray(dims)
-                            )
                     elif host_render:
                         maskj = _compiled_volume_mask_fn(cfg)(
                             jnp.asarray(vol), jnp.asarray(dims)
                         )
                         mask = np.asarray(maskj)
                     else:
+                        # single program computes mask + renders in one jit;
+                        # this branch never runs under z-shard (zshard takes
+                        # precedence), so materializing here cannot desync
                         maskj, grayj, segj = _compiled_volume_fn(cfg)(
                             jnp.asarray(vol), jnp.asarray(dims)
                         )
                         mask = np.asarray(maskj)
-                    if not host_render and i_export:
-                        gray = np.asarray(grayj)
-                        seg = np.asarray(segj)
+                        if not host_render and i_export:
+                            gray = np.asarray(grayj)
+                            seg = np.asarray(segj)
                 if not i_export:
                     # global z-shard, rank != 0: compute was cooperative but
-                    # rank 0 owns the export/manifest; count and move on
-                    ok_patients += 1
+                    # rank 0 owns the export/manifest. Learn its outcome
+                    # (collective, mirroring the load step) before counting,
+                    # so ok_patients — and the exit code — agree on every
+                    # rank (ADVICE r2)
+                    export_ok = _all_ranks_ok(True)
                     results[pid] = {"slices": depth, "mask_voxels": int(mask.sum())}
-                    continue
-                with timer.section(f"export/{pid}"):
-                    if not args.resume:
-                        clean_directory(out_root / pid)
-                    if host_render:
-                        from nm03_capstone_project_tpu.render.export import (
-                            render_export_pairs,
-                        )
-
-                        done = render_export_pairs(
-                            [
-                                (stems[i], vol[i], mask[i], dims)
-                                for i in range(depth)
-                            ],
-                            out_root / pid,
-                            cfg,
-                        )
+                    if export_ok:
+                        ok_patients += 1
                     else:
-                        done = export_pairs(
-                            [(stems[i], gray[i], seg[i]) for i in range(depth)],
-                            out_root / pid,
+                        print(
+                            f"Patient {pid}: export failed on the exporting rank",
+                            file=sys.stderr,
                         )
-                    for stem in done:
-                        manifest.record(pid, stem, STATUS_DONE)
-                    manifest.flush()
-                    if args.export_mhd:
-                        from nm03_capstone_project_tpu.data.imageio import (
-                            write_metaimage,
-                        )
+                    continue
+                export_error, missing = None, []
+                try:
+                    if not host_render and gray is None:
+                        # deferred rank-local render (student / z-shard
+                        # modes): per-rank local math, only the exporting
+                        # rank pays it — and inside this guard so a failure
+                        # reaches the outcome collective below
+                        with timer.section(f"render/{pid}"):
+                            grayj, segj = _compiled_render_fn(cfg)(
+                                jnp.asarray(vol), maskj, jnp.asarray(dims)
+                            )
+                            gray = np.asarray(grayj)
+                            seg = np.asarray(segj)
+                    with timer.section(f"export/{pid}"):
+                        if not args.resume:
+                            clean_directory(out_root / pid)
+                        if host_render:
+                            from nm03_capstone_project_tpu.render.export import (
+                                render_export_pairs,
+                            )
 
-                        write_metaimage(mask, out_root / pid / "mask.mhd")
-                missing = sorted(set(stems) - set(done))
-                for stem in missing:
-                    manifest.record(pid, stem, STATUS_FAILED)
+                            done = render_export_pairs(
+                                [
+                                    (stems[i], vol[i], mask[i], dims)
+                                    for i in range(depth)
+                                ],
+                                out_root / pid,
+                                cfg,
+                            )
+                        else:
+                            done = export_pairs(
+                                [(stems[i], gray[i], seg[i]) for i in range(depth)],
+                                out_root / pid,
+                            )
+                        for stem in done:
+                            manifest.record(pid, stem, STATUS_DONE)
+                        manifest.flush()
+                        if args.export_mhd:
+                            from nm03_capstone_project_tpu.data.imageio import (
+                                write_metaimage,
+                            )
+
+                            write_metaimage(mask, out_root / pid / "mask.mhd")
+                    missing = sorted(set(stems) - set(done))
+                    for stem in missing:
+                        manifest.record(pid, stem, STATUS_FAILED)
+                    if missing:
+                        manifest.flush()
+                except Exception as e:  # noqa: BLE001 — judged collectively
+                    # an export crash must still reach the outcome collective
+                    # below, or the waiting ranks would deadlock
+                    export_error = e
+                if global_zshard:
+                    _all_ranks_ok(export_error is None and not missing)
+                if export_error is not None:
+                    raise export_error
                 if missing:
-                    manifest.flush()
                     # success is "the JPEG pair exists" (runner contract)
                     print(
                         f"Patient {pid}: {len(missing)} slices failed to export",

@@ -123,6 +123,46 @@ def test_cli_arg_round_trip():
     assert (d.grow_low, d.grow_high) == (0.74, 0.91)
 
 
+def test_sequential_parser_accepts_distributed_flags():
+    # README advertises --distributed on every batch driver; the sequential
+    # parser silently lacked the group (ADVICE r2) so argparse rejected it
+    from nm03_capstone_project_tpu.cli.sequential import build_parser
+
+    args = build_parser().parse_args(
+        ["--synthetic", "1", "--distributed", "--num-processes", "2",
+         "--process-id", "1", "--coordinator-address", "h:1234"]
+    )
+    assert args.distributed and args.num_processes == 2
+
+
+def test_allgather_cluster_counts_survives_voxel_scale_counters(monkeypatch):
+    # voxel counters (up to 65536 per slice) overflowed the old int32 path
+    # past ~33k slices (ADVICE r2). The fix must survive jax's int64->int32
+    # canonicalization inside the multi-process collective (x64 is never
+    # enabled here), so simulate it: the stub casts whatever it is handed to
+    # int32, exactly what device_put does on the >1-process branch.
+    from jax.experimental import multihost_utils
+
+    from nm03_capstone_project_tpu.cli import common
+
+    def canonicalizing_allgather(arr):
+        squeezed = np.asarray(arr).astype(np.int32)  # would clip/wrap int64
+        return np.stack([squeezed, squeezed])  # pretend world=2, equal ranks
+
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather", canonicalizing_allgather
+    )
+    big = 70_000 * 65_536  # ~4.6e9 > 2**31
+    out = common.allgather_cluster_counts(
+        {"inter": big, "union": big + 1}, world=2
+    )
+    assert out["inter"] == 2 * big and out["union"] == 2 * (big + 1)
+    assert out["per_process"]["1"]["inter"] == big
+
+    with pytest.raises(ValueError, match="non-negative"):
+        common.allgather_cluster_counts({"inter": -1}, world=1)
+
+
 def test_export_failure_not_counted_as_success(cohort, tmp_path, monkeypatch):
     """A slice whose JPEG never hits disk must be FAILED, not DONE."""
     import nm03_capstone_project_tpu.render.export as export_mod
