@@ -616,6 +616,20 @@ class CohortProcessor:
             min_dim=self.cfg.min_dim,
             threads=threads,
         )
+        # parse failures retry through the Python reader: its envelope is a
+        # superset of the C++ parser's (compressed transfer syntaxes — RLE,
+        # JPEG lossless, baseline JPEG — decode in data/codecs.py only), so
+        # a compressed cohort still flows through the native fast path with
+        # per-slice fallback instead of failing wholesale
+        for i, (f, o, e) in enumerate(zip(batch_files, okf, errs)):
+            if not o and int(e) == 2:  # "DICOM parse failed"
+                px = decode_and_guard(f, self.cfg)
+                if px is not None:
+                    h, w = px.shape
+                    pixels[i] = 0.0  # slot may hold a partial native write
+                    pixels[i, :h, :w] = px
+                    dims[i] = (h, w)
+                    okf[i] = True
         stems = [f.stem for f in batch_files]
         bad = [s for s, o in zip(stems, okf) if not o]
         for f, o, e in zip(batch_files, okf, errs):

@@ -1,0 +1,392 @@
+"""Compressed-pixel codecs for the DICOM importer (host-side, pure Python).
+
+Closes the round-2 breadth gap vs the reference importer: FAST sits on DCMTK
+(reference src/include/FAST/FAST_directives.hpp:30 via ``DICOMFileImporter``)
+and reads compressed transfer syntaxes; dicomlite previously rejected them
+all with transcode instructions. This module implements the two lossless
+families that dominate medical archives — both bit-exact, so the decoded
+float32 slice is identical to the uncompressed path:
+
+* **RLE Lossless** (1.2.840.10008.1.2.5): the DICOM PackBits variant,
+  PS3.5 §8.2.2 + Annex G — a 64-byte segment-offset header, one
+  byte-plane segment per sample byte (MSB plane first), each PackBits
+  run-length coded. Encoder + decoder (the encoder backs the writer's
+  round-trip tests and ``write_dicom(..., transfer_syntax=RLE_LOSSLESS)``).
+
+* **JPEG Lossless, Non-Hierarchical** (1.2.840.10008.1.2.4.57 and the
+  first-order-prediction .70 that DCMTK emits by default): ITU-T T.81
+  process 14, SOF3 — Huffman-coded prediction residuals, any selection
+  value 1-7, point transform, 2-16 bit precision, single component.
+  Decoder is general; the encoder emits selection value 1 (SV1), the .70
+  profile.
+
+Baseline 8-bit JPEG (1.2.840.10008.1.2.4.50, lossy) is handled in
+dicomlite via PIL — re-implementing a lossy DCT decoder buys no exactness
+and PIL ships in the image.
+
+These run on the host IO path (decode feeds the host->HBM prefetch queue),
+not on the TPU: entropy decoding is branchy byte-chasing, the exact shape
+of work a systolic array cannot express. NumPy vectorization keeps the
+byte-plane recomposition and prediction sweeps array-shaped.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+
+class CodecError(ValueError):
+    """Raised when a compressed pixel stream is malformed."""
+
+
+# ---------------------------------------------------------------------------
+# RLE Lossless (PS3.5 Annex G)
+# ---------------------------------------------------------------------------
+
+
+def packbits_decode(seg: bytes, expected: int) -> bytes:
+    """Decode one PackBits-coded RLE segment to exactly ``expected`` bytes."""
+    out = bytearray()
+    i, n = 0, len(seg)
+    while i < n and len(out) < expected:
+        ctrl = seg[i]
+        i += 1
+        if ctrl < 128:  # literal run: copy next ctrl+1 bytes
+            j = i + ctrl + 1
+            if j > n:
+                raise CodecError("RLE literal run overruns segment")
+            out += seg[i:j]
+            i = j
+        elif ctrl > 128:  # replicate run: next byte repeated 257-ctrl times
+            if i >= n:
+                raise CodecError("RLE replicate run missing its byte")
+            out += seg[i : i + 1] * (257 - ctrl)
+            i += 1
+        # ctrl == 128: no-op (spec: reserved, skip)
+    if len(out) < expected:
+        raise CodecError(f"RLE segment decoded {len(out)} bytes, expected {expected}")
+    return bytes(out[:expected])
+
+
+def packbits_encode(seg: bytes) -> bytes:
+    """PackBits-encode one byte plane (replicate runs >= 3, literals else)."""
+    out = bytearray()
+    i, n = 0, len(seg)
+    while i < n:
+        run = 1
+        while i + run < n and run < 128 and seg[i + run] == seg[i]:
+            run += 1
+        if run >= 3:
+            out += bytes((257 - run, seg[i]))
+            i += run
+            continue
+        # literal: extend until a >=3 replicate run starts (or 128 bytes)
+        j = i + run
+        while j < n and j - i < 128:
+            r = 1
+            while j + r < n and r < 3 and seg[j + r] == seg[j]:
+                r += 1
+            if r >= 3:
+                break
+            j += r
+        j = min(j, i + 128)
+        out += bytes((j - i - 1,)) + seg[i:j]
+        i = j
+    if len(out) % 2:
+        out.append(0)  # segments are padded to even length (Annex G.3.1)
+    return bytes(out)
+
+
+def rle_decode_frame(frame: bytes, rows: int, cols: int, itemsize: int) -> np.ndarray:
+    """Decode one RLE frame -> uint8/uint16 (rows, cols) array.
+
+    Segments are byte planes of the composite pixel code, most-significant
+    plane first (Annex G.2), so a 16-bit image recomposes as
+    ``(plane0 << 8) | plane1``.
+    """
+    if len(frame) < 64:
+        raise CodecError("RLE frame shorter than its 64-byte header")
+    header = struct.unpack_from("<16I", frame, 0)
+    nseg = header[0]
+    if nseg != itemsize:
+        raise CodecError(
+            f"RLE frame has {nseg} segments, expected {itemsize} "
+            "(one byte plane per sample byte, monochrome)"
+        )
+    offsets = list(header[1 : 1 + nseg])
+    if any(o < 64 or o > len(frame) for o in offsets) or sorted(offsets) != offsets:
+        raise CodecError(f"RLE segment offsets invalid: {offsets}")
+    npix = rows * cols
+    planes = []
+    for i, off in enumerate(offsets):
+        end = offsets[i + 1] if i + 1 < nseg else len(frame)
+        planes.append(
+            np.frombuffer(packbits_decode(frame[off:end], npix), np.uint8)
+        )
+    if itemsize == 1:
+        return planes[0].reshape(rows, cols).copy()
+    return (
+        (planes[0].astype(np.uint16) << 8) | planes[1].astype(np.uint16)
+    ).reshape(rows, cols)
+
+
+def rle_encode_frame(pixels: np.ndarray) -> bytes:
+    """Encode a uint8/uint16 (rows, cols) array as one RLE frame."""
+    if pixels.dtype == np.uint16:
+        flat = pixels.ravel()
+        planes = [(flat >> 8).astype(np.uint8).tobytes(), (flat & 0xFF).astype(np.uint8).tobytes()]
+    elif pixels.dtype == np.uint8:
+        planes = [pixels.ravel().tobytes()]
+    else:
+        raise CodecError(f"RLE encoder expects uint8/uint16, got {pixels.dtype}")
+    segs = [packbits_encode(p) for p in planes]
+    offsets, pos = [], 64
+    for s in segs:
+        offsets.append(pos)
+        pos += len(s)
+    header = struct.pack(
+        "<16I", len(segs), *offsets, *([0] * (15 - len(segs)))
+    )
+    return header + b"".join(segs)
+
+
+# ---------------------------------------------------------------------------
+# JPEG Lossless (ITU-T T.81 process 14, SOF3)
+# ---------------------------------------------------------------------------
+
+_SOI, _EOI, _SOF3, _DHT, _SOS, _DNL = 0xD8, 0xD9, 0xC3, 0xC4, 0xDA, 0xDC
+
+
+class _BitReader:
+    """MSB-first bit reader over entropy-coded data with FF00 byte stuffing."""
+
+    def __init__(self, buf: bytes, pos: int):
+        self.buf = buf
+        self.pos = pos
+        self.bits = 0
+        self.nbits = 0
+
+    def read_bit(self) -> int:
+        if self.nbits == 0:
+            if self.pos >= len(self.buf):
+                raise CodecError("JPEG entropy data truncated")
+            b = self.buf[self.pos]
+            self.pos += 1
+            if b == 0xFF:
+                if self.pos >= len(self.buf):
+                    raise CodecError("JPEG entropy data truncated at FF")
+                nxt = self.buf[self.pos]
+                if nxt == 0x00:
+                    self.pos += 1  # stuffed byte
+                else:
+                    # a real marker mid-scan (e.g. premature EOI)
+                    raise CodecError(f"unexpected JPEG marker FF{nxt:02x} in scan")
+            self.bits = b
+            self.nbits = 8
+        self.nbits -= 1
+        return (self.bits >> self.nbits) & 1
+
+    def read_bits(self, n: int) -> int:
+        v = 0
+        for _ in range(n):
+            v = (v << 1) | self.read_bit()
+        return v
+
+
+def _build_huffman(bits_counts, values):
+    """Canonical Huffman -> {(length, code): value} (T.81 Annex C)."""
+    table = {}
+    code = 0
+    k = 0
+    for length in range(1, 17):
+        for _ in range(bits_counts[length - 1]):
+            table[(length, code)] = values[k]
+            code += 1
+            k += 1
+        code <<= 1
+    return table
+
+
+def _huff_decode(reader: _BitReader, table) -> int:
+    code, length = 0, 0
+    while length < 16:
+        code = (code << 1) | reader.read_bit()
+        length += 1
+        v = table.get((length, code))
+        if v is not None:
+            return v
+    raise CodecError("invalid JPEG Huffman code")
+
+
+def _extend(bits: int, ssss: int) -> int:
+    """T.81 F.2.2.1: map SSSS magnitude bits to a signed difference."""
+    if ssss == 0:
+        return 0
+    if ssss == 16:
+        return 32768  # no magnitude bits follow (lossless-mode special case)
+    if bits < (1 << (ssss - 1)):
+        return bits - (1 << ssss) + 1
+    return bits
+
+
+def jpeg_lossless_decode(data: bytes) -> np.ndarray:
+    """Decode a single-component lossless JPEG (SOF3) stream.
+
+    Supports any predictor selection value 1-7, point transform, 2-16 bit
+    precision; restart intervals are not supported (DCMTK does not emit them
+    for single-frame medical images). Returns uint16 (rows, cols).
+    """
+    if len(data) < 4 or data[0] != 0xFF or data[1] != _SOI:
+        raise CodecError("not a JPEG stream (missing SOI)")
+    pos = 2
+    precision = rows = cols = None
+    huff_tables: dict = {}
+    sel = 1
+    pt = 0
+    table_id = 0
+    while pos + 4 <= len(data):
+        if data[pos] != 0xFF:
+            raise CodecError(f"expected JPEG marker at {pos}")
+        marker = data[pos + 1]
+        pos += 2
+        if marker == _EOI:
+            break
+        seglen = struct.unpack_from(">H", data, pos)[0]
+        seg_end = pos + seglen
+        if seg_end > len(data):
+            raise CodecError("truncated JPEG marker segment")
+        body = data[pos + 2 : seg_end]
+        if marker == _SOF3:
+            precision, rows, cols, ncomp = struct.unpack_from(">BHHB", body, 0)
+            if ncomp != 1:
+                raise CodecError(f"lossless JPEG: expected 1 component, got {ncomp}")
+        elif marker in (0xC0, 0xC1, 0xC2, 0xC5, 0xC6, 0xC7, 0xC9, 0xCA, 0xCB):
+            raise CodecError(
+                f"JPEG SOF{marker - 0xC0} is not lossless process 14 (SOF3)"
+            )
+        elif marker == _DHT:
+            b = 0
+            while b < len(body):
+                tc_th = body[b]
+                counts = list(body[b + 1 : b + 17])
+                nvals = sum(counts)
+                vals = list(body[b + 17 : b + 17 + nvals])
+                huff_tables[tc_th & 0x0F] = _build_huffman(counts, vals)
+                b += 17 + nvals
+        elif marker == _SOS:
+            ns = body[0]
+            if ns != 1:
+                raise CodecError(f"expected 1 scan component, got {ns}")
+            table_id = body[2] >> 4  # Td (DC table selects the lossless table)
+            sel = body[1 + 2 * ns]  # Ss = predictor selection value
+            pt = body[3 + 2 * ns] & 0x0F  # Al = point transform
+            pos = seg_end
+            break  # entropy-coded data follows
+        pos = seg_end
+    if precision is None or rows is None:
+        raise CodecError("JPEG stream missing SOF3 header")
+    if table_id not in huff_tables:
+        raise CodecError(f"JPEG scan references undefined Huffman table {table_id}")
+    if sel < 1 or sel > 7:
+        raise CodecError(f"unsupported lossless predictor selection {sel}")
+
+    table = huff_tables[table_id]
+    reader = _BitReader(data, pos)
+    out = np.zeros((rows, cols), np.int32)
+    default = 1 << (precision - pt - 1)
+    for y in range(rows):
+        row = out[y]
+        prev = out[y - 1] if y else None
+        for x in range(cols):
+            ssss = _huff_decode(reader, table)
+            diff = _extend(reader.read_bits(ssss) if 0 < ssss < 16 else 0, ssss)
+            if y == 0:
+                pred = default if x == 0 else row[x - 1]
+            elif x == 0:
+                pred = prev[0]
+            elif sel == 1:
+                pred = row[x - 1]
+            elif sel == 2:
+                pred = prev[x]
+            elif sel == 3:
+                pred = prev[x - 1]
+            else:
+                ra, rb, rc = int(row[x - 1]), int(prev[x]), int(prev[x - 1])
+                if sel == 4:
+                    pred = ra + rb - rc
+                elif sel == 5:
+                    pred = ra + ((rb - rc) >> 1)
+                elif sel == 6:
+                    pred = rb + ((ra - rc) >> 1)
+                else:  # sel == 7
+                    pred = (ra + rb) >> 1
+            row[x] = (int(pred) + diff) & 0xFFFF
+    return (out.astype(np.uint16) << pt)
+
+
+# The encoder's one Huffman table: categories 0..16 all get 5-bit codes
+# (17 <= 2^5, and the all-ones 5-bit code 0b11111 stays unused as T.81
+# requires). Optimal coding is not the point — bit-exact round-trip is.
+_ENC_BITS = [0, 0, 0, 0, 17] + [0] * 11
+_ENC_VALUES = list(range(17))
+
+
+def jpeg_lossless_encode(pixels: np.ndarray, precision: int = 16) -> bytes:
+    """Encode uint16 (rows, cols) as lossless JPEG, process 14 SV1 (.70).
+
+    Backs ``write_dicom(..., transfer_syntax=JPEG_LOSSLESS_SV1)`` and the
+    importer round-trip tests; decodes bit-exactly with any T.81 process-14
+    decoder (verified against our own general decoder).
+    """
+    if pixels.ndim != 2 or pixels.dtype != np.uint16:
+        raise CodecError(f"encoder expects 2D uint16, got {pixels.dtype} {pixels.shape}")
+    rows, cols = pixels.shape
+    px = pixels.astype(np.int32)
+    # SV1 prediction: left neighbour; first row predicts from above;
+    # origin predicts the midpoint 2^(P-1)
+    pred = np.empty_like(px)
+    pred[:, 1:] = px[:, :-1]
+    pred[1:, 0] = px[:-1, 0]
+    pred[0, 0] = 1 << (precision - 1)
+    diffs = (px - pred) & 0xFFFF  # modulo-2^16 difference arithmetic (T.81 H.1)
+
+    out = bytearray(b"\xff\xd8")  # SOI
+    sof = struct.pack(">BHHB", precision, rows, cols, 1) + bytes((1, 0x11, 0))
+    out += b"\xff\xc3" + struct.pack(">H", len(sof) + 2) + sof
+    dht = bytes((0x00,)) + bytes(_ENC_BITS) + bytes(_ENC_VALUES)
+    out += b"\xff\xc4" + struct.pack(">H", len(dht) + 2) + dht
+    sos = bytes((1, 1, 0x00, 1, 0, 0x00))  # 1 comp, Td=Ta=0, Ss=1(SV1), Se=0, Pt=0
+    out += b"\xff\xda" + struct.pack(">H", len(sos) + 2) + sos
+
+    acc, nacc = 0, 0
+    body = bytearray()
+
+    def put(value: int, nbits: int):
+        nonlocal acc, nacc
+        acc = (acc << nbits) | (value & ((1 << nbits) - 1))
+        nacc += nbits
+        while nacc >= 8:
+            nacc -= 8
+            byte = (acc >> nacc) & 0xFF
+            body.append(byte)
+            if byte == 0xFF:
+                body.append(0x00)  # byte stuffing
+
+    for d in diffs.ravel():
+        d = int(d)
+        if d >= 32768:
+            d -= 65536  # back to signed [-32768, 32767]
+        if d == -32768:
+            put(16, 5)  # SSSS=16: diff 32768 == -32768 mod 2^16, no extra bits
+            continue
+        mag = abs(d)
+        ssss = mag.bit_length()
+        put(ssss, 5)
+        if ssss:
+            put(d if d > 0 else d - 1, ssss)  # negative: low bits of d-1
+    if nacc:
+        put(0x7F, 8 - nacc)  # final-byte padding is 1-bits (T.81 F.1.2.3)
+    out += body + b"\xff\xd9"  # EOI
+    return bytes(out)

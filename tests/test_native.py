@@ -200,3 +200,37 @@ class TestNativeRunnerIntegration:
         )
         assert py.process_all_patients().succeeded_slices == 4
         assert digest(tmp_path / "nat") == digest(tmp_path / "py")
+
+    def test_native_batch_falls_back_to_python_for_compressed(self, tmp_path):
+        """An RLE-compressed slice in a native-loader batch decodes via the
+        Python reader's compressed envelope instead of failing the slice
+        (the C++ parser reads uncompressed LE only)."""
+        from nm03_capstone_project_tpu.cli.runner import CohortProcessor
+        from nm03_capstone_project_tpu.config import BatchConfig, PipelineConfig
+        from nm03_capstone_project_tpu.data.dicomlite import RLE_LOSSLESS
+
+        cfg = PipelineConfig(canvas=128, render_size=128)
+        root = tmp_path / "cohort" / "PGBM-0001" / "1-series"
+        root.mkdir(parents=True)
+        rng = np.random.default_rng(3)
+        want = {}
+        for i, ts in enumerate([None, RLE_LOSSLESS, None]):
+            img = rng.integers(0, 4000, size=(100, 100)).astype(np.uint16)
+            kw = {"transfer_syntax": ts} if ts else {}
+            write_dicom(root / f"1-{i + 1:02d}.dcm", img, **kw)
+            want[f"1-{i + 1:02d}"] = img
+        proc = CohortProcessor(
+            tmp_path / "cohort", tmp_path / "out", cfg=cfg,
+            batch_cfg=BatchConfig(batch_size=3, io_workers=2, use_native=True),
+            mode="parallel",
+        )
+        batch = proc._decode_batch_native(
+            sorted(root.glob("*.dcm")), pad_to=3
+        )
+        assert batch["bad"] == []
+        assert batch["stems"] == sorted(want)
+        for i, stem in enumerate(batch["stems"]):
+            np.testing.assert_array_equal(
+                batch["pixels"][i, :100, :100], want[stem].astype(np.float32)
+            )
+            assert tuple(batch["dims"][i]) == (100, 100)
