@@ -102,10 +102,17 @@ _STAGE_BOUND = {
     "median7": "compute (VPU Batcher-merge network, column presort)",
     "sharpen": "memory (9-tap shifted-add sweeps, HBM-limited)",
     "region_grow": "iteration (sequential one-ring fixpoint sweeps)",
-    "region_grow_jump": "iteration (O(log) pointer-jumping schedule)",
     "cast_dilate": "memory (VPU reduce-window, HBM-limited)",
     "render": "memory (gather + compositing, HBM-limited)",
 }
+# The `jump` growing schedule is out of the stage matrix (round 3): with the
+# pipeline's adaptive seed grid the band path length is bounded by seed
+# spacing and the dilate schedule wins at every canvas size measured
+# (512/1024/2048: 57/312/1532 ms vs 91/497/4265 ms on XLA:CPU). Its real win
+# region is sparse/single seeds with canvas-length paths, where it is 2-3x
+# faster AND converges while the dilate schedule hits max_iters — measured
+# and documented in docs/PERF.md; the op stays available via
+# --grow-algorithm jump.
 
 # Minimum algorithmic HBM traffic per stage in bytes, f(batch, canvas,
 # render_size): the data each stage MUST read + write (f32 in/out for the
@@ -287,10 +294,7 @@ def _stage_times(device, reps):
     from nm03_capstone_project_tpu.pipeline.slice_pipeline import segment
     from nm03_capstone_project_tpu.render.render import render_pair
 
-    import dataclasses
-
     cfg = PipelineConfig()
-    cfg_jump = dataclasses.replace(cfg, grow_algorithm="jump")
 
     def vm(f):
         return jax.vmap(f)
@@ -313,7 +317,6 @@ def _stage_times(device, reps):
         lambda p: sharpen(p, cfg.sharpen_gain, cfg.sharpen_sigma, cfg.sharpen_kernel)
     )
     f_grow = vm(lambda p, d: segment(p, d, cfg))
-    f_grow_jump = vm(lambda p, d: segment(p, d, cfg_jump))
     f_post = vm(
         lambda s, d: dilate(cast_uint8(s), cfg.morph_size)
         * valid_mask(d, s.shape[-2:]).astype(jnp.uint8)
@@ -335,7 +338,6 @@ def _stage_times(device, reps):
             "median7": (normed,),
             "sharpen": (med,),
             "region_grow": (pre, dm),
-            "region_grow_jump": (pre, dm),
             "cast_dilate": (seg, dm),
             "render": (px, mask, dm),
         }
@@ -351,7 +353,6 @@ def _stage_times(device, reps):
         "median7": f_med,
         "sharpen": f_sharp,
         "region_grow": f_grow,
-        "region_grow_jump": f_grow_jump,
         "cast_dilate": f_post,
         "render": f_render,
     }
@@ -379,13 +380,9 @@ def _stage_times(device, reps):
             f"floor {ms - device_ms:.2f}) ({_STAGE_BOUND[name]})"
             + (f" {entry['achieved_gbps']} GB/s" if "achieved_gbps" in entry else "")
         )
-    # region_grow_jump is an ALTERNATIVE schedule for the region_grow stage,
-    # not an additional pipeline stage — keep it out of the share denominator
-    total = sum(
-        s["ms_per_batch"] for n, s in stages.items() if n != "region_grow_jump"
-    )
-    for name, s in stages.items():
-        if total and name != "region_grow_jump":
+    total = sum(s["ms_per_batch"] for s in stages.values())
+    for s in stages.values():
+        if total:
             s["share"] = round(s["ms_per_batch"] / total, 3)
     return {
         "device_kind": kind,

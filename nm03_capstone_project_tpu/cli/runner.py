@@ -620,10 +620,20 @@ class CohortProcessor:
         # superset of the C++ parser's (compressed transfer syntaxes — RLE,
         # JPEG lossless, baseline JPEG — decode in data/codecs.py only), so
         # a compressed cohort still flows through the native fast path with
-        # per-slice fallback instead of failing wholesale
-        for i, (f, o, e) in enumerate(zip(batch_files, okf, errs)):
-            if not o and int(e) == 2:  # "DICOM parse failed"
-                px = decode_and_guard(f, self.cfg)
+        # per-slice fallback instead of failing wholesale. The retries run
+        # on their own small pool: a fully-compressed batch would otherwise
+        # decode serially on this one thread.
+        retry_idx = [
+            i for i, (o, e) in enumerate(zip(okf, errs))
+            if not o and int(e) == 2  # "DICOM parse failed"
+        ]
+        if retry_idx:
+            with cf.ThreadPoolExecutor(min(threads, len(retry_idx))) as pool:
+                retried = pool.map(
+                    lambda i: decode_and_guard(batch_files[i], self.cfg),
+                    retry_idx,
+                )
+            for i, px in zip(retry_idx, retried):
                 if px is not None:
                     h, w = px.shape
                     pixels[i] = 0.0  # slot may hold a partial native write

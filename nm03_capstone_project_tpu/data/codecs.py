@@ -156,7 +156,7 @@ def rle_encode_frame(pixels: np.ndarray) -> bytes:
 # JPEG Lossless (ITU-T T.81 process 14, SOF3)
 # ---------------------------------------------------------------------------
 
-_SOI, _EOI, _SOF3, _DHT, _SOS, _DNL = 0xD8, 0xD9, 0xC3, 0xC4, 0xDA, 0xDC
+_SOI, _EOI, _SOF3, _DHT, _SOS = 0xD8, 0xD9, 0xC3, 0xC4, 0xDA
 
 
 class _BitReader:
@@ -273,7 +273,11 @@ def jpeg_lossless_decode(data: bytes) -> np.ndarray:
                 counts = list(body[b + 1 : b + 17])
                 nvals = sum(counts)
                 vals = list(body[b + 17 : b + 17 + nvals])
-                huff_tables[tc_th & 0x0F] = _build_huffman(counts, vals)
+                # key on (class, id): an AC-class table sharing a DC table's
+                # destination id is legal T.81 and must not clobber it
+                huff_tables[(tc_th >> 4, tc_th & 0x0F)] = _build_huffman(
+                    counts, vals
+                )
                 b += 17 + nvals
         elif marker == _SOS:
             ns = body[0]
@@ -287,12 +291,12 @@ def jpeg_lossless_decode(data: bytes) -> np.ndarray:
         pos = seg_end
     if precision is None or rows is None:
         raise CodecError("JPEG stream missing SOF3 header")
-    if table_id not in huff_tables:
+    if (0, table_id) not in huff_tables:  # lossless scans use DC-class tables
         raise CodecError(f"JPEG scan references undefined Huffman table {table_id}")
     if sel < 1 or sel > 7:
         raise CodecError(f"unsupported lossless predictor selection {sel}")
 
-    table = huff_tables[table_id]
+    table = huff_tables[(0, table_id)]
     reader = _BitReader(data, pos)
     out = np.zeros((rows, cols), np.int32)
     default = 1 << (precision - pt - 1)
