@@ -213,6 +213,7 @@ class CohortProcessor:
         process_rank: int = 0,
         process_count: int = 1,
         model_params=None,
+        mask_sink=None,
     ):
         if mode not in ("sequential", "parallel"):
             raise ValueError(f"unknown mode: {mode}")
@@ -233,6 +234,13 @@ class CohortProcessor:
         # a trained student checkpoint (2D U-Net host pytree) replaces the
         # classical pipeline's compute when given (--model)
         self.model_params = model_params
+        # metrics hook: called (patient_id, stem, mask_2d) for every slice
+        # whose mask reaches the host, i.e. in host-render mode (the
+        # default) — scripts/student_eval.py consumes this for cohort-scale
+        # teacher-vs-student IoU without decoding exported JPEGs. In
+        # parallel mode it fires on IO-pool threads: the sink must be
+        # thread-safe.
+        self.mask_sink = mask_sink
         self._student_fns: dict = {}
         self.timer = Timer()
         self.out_root.mkdir(parents=True, exist_ok=True)
@@ -363,6 +371,8 @@ class CohortProcessor:
                 if host_render:
                     with self.timer.section("compute"):
                         mask = np.asarray(fn(padded, dims))
+                    if self.mask_sink is not None:
+                        self.mask_sink(patient_id, stem, mask)
                     with self.timer.section("export"):
                         written = render_export_pairs(
                             [(stem, padded, mask, dims)],
@@ -557,6 +567,9 @@ class CohortProcessor:
 
                     def fetch_render_export(mask_dev=mask_dev, batch=batch):
                         mask_b = np.asarray(mask_dev)
+                        if self.mask_sink is not None:
+                            for i, s in enumerate(batch["stems"]):
+                                self.mask_sink(patient_id, s, mask_b[i])
                         items = [
                             (
                                 s,

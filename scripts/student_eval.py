@@ -1,0 +1,230 @@
+"""Cohort-scale teacher-vs-student deployment eval -> results/student_eval.json.
+
+VERDICT r2 item 7: the distillation stack existed and deployed (--model on
+both 2D batch drivers), but no committed record measured the student's
+accuracy at deployment scale. This script closes that: it trains the 2D
+student against the classical-pipeline teacher, deploys it through BOTH
+batch drivers (CohortProcessor sequential + parallel — the real driver
+paths: discovery, DICOM decode, manifests, JPEG export) over the synthetic
+cohort, and records teacher-vs-student IoU per driver mode plus wall
+throughput, using the runner's ``mask_sink`` hook so the comparison is over
+exactly the masks the drivers export.
+
+CPU-sized defaults (minibatched training; XLA:CPU full-batch steps at
+deployment scale run ~33 s). The TPU revalidation pass
+(scripts/tpu_revalidate.sh) reruns it chip-sized:
+
+    python scripts/student_eval.py --steps 300 --minibatch 0
+
+Writes ``--out`` (default results/student_eval.json) via
+utils.timing.write_results_json, so the record carries the git SHA.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--patients", type=int, default=20)
+    ap.add_argument("--slices", type=int, default=22, help="slices per patient")
+    ap.add_argument("--train-slices", type=int, default=128,
+                    help="training subset size (the eval still runs the full cohort)")
+    ap.add_argument("--steps", type=int, default=120)
+    ap.add_argument("--minibatch", type=int, default=16,
+                    help="per-step minibatch; 0 = full batch")
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--base-channels", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="results/student_eval.json")
+    return ap.parse_args(argv)
+
+
+def _collect_run(cohort_root, out_dir, cfg, mode, model_params=None):
+    """One driver run; returns ({(pid, stem): bool mask}, summary, wall_s)."""
+    from nm03_capstone_project_tpu.cli.runner import CohortProcessor
+    from nm03_capstone_project_tpu.config import BatchConfig
+
+    masks: dict = {}
+    lock = threading.Lock()
+
+    def sink(pid, stem, mask):
+        with lock:  # parallel mode calls from IO-pool threads
+            masks[(pid, stem)] = np.asarray(mask).astype(bool)
+
+    proc = CohortProcessor(
+        cohort_root,
+        out_dir,
+        cfg=cfg,
+        batch_cfg=BatchConfig(),
+        mode=mode,
+        model_params=model_params,
+        mask_sink=sink,
+    )
+    t0 = time.perf_counter()
+    summary = proc.process_all_patients()
+    return masks, summary, time.perf_counter() - t0
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    t_start = time.perf_counter()
+
+    import shutil
+
+    import jax
+
+    from nm03_capstone_project_tpu.config import PipelineConfig
+
+    cfg = PipelineConfig()
+    backend = jax.devices()[0].platform
+    print(f"backend: {backend} ({jax.devices()[0].device_kind})")
+
+    root = Path(tempfile.mkdtemp(prefix="student_eval_cohort_"))
+    scratch = Path(tempfile.mkdtemp(prefix="student_eval_out_"))
+    try:
+        return _run_eval(args, cfg, backend, root, scratch, t_start)
+    finally:
+        # the revalidation pass reruns this on every chip window; leaked
+        # cohorts + 4 full export trees per run would fill /tmp
+        shutil.rmtree(root, ignore_errors=True)
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
+def _run_eval(args, cfg, backend, root, scratch, t_start) -> int:
+    import jax
+
+    from nm03_capstone_project_tpu.cli.runner import decode_and_guard
+    from nm03_capstone_project_tpu.data.discovery import (
+        find_patient_dirs,
+        load_dicom_files_for_patient,
+    )
+    from nm03_capstone_project_tpu.data.synthetic import write_synthetic_cohort
+    from nm03_capstone_project_tpu.models import (
+        distill_batch,
+        init_unet,
+        prepare_student_inputs,
+    )
+    from nm03_capstone_project_tpu.models.train import make_optimizer, train_step
+    from nm03_capstone_project_tpu.utils.timing import write_results_json
+
+    write_synthetic_cohort(
+        root, n_patients=args.patients, n_slices=args.slices, seed=args.seed
+    )
+
+    # ---- teacher labels + training subset --------------------------------
+    pixels, dims = [], []
+    for pid in find_patient_dirs(root):
+        for f in load_dicom_files_for_patient(root, pid):
+            if len(pixels) >= args.train_slices:
+                break
+            px = decode_and_guard(f, cfg)
+            if px is None:
+                continue
+            canvas = np.zeros((cfg.canvas, cfg.canvas), np.float32)
+            canvas[: px.shape[0], : px.shape[1]] = px
+            pixels.append(canvas)
+            dims.append(px.shape)
+    px = np.stack(pixels)
+    dm = np.asarray(dims, np.int32)
+    t0 = time.perf_counter()
+    labels = np.asarray(distill_batch(px, dm, cfg))
+    label_s = time.perf_counter() - t0
+    print(f"teacher labels: {len(px)} slices in {label_s:.1f}s "
+          f"({labels.sum()} positive voxels)")
+
+    # ---- distillation -----------------------------------------------------
+    x = np.asarray(prepare_student_inputs(px, cfg))
+    params = init_unet(jax.random.PRNGKey(args.seed), base=args.base_channels)
+    tx = make_optimizer(args.lr, total_steps=args.steps)
+    opt = tx.init(params)
+    rng = np.random.default_rng(args.seed)
+    losses = []
+    t0 = time.perf_counter()
+    for step in range(args.steps):
+        if args.minibatch and args.minibatch < len(x):
+            idx = rng.choice(len(x), args.minibatch, replace=False)
+            bx, bl, bd = x[idx], labels[idx], dm[idx]
+        else:
+            bx, bl, bd = x, labels, dm
+        params, opt, loss = train_step(params, opt, bx, bl, bd, tx=tx)
+        losses.append(float(loss))
+        if step % 20 == 0 or step == args.steps - 1:
+            print(f"step {step}: loss {losses[-1]:.4f}", flush=True)
+    train_s = time.perf_counter() - t0
+    if losses[-1] >= losses[0]:
+        print("WARNING: training loss did not improve", file=sys.stderr)
+
+    # ---- deployment eval through both drivers ----------------------------
+    record = {
+        "backend": backend,
+        "cohort": {"patients": args.patients, "slices_per_patient": args.slices},
+        "train": {
+            "slices": len(px),
+            "steps": args.steps,
+            "minibatch": args.minibatch,
+            "base_channels": args.base_channels,
+            "loss_first": round(losses[0], 4),
+            "loss_last": round(losses[-1], 4),
+            "label_s": round(label_s, 1),
+            "train_s": round(train_s, 1),
+        },
+        "modes": {},
+    }
+    for mode in ("sequential", "parallel"):
+        teacher, t_sum, t_wall = _collect_run(root, scratch / f"t-{mode}", cfg, mode)
+        student, s_sum, s_wall = _collect_run(
+            root, scratch / f"s-{mode}", cfg, mode, model_params=params
+        )
+        common_keys = sorted(set(teacher) & set(student))
+        inter = union = 0
+        per_patient: dict = {}
+        for key in common_keys:
+            t, s = teacher[key], student[key]
+            pi, pu = int((t & s).sum()), int((t | s).sum())
+            inter += pi
+            union += pu
+            acc = per_patient.setdefault(key[0], [0, 0])
+            acc[0] += pi
+            acc[1] += pu
+        # a zero union (no slices compared, or all-empty masks on both
+        # sides) is a FAILED comparison, scored 0 — never NaN, which would
+        # both slip past the min() gate below and break strict-JSON readers
+        iou = inter / union if union else 0.0
+        patient_ious = sorted(
+            i / u for i, u in per_patient.values() if u
+        )
+        record["modes"][mode] = {
+            "iou": round(iou, 4),
+            "degenerate": union == 0,
+            "patient_iou_min": round(patient_ious[0], 4) if patient_ious else None,
+            "patient_iou_median": (
+                round(patient_ious[len(patient_ious) // 2], 4)
+                if patient_ious else None
+            ),
+            "slices_compared": len(common_keys),
+            "teacher_ok": t_sum.succeeded_slices,
+            "student_ok": s_sum.succeeded_slices,
+            "teacher_slices_per_s": round(t_sum.succeeded_slices / t_wall, 2),
+            "student_slices_per_s": round(s_sum.succeeded_slices / s_wall, 2),
+        }
+        print(f"{mode}: IoU {iou:.4f} over {len(common_keys)} slices "
+              f"(teacher {t_wall:.1f}s, student {s_wall:.1f}s)")
+
+    record["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+    write_results_json(args.out, record)
+    print(f"wrote {args.out}")
+    worst = min(m["iou"] for m in record["modes"].values())
+    return 0 if worst > 0.5 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

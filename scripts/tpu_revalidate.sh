@@ -45,6 +45,12 @@ timeout 1200 python -m nm03_capstone_project_tpu.cli.volume \
   --results-json results/results_volume.json >/tmp/tpu-vol.log 2>&1 \
   || echo "volume failed; see /tmp/tpu-vol.log"
 
+echo "== student deployment eval =="
+# chip-sized: full-batch steps are cheap on the TPU (CPU needs minibatches)
+timeout 1800 python scripts/student_eval.py --steps 300 --minibatch 0 \
+  --out results/student_eval.json >/tmp/tpu-se.log 2>&1 \
+  || echo "student eval failed; see /tmp/tpu-se.log"
+
 echo "== summary =="
 python - <<'EOF'
 import json, pathlib

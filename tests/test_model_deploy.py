@@ -121,6 +121,35 @@ def test_volume_driver_deploys_3d_student(tmp_path):
         ])
 
 
+@pytest.mark.parametrize("mode", ["sequential", "parallel"])
+def test_mask_sink_sees_every_slice(cohort, tmp_path, mode):
+    """The runner's metrics hook fires once per successful slice with the
+    exact mask the driver exports (scripts/student_eval.py's foundation)."""
+    import threading
+
+    got = {}
+    lock = threading.Lock()
+
+    def sink(pid, stem, mask):
+        with lock:
+            got[(pid, stem)] = np.asarray(mask)
+
+    proc = CohortProcessor(
+        cohort,
+        tmp_path / mode,
+        cfg=CFG,
+        batch_cfg=BatchConfig(batch_size=3, io_workers=2),
+        mode=mode,
+        mask_sink=sink,
+    )
+    summary = proc.process_all_patients()
+    assert len(got) == summary.succeeded_slices == 8
+    for (pid, stem), mask in got.items():
+        assert pid.startswith("PGBM-")
+        assert mask.shape == (CFG.canvas, CFG.canvas)
+        assert mask.dtype == np.uint8
+
+
 def test_student_masks_overlap_teacher(cohort, checkpoint, tmp_path):
     """The deployed student finds the lesions the teacher finds (IoU, not
     bit-equality — it is a learned approximation)."""

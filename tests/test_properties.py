@@ -130,3 +130,49 @@ def test_normalize_clip_stay_in_declared_range(data, hw):
     )
     assert np.isfinite(out).all()
     assert out.min() >= 0.68 - 1e-6 and out.max() <= 4000.0 + 1e-6
+
+
+# ---------------------------------------------------------------------------
+# Compressed-pixel codecs (data/codecs.py): pure host-side code, no jit cost,
+# so these can afford arbitrary shapes per example.
+# ---------------------------------------------------------------------------
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    data=st.data(),
+    hw=st.tuples(st.integers(1, 48), st.integers(1, 48)),
+    kind=st.sampled_from(["noise", "runs", "gradient"]),
+)
+def test_rle_round_trip_any_content(data, hw, kind):
+    from nm03_capstone_project_tpu.data import codecs
+
+    h, w = hw
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31 - 1)))
+    if kind == "noise":
+        img = rng.integers(0, 65_536, (h, w), dtype=np.uint16)
+    elif kind == "runs":
+        img = np.repeat(
+            rng.integers(0, 65_536, (h, 1), dtype=np.uint16), w, axis=1
+        )
+    else:
+        img = (np.outer(np.arange(h), np.arange(w)) % 65_536).astype(np.uint16)
+    dec = codecs.rle_decode_frame(codecs.rle_encode_frame(img), h, w, 2)
+    np.testing.assert_array_equal(dec, img)
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    data=st.data(),
+    hw=st.tuples(st.integers(1, 40), st.integers(1, 40)),
+)
+def test_jpeg_lossless_round_trip_any_content(data, hw):
+    from nm03_capstone_project_tpu.data import codecs
+
+    h, w = hw
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31 - 1)))
+    # full-range noise maximizes diff magnitudes (exercises every SSSS
+    # category incl. the no-extra-bits 16 case)
+    img = rng.integers(0, 65_536, (h, w), dtype=np.uint16)
+    dec = codecs.jpeg_lossless_decode(codecs.jpeg_lossless_encode(img))
+    np.testing.assert_array_equal(dec, img)
