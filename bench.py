@@ -70,7 +70,7 @@ BATCH = 32
 # size is free to choose when the metric is throughput, and bigger batches
 # amortize dispatch/sync better on the chip; the CPU baseline then reruns
 # at the winning size so vs_baseline stays a same-program ratio
-ACCEL_BATCH_SWEEP = (32, 128)
+ACCEL_BATCH_SWEEP = (32, 128, 256)
 CANVAS = 256
 TPU_REPS = 40
 CPU_REPS = 2

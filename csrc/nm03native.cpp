@@ -152,18 +152,49 @@ struct DataSet {
   std::map<Tag, std::vector<uint8_t>> meta;
   const uint8_t* pixel_data = nullptr;
   size_t pixel_len = 0;
+  // encapsulated PixelData fragments (byte spans into the file buffer)
+  std::vector<std::pair<const uint8_t*, size_t>> fragments;
 };
 
+// Encapsulated PixelData: Basic Offset Table item, then one item per
+// fragment, closed by a sequence delimiter (PS3.5 A.4; mirrors
+// _read_fragments in dicomlite.py).
+bool read_fragments(ByteReader& r, DataSet* out) {
+  bool first = true;
+  while (!r.atend() && r.ok) {
+    Element e = read_element(r);
+    if (e.group == 0xFFFE && e.elem == 0xE0DD) return true;  // seq delimiter
+    if (e.group != 0xFFFE || e.elem != 0xE000 || e.length == kUndefined) {
+      set_error("malformed encapsulated PixelData item");
+      return false;
+    }
+    if (e.length > r.len - r.pos) {
+      set_error("encapsulated fragment overruns file");
+      return false;
+    }
+    if (!first)  // the first item is the Basic Offset Table
+      out->fragments.emplace_back(r.buf + r.pos, (size_t)e.length);
+    first = false;
+    r.pos += e.length;
+  }
+  set_error("encapsulated PixelData missing sequence delimiter");
+  return false;
+}
+
 bool parse_dataset(const uint8_t* buf, size_t len, bool explicit_vr,
-                   DataSet* out) {
+                   DataSet* out, bool encapsulated = false) {
   ByteReader r{buf, len, 0, explicit_vr};
   while (!r.atend()) {
     Element e = read_element(r);
     if (!r.ok) { set_error("truncated DICOM element structure"); return false; }
     if (e.group == 0x7FE0 && e.elem == 0x0010) {
       if (e.length == kUndefined) {
-        set_error("encapsulated (compressed) PixelData is not supported");
-        return false;
+        if (!encapsulated) {
+          set_error("encapsulated PixelData under an uncompressed transfer syntax");
+          return false;
+        }
+        if (!read_fragments(r, out)) return false;
+        continue;
       }
       // clamp a declared length that overruns the file (Python's slice
       // semantics in dicomlite.py:142); the rows*cols sufficiency check
@@ -220,6 +251,74 @@ double meta_float(const DataSet& ds, Tag t, double dflt) {
   try { return std::stod(ascii_value(it->second)); } catch (...) { return dflt; }
 }
 
+// ---------------------------------------------------------------------------
+// RLE Lossless (PS3.5 Annex G) — mirrors data/codecs.py:rle_decode_frame.
+// Decodes one frame into little-endian sample bytes (the layout the pixel
+// conversion loops below already read), recomposed from the MSB-first
+// byte-plane segments.
+// ---------------------------------------------------------------------------
+
+uint32_t le32_at(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+
+bool packbits_decode(const uint8_t* seg, size_t seg_len, uint8_t* out,
+                     size_t expected) {
+  size_t i = 0, got = 0;
+  while (i < seg_len && got < expected) {
+    uint8_t ctrl = seg[i++];
+    if (ctrl < 128) {  // literal run: copy next ctrl+1 bytes
+      size_t count = (size_t)ctrl + 1;
+      if (i + count > seg_len) { set_error("RLE literal run overruns segment"); return false; }
+      if (got + count > expected) count = expected - got;
+      std::memcpy(out + got, seg + i, count);
+      i += (size_t)ctrl + 1;
+      got += count;
+    } else if (ctrl > 128) {  // replicate: next byte repeated 257-ctrl times
+      if (i >= seg_len) { set_error("RLE replicate run missing its byte"); return false; }
+      size_t count = 257 - ctrl;
+      if (got + count > expected) count = expected - got;
+      std::memset(out + got, seg[i], count);
+      ++i;
+      got += count;
+    }
+    // ctrl == 128: no-op (reserved)
+  }
+  if (got < expected) { set_error("RLE segment decoded short"); return false; }
+  return true;
+}
+
+bool rle_decode_frame(const uint8_t* frame, size_t flen, size_t rows,
+                      size_t cols, int itemsize, std::vector<uint8_t>* out) {
+  if (flen < 64) { set_error("RLE frame shorter than its 64-byte header"); return false; }
+  uint32_t nseg = le32_at(frame);
+  if ((int)nseg != itemsize) { set_error("RLE segment count mismatch"); return false; }
+  uint32_t offsets[15];
+  for (uint32_t s = 0; s < nseg; ++s) {
+    offsets[s] = le32_at(frame + 4 + 4 * s);
+    if (offsets[s] < 64 || offsets[s] > flen ||
+        (s && offsets[s] < offsets[s - 1])) {
+      set_error("RLE segment offsets invalid");
+      return false;
+    }
+  }
+  size_t npix = rows * cols;
+  out->resize(npix * itemsize);
+  std::vector<uint8_t> plane(npix);
+  for (uint32_t s = 0; s < nseg; ++s) {
+    size_t start = offsets[s];
+    size_t end = (s + 1 < nseg) ? offsets[s + 1] : flen;
+    if (!packbits_decode(frame + start, end - start, plane.data(), npix))
+      return false;
+    // segment order is MSB plane first; emit little-endian sample bytes
+    size_t byte_index = (size_t)(itemsize - 1 - (int)s);
+    for (size_t i = 0; i < npix; ++i)
+      (*out)[i * itemsize + byte_index] = plane[i];
+  }
+  return true;
+}
+
 bool read_file(const char* path, std::vector<uint8_t>* out) {
   FILE* f = std::fopen(path, "rb");
   if (!f) { set_error(std::string("cannot open ") + path); return false; }
@@ -272,17 +371,29 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
   }
 
   bool explicit_vr;
+  bool rle = false;
   if (transfer_syntax == "1.2.840.10008.1.2.1") explicit_vr = true;
   else if (transfer_syntax == "1.2.840.10008.1.2") explicit_vr = false;
+  else if (transfer_syntax == "1.2.840.10008.1.2.5") {
+    // RLE Lossless decodes natively; other compressed syntaxes fall back
+    // to the Python reader (cli/runner.py retries parse failures there)
+    explicit_vr = true;
+    rle = true;
+  }
   else { set_error("unsupported transfer syntax: " + transfer_syntax); return false; }
 
   DataSet ds;
-  if (!parse_dataset(body, body_len, explicit_vr, &ds)) return false;
+  if (!parse_dataset(body, body_len, explicit_vr, &ds, rle)) return false;
 
   long rows = 0, cols = 0;
   if (!meta_int(ds, tag(0x0028, 0x0010), &rows) ||
-      !meta_int(ds, tag(0x0028, 0x0011), &cols) || !ds.pixel_data) {
+      !meta_int(ds, tag(0x0028, 0x0011), &cols) ||
+      (!ds.pixel_data && ds.fragments.empty())) {
     set_error("missing Rows/Columns/PixelData");
+    return false;
+  }
+  if (rle && ds.pixel_data) {
+    set_error("RLE transfer syntax with native PixelData (malformed file)");
     return false;
   }
   long bits = 16, pixrep = 0, samples = 1;
@@ -294,6 +405,29 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
   bool is_signed = pixrep == 1;
 
   size_t expected = (size_t)rows * cols * (bits / 8);
+  // Plausibility bound BEFORE any decode-side allocation: the uncompressed
+  // path is implicitly bounded by the file size (pixel_len < expected
+  // rejects), but RLE expands, so hostile Rows/Columns (65535 x 65535 =
+  // an 8.6 GB resize) must fail gracefully here, not via std::bad_alloc
+  // escaping the C ABI.
+  if (rows <= 0 || cols <= 0 || rows > 32768 || cols > 32768 ||
+      expected > ((size_t)1 << 28)) {
+    set_error("implausible Rows/Columns");
+    return false;
+  }
+  std::vector<uint8_t> rle_buf;
+  if (rle) {
+    if (ds.fragments.size() != 1) {
+      set_error("multi-fragment RLE (multi-frame?) out of envelope");
+      return false;
+    }
+    if (!rle_decode_frame(ds.fragments[0].first, ds.fragments[0].second,
+                          (size_t)rows, (size_t)cols, (int)(bits / 8),
+                          &rle_buf))
+      return false;
+    ds.pixel_data = rle_buf.data();
+    ds.pixel_len = rle_buf.size();
+  }
   if (ds.pixel_len < expected) { set_error("PixelData truncated"); return false; }
 
   double slope = meta_float(ds, tag(0x0028, 0x1053), 1.0);
@@ -570,13 +704,19 @@ NM03_EXPORT int nm03_version() { return 1; }
 // Returns 0 on success.
 NM03_EXPORT int nm03_dicom_read(const char* path, float* out, long max_elems,
                                 int* rows, int* cols) {
-  std::vector<uint8_t> raw;
-  if (!read_file(path, &raw)) return 1;
-  std::vector<float> pixels;
-  if (!decode_dicom(raw.data(), raw.size(), &pixels, rows, cols)) return 2;
-  if ((long)pixels.size() > max_elems) { set_error("output buffer too small"); return 3; }
-  std::memcpy(out, pixels.data(), pixels.size() * sizeof(float));
-  return 0;
+  try {
+    std::vector<uint8_t> raw;
+    if (!read_file(path, &raw)) return 1;
+    std::vector<float> pixels;
+    if (!decode_dicom(raw.data(), raw.size(), &pixels, rows, cols)) return 2;
+    if ((long)pixels.size() > max_elems) { set_error("output buffer too small"); return 3; }
+    std::memcpy(out, pixels.data(), pixels.size() * sizeof(float));
+    return 0;
+  } catch (const std::exception& e) {
+    // an exception must never unwind through the extern "C" boundary (UB)
+    set_error(std::string("decode exception: ") + e.what());
+    return 2;
+  }
 }
 
 // Thread-pool batch decode into a padded canvas arena.
@@ -612,8 +752,15 @@ NM03_EXPORT int nm03_load_batch(const char** paths, int n, int canvas_h,
       auto fail = [&](int code) { if (err) err[i] = code; };
       int rows = 0, cols = 0;
       std::vector<uint8_t> raw;
-      if (!read_file(paths[i], &raw)) { fail(1); continue; }
-      if (!decode_dicom(raw.data(), raw.size(), &pixels, &rows, &cols)) {
+      try {
+        if (!read_file(paths[i], &raw)) { fail(1); continue; }
+        if (!decode_dicom(raw.data(), raw.size(), &pixels, &rows, &cols)) {
+          fail(2);
+          continue;
+        }
+      } catch (const std::exception&) {
+        // per-slice catch-and-continue: an exception escaping a std::thread
+        // lambda would std::terminate the whole Python process
         fail(2);
         continue;
       }

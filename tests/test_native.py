@@ -45,6 +45,21 @@ class TestNativeDicom:
             nat, raw.astype(np.float32) * 0.5 + 10.0, rtol=1e-6
         )
 
+    def test_rle_matches_python_reader(self, tmp_path):
+        """The C++ parser decodes RLE Lossless natively, bit-identical to
+        the Python reader's codecs.py path."""
+        from nm03_capstone_project_tpu.data.dicomlite import RLE_LOSSLESS
+
+        rng = np.random.default_rng(7)
+        img = rng.integers(0, 4000, size=(70, 50)).astype(np.uint16)
+        img[:20, :20] = 99  # replicate runs
+        p = tmp_path / "rle.dcm"
+        write_dicom(p, img, rescale_slope=2.0, rescale_intercept=-10.0,
+                    transfer_syntax=RLE_LOSSLESS)
+        nat = native.read_dicom_native(p)
+        py = read_dicom(p)
+        np.testing.assert_array_equal(nat, py.pixels)
+
     def test_rejects_garbage(self, tmp_path):
         p = tmp_path / "bad.dcm"
         p.write_bytes(b"not a dicom file at all, definitely not")
