@@ -1,11 +1,18 @@
 #!/bin/bash
 # One-shot TPU revalidation: run after the accelerator tunnel recovers.
 #
-# Refreshes every chip-measured artifact with the CURRENT code:
-#   1. bench.py            -> results/bench_tpu_<date>.json (headline + stages)
-#   2. nm03-sequential     -> results/results_sequential.json (wall_s record)
-#   3. nm03-parallel       -> results/results_parallel.json
-#   4. nm03-volume         -> results/results_volume.json (3D path on chip)
+# Refreshes every chip-measured artifact with the CURRENT code, ordered by
+# marginal value so a mid-pass re-wedge costs the least-novel records first:
+#   1. bench.py            -> results/bench_tpu_<date>.json (headline +
+#                             stages incl. device_ms/roofline — the round's
+#                             single most important artifact)
+#   2. nm03-volume         -> results/results_volume.json (the 3D path has
+#                             never had a chip record)
+#   3. nm03-parallel       -> results/results_parallel.json (fast)
+#   4. nm03-sequential     -> results/results_sequential.json (slowest:
+#                             tunnel-latency-bound per slice)
+#   5. student_eval.py     -> results/student_eval.json (teacher-vs-student
+#                             IoU through both drivers, chip-sized training)
 #
 # Everything is sequenced (one chip; concurrent runs would contend) and each
 # step tolerates failure so a mid-run tunnel wedge still leaves the earlier
@@ -27,11 +34,11 @@ timeout 1800 env NM03_BENCH_VIGIL_BUDGET_S=600 \
   && cat "results/bench_tpu_${STAMP}.json" \
   || echo "bench failed; see bench_stderr.log"
 
-echo "== sequential cohort =="
-timeout 1500 python -m nm03_capstone_project_tpu.cli.sequential \
-  --synthetic 20 --synthetic-slices 22 --output /tmp/tpu-out-seq \
-  --results-json results/results_sequential.json >/tmp/tpu-seq.log 2>&1 \
-  || echo "sequential failed; see /tmp/tpu-seq.log"
+echo "== volume driver =="
+timeout 1200 python -m nm03_capstone_project_tpu.cli.volume \
+  --synthetic 4 --synthetic-slices 8 --output /tmp/tpu-out-vol --export-mhd \
+  --results-json results/results_volume.json >/tmp/tpu-vol.log 2>&1 \
+  || echo "volume failed; see /tmp/tpu-vol.log"
 
 echo "== parallel cohort =="
 timeout 1200 python -m nm03_capstone_project_tpu.cli.parallel \
@@ -39,11 +46,11 @@ timeout 1200 python -m nm03_capstone_project_tpu.cli.parallel \
   --results-json results/results_parallel.json >/tmp/tpu-par.log 2>&1 \
   || echo "parallel failed; see /tmp/tpu-par.log"
 
-echo "== volume driver =="
-timeout 1200 python -m nm03_capstone_project_tpu.cli.volume \
-  --synthetic 4 --synthetic-slices 8 --output /tmp/tpu-out-vol --export-mhd \
-  --results-json results/results_volume.json >/tmp/tpu-vol.log 2>&1 \
-  || echo "volume failed; see /tmp/tpu-vol.log"
+echo "== sequential cohort =="
+timeout 1500 python -m nm03_capstone_project_tpu.cli.sequential \
+  --synthetic 20 --synthetic-slices 22 --output /tmp/tpu-out-seq \
+  --results-json results/results_sequential.json >/tmp/tpu-seq.log 2>&1 \
+  || echo "sequential failed; see /tmp/tpu-seq.log"
 
 echo "== student deployment eval =="
 # chip-sized: full-batch steps are cheap on the TPU (CPU needs minibatches)
@@ -59,6 +66,6 @@ for f in sorted(pathlib.Path("results").glob("*.json")):
         d = json.loads(f.read_text())
     except Exception as e:
         print(f.name, "unreadable:", e); continue
-    keys = {k: d[k] for k in ("backend", "value", "vs_baseline", "wall_s", "mode") if k in d}
+    keys = {k: d[k] for k in ("backend", "value", "vs_baseline", "wall_s", "mode", "git_sha") if k in d}
     print(f.name, keys)
 EOF
