@@ -55,7 +55,7 @@ timeout 1500 python -m nm03_capstone_project_tpu.cli.sequential \
 echo "== student deployment eval =="
 # chip-sized: full-batch steps are cheap on the TPU (CPU needs minibatches)
 timeout 1800 python scripts/student_eval.py --steps 300 --minibatch 0 \
-  --out results/student_eval.json >/tmp/tpu-se.log 2>&1 \
+  --train-slices 440 --out results/student_eval.json >/tmp/tpu-se.log 2>&1 \
   || echo "student eval failed; see /tmp/tpu-se.log"
 
 echo "== summary =="
