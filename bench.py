@@ -371,7 +371,9 @@ def _stage_times(device, reps):
         bytes_fn = _STAGE_MIN_BYTES.get(name)
         if bytes_fn and device_ms > 0:
             gbps = bytes_fn(BATCH, CANVAS, RENDER_SIZE) / 1e9 / (device_ms / 1e3)
-            entry["achieved_gbps"] = round(gbps, 1)
+            # 3 decimals: tiny test shapes measure fractions of a GB/s, and
+            # rounding those to 0.0 made the figure (and its test) vanish
+            entry["achieved_gbps"] = round(gbps, 3)
             if peak:
                 entry["pct_of_hbm_peak"] = round(100.0 * gbps / peak, 1)
         stages[name] = entry

@@ -319,6 +319,213 @@ bool rle_decode_frame(const uint8_t* frame, size_t flen, size_t rows,
   return true;
 }
 
+// ---------------------------------------------------------------------------
+// JPEG Lossless (ITU-T T.81 process 14, SOF3) — mirrors
+// data/codecs.py:jpeg_lossless_decode. Any predictor selection 1-7, point
+// transform, 2-16 bit precision, single component, no restart intervals.
+// The Python decoder is the reference implementation; this one keeps
+// JPEG-lossless cohorts on the threaded native fast path (the pure-Python
+// per-pixel Huffman loop costs ~0.5 s per 256x256 slice).
+// ---------------------------------------------------------------------------
+
+struct JBitReader {
+  const uint8_t* buf;
+  size_t len, pos;
+  uint32_t acc = 0;
+  int nacc = 0;
+  bool ok = true;
+
+  int read_bit() {
+    if (nacc == 0) {
+      if (pos >= len) { ok = false; return 0; }
+      uint8_t b = buf[pos++];
+      if (b == 0xFF) {
+        if (pos >= len) { ok = false; return 0; }
+        if (buf[pos] == 0x00) ++pos;  // stuffed byte
+        else { ok = false; return 0; }  // real marker mid-scan
+      }
+      acc = b;
+      nacc = 8;
+    }
+    --nacc;
+    return (acc >> nacc) & 1;
+  }
+  uint32_t read_bits(int n) {
+    uint32_t v = 0;
+    for (int i = 0; i < n; ++i) v = (v << 1) | (uint32_t)read_bit();
+    return v;
+  }
+};
+
+// Canonical Huffman (T.81 Annex C): codes of each length are consecutive.
+struct JHuffTable {
+  uint32_t first_code[17];  // smallest code of each length
+  int first_index[17];      // index into values of that code
+  int count[17];            // codes of each length
+  std::vector<uint8_t> values;
+  bool present = false;
+};
+
+void build_huffman(const uint8_t* counts, const uint8_t* vals, int nvals,
+                   JHuffTable* t) {
+  t->values.assign(vals, vals + nvals);
+  uint32_t code = 0;
+  int index = 0;
+  for (int length = 1; length <= 16; ++length) {
+    t->first_code[length] = code;
+    t->first_index[length] = index;
+    t->count[length] = counts[length - 1];
+    code = (code + counts[length - 1]) << 1;
+    index += counts[length - 1];
+  }
+  t->present = true;
+}
+
+int huff_decode(JBitReader& r, const JHuffTable& t) {
+  uint32_t code = 0;
+  for (int length = 1; length <= 16; ++length) {
+    code = (code << 1) | (uint32_t)r.read_bit();
+    if (!r.ok) return -1;
+    if (t.count[length] &&
+        code < t.first_code[length] + (uint32_t)t.count[length]) {
+      return t.values[t.first_index[length] + (code - t.first_code[length])];
+    }
+  }
+  return -1;
+}
+
+// T.81 F.2.2.1: map SSSS magnitude bits to a signed difference.
+int32_t jpeg_extend(uint32_t bits, int ssss) {
+  if (ssss == 0) return 0;
+  if (ssss == 16) return 32768;  // no magnitude bits (lossless special case)
+  if (bits < (1u << (ssss - 1))) return (int32_t)bits - (1 << ssss) + 1;
+  return (int32_t)bits;
+}
+
+bool jpeg_lossless_decode(const uint8_t* data, size_t len,
+                          std::vector<uint16_t>* out, long* rows_out,
+                          long* cols_out) {
+  if (len < 4 || data[0] != 0xFF || data[1] != 0xD8) {
+    set_error("not a JPEG stream (missing SOI)");
+    return false;
+  }
+  size_t pos = 2;
+  int precision = -1;
+  long rows = 0, cols = 0;
+  JHuffTable tables[2][4];  // [class][id]; lossless scans use class 0
+  int sel = 1, pt = 0, table_id = 0;
+  bool got_sos = false;
+  while (pos + 4 <= len) {
+    if (data[pos] != 0xFF) { set_error("expected JPEG marker"); return false; }
+    uint8_t marker = data[pos + 1];
+    pos += 2;
+    if (marker == 0xD9) break;  // EOI
+    size_t seglen = ((size_t)data[pos] << 8) | data[pos + 1];
+    size_t seg_end = pos + seglen;
+    if (seglen < 2 || seg_end > len) {
+      // seglen includes its own 2 bytes; < 2 would underflow body_len
+      set_error("truncated JPEG marker segment");
+      return false;
+    }
+    const uint8_t* body = data + pos + 2;
+    size_t body_len = seglen - 2;
+    if (marker == 0xC3) {  // SOF3
+      if (body_len < 6) { set_error("short SOF3"); return false; }
+      precision = body[0];
+      rows = ((long)body[1] << 8) | body[2];
+      cols = ((long)body[3] << 8) | body[4];
+      if (body[5] != 1) { set_error("lossless JPEG: expected 1 component"); return false; }
+    } else if ((marker >= 0xC0 && marker <= 0xCB) && marker != 0xC3 &&
+               marker != 0xC4 && marker != 0xC8) {
+      set_error("JPEG SOF is not lossless process 14 (SOF3)");
+      return false;
+    } else if (marker == 0xC4) {  // DHT
+      size_t b = 0;
+      while (b + 17 <= body_len) {
+        uint8_t tc_th = body[b];
+        int tc = tc_th >> 4, th = tc_th & 0x0F;
+        int nvals = 0;
+        for (int i = 0; i < 16; ++i) nvals += body[b + 1 + i];
+        if (b + 17 + nvals > body_len || tc > 1 || th > 3) {
+          set_error("malformed DHT");
+          return false;
+        }
+        build_huffman(body + b + 1, body + b + 17, nvals, &tables[tc][th]);
+        b += 17 + (size_t)nvals;
+      }
+    } else if (marker == 0xDA) {  // SOS
+      if (body_len < 6 || body[0] != 1) { set_error("expected 1 scan component"); return false; }
+      table_id = body[2] >> 4;  // Td
+      sel = body[3];            // Ss = predictor selection value
+      pt = body[5] & 0x0F;      // Al = point transform
+      pos = seg_end;
+      got_sos = true;
+      break;  // entropy-coded data follows
+    }
+    pos = seg_end;
+  }
+  if (precision < 0 || !got_sos) { set_error("JPEG stream missing SOF3/SOS"); return false; }
+  if (table_id > 3 || !tables[0][table_id].present) {
+    set_error("JPEG scan references undefined Huffman table");
+    return false;
+  }
+  if (sel < 1 || sel > 7) { set_error("unsupported lossless predictor"); return false; }
+  if (rows <= 0 || cols <= 0 || rows > 32768 || cols > 32768) {
+    set_error("implausible JPEG dimensions");
+    return false;
+  }
+  if (precision < 2 || precision > 16 || pt >= precision) {
+    // T.81: lossless precision is 2-16; pt >= precision would make the
+    // default predictor's shift count negative (UB)
+    set_error("invalid JPEG precision/point-transform");
+    return false;
+  }
+
+  const JHuffTable& table = tables[0][table_id];
+  JBitReader r{data, len, pos};
+  out->assign((size_t)rows * cols, 0);
+  std::vector<int32_t> cur(cols), prev(cols);
+  int32_t dflt = 1 << (precision - pt - 1);
+  for (long y = 0; y < rows; ++y) {
+    for (long x = 0; x < cols; ++x) {
+      int ssss = huff_decode(r, table);
+      if (ssss < 0 || !r.ok) { set_error("invalid JPEG Huffman code"); return false; }
+      if (ssss > 16) {
+        // DHT values are arbitrary bytes; >16 would be shift-count UB in
+        // jpeg_extend and silent divergence from the Python reference
+        set_error("invalid JPEG difference category");
+        return false;
+      }
+      uint32_t extra = (ssss > 0 && ssss < 16) ? r.read_bits(ssss) : 0;
+      if (!r.ok) { set_error("JPEG entropy data truncated"); return false; }
+      int32_t diff = jpeg_extend(extra, ssss);
+      int32_t pred;
+      if (y == 0) {
+        pred = (x == 0) ? dflt : cur[x - 1];
+      } else if (x == 0) {
+        pred = prev[0];
+      } else {
+        int32_t ra = cur[x - 1], rb = prev[x], rc = prev[x - 1];
+        switch (sel) {
+          case 1: pred = ra; break;
+          case 2: pred = rb; break;
+          case 3: pred = rc; break;
+          case 4: pred = ra + rb - rc; break;
+          case 5: pred = ra + ((rb - rc) >> 1); break;
+          case 6: pred = rb + ((ra - rc) >> 1); break;
+          default: pred = (ra + rb) >> 1; break;
+        }
+      }
+      cur[x] = (pred + diff) & 0xFFFF;
+      (*out)[(size_t)y * cols + x] = (uint16_t)(cur[x] << pt);
+    }
+    std::swap(cur, prev);
+  }
+  *rows_out = rows;
+  *cols_out = cols;
+  return true;
+}
+
 bool read_file(const char* path, std::vector<uint8_t>* out) {
   FILE* f = std::fopen(path, "rb");
   if (!f) { set_error(std::string("cannot open ") + path); return false; }
@@ -371,19 +578,24 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
   }
 
   bool explicit_vr;
-  bool rle = false;
+  bool rle = false, jpegll = false;
   if (transfer_syntax == "1.2.840.10008.1.2.1") explicit_vr = true;
   else if (transfer_syntax == "1.2.840.10008.1.2") explicit_vr = false;
   else if (transfer_syntax == "1.2.840.10008.1.2.5") {
-    // RLE Lossless decodes natively; other compressed syntaxes fall back
-    // to the Python reader (cli/runner.py retries parse failures there)
+    // RLE Lossless and JPEG Lossless decode natively; other compressed
+    // syntaxes (baseline JPEG, JPEG-LS, J2K) fall back to the Python
+    // reader (cli/runner.py retries parse failures there)
     explicit_vr = true;
     rle = true;
+  } else if (transfer_syntax == "1.2.840.10008.1.2.4.57" ||
+             transfer_syntax == "1.2.840.10008.1.2.4.70") {
+    explicit_vr = true;
+    jpegll = true;
   }
   else { set_error("unsupported transfer syntax: " + transfer_syntax); return false; }
 
   DataSet ds;
-  if (!parse_dataset(body, body_len, explicit_vr, &ds, rle)) return false;
+  if (!parse_dataset(body, body_len, explicit_vr, &ds, rle || jpegll)) return false;
 
   long rows = 0, cols = 0;
   if (!meta_int(ds, tag(0x0028, 0x0010), &rows) ||
@@ -392,8 +604,8 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
     set_error("missing Rows/Columns/PixelData");
     return false;
   }
-  if (rle && ds.pixel_data) {
-    set_error("RLE transfer syntax with native PixelData (malformed file)");
+  if ((rle || jpegll) && ds.pixel_data) {
+    set_error("compressed transfer syntax with native PixelData (malformed file)");
     return false;
   }
   long bits = 16, pixrep = 0, samples = 1;
@@ -415,7 +627,7 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
     set_error("implausible Rows/Columns");
     return false;
   }
-  std::vector<uint8_t> rle_buf;
+  std::vector<uint8_t> decomp_buf;  // decoded samples as LE bytes
   if (rle) {
     if (ds.fragments.size() != 1) {
       set_error("multi-fragment RLE (multi-frame?) out of envelope");
@@ -423,10 +635,47 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
     }
     if (!rle_decode_frame(ds.fragments[0].first, ds.fragments[0].second,
                           (size_t)rows, (size_t)cols, (int)(bits / 8),
-                          &rle_buf))
+                          &decomp_buf))
       return false;
-    ds.pixel_data = rle_buf.data();
-    ds.pixel_len = rle_buf.size();
+    ds.pixel_data = decomp_buf.data();
+    ds.pixel_len = decomp_buf.size();
+  } else if (jpegll) {
+    // single fragment (the common single-frame case) decodes in place; a
+    // frame spanning fragments is joined first
+    const uint8_t* stream_ptr = ds.fragments[0].first;
+    size_t stream_len = ds.fragments[0].second;
+    std::vector<uint8_t> joined;
+    if (ds.fragments.size() > 1) {
+      for (const auto& f : ds.fragments)
+        joined.insert(joined.end(), f.first, f.first + f.second);
+      stream_ptr = joined.data();
+      stream_len = joined.size();
+    }
+    std::vector<uint16_t> samples;
+    long jr = 0, jc = 0;
+    if (!jpeg_lossless_decode(stream_ptr, stream_len, &samples, &jr, &jc))
+      return false;
+    if (jr != rows || jc != cols) {
+      set_error("JPEG frame dimensions disagree with DICOM header");
+      return false;
+    }
+    decomp_buf.resize(samples.size() * (bits / 8));
+    if (bits == 16) {
+      for (size_t i = 0; i < samples.size(); ++i) {
+        decomp_buf[2 * i] = (uint8_t)(samples[i] & 0xFF);
+        decomp_buf[2 * i + 1] = (uint8_t)(samples[i] >> 8);
+      }
+    } else {
+      for (size_t i = 0; i < samples.size(); ++i) {
+        if (samples[i] > 0xFF) {
+          set_error("lossless JPEG precision exceeds BitsAllocated=8");
+          return false;
+        }
+        decomp_buf[i] = (uint8_t)samples[i];
+      }
+    }
+    ds.pixel_data = decomp_buf.data();
+    ds.pixel_len = decomp_buf.size();
   }
   if (ds.pixel_len < expected) { set_error("PixelData truncated"); return false; }
 

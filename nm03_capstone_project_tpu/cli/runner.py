@@ -630,13 +630,13 @@ class CohortProcessor:
             threads=threads,
         )
         # parse failures retry through the Python reader: its envelope is a
-        # superset of the C++ parser's (the C++ side decodes uncompressed LE
-        # and RLE Lossless; JPEG lossless and baseline JPEG decode in
-        # data/codecs.py only), so a compressed cohort still flows through
-        # the native fast path with per-slice fallback instead of failing
-        # wholesale. The retries run on their own small pool: a
-        # fully-JPEG-compressed batch would otherwise decode serially on
-        # this one thread.
+        # superset of the C++ parser's (the C++ side decodes uncompressed
+        # LE, RLE Lossless and JPEG Lossless; baseline JPEG decodes via
+        # PIL in the Python reader only), so a compressed cohort still
+        # flows through the native fast path with per-slice fallback
+        # instead of failing wholesale. The retries run on their own small
+        # pool: a fully-baseline-JPEG batch would otherwise decode
+        # serially on this one thread.
         retry_idx = [
             i for i, (o, e) in enumerate(zip(okf, errs))
             if not o and int(e) == 2  # "DICOM parse failed"

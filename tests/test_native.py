@@ -45,20 +45,142 @@ class TestNativeDicom:
             nat, raw.astype(np.float32) * 0.5 + 10.0, rtol=1e-6
         )
 
-    def test_rle_matches_python_reader(self, tmp_path):
-        """The C++ parser decodes RLE Lossless natively, bit-identical to
-        the Python reader's codecs.py path."""
-        from nm03_capstone_project_tpu.data.dicomlite import RLE_LOSSLESS
+    @pytest.mark.parametrize("ts_name", ["RLE_LOSSLESS", "JPEG_LOSSLESS_SV1"])
+    def test_compressed_matches_python_reader(self, tmp_path, ts_name):
+        """The C++ parser decodes RLE and JPEG Lossless natively,
+        bit-identical to the Python reader's codecs.py path."""
+        from nm03_capstone_project_tpu.data import dicomlite
 
         rng = np.random.default_rng(7)
         img = rng.integers(0, 4000, size=(70, 50)).astype(np.uint16)
         img[:20, :20] = 99  # replicate runs
-        p = tmp_path / "rle.dcm"
+        p = tmp_path / "c.dcm"
         write_dicom(p, img, rescale_slope=2.0, rescale_intercept=-10.0,
-                    transfer_syntax=RLE_LOSSLESS)
+                    transfer_syntax=getattr(dicomlite, ts_name))
         nat = native.read_dicom_native(p)
         py = read_dicom(p)
         np.testing.assert_array_equal(nat, py.pixels)
+
+    @staticmethod
+    def _encapsulated_dicom(path, fragments, rows, cols, bits=16):
+        """Hand-build a JPEG-lossless Part-10 file from raw fragments."""
+        import struct
+
+        from nm03_capstone_project_tpu.data.dicomlite import (
+            _element,
+            _ITEM,
+            _SEQ_DELIM,
+            JPEG_LOSSLESS,
+        )
+
+        items = struct.pack("<HHI", *_ITEM, 0)  # empty Basic Offset Table
+        for frag in fragments:
+            if len(frag) % 2:
+                frag += b"\x00"
+            items += struct.pack("<HHI", *_ITEM, len(frag)) + frag
+        items += struct.pack("<HHI", *_SEQ_DELIM, 0)
+        meta_elems = _element(0x0002, 0x0010, b"UI", JPEG_LOSSLESS.encode())
+        meta = (
+            _element(0x0002, 0x0000, b"UL", struct.pack("<I", len(meta_elems)))
+            + meta_elems
+        )
+        ds = (
+            _element(0x0028, 0x0002, b"US", struct.pack("<H", 1))
+            + _element(0x0028, 0x0010, b"US", struct.pack("<H", rows))
+            + _element(0x0028, 0x0011, b"US", struct.pack("<H", cols))
+            + _element(0x0028, 0x0100, b"US", struct.pack("<H", bits))
+            + _element(0x0028, 0x0103, b"US", struct.pack("<H", 0))
+            + struct.pack("<HH", 0x7FE0, 0x0010)
+            + b"OB\x00\x00"
+            + struct.pack("<I", 0xFFFFFFFF)
+            + items
+        )
+        path.write_bytes(b"\x00" * 128 + b"DICM" + meta + ds)
+
+    @pytest.mark.parametrize("sel", [2, 3, 4, 5, 6, 7])
+    def test_jpegll_predictors_native_matches_python(self, tmp_path, sel):
+        """Predictor selections 2-7: both decoders apply the same (well-
+        defined) prediction to the same entropy stream, so outputs must be
+        bit-identical even though the stream was entropy-coded for SV1."""
+        from nm03_capstone_project_tpu.data import codecs
+
+        rng = np.random.default_rng(sel)
+        img = rng.integers(0, 4096, (23, 31)).astype(np.uint16)
+        stream = bytearray(codecs.jpeg_lossless_encode(img))
+        sos = stream.index(b"\xff\xda")
+        assert stream[sos + 4 + 3] == 1  # Ss byte (SV1 as written)
+        stream[sos + 4 + 3] = sel
+        py = codecs.jpeg_lossless_decode(bytes(stream))
+        p = tmp_path / "sel.dcm"
+        self._encapsulated_dicom(p, [bytes(stream)], 23, 31)
+        nat = native.read_dicom_native(p)
+        np.testing.assert_array_equal(nat, py.astype(np.float32))
+
+    def test_jpegll_point_transform_native_matches_python(self, tmp_path):
+        from nm03_capstone_project_tpu.data import codecs
+
+        rng = np.random.default_rng(42)
+        img = rng.integers(0, 4096, (16, 20)).astype(np.uint16)
+        stream = bytearray(codecs.jpeg_lossless_encode(img))
+        sos = stream.index(b"\xff\xda")
+        stream[sos + 4 + 5] = 2  # Al = point transform 2
+        py = codecs.jpeg_lossless_decode(bytes(stream))
+        p = tmp_path / "pt.dcm"
+        self._encapsulated_dicom(p, [bytes(stream)], 16, 20)
+        np.testing.assert_array_equal(
+            native.read_dicom_native(p), py.astype(np.float32)
+        )
+
+    def test_jpegll_8bit_native_matches_python(self, tmp_path):
+        from nm03_capstone_project_tpu.data import codecs
+
+        rng = np.random.default_rng(3)
+        img = rng.integers(0, 256, (17, 19)).astype(np.uint16)
+        stream = codecs.jpeg_lossless_encode(img, precision=8)
+        py = codecs.jpeg_lossless_decode(stream)
+        p = tmp_path / "p8.dcm"
+        self._encapsulated_dicom(p, [stream], 17, 19, bits=8)
+        np.testing.assert_array_equal(
+            native.read_dicom_native(p), py.astype(np.float32)
+        )
+
+    def test_jpegll_multifragment_native_matches_python(self, tmp_path):
+        from nm03_capstone_project_tpu.data import codecs
+
+        rng = np.random.default_rng(9)
+        img = rng.integers(0, 65536, (32, 32)).astype(np.uint16)
+        stream = codecs.jpeg_lossless_encode(img)
+        cut = len(stream) // 2
+        if cut % 2:  # fragments must be even-length without padding bytes
+            cut += 1  # landing mid-stream; both halves rejoin exactly
+        p = tmp_path / "mf.dcm"
+        self._encapsulated_dicom(p, [stream[:cut], stream[cut:]], 32, 32)
+        np.testing.assert_array_equal(
+            native.read_dicom_native(p), img.astype(np.float32)
+        )
+
+    def test_jpegll_malformed_segments_fail_cleanly(self, tmp_path):
+        """Hostile streams must return a parse error, never crash: zero-length
+        marker segment (the size_t underflow), bad precision, bad SSSS."""
+        from nm03_capstone_project_tpu.data import codecs
+
+        img = np.arange(64, dtype=np.uint16).reshape(8, 8)
+        stream = bytearray(codecs.jpeg_lossless_encode(img))
+        # (a) DHT segment claiming length 0
+        dht = stream.index(b"\xff\xc4")
+        bad = bytes(stream[:dht + 2]) + b"\x00\x00" + bytes(stream[dht + 2:])
+        p = tmp_path / "m1.dcm"
+        self._encapsulated_dicom(p, [bad], 8, 8)
+        with pytest.raises(ValueError):
+            native.read_dicom_native(p)
+        # (b) SOF3 precision 0
+        sof = stream.index(b"\xff\xc3")
+        bad2 = bytearray(stream)
+        bad2[sof + 4] = 0
+        p2 = tmp_path / "m2.dcm"
+        self._encapsulated_dicom(p2, [bytes(bad2)], 8, 8)
+        with pytest.raises(ValueError):
+            native.read_dicom_native(p2)
 
     def test_rejects_garbage(self, tmp_path):
         p = tmp_path / "bad.dcm"
