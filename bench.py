@@ -858,6 +858,26 @@ def _measure_accel():
 _CPU_ENV = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": None}
 
 
+_PARTIAL_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "results", "bench_partial.json"
+)
+
+
+def _bank_partial(state) -> None:
+    """Write the would-be JSON to results/bench_partial.json: SIGKILL-proof
+    on-disk evidence of everything measured so far (stdout still carries
+    exactly one line, at the end). Written atomically — a kill mid-write
+    must not destroy the previously banked record."""
+    try:
+        os.makedirs(os.path.dirname(_PARTIAL_PATH), exist_ok=True)
+        tmp = _PARTIAL_PATH + f".{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(_compose(state["accel"], state["cpu"], state["meta"]), f)
+        os.replace(tmp, _PARTIAL_PATH)
+    except OSError:
+        pass
+
+
 def main() -> None:
     # Flow (VERDICT r2 item 1): quick accel probe round; on success, one
     # long-timeout accel attempt. If the tunnel is wedged (or the attempt
@@ -870,6 +890,12 @@ def main() -> None:
     budget_s = float(os.environ.get(VIGIL_BUDGET_ENV, VIGIL_BUDGET_DEFAULT_S))
     deadline = t0 + budget_s
     _PROBE_HISTORY.clear()
+    try:
+        # a stale banked record from a previous run must not masquerade as
+        # this run's if we are killed before the first bank
+        os.unlink(_PARTIAL_PATH)
+    except OSError:
+        pass
     state = {
         "accel": None,
         "cpu": None,
@@ -926,11 +952,17 @@ def main() -> None:
         if cpu is not None and "xla_tput" not in cpu:
             cpu = None
         state["cpu"] = cpu
+        # bank the best-so-far record to a file before entering the vigil:
+        # stdout still carries exactly ONE line at the end, but if an
+        # external supervisor hard-kills (SIGKILL) mid-vigil — which no
+        # handler can catch — the round's measurement survives on disk
+        _bank_partial(state)
         # now spend whatever budget remains waiting for the tunnel — the
         # heavy attempt itself is not deadline-capped (real work > budget)
         if _accel_vigil({}, t0, deadline):
             accel = _measure_accel()
             state["accel"] = accel
+            _bank_partial(state)
     elif accel["backend"] != "cpu":
         # accel record in hand: CPU baseline at exactly the winning batch
         cpu = _run_measurement(
@@ -948,6 +980,7 @@ def main() -> None:
         state["cpu"] = cpu
 
     state["meta"]["elapsed_s"] = round(time.monotonic() - t0, 1)
+    _bank_partial(state)
     print(json.dumps(_compose(accel, cpu, state["meta"])), flush=True)
     # only restore AFTER the record is on stdout — restoring first would
     # reopen the very lost-record window the handler exists to close

@@ -402,9 +402,13 @@ int32_t jpeg_extend(uint32_t bits, int ssss) {
   return (int32_t)bits;
 }
 
-bool jpeg_lossless_decode(const uint8_t* data, size_t len,
-                          std::vector<uint16_t>* out, long* rows_out,
-                          long* cols_out) {
+// expect_rows/expect_cols: the DICOM header's dimensions — checked right
+// after SOF3 parses, BEFORE sizing the output, so a hostile embedded JPEG
+// claiming 32768x32768 cannot drive a ~2 GiB allocation + gigapixel decode
+// that the caller's post-hoc dimension check would only catch afterwards.
+bool jpeg_lossless_decode(const uint8_t* data, size_t len, long expect_rows,
+                          long expect_cols, std::vector<uint16_t>* out,
+                          long* rows_out, long* cols_out) {
   if (len < 4 || data[0] != 0xFF || data[1] != 0xD8) {
     set_error("not a JPEG stream (missing SOI)");
     return false;
@@ -470,8 +474,8 @@ bool jpeg_lossless_decode(const uint8_t* data, size_t len,
     return false;
   }
   if (sel < 1 || sel > 7) { set_error("unsupported lossless predictor"); return false; }
-  if (rows <= 0 || cols <= 0 || rows > 32768 || cols > 32768) {
-    set_error("implausible JPEG dimensions");
+  if (rows != expect_rows || cols != expect_cols) {
+    set_error("JPEG frame dimensions disagree with DICOM header");
     return false;
   }
   if (precision < 2 || precision > 16 || pt >= precision) {
@@ -653,12 +657,9 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
     }
     std::vector<uint16_t> samples;
     long jr = 0, jc = 0;
-    if (!jpeg_lossless_decode(stream_ptr, stream_len, &samples, &jr, &jc))
+    if (!jpeg_lossless_decode(stream_ptr, stream_len, rows, cols, &samples,
+                              &jr, &jc))
       return false;
-    if (jr != rows || jc != cols) {
-      set_error("JPEG frame dimensions disagree with DICOM header");
-      return false;
-    }
     decomp_buf.resize(samples.size() * (bits / 8));
     if (bits == 16) {
       for (size_t i = 0; i < samples.size(); ++i) {

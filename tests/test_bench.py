@@ -272,6 +272,12 @@ class TestOrchestrator:
         assert out["cpu_baseline_tput"] == 8.0
         assert out["vs_baseline"] == pytest.approx(125.0)
         assert "error" not in out
+        # the SIGKILL-proof on-disk copy tracked the run (gitignored)
+        banked = json.loads(
+            (pathlib.Path(_BENCH_PATH).parent / "results" / "bench_partial.json")
+            .read_text()
+        )
+        assert banked["value"] == out["value"]
 
     def test_wedge_vigil_exhausted_emits_cpu_fallback(self, monkeypatch, capsys):
         out, calls = self._run_main(
