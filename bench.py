@@ -448,9 +448,17 @@ def worker(
     devices = jax.devices()
     dev = devices[0]
     from nm03_capstone_project_tpu.core.backend import _TPU_PLATFORMS
+    from nm03_capstone_project_tpu.utils.profiling import profile_trace
 
     on_tpu = dev.platform in _TPU_PLATFORMS
     _log(f"worker backend: {dev.platform} ({len(devices)} devices)")
+
+    # NM03_BENCH_PROFILE_DIR: capture a jax.profiler trace (the roofline
+    # evidence VERDICT r2 asked for — true device timelines, not just wall
+    # deltas). The traced rep-block runs AFTER the sweep at the winning
+    # batch and is excluded from the measured numbers, because tracing
+    # perturbs them; the record marks that a trace was captured.
+    profile_dir = os.environ.get("NM03_BENCH_PROFILE_DIR")
 
     result: dict = {}
     emit({"backend": dev.platform})
@@ -474,6 +482,12 @@ def worker(
             }
         )
     tput, batch, xla_sum, pixels, dims = best
+    if profile_dir:
+        # dedicated traced rep-block at the winning batch, off the clock
+        _log(f"capturing profiler trace at batch {batch} into {profile_dir}")
+        with profile_trace(profile_dir):
+            _bench_on(dev, pixels, dims, min(reps, 8), use_pallas=False)
+        emit({"profile_dir": profile_dir})
     # honest fused-pipeline roofline anchor: the mask program's minimum HBM
     # traffic is one f32 read + one u8 write per pixel; at the measured
     # slices/s that is the achieved end-to-end bandwidth (the pipeline is
@@ -792,7 +806,8 @@ def _compose(accel, cpu, meta) -> dict:
             out["pallas_checksum_ok"] = accel["pallas_checksum_ok"]
         if "stages" in accel:
             out["stages"] = accel["stages"]
-        for key in ("device_kind", "hbm_peak_gbps", "fused_min_traffic_gbps"):
+        for key in ("device_kind", "hbm_peak_gbps", "fused_min_traffic_gbps",
+                    "profile_dir"):
             if key in accel:
                 out[key] = accel[key]
         if "student_tput" in accel:
@@ -821,7 +836,8 @@ def _compose(accel, cpu, meta) -> dict:
             out["xla_by_batch"] = cpu["xla_by_batch"]
         if "stages" in cpu:
             out["stages"] = cpu["stages"]
-        for key in ("device_kind", "hbm_peak_gbps", "fused_min_traffic_gbps"):
+        for key in ("device_kind", "hbm_peak_gbps", "fused_min_traffic_gbps",
+                    "profile_dir"):
             if key in cpu:
                 out[key] = cpu[key]
         if "student_tput" in cpu:
@@ -921,6 +937,7 @@ def main() -> None:
                 state[key] = merged
         state["meta"]["terminated"] = "signal mid-run; emitted best-so-far"
         state["meta"]["elapsed_s"] = round(time.monotonic() - t0, 1)
+        _bank_partial(state)  # the on-disk copy must match what we emit
         print(json.dumps(_compose(state["accel"], state["cpu"], state["meta"])),
               flush=True)
         os._exit(0)
@@ -931,6 +948,9 @@ def main() -> None:
     if _probe_until_healthy({}, "accel", t0):
         accel = _measure_accel()
         state["accel"] = accel
+        # bank before the CPU baseline: a kill during that phase must not
+        # cost the already-measured accelerator record
+        _bank_partial(state)
 
     cpu = None
     if accel is None:

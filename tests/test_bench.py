@@ -95,6 +95,13 @@ class TestWorker:
 
 
 class TestOrchestrator:
+    @pytest.fixture(autouse=True)
+    def _isolated_partial_path(self, monkeypatch, tmp_path):
+        # main() unlinks + rewrites the banked-record path; tests must never
+        # touch the real results/bench_partial.json a chip run left behind
+        self.partial_path = tmp_path / "bench_partial.json"
+        monkeypatch.setattr(bench, "_PARTIAL_PATH", str(self.partial_path))
+
     def _run_main(self, monkeypatch, capsys, accel, cpu, probe_ok=True,
                   vigil_ok=False):
         calls = []
@@ -273,10 +280,7 @@ class TestOrchestrator:
         assert out["vs_baseline"] == pytest.approx(125.0)
         assert "error" not in out
         # the SIGKILL-proof on-disk copy tracked the run (gitignored)
-        banked = json.loads(
-            (pathlib.Path(_BENCH_PATH).parent / "results" / "bench_partial.json")
-            .read_text()
-        )
+        banked = json.loads(self.partial_path.read_text())
         assert banked["value"] == out["value"]
 
     def test_wedge_vigil_exhausted_emits_cpu_fallback(self, monkeypatch, capsys):
