@@ -777,6 +777,14 @@ def _run_measurement(label, worker_args, env_overrides, timeout_s):
         os.unlink(out_path)
 
 
+def _copy_optional(out: dict, rec: dict) -> None:
+    """Carry a measurement record's optional sections into the emitted JSON."""
+    for key in ("stages", "device_kind", "hbm_peak_gbps",
+                "fused_min_traffic_gbps", "profile_dir", "student_tput"):
+        if key in rec:
+            out[key] = rec[key]
+
+
 def _compose(accel, cpu, meta) -> dict:
     """Fold the accel/cpu worker records into the one emitted JSON object."""
     out = {
@@ -804,14 +812,7 @@ def _compose(accel, cpu, meta) -> dict:
         if "pallas_tput" in accel:
             out["pallas_tput"] = round(accel["pallas_tput"], 2)
             out["pallas_checksum_ok"] = accel["pallas_checksum_ok"]
-        if "stages" in accel:
-            out["stages"] = accel["stages"]
-        for key in ("device_kind", "hbm_peak_gbps", "fused_min_traffic_gbps",
-                    "profile_dir"):
-            if key in accel:
-                out[key] = accel[key]
-        if "student_tput" in accel:
-            out["student_tput"] = accel["student_tput"]
+        _copy_optional(out, accel)
         if accel["backend"] == "cpu":
             out["vs_baseline"] = 1.0
             out["error"] = "no accelerator backend available; measured cpu only"
@@ -834,14 +835,7 @@ def _compose(accel, cpu, meta) -> dict:
             out["batch"] = cpu["xla_batch"]
         if "xla_by_batch" in cpu:
             out["xla_by_batch"] = cpu["xla_by_batch"]
-        if "stages" in cpu:
-            out["stages"] = cpu["stages"]
-        for key in ("device_kind", "hbm_peak_gbps", "fused_min_traffic_gbps",
-                    "profile_dir"):
-            if key in cpu:
-                out[key] = cpu[key]
-        if "student_tput" in cpu:
-            out["student_tput"] = cpu["student_tput"]
+        _copy_optional(out, cpu)
         out["error"] = "accelerator worker failed; cpu fallback measured"
     else:
         out["backend"] = "none"
@@ -944,16 +938,15 @@ def main() -> None:
 
     old_term = signal.signal(signal.SIGTERM, _on_term)
 
-    accel = None
+    # state is the single source of truth for what has been measured — the
+    # SIGTERM handler and the banked on-disk record both read it
     if _probe_until_healthy({}, "accel", t0):
-        accel = _measure_accel()
-        state["accel"] = accel
+        state["accel"] = _measure_accel()
         # bank before the CPU baseline: a kill during that phase must not
         # cost the already-measured accelerator record
         _bank_partial(state)
 
-    cpu = None
-    if accel is None:
+    if state["accel"] is None:
         # tunnel wedged or attempt lost — bank the CPU baseline first (it
         # cannot touch the tunnel), sweeping every accel batch size so the
         # ratio stays same-program whatever batch later wins on the chip,
@@ -969,9 +962,7 @@ def main() -> None:
             _CPU_ENV,
             CPU_TIMEOUT_S,
         )
-        if cpu is not None and "xla_tput" not in cpu:
-            cpu = None
-        state["cpu"] = cpu
+        state["cpu"] = cpu if cpu and "xla_tput" in cpu else None
         # bank the best-so-far record to a file before entering the vigil:
         # stdout still carries exactly ONE line at the end, but if an
         # external supervisor hard-kills (SIGKILL) mid-vigil — which no
@@ -980,28 +971,26 @@ def main() -> None:
         # now spend whatever budget remains waiting for the tunnel — the
         # heavy attempt itself is not deadline-capped (real work > budget)
         if _accel_vigil({}, t0, deadline):
-            accel = _measure_accel()
-            state["accel"] = accel
+            state["accel"] = _measure_accel()
             _bank_partial(state)
-    elif accel["backend"] != "cpu":
+    elif state["accel"]["backend"] != "cpu":
         # accel record in hand: CPU baseline at exactly the winning batch
         cpu = _run_measurement(
             "cpu baseline",
             [
                 "--platform", "cpu",
                 "--reps", str(CPU_REPS),
-                "--batches", str(accel.get("xla_batch", BATCH)),
+                "--batches", str(state["accel"].get("xla_batch", BATCH)),
             ],
             _CPU_ENV,
             CPU_TIMEOUT_S,
         )
-        if cpu is not None and "xla_tput" not in cpu:
-            cpu = None
-        state["cpu"] = cpu
+        state["cpu"] = cpu if cpu and "xla_tput" in cpu else None
 
     state["meta"]["elapsed_s"] = round(time.monotonic() - t0, 1)
     _bank_partial(state)
-    print(json.dumps(_compose(accel, cpu, state["meta"])), flush=True)
+    print(json.dumps(_compose(state["accel"], state["cpu"], state["meta"])),
+          flush=True)
     # only restore AFTER the record is on stdout — restoring first would
     # reopen the very lost-record window the handler exists to close
     signal.signal(signal.SIGTERM, old_term)
