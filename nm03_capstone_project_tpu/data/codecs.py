@@ -259,6 +259,8 @@ def jpeg_lossless_decode(data: bytes) -> np.ndarray:
             raise CodecError("truncated JPEG marker segment")
         body = data[pos + 2 : seg_end]
         if marker == _SOF3:
+            if len(body) < 6:
+                raise CodecError("short SOF3 segment")
             precision, rows, cols, ncomp = struct.unpack_from(">BHHB", body, 0)
             if ncomp != 1:
                 raise CodecError(f"lossless JPEG: expected 1 component, got {ncomp}")
@@ -272,6 +274,9 @@ def jpeg_lossless_decode(data: bytes) -> np.ndarray:
                 tc_th = body[b]
                 counts = list(body[b + 1 : b + 17])
                 nvals = sum(counts)
+                if len(counts) < 16 or b + 17 + nvals > len(body):
+                    # counts promising more values than the segment holds
+                    raise CodecError("malformed DHT segment")
                 vals = list(body[b + 17 : b + 17 + nvals])
                 # key on (class, id): an AC-class table sharing a DC table's
                 # destination id is legal T.81 and must not clobber it
@@ -280,6 +285,8 @@ def jpeg_lossless_decode(data: bytes) -> np.ndarray:
                 )
                 b += 17 + nvals
         elif marker == _SOS:
+            if len(body) < 6:  # ns(1) + 1 comp spec(2) + Ss/Se/AhAl(3)
+                raise CodecError("short SOS segment")
             ns = body[0]
             if ns != 1:
                 raise CodecError(f"expected 1 scan component, got {ns}")

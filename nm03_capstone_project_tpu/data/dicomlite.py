@@ -318,7 +318,12 @@ def read_dicom(path: str | os.PathLike) -> DicomSlice:
         first = True
         while r.pos < meta_end and not r.atend():
             mark = r.pos
-            group, elem, vr, length = r.element()
+            try:
+                group, elem, vr, length = r.element()
+            except struct.error as e:
+                # a file truncated inside a meta element header must reject
+                # cleanly, like the dataset-side parse below
+                raise DicomParseError(f"truncated file meta group: {e}") from e
             if group != 0x0002:
                 r.pos = mark
                 break
@@ -327,7 +332,10 @@ def read_dicom(path: str | os.PathLike) -> DicomSlice:
             if first and (group, elem) == (0x0002, 0x0000) and len(value) == 4:
                 meta_end = r.pos + struct.unpack("<I", value)[0]
             if (group, elem) == (0x0002, 0x0010):
-                transfer_syntax = value.decode("ascii").strip("\x00 ")
+                # errors="replace": corrupt bytes yield a UID that matches no
+                # known syntax and is rejected cleanly, instead of a
+                # UnicodeDecodeError escaping the DicomParseError contract
+                transfer_syntax = value.decode("ascii", "replace").strip("\x00 ")
             first = False
         body = raw[r.pos :]
     elif raw[:4] == b"DICM":
