@@ -231,12 +231,17 @@ def _extend(bits: int, ssss: int) -> int:
     return bits
 
 
-def jpeg_lossless_decode(data: bytes) -> np.ndarray:
+def jpeg_lossless_decode(data: bytes, expect_shape=None) -> np.ndarray:
     """Decode a single-component lossless JPEG (SOF3) stream.
 
     Supports any predictor selection value 1-7, point transform, 2-16 bit
     precision; restart intervals are not supported (DCMTK does not emit them
     for single-frame medical images). Returns uint16 (rows, cols).
+
+    ``expect_shape``: when the caller knows the frame dimensions (the DICOM
+    header's Rows/Columns), a disagreeing SOF3 is rejected BEFORE the
+    output allocates — a corrupt header must not drive a multi-GB
+    ``np.zeros`` or a gigapixel decode loop.
     """
     if len(data) < 4 or data[0] != 0xFF or data[1] != _SOI:
         raise CodecError("not a JPEG stream (missing SOI)")
@@ -302,6 +307,18 @@ def jpeg_lossless_decode(data: bytes) -> np.ndarray:
         raise CodecError(f"JPEG scan references undefined Huffman table {table_id}")
     if sel < 1 or sel > 7:
         raise CodecError(f"unsupported lossless predictor selection {sel}")
+    if not (2 <= precision <= 16) or pt >= precision:
+        # T.81 range; pt >= precision would make the default predictor's
+        # shift count negative (a bare ValueError, not CodecError)
+        raise CodecError(
+            f"invalid JPEG precision/point-transform {precision}/{pt}"
+        )
+    if expect_shape is not None and (rows, cols) != tuple(expect_shape):
+        raise CodecError(
+            f"JPEG frame is ({rows}, {cols}), expected {tuple(expect_shape)}"
+        )
+    if rows <= 0 or cols <= 0 or rows > 32768 or cols > 32768:
+        raise CodecError(f"implausible JPEG dimensions ({rows}, {cols})")
 
     table = huff_tables[(0, table_id)]
     reader = _BitReader(data, pos)

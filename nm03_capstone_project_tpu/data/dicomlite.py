@@ -269,7 +269,9 @@ def _decode_compressed(
                 )
             arr = codecs.rle_decode_frame(fragments[0], rows, cols, dtype.itemsize)
         elif transfer_syntax in (JPEG_LOSSLESS, JPEG_LOSSLESS_SV1):
-            arr = codecs.jpeg_lossless_decode(b"".join(fragments))
+            arr = codecs.jpeg_lossless_decode(
+                b"".join(fragments), expect_shape=(rows, cols)
+            )
             if dtype.itemsize == 1:
                 if arr.max(initial=0) > 0xFF:
                     raise DicomParseError(
@@ -286,8 +288,13 @@ def _decode_compressed(
                     "baseline JPEG (1.2.840.10008.1.2.4.50) is 8-bit only, "
                     f"but BitsAllocated={dtype.itemsize * 8}"
                 )
-            img = Image.open(io.BytesIO(b"".join(fragments)))
-            arr = np.asarray(img.convert("L"), np.uint8)
+            try:
+                img = Image.open(io.BytesIO(b"".join(fragments)))
+                arr = np.asarray(img.convert("L"), np.uint8)
+            except (OSError, ValueError) as e:
+                # PIL raises UnidentifiedImageError (an OSError) on corrupt
+                # streams; the importer contract is DicomParseError only
+                raise DicomParseError(f"baseline JPEG decode failed: {e}") from e
     except codecs.CodecError as e:
         raise DicomParseError(f"compressed PixelData decode failed: {e}") from e
     if arr.shape != (rows, cols):

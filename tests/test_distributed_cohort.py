@@ -83,6 +83,51 @@ _VOL_WORKER = textwrap.dedent(
 ).format(repo=str(_REPO))
 
 
+_VOL_FAIL_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    cohort, outdir = sys.argv[4], sys.argv[5]
+
+    # Inject an export failure for PGBM-0001: only the exporting rank ever
+    # calls render_export_pairs, so this fires on rank 0 alone — the exact
+    # rank-0-only failure that must reach the outcome collective (ADVICE r2)
+    import nm03_capstone_project_tpu.render.export as export_mod
+    real = export_mod.render_export_pairs
+    def failing(items, out_dir, cfg, max_workers=4):
+        if "PGBM-0001" in str(out_dir):
+            raise IOError("injected export failure")
+        return real(items, out_dir, cfg, max_workers)
+    export_mod.render_export_pairs = failing
+
+    from nm03_capstone_project_tpu.cli import volume
+
+    rc = volume.main([
+        "--base-path", cohort,
+        "--output", outdir,
+        "--z-shard",
+        "--distributed",
+        "--coordinator-address", f"127.0.0.1:{{port}}",
+        "--num-processes", str(nproc),
+        "--process-id", str(pid),
+        "--canvas", "128", "--render-size", "128",
+    ])
+    # BOTH ranks must agree the cohort partially failed (rc 1): before the
+    # round-3 export-outcome collective, non-exporting ranks counted the
+    # patient ok and exited 0 while rank 0 exited 1
+    assert rc == 1, f"rank {{pid}} rc={{rc}} (want 1)"
+    print(f"VFOK {{pid}}", flush=True)
+    """
+).format(repo=str(_REPO))
+
+
 _TRAIN_WORKER = textwrap.dedent(
     """
     import os, sys
@@ -180,6 +225,29 @@ class TestDistributedCohort:
         assert rec["z_sharded"] is True and rec["z_global"] is True
         assert len(rec["patients"]) == 2
         assert all(v["mask_voxels"] > 0 for v in rec["patients"].values())
+
+    def test_volume_zshard_export_failure_agrees_across_ranks(self, tmp_path):
+        # rank 0's export crashes for one patient; the outcome collective
+        # must (a) keep later patients' collectives paired — patient 2 still
+        # exports fully — and (b) give every rank the same rc=1
+        from nm03_capstone_project_tpu.data.synthetic import write_synthetic_cohort
+
+        cohort = tmp_path / "cohort"
+        write_synthetic_cohort(
+            cohort, n_patients=2, n_slices=4, height=128, width=120
+        )
+        outdir = tmp_path / "out"
+        script = tmp_path / "vf_worker.py"
+        script.write_text(_VOL_FAIL_WORKER)
+        outs = run_job_with_port_retry(
+            script, tmp_path, 2, extra_args=[str(cohort), str(outdir)]
+        )
+        for pid in range(2):
+            assert f"VFOK {pid}" in outs[pid]
+        # the failed patient exported nothing; the next one is complete —
+        # proof the collectives stayed paired after the rank-0-only failure
+        assert list((outdir / "PGBM-0001").glob("*.jpg")) == []
+        assert len(list((outdir / "PGBM-0002").glob("*.jpg"))) == 8
 
     def test_distributed_training_across_two_processes(self, tmp_path):
         # dp training over 2 hosts x 4 devices: shards distilled locally,
