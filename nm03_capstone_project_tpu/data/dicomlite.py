@@ -291,9 +291,11 @@ def _decode_compressed(
             try:
                 img = Image.open(io.BytesIO(b"".join(fragments)))
                 arr = np.asarray(img.convert("L"), np.uint8)
-            except (OSError, ValueError) as e:
+            except (OSError, ValueError, Image.DecompressionBombError) as e:
                 # PIL raises UnidentifiedImageError (an OSError) on corrupt
-                # streams; the importer contract is DicomParseError only
+                # streams and DecompressionBombError (a bare Exception
+                # subclass) on hostile declared dimensions; the importer
+                # contract is DicomParseError only
                 raise DicomParseError(f"baseline JPEG decode failed: {e}") from e
     except codecs.CodecError as e:
         raise DicomParseError(f"compressed PixelData decode failed: {e}") from e

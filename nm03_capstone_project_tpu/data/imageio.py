@@ -125,7 +125,9 @@ def read_metaimage(path: str | os.PathLike) -> Tuple[np.ndarray, Tuple[float, ..
     """
     mhd = Path(path)
     fields: Dict[str, str] = {}
-    for line in mhd.read_text().splitlines():
+    # errors="replace": corrupt header bytes garble fields, which then fail
+    # the checks below as ValueError — never a UnicodeDecodeError escape
+    for line in mhd.read_text(errors="replace").splitlines():
         if "=" in line:
             key, _, val = line.partition("=")
             fields[key.strip()] = val.strip()
@@ -150,9 +152,17 @@ def read_metaimage(path: str | os.PathLike) -> Tuple[np.ndarray, Tuple[float, ..
             f"{mhd}: multi-file MetaImage (LIST / pattern data files) not supported"
         )
 
-    payload = (mhd.parent / data_file).read_bytes()
+    try:
+        payload = (mhd.parent / data_file).read_bytes()
+    except OSError as e:
+        # missing/unreadable data file (or a corrupt name resolving to a
+        # directory) is a malformed pair per this reader's contract
+        raise ValueError(f"{mhd}: cannot read data file {data_file!r}: {e}") from e
     if fields.get("CompressedData", "False").lower() == "true":
-        payload = zlib.decompress(payload)
+        try:
+            payload = zlib.decompress(payload)
+        except zlib.error as e:
+            raise ValueError(f"{mhd}: corrupt compressed data: {e}") from e
     shape = shape_xyz[::-1]  # header is x y z; numpy wants z y x
     expected = int(np.prod(shape)) * np.dtype(dtype).itemsize
     if len(payload) != expected:

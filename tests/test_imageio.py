@@ -101,3 +101,43 @@ class TestMetaImage:
         back, spacing = read_metaimage(tmp_path / "mask.mhd")
         np.testing.assert_array_equal(back, mask)
         assert spacing == (5.0, 1.0, 1.0)
+
+
+def test_metaimage_mutation_fuzz_rejects_cleanly(tmp_path):
+    """Byte-corrupted .mhd headers must decode or raise ValueError — never
+    UnicodeDecodeError / IsADirectoryError / zlib.error (all observed before
+    the round-3 guards)."""
+    rng = np.random.default_rng(5)
+    vol = (rng.random((4, 8, 8)) * 100).astype(np.uint8)
+    write_metaimage(vol, tmp_path / "v.mhd")
+    src = (tmp_path / "v.mhd").read_bytes()
+    for _ in range(80):
+        raw = bytearray(src)
+        for _ in range(rng.integers(1, 5)):
+            mode = rng.integers(0, 3)
+            if mode == 0 and len(raw):
+                raw[rng.integers(0, len(raw))] = rng.integers(0, 256)
+            elif mode == 1 and len(raw) > 10:
+                raw = raw[: rng.integers(5, len(raw))]
+            else:
+                at = rng.integers(0, len(raw))
+                raw[at:at] = bytes(rng.integers(0, 256, 6, dtype=np.uint8))
+        (tmp_path / "m.mhd").write_bytes(bytes(raw))
+        try:
+            read_metaimage(tmp_path / "m.mhd")
+        except ValueError:
+            pass
+
+
+def test_metaimage_corrupt_compressed_payload_rejects_cleanly(tmp_path):
+    """A corrupt .zraw must raise ValueError, not zlib.error."""
+    import pytest
+
+    vol = (np.random.default_rng(1).random((4, 8, 8)) * 100).astype(np.uint8)
+    write_metaimage(vol, tmp_path / "c.mhd", compressed=True)
+    zraw = tmp_path / "c.zraw"
+    data = bytearray(zraw.read_bytes())
+    data[: min(8, len(data))] = b"\xff" * min(8, len(data))
+    zraw.write_bytes(bytes(data))
+    with pytest.raises(ValueError, match="corrupt compressed"):
+        read_metaimage(tmp_path / "c.mhd")
