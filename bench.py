@@ -611,6 +611,48 @@ def _git_sha() -> str:
         return "unknown"
 
 
+def _tunnel_tcp_probe() -> dict:
+    """TCP-level check of the tunnel relay endpoints (stdlib, ~instant).
+
+    Distinguishes the two wedge modes a jax-level probe cannot: 'refused'
+    (the relay process is not even listening — restart-side problem) vs
+    'open' (listening but the claim/compile path is hung). Round 3 observed
+    the former: during the 13h+ wedge nothing listened on any relay port.
+    """
+    import socket
+
+    ips = [
+        ip.strip()
+        for ip in os.environ.get("PALLAS_AXON_POOL_IPS", "").split(",")
+        if ip.strip()
+    ]
+    import errno
+
+    out = {}
+    for ip in ips[:4]:
+        for port in (8081, 8082, 8083):  # axon claim/serve ports
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            # 0.5s: refused-vs-listening is one RTT on these loopback/pool
+            # addresses, and the worst case (filtered port -> full timeout
+            # on every socket) must not eat the vigil's re-probe budget
+            s.settimeout(0.5)
+            try:
+                rc = s.connect_ex((ip, port))
+                if rc == 0:
+                    out[f"{ip}:{port}"] = "open"
+                elif rc in (errno.EAGAIN, errno.EWOULDBLOCK, errno.EINPROGRESS):
+                    # connect_ex reports an expired settimeout as EAGAIN —
+                    # filtered/blackholed, NOT refused (different remediation)
+                    out[f"{ip}:{port}"] = "timeout"
+                else:
+                    out[f"{ip}:{port}"] = f"closed({rc})"
+            except OSError as e:
+                out[f"{ip}:{port}"] = f"error({e})"
+            finally:
+                s.close()
+    return out
+
+
 def _claim_holder_snapshot() -> str:
     """Best-effort list of processes that could be wedging the tunnel (a hung
     client HOLDS the chip claim until it dies) — recorded on probe timeout so
@@ -662,6 +704,7 @@ def _probe_once(env_overrides, label, t0) -> bool:
         entry["stderr_tail"] = (stderr or "")[-400:]
         if rc is None:  # timeout = wedge; record who might hold the claim
             entry["claim_holders"] = _claim_holder_snapshot()
+            entry["tunnel_tcp"] = _tunnel_tcp_probe()
     _PROBE_HISTORY.append(entry)
     return res is not None
 
