@@ -744,29 +744,53 @@ def _probe_until_healthy(env_overrides, label, t0=None) -> bool:
     return False
 
 
+TCP_VIGIL_SPACING_S = 20
+
+
 def _accel_vigil(env_overrides, t0, deadline) -> bool:
-    """Spaced re-probes until the tunnel answers or the budget is spent.
+    """Re-probes until the tunnel answers or the budget is spent.
 
     Runs AFTER the CPU baseline is banked, so every minute here is a minute
     that could still win the round's accelerator record — the round-2 bench
     forfeited its window 3 minutes in and then idled through 7 minutes of
     CPU work with no re-probe (VERDICT r2 weak item 1).
+
+    Two-tier cadence: the instant TCP relay check runs every 20s, and the
+    expensive jax probe (90s timeout on a dead relay) fires when a relay
+    port opens — so a recovery is caught within seconds — or on the
+    3-minute schedule regardless, as a safety net against the port
+    assumption being wrong.
     """
     attempt = 0
+    last_full_probe = -float("inf")
     while True:
-        # probe on loop entry: minutes of CPU-baseline work just elapsed
-        # since the last probe, so sleeping first would idle real budget
-        attempt += 1
-        if _probe_once(env_overrides, f"vigil probe {attempt}", t0):
-            _log(f"vigil: tunnel recovered on re-probe {attempt}")
-            return True
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             _log("vigil: budget exhausted; emitting with what we have")
             return False
-        wait = min(PROBE_VIGIL_SPACING_S, max(remaining - PROBE_TIMEOUT_S, 1))
-        _log(f"vigil: sleeping {wait:.0f}s ({remaining:.0f}s of budget left)")
-        time.sleep(wait)
+        tcp = _tunnel_tcp_probe()
+        since_last = time.monotonic() - last_full_probe
+        # rate-limit the relay-up trigger: a port that is open while the
+        # claim path is hung must not turn the vigil into a 90s-timeout
+        # probe hammer (stamped AFTER the probe so its own duration does
+        # not count toward the interval)
+        relay_up = any(v == "open" for v in tcp.values()) and since_last >= 60
+        due = since_last >= PROBE_VIGIL_SPACING_S
+        if relay_up or due:
+            if remaining < PROBE_TIMEOUT_S + 10:
+                # a probe launched now would overshoot the wall budget into
+                # the external driver's kill window; stop cleanly instead
+                _log("vigil: budget too low for another probe; emitting")
+                return False
+            if relay_up:
+                _log(f"vigil: relay TCP open ({tcp}); probing now")
+            attempt += 1
+            ok = _probe_once(env_overrides, f"vigil probe {attempt}", t0)
+            last_full_probe = time.monotonic()
+            if ok:
+                _log(f"vigil: tunnel recovered on re-probe {attempt}")
+                return True
+        time.sleep(min(TCP_VIGIL_SPACING_S, max(deadline - time.monotonic(), 1)))
 
 
 # (label, sections-path) of the in-flight worker, so the SIGTERM handler can

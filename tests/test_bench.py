@@ -310,6 +310,33 @@ class TestOrchestrator:
         assert isinstance(out["probe_history"], list)
         assert out["elapsed_s"] >= 0
 
+    def test_accel_vigil_tcp_open_triggers_early_probe(self, monkeypatch):
+        # the vigil's cheap TCP tier must fire the expensive jax probe
+        # within seconds of the relay port opening, instead of waiting for
+        # the 3-minute schedule — simulated clock, no real sleeping
+        now = [0.0]
+        monkeypatch.setattr(bench.time, "monotonic", lambda: now[0])
+        monkeypatch.setattr(
+            bench.time, "sleep", lambda s: now.__setitem__(0, now[0] + s)
+        )
+        probes = []
+        monkeypatch.setattr(
+            bench, "_tunnel_tcp_probe",
+            lambda: {"p": "open" if now[0] > 100 else "closed(111)"},
+        )
+
+        def probe_once(env, label, t0):
+            probes.append(now[0])
+            now[0] += 90  # a probe against a sick tunnel costs its timeout
+            return now[0] > 250  # recovers on the third attempt
+
+        monkeypatch.setattr(bench, "_probe_once", probe_once)
+        assert bench._accel_vigil({}, 0.0, 2000.0)
+        assert probes[0] == 0.0  # probe-on-entry preserved
+        # the relay opened at t=100; the reaction landed well inside the
+        # old 180s spacing (at ~110s, one 20s TCP tick + rate limit)
+        assert any(100 < t < 180 for t in probes[1:]), probes
+
     def test_probe_once_records_diagnostics(self, monkeypatch):
         # a timed-out probe (rc None) must leave stderr tail + claim-holder
         # snapshot in the history — the round-2 record was undiagnosable
