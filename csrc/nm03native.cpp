@@ -457,6 +457,12 @@ bool jpeg_lossless_decode(const uint8_t* data, size_t len, long expect_rows,
         build_huffman(body + b + 1, body + b + 17, nvals, &tables[tc][th]);
         b += 17 + (size_t)nvals;
       }
+      if (b != body_len) {
+        // trailing bytes too short for another table: the Python
+        // reference rejects this stream; the decoders must agree
+        set_error("malformed DHT");
+        return false;
+      }
     } else if (marker == 0xDA) {  // SOS
       if (body_len < 6 || body[0] != 1) { set_error("expected 1 scan component"); return false; }
       table_id = body[2] >> 4;  // Td

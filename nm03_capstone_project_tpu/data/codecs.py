@@ -329,6 +329,10 @@ def jpeg_lossless_decode(data: bytes, expect_shape=None) -> np.ndarray:
         prev = out[y - 1] if y else None
         for x in range(cols):
             ssss = _huff_decode(reader, table)
+            if ssss > 16:
+                # DHT values are arbitrary bytes; >16 desyncs the bit
+                # stream into silent garbage (C++ decoder has this guard)
+                raise CodecError(f"invalid JPEG difference category {ssss}")
             diff = _extend(reader.read_bits(ssss) if 0 < ssss < 16 else 0, ssss)
             if y == 0:
                 pred = default if x == 0 else row[x - 1]

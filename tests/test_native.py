@@ -380,24 +380,70 @@ class TestNativeRunnerIntegration:
         assert py.process_all_patients().succeeded_slices == 4
         assert digest(tmp_path / "nat") == digest(tmp_path / "py")
 
+    @staticmethod
+    def _write_baseline_jpeg_dicom(path, img_u8):
+        """A baseline-JPEG (1.2.840.10008.1.2.4.50) file: the one compressed
+        syntax the C++ parser still rejects, so it MUST drive the runner's
+        per-slice Python retry."""
+        import io
+        import struct as st
+
+        from PIL import Image
+
+        from nm03_capstone_project_tpu.data.dicomlite import (
+            _element,
+            _encapsulate,
+            JPEG_BASELINE,
+        )
+
+        buf = io.BytesIO()
+        Image.fromarray(img_u8, "L").save(buf, "JPEG", quality=100)
+        meta_elems = _element(0x0002, 0x0010, b"UI", JPEG_BASELINE.encode())
+        meta = (
+            _element(0x0002, 0x0000, b"UL", st.pack("<I", len(meta_elems)))
+            + meta_elems
+        )
+        h, w = img_u8.shape
+        ds = (
+            _element(0x0028, 0x0002, b"US", st.pack("<H", 1))
+            + _element(0x0028, 0x0010, b"US", st.pack("<H", h))
+            + _element(0x0028, 0x0011, b"US", st.pack("<H", w))
+            + _element(0x0028, 0x0100, b"US", st.pack("<H", 8))
+            + _element(0x0028, 0x0103, b"US", st.pack("<H", 0))
+            + st.pack("<HH", 0x7FE0, 0x0010)
+            + b"OB\x00\x00"
+            + st.pack("<I", 0xFFFFFFFF)
+            + _encapsulate(buf.getvalue())
+        )
+        path.write_bytes(b"\x00" * 128 + b"DICM" + meta + ds)
+
     def test_native_batch_falls_back_to_python_for_compressed(self, tmp_path):
-        """An RLE-compressed slice in a native-loader batch decodes via the
-        Python reader's compressed envelope instead of failing the slice
-        (the C++ parser reads uncompressed LE only)."""
+        """A batch mixing native-decodable slices (plain, RLE — both on the
+        C++ fast path) with a baseline-JPEG slice (C++ rejects, code 2)
+        must repair the failed slot through the Python reader's retry pool
+        instead of failing the slice."""
         from nm03_capstone_project_tpu.cli.runner import CohortProcessor
         from nm03_capstone_project_tpu.config import BatchConfig, PipelineConfig
-        from nm03_capstone_project_tpu.data.dicomlite import RLE_LOSSLESS
+        from nm03_capstone_project_tpu.data.dicomlite import (
+            read_dicom,
+            RLE_LOSSLESS,
+        )
 
         cfg = PipelineConfig(canvas=128, render_size=128)
         root = tmp_path / "cohort" / "PGBM-0001" / "1-series"
         root.mkdir(parents=True)
         rng = np.random.default_rng(3)
         want = {}
-        for i, ts in enumerate([None, RLE_LOSSLESS, None]):
+        for i, ts in enumerate([None, RLE_LOSSLESS]):
             img = rng.integers(0, 4000, size=(100, 100)).astype(np.uint16)
             kw = {"transfer_syntax": ts} if ts else {}
             write_dicom(root / f"1-{i + 1:02d}.dcm", img, **kw)
-            want[f"1-{i + 1:02d}"] = img
+            want[f"1-{i + 1:02d}"] = img.astype(np.float32)
+        jb = rng.integers(0, 256, size=(100, 100)).astype(np.uint8)
+        self._write_baseline_jpeg_dicom(root / "1-03.dcm", jb)
+        # the retried slice's ground truth is whatever the Python reader
+        # yields (baseline JPEG is lossy)
+        want["1-03"] = read_dicom(root / "1-03.dcm").pixels
         proc = CohortProcessor(
             tmp_path / "cohort", tmp_path / "out", cfg=cfg,
             batch_cfg=BatchConfig(batch_size=3, io_workers=2, use_native=True),
@@ -410,6 +456,8 @@ class TestNativeRunnerIntegration:
         assert batch["stems"] == sorted(want)
         for i, stem in enumerate(batch["stems"]):
             np.testing.assert_array_equal(
-                batch["pixels"][i, :100, :100], want[stem].astype(np.float32)
+                batch["pixels"][i, :100, :100], want[stem]
             )
+            # padding stays zeroed around the retried slice too
+            assert batch["pixels"][i, 100:, :].sum() == 0
             assert tuple(batch["dims"][i]) == (100, 100)
