@@ -41,6 +41,12 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="also write each patient's 3D mask as MetaImage (<patient>/mask.mhd)",
     )
+    p.add_argument(
+        "--mhd-compressed",
+        action="store_true",
+        help="zlib-compress the MetaImage pixel payload (.zraw); binary masks "
+        "compress ~100x",
+    )
     common.add_render_stage_arg(p)
     common.add_model_arg(p)
     common.add_distributed_args(
@@ -469,7 +475,11 @@ def run(args: argparse.Namespace) -> int:
                                 write_metaimage,
                             )
 
-                            write_metaimage(mask, out_root / pid / "mask.mhd")
+                            write_metaimage(
+                                mask,
+                                out_root / pid / "mask.mhd",
+                                compressed=getattr(args, "mhd_compressed", False),
+                            )
                     missing = sorted(set(stems) - set(done))
                     for stem in missing:
                         manifest.record(pid, stem, STATUS_FAILED)

@@ -279,8 +279,16 @@ def jpeg_lossless_decode(data: bytes, expect_shape=None) -> np.ndarray:
                 tc_th = body[b]
                 counts = list(body[b + 1 : b + 17])
                 nvals = sum(counts)
-                if len(counts) < 16 or b + 17 + nvals > len(body):
-                    # counts promising more values than the segment holds
+                if (
+                    len(counts) < 16
+                    or b + 17 + nvals > len(body)
+                    or (tc_th >> 4) > 1
+                    or (tc_th & 0x0F) > 3
+                ):
+                    # counts promising more values than the segment holds,
+                    # or an out-of-range table class/id (T.81: Tc 0-1,
+                    # Th 0-3; the C++ decoder rejects these — acceptance
+                    # must agree across implementations)
                     raise CodecError("malformed DHT segment")
                 vals = list(body[b + 17 : b + 17 + nvals])
                 # key on (class, id): an AC-class table sharing a DC table's

@@ -458,6 +458,8 @@ class TestNativeRunnerIntegration:
             np.testing.assert_array_equal(
                 batch["pixels"][i, :100, :100], want[stem]
             )
-            # padding stays zeroed around the retried slice too
+            # padding stays zeroed around the retried slice too — below AND
+            # to the right (a wrong row stride would spill rightward only)
             assert batch["pixels"][i, 100:, :].sum() == 0
+            assert batch["pixels"][i, :100, 100:].sum() == 0
             assert tuple(batch["dims"][i]) == (100, 100)

@@ -53,6 +53,16 @@ class TestVolumeCLI:
             mask, _ = read_metaimage(out2 / pid / "mask.mhd")
             assert mask.sum() == r2["mask_voxels"]
 
+    def test_compressed_mhd_export_round_trips(self, tmp_path):
+        rc, out = _run(tmp_path, "--export-mhd", "--mhd-compressed")
+        assert rc == 0
+        pid = "PGBM-0001"
+        assert (out / pid / "mask.zraw").exists()
+        assert not (out / pid / "mask.raw").exists()
+        mask, _ = read_metaimage(out / pid / "mask.mhd")
+        rec = json.loads((out / "res.json").read_text())["patients"][pid]
+        assert mask.sum() == rec["mask_voxels"]
+
     def test_resume_skips_completed_patients(self, tmp_path, capsys):
         rc, out = _run(tmp_path)
         assert rc == 0
