@@ -95,7 +95,11 @@ def initialize(
     if jax.distributed.is_initialized():  # someone else already joined us
         _initialized = True
         return True
-    explicit = coordinator_address is not None or num_processes is not None
+    explicit = (
+        coordinator_address is not None
+        or num_processes is not None
+        or process_id is not None
+    )
     detected = None if explicit else _cluster_detected()
     if not explicit and detected is False:
         # structurally nothing to join: no arguments, no cluster env signal,
@@ -144,22 +148,15 @@ def initialize(
 def global_mesh(axis_names: Sequence[str] = ("data",), axis_sizes=None):
     """Mesh over EVERY device in the job (all hosts), not just local ones.
 
-    Mirrors :func:`nm03_capstone_project_tpu.parallel.make_mesh` but over the
-    global device set, laid out so the trailing mesh axis varies fastest
-    within a host — keeping intra-host neighbors on ICI and crossing DCN only
-    along the leading (typically ``data``) axis.
+    Delegates to :func:`nm03_capstone_project_tpu.parallel.make_mesh`, whose
+    default device pool is already ``jax.devices()`` — the global set after
+    :func:`initialize` — laid out so the trailing mesh axis varies fastest
+    within a host: intra-host neighbors stay on ICI and only the leading
+    (typically ``data``) axis crosses DCN.
     """
-    import jax
-    import numpy as np
-    from jax.sharding import Mesh
+    from nm03_capstone_project_tpu.parallel.mesh import make_mesh
 
-    devices = jax.devices()  # global across processes after initialize()
-    n = len(devices)
-    if axis_sizes is None:
-        axis_sizes = [n] + [1] * (len(axis_names) - 1)
-    if int(np.prod(axis_sizes)) != n:
-        raise ValueError(f"axis_sizes {axis_sizes} != global device count {n}")
-    return Mesh(np.asarray(devices).reshape(axis_sizes), tuple(axis_names))
+    return make_mesh(axis_names=axis_names, axis_sizes=axis_sizes)
 
 
 def process_info() -> dict:

@@ -82,20 +82,29 @@ def _region_grow_local(
     def global_count(region):
         return jax.lax.psum(region.sum(), AXIS)
 
+    # the state carries the CURRENT region's count so each convergence
+    # check costs one psum, not two (cond used to recompute the popcount
+    # + collective the body had just evaluated)
     def cond(state):
-        region, prev_count, iters = state
-        return (global_count(region) != prev_count) & (iters < max_iters)
+        _, prev_count, count, iters = state
+        return (count != prev_count) & (iters < max_iters)
 
     def body(state):
-        region, _, iters = state
-        count = global_count(region)
-        return grow_block(region), count, iters + block_iters
+        region, _, count, iters = state
+        new_region = grow_block(region)
+        return new_region, count, global_count(new_region), iters + block_iters
 
     region0 = seeds & band_mask
-    region, _, _ = jax.lax.while_loop(
+    region1 = grow_block(region0)
+    region, _, _, _ = jax.lax.while_loop(
         cond,
         body,
-        (grow_block(region0), global_count(region0), jnp.int32(block_iters)),
+        (
+            region1,
+            global_count(region0),
+            global_count(region1),
+            jnp.int32(block_iters),
+        ),
     )
     return region
 
@@ -121,7 +130,7 @@ def _compiled_zsharded(mesh: Mesh, cfg: PipelineConfig):
             pre, seeds, band, n_shards, cfg.grow_block_iters, cfg.grow_max_iters
         )
 
-        seg = cast_uint8(region.astype(jnp.uint8))
+        seg = cast_uint8(region)
         # the final dilation has z-radius morph_size//2: exchange that many
         # halo planes (VERDICT r1 weak #6 — one plane is silently wrong for
         # morph_size >= 5 at shard boundaries). morph_size=1 has radius 0:

@@ -233,7 +233,7 @@ class TestDistributed:
 
         from nm03_capstone_project_tpu.parallel import distributed
 
-        with _pytest.raises(ValueError, match="global device count"):
+        with _pytest.raises(ValueError, match="axis_sizes"):
             distributed.global_mesh(("data",), (len(jax.devices()) + 1,))
 
     def test_process_info_single_host(self):
