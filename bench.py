@@ -88,7 +88,31 @@ CPU_TIMEOUT_S = 420
 # round's TPU record while the orchestrator then idled 7 minutes on CPU work.
 PROBE_VIGIL_SPACING_S = 180
 VIGIL_BUDGET_ENV = "NM03_BENCH_VIGIL_BUDGET_S"
-VIGIL_BUDGET_DEFAULT_S = 2400.0  # total wall budget incl. the CPU baseline
+# Total wall budget for the WHOLE orchestrator run — probe round, accel
+# attempt, CPU baseline, vigil, emit. MUST stay under the driver's 1800 s
+# kill with slack: round 3's record was rc=124/parsed:null precisely because
+# the old 2400 s default let the wedge vigil outlive the external timeout
+# (VERDICT r3 weak item 1). Longer manual vigils: NM03_BENCH_VIGIL_BUDGET_S.
+VIGIL_BUDGET_DEFAULT_S = 1500.0
+# Wall reserved at the tail of the budget for section merging + composing +
+# printing the final JSON line (pure host work, but leave real slack).
+EMIT_RESERVE_S = 45.0
+# Wall reserved for the CPU-baseline worker when capping the accel attempt:
+# without a baseline the record's vs_baseline degrades to 1.0 + error.
+CPU_RESERVE_S = 150.0
+# Accel-attempt shedding tiers (VERDICT r3 item 1: "shed the batch sweep /
+# stage matrix first when the budget runs short"). Below FULL, the attempt
+# drops the sweep, stage matrix, student and Pallas legs and measures one
+# headline batch; below REDUCED there is no time for compile+measure at all.
+# FULL is sized at >4x the observed healthy-tunnel full program (~110 s
+# wall, 2026-07-31 chip run) — a deadline-capped attempt can still be
+# timeout-killed mid-claim if the run needs the pathological end of
+# ACCEL_TIMEOUT_S, but in that regime the tunnel is already sick and the
+# alternative is the external driver's own kill, which wedges just as hard
+# and loses the record besides.
+MIN_ACCEL_FULL_S = 480.0
+MIN_ACCEL_REDUCED_S = 150.0
+MIN_CPU_ATTEMPT_S = 60.0
 
 _SENTINEL = "@@BENCH_RESULT@@"
 
@@ -602,8 +626,12 @@ def _git_sha() -> str:
             ["git", "rev-parse", "--short", "HEAD"],
             capture_output=True, text=True, timeout=10, cwd=cwd,
         ).stdout.strip()
+        # exclude the bench's own output artifacts: a run that only WROTE
+        # results must not stamp itself dirty (round-3's chip record carried
+        # "-dirty" purely because its stdout redirect pre-created the file)
         dirty = subprocess.run(
-            ["git", "status", "--porcelain"],
+            ["git", "status", "--porcelain", "--",
+             ".", ":(exclude)results", ":(exclude)bench_stderr.log"],
             capture_output=True, text=True, timeout=10, cwd=cwd,
         ).stdout.strip()
         return sha + ("-dirty" if dirty else "") if sha else "unknown"
@@ -709,7 +737,7 @@ def _probe_once(env_overrides, label, t0) -> bool:
     return res is not None
 
 
-def _probe_until_healthy(env_overrides, label, t0=None) -> bool:
+def _probe_until_healthy(env_overrides, label, t0=None, deadline=None) -> bool:
     """Short probe attempts with backoff until the backend answers.
 
     A hung probe holds no chip claim (it never gets past device init), so
@@ -721,11 +749,26 @@ def _probe_until_healthy(env_overrides, label, t0=None) -> bool:
     consecutive timeouts end this INITIAL round quickly. Main() then runs the
     CPU baseline (tunnel-independent) and hands the remaining budget to
     _accel_vigil rather than giving up on the round (VERDICT r2 item 1).
+
+    ``deadline``: the orchestrator's wall budget. The retry schedule must
+    never be the thing that eats the round — a probe (or its backoff) that
+    would overrun the budget minus the CPU-baseline + emit reserve is
+    skipped and the round falls through to the wedge path.
     """
     if t0 is None:
         t0 = time.monotonic()
     consecutive_timeouts = 0
     for attempt in range(1, PROBE_ATTEMPTS + 1):
+        if deadline is not None and (
+            deadline - time.monotonic()
+            < PROBE_TIMEOUT_S + MIN_ACCEL_REDUCED_S + CPU_RESERVE_S + EMIT_RESERVE_S
+        ):
+            # a success here could not be measured anyway (the attempt needs
+            # MIN_ACCEL_REDUCED_S past the CPU + emit reserves) — don't burn
+            # a probe on an unmeasurable recovery; fall through to the wedge
+            # path so the CPU baseline still lands
+            _log(f"{label}: budget too low for probe+attempt; wedge path")
+            return False
         ok = _probe_once(
             env_overrides, f"{label} probe {attempt}/{PROBE_ATTEMPTS}", t0
         )
@@ -777,10 +820,11 @@ def _accel_vigil(env_overrides, t0, deadline) -> bool:
         relay_up = any(v == "open" for v in tcp.values()) and since_last >= 60
         due = since_last >= PROBE_VIGIL_SPACING_S
         if relay_up or due:
-            if remaining < PROBE_TIMEOUT_S + 10:
-                # a probe launched now would overshoot the wall budget into
-                # the external driver's kill window; stop cleanly instead
-                _log("vigil: budget too low for another probe; emitting")
+            if remaining < PROBE_TIMEOUT_S + MIN_ACCEL_REDUCED_S + EMIT_RESERVE_S:
+                # a probe launched now either overshoots the wall budget or
+                # recovers a tunnel there is no time left to measure on —
+                # both are wasted wall; stop cleanly instead
+                _log("vigil: budget too low for another probe+attempt; emitting")
                 return False
             if relay_up:
                 _log(f"vigil: relay TCP open ({tcp}); probing now")
@@ -910,21 +954,45 @@ def _compose(accel, cpu, meta) -> dict:
     return out
 
 
-def _measure_accel():
-    """One long-timeout accelerator attempt; None if the headline is lost."""
-    accel = _run_measurement(
-        "accel measurement",
-        [
-            "--reps",
-            str(TPU_REPS),
-            "--pallas",
-            "--stages",
-            "--batches",
-            ",".join(str(b) for b in ACCEL_BATCH_SWEEP),
-        ],
-        {},
-        ACCEL_TIMEOUT_S,
-    )
+def _measure_accel(deadline=None, cpu_banked=False):
+    """One long-timeout accelerator attempt; None if the headline is lost.
+
+    ``deadline``-aware (VERDICT r3 item 1): the attempt's timeout is capped
+    so the orchestrator can still run the CPU baseline and emit inside the
+    wall budget. When the cap leaves too little for the full program, the
+    batch sweep / stage matrix / Pallas / student legs are shed first and a
+    single headline batch is measured; when even that cannot fit, the
+    attempt is skipped (an un-measurable recovery is not worth a mid-compile
+    kill, which wedges the tunnel for whoever runs next).
+
+    ``cpu_banked``: True on the vigil path, where the CPU baseline already
+    ran and NO cpu work follows this attempt — reserving CPU_RESERVE_S
+    there would double-count it and shed (or skip) late recoveries that
+    genuinely fit, forfeiting the round's accelerator record.
+    """
+    timeout_s = ACCEL_TIMEOUT_S
+    args = [
+        "--reps",
+        str(TPU_REPS),
+        "--pallas",
+        "--stages",
+        "--batches",
+        ",".join(str(b) for b in ACCEL_BATCH_SWEEP),
+    ]
+    if deadline is not None:
+        reserve = EMIT_RESERVE_S + (0.0 if cpu_banked else CPU_RESERVE_S)
+        remaining = deadline - time.monotonic() - reserve
+        if remaining < MIN_ACCEL_REDUCED_S:
+            _log(f"accel: {remaining:.0f}s left — no room for an attempt; skipping")
+            return None
+        if remaining < MIN_ACCEL_FULL_S:
+            _log(
+                f"accel: {remaining:.0f}s left — shedding sweep/stages/"
+                "pallas/student; headline batch only"
+            )
+            args = ["--reps", str(TPU_REPS), "--batches", str(BATCH)]
+        timeout_s = min(ACCEL_TIMEOUT_S, remaining)
+    accel = _run_measurement("accel measurement", args, {}, timeout_s)
     # a partial record without the headline number is useless — treat as lost
     if accel is not None and "xla_tput" not in accel:
         _log(f"accel sections incomplete ({sorted(accel)}); discarding")
@@ -935,8 +1003,14 @@ def _measure_accel():
 _CPU_ENV = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": None}
 
 
-_PARTIAL_PATH = os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), "results", "bench_partial.json"
+# abspath: a bare-filename override would give _bank_partial an empty
+# dirname, whose makedirs('') OSError is silently swallowed — and the
+# SIGKILL-proof banked record would never be written
+_PARTIAL_PATH = os.path.abspath(
+    os.environ.get("NM03_BENCH_PARTIAL_PATH")
+    or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results", "bench_partial.json"
+    )
 )
 
 
@@ -960,7 +1034,10 @@ def main() -> None:
     # long-timeout accel attempt. If the tunnel is wedged (or the attempt
     # lost), bank the tunnel-independent CPU baseline IMMEDIATELY, then keep
     # re-probing at PROBE_VIGIL_SPACING_S until the overall wall budget
-    # (NM03_BENCH_VIGIL_BUDGET_S, default 40 min) is spent — only then emit.
+    # (NM03_BENCH_VIGIL_BUDGET_S, default 25 min — strictly inside the
+    # driver's 30 min kill) is spent — only then emit. EVERY phase is capped
+    # against the deadline (VERDICT r3 item 1): probe retries, the accel
+    # attempt (shedding sweep/stages first), the CPU baseline, the vigil.
     # The orchestrator never imports jax; all measurement is in subprocess
     # workers with hard timeouts, and probe diagnostics land in the JSON.
     t0 = time.monotonic()
@@ -1004,11 +1081,31 @@ def main() -> None:
         os._exit(0)
 
     old_term = signal.signal(signal.SIGTERM, _on_term)
+    # SIGALRM backstop: if any phase wedges past its cap (e.g. an unkillable
+    # worker blocking communicate()), the alarm forces the best-so-far emit
+    # well before the external driver's kill. Cancelled before the normal
+    # emit so the record can never be printed twice.
+    old_alrm = signal.signal(signal.SIGALRM, _on_term)
+    signal.alarm(int(budget_s + EMIT_RESERVE_S))
+
+    def _measure_cpu(batch_args):
+        """Deadline-capped CPU-baseline attempt; None when lost or skipped."""
+        timeout_s = min(CPU_TIMEOUT_S, deadline - time.monotonic() - EMIT_RESERVE_S)
+        if timeout_s < MIN_CPU_ATTEMPT_S:
+            _log("cpu baseline: budget too low; skipping")
+            return None
+        cpu = _run_measurement(
+            "cpu baseline",
+            ["--platform", "cpu", "--reps", str(CPU_REPS), *batch_args],
+            _CPU_ENV,
+            timeout_s,
+        )
+        return cpu if cpu and "xla_tput" in cpu else None
 
     # state is the single source of truth for what has been measured — the
     # SIGTERM handler and the banked on-disk record both read it
-    if _probe_until_healthy({}, "accel", t0):
-        state["accel"] = _measure_accel()
+    if _probe_until_healthy({}, "accel", t0, deadline):
+        state["accel"] = _measure_accel(deadline)
         # bank before the CPU baseline: a kill during that phase must not
         # cost the already-measured accelerator record
         _bank_partial(state)
@@ -1018,49 +1115,37 @@ def main() -> None:
         # cannot touch the tunnel), sweeping every accel batch size so the
         # ratio stays same-program whatever batch later wins on the chip,
         # and carrying the stage breakdown for diagnosability
-        cpu = _run_measurement(
-            "cpu baseline",
-            [
-                "--platform", "cpu",
-                "--reps", str(CPU_REPS),
-                "--batches", ",".join(str(b) for b in ACCEL_BATCH_SWEEP),
-                "--stages",
-            ],
-            _CPU_ENV,
-            CPU_TIMEOUT_S,
+        state["cpu"] = _measure_cpu(
+            ["--batches", ",".join(str(b) for b in ACCEL_BATCH_SWEEP), "--stages"]
         )
-        state["cpu"] = cpu if cpu and "xla_tput" in cpu else None
         # bank the best-so-far record to a file before entering the vigil:
         # stdout still carries exactly ONE line at the end, but if an
         # external supervisor hard-kills (SIGKILL) mid-vigil — which no
         # handler can catch — the round's measurement survives on disk
         _bank_partial(state)
-        # now spend whatever budget remains waiting for the tunnel — the
-        # heavy attempt itself is not deadline-capped (real work > budget)
+        # now spend whatever budget remains waiting for the tunnel; a late
+        # recovery gets a deadline-capped (possibly shed) attempt with no
+        # CPU reserve — the baseline above is the only cpu work this path does
         if _accel_vigil({}, t0, deadline):
-            state["accel"] = _measure_accel()
+            state["accel"] = _measure_accel(deadline, cpu_banked=True)
             _bank_partial(state)
     elif state["accel"]["backend"] != "cpu":
         # accel record in hand: CPU baseline at exactly the winning batch
-        cpu = _run_measurement(
-            "cpu baseline",
-            [
-                "--platform", "cpu",
-                "--reps", str(CPU_REPS),
-                "--batches", str(state["accel"].get("xla_batch", BATCH)),
-            ],
-            _CPU_ENV,
-            CPU_TIMEOUT_S,
+        state["cpu"] = _measure_cpu(
+            ["--batches", str(state["accel"].get("xla_batch", BATCH))]
         )
-        state["cpu"] = cpu if cpu and "xla_tput" in cpu else None
 
     state["meta"]["elapsed_s"] = round(time.monotonic() - t0, 1)
     _bank_partial(state)
+    # nothing left but pure host compose+print: the alarm's job is done, and
+    # cancelling it first means the record can never hit stdout twice
+    signal.alarm(0)
     print(json.dumps(_compose(state["accel"], state["cpu"], state["meta"])),
           flush=True)
     # only restore AFTER the record is on stdout — restoring first would
     # reopen the very lost-record window the handler exists to close
     signal.signal(signal.SIGTERM, old_term)
+    signal.signal(signal.SIGALRM, old_alrm)
 
 
 if __name__ == "__main__":

@@ -31,6 +31,10 @@ from pathlib import Path
 
 import numpy as np
 
+# Self-locating: runnable as `python scripts/student_eval.py` even when the
+# package is not installed (sys.path[0] is scripts/, not the repo root).
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
 
 def parse_args(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
