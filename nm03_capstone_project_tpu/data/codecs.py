@@ -251,6 +251,7 @@ def jpeg_lossless_decode(data: bytes, expect_shape=None) -> np.ndarray:
     sel = 1
     pt = 0
     table_id = 0
+    got_sos = False
     while pos + 4 <= len(data):
         if data[pos] != 0xFF:
             raise CodecError(f"expected JPEG marker at {pos}")
@@ -306,11 +307,18 @@ def jpeg_lossless_decode(data: bytes, expect_shape=None) -> np.ndarray:
             table_id = body[2] >> 4  # Td (DC table selects the lossless table)
             sel = body[1 + 2 * ns]  # Ss = predictor selection value
             pt = body[3 + 2 * ns] & 0x0F  # Al = point transform
+            got_sos = True
             pos = seg_end
             break  # entropy-coded data follows
         pos = seg_end
     if precision is None or rows is None:
         raise CodecError("JPEG stream missing SOF3 header")
+    if not got_sos:
+        # without this a SOF3+DHT stream with no scan would decode trailing
+        # bytes as entropy data under the default sel/table — an acceptance
+        # divergence from the native decoder, which requires a scan header
+        # (csrc/nm03native.cpp got_sos check)
+        raise CodecError("JPEG stream missing SOS marker")
     if (0, table_id) not in huff_tables:  # lossless scans use DC-class tables
         raise CodecError(f"JPEG scan references undefined Huffman table {table_id}")
     if sel < 1 or sel > 7:

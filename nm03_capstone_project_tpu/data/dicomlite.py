@@ -260,6 +260,17 @@ def _decode_compressed(
 
     if not fragments:
         raise DicomParseError("encapsulated PixelData has no fragments")
+    # Header plausibility bound BEFORE any decoder allocates: a hostile file
+    # declaring 65535x65535 must fail here, not after rle_decode_frame's
+    # replicate pass expands fragments into a multi-GB host buffer. Same
+    # caps as the native decoder (32768 per axis, 2^28 output bytes).
+    if not (0 < rows <= 32768 and 0 < cols <= 32768) or (
+        rows * cols * dtype.itemsize > 1 << 28
+    ):
+        raise DicomParseError(
+            f"implausible compressed-frame dimensions ({rows}, {cols}) at "
+            f"{dtype.itemsize * 8}-bit"
+        )
     try:
         if transfer_syntax == RLE_LOSSLESS:
             if len(fragments) != 1:

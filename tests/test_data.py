@@ -304,6 +304,35 @@ class TestCompressedTransferSyntaxes:
         with pytest.raises(codecs.CodecError):
             codecs.jpeg_lossless_decode(enc[: len(enc) // 2])
 
+    def test_jpeg_stream_without_sos_rejected(self):
+        # SOF3+DHT but no scan header: decoding trailing bytes as entropy
+        # data under the default predictor/table would be an acceptance
+        # divergence from the native decoder (ADVICE r3)
+        from nm03_capstone_project_tpu.data import codecs
+
+        img = np.arange(64, dtype=np.uint16).reshape(8, 8)
+        enc = codecs.jpeg_lossless_encode(img)
+        i = enc.index(b"\xff\xda")  # strip the SOS segment + scan
+        with pytest.raises(codecs.CodecError, match="missing SOS"):
+            codecs.jpeg_lossless_decode(enc[:i] + b"\xff\xd9")
+
+    def test_hostile_rle_dimensions_rejected_before_decode(self):
+        # a file declaring 65535x65535 must fail the plausibility bound
+        # BEFORE rle_decode_frame's replicate pass can expand fragments into
+        # a multi-GB host allocation (ADVICE r3; native caps: 32768 / 2^28)
+        from nm03_capstone_project_tpu.data.dicomlite import (
+            RLE_LOSSLESS,
+            DicomParseError,
+            _decode_compressed,
+        )
+
+        header = struct.pack("<16I", 1, 64, *([0] * 14))
+        with pytest.raises(DicomParseError, match="implausible"):
+            _decode_compressed(
+                RLE_LOSSLESS, [header + b"\x00" * 8], 65535, 65535,
+                np.dtype("<u2"),
+            )
+
 
 class TestImporterEnvelopeMinimal:
     @staticmethod
