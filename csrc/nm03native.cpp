@@ -550,6 +550,331 @@ bool read_file(const char* path, std::vector<uint8_t>* out) {
   return true;
 }
 
+// ---------------------------------------------------------------------------
+// JPEG-LS (ITU-T T.87) decoder — native mirror of data/codecs.py
+// jpegls_decode. LOCO-I: MED prediction, 365 bias-corrected Golomb contexts,
+// run mode with two run-interruption contexts. Lossless + near-lossless,
+// single component, interleave none; conformance pinned against CharLS
+// streams by tests/test_jpegls.py::TestNativeParity (vendored goldens +
+// live three-way fuzz) alongside the Python decoder.
+// ---------------------------------------------------------------------------
+
+struct JlsBitReader {
+  const uint8_t* buf;
+  size_t len, pos;
+  uint64_t cache = 0;
+  int nbits = 0;
+  bool prev_ff = false;
+  bool ok = true;
+
+  bool fill() {
+    if (pos >= len) { ok = false; return false; }
+    uint8_t b = buf[pos];
+    if (prev_ff) {
+      if (b >= 0x80) { ok = false; return false; }  // marker ends the scan
+      ++pos;
+      cache = (cache << 7) | b;
+      nbits += 7;
+      prev_ff = false;
+    } else {
+      ++pos;
+      cache = (cache << 8) | b;
+      nbits += 8;
+      prev_ff = (b == 0xFF);
+    }
+    return true;
+  }
+  int read_bit() {
+    if (nbits == 0 && !fill()) return 0;
+    --nbits;
+    return (int)((cache >> nbits) & 1);
+  }
+  uint32_t read_bits(int n) {
+    while (nbits < n) if (!fill()) return 0;
+    nbits -= n;
+    uint32_t v = (uint32_t)((cache >> nbits) & ((1u << n) - 1));
+    cache &= (nbits ? ((uint64_t)1 << nbits) - 1 : 0);
+    return v;
+  }
+  int read_zero_run(int cap) {
+    int z = 0;
+    while (true) {
+      if (read_bit()) return z;
+      if (!ok) return -1;
+      if (++z > cap) { ok = false; return -1; }
+    }
+  }
+};
+
+struct JlsRunCtx { int32_t a, n, nn; };
+
+bool jpegls_decode(const uint8_t* data, size_t len, long expect_rows,
+                   long expect_cols, std::vector<uint16_t>* out,
+                   long* rows_out, long* cols_out) {
+  if (len < 4 || data[0] != 0xFF || data[1] != 0xD8) {
+    set_error("not a JPEG-LS stream (missing SOI)");
+    return false;
+  }
+  size_t pos = 2;
+  int precision = -1;
+  long rows = 0, cols = 0;
+  long maxval_hdr = 0, t1_hdr = 0, t2_hdr = 0, t3_hdr = 0, reset_hdr = 0;
+  int near = 0;
+  size_t entropy_at = 0;
+  bool got_sos = false;
+  while (pos + 4 <= len) {
+    if (data[pos] != 0xFF) { set_error("expected JPEG-LS marker"); return false; }
+    uint8_t marker = data[pos + 1];
+    pos += 2;
+    if (marker == 0xD9) break;  // EOI before SOS
+    size_t seglen = ((size_t)data[pos] << 8) | data[pos + 1];
+    size_t seg_end = pos + seglen;
+    if (seglen < 2 || seg_end > len) { set_error("truncated JPEG-LS segment"); return false; }
+    const uint8_t* body = data + pos + 2;
+    size_t body_len = seglen - 2;
+    if (marker == 0xF7) {  // SOF55
+      if (body_len < 6) { set_error("short SOF55"); return false; }
+      precision = body[0];
+      rows = ((long)body[1] << 8) | body[2];
+      cols = ((long)body[3] << 8) | body[4];
+      if (body[5] != 1) { set_error("JPEG-LS: expected 1 component"); return false; }
+    } else if (marker >= 0xC0 && marker <= 0xCB && marker != 0xC4 && marker != 0xC8) {
+      set_error("not JPEG-LS (wrong SOF)");
+      return false;
+    } else if (marker == 0xF8) {  // LSE
+      if (body_len < 1 || body[0] != 1) { set_error("unsupported LSE segment"); return false; }
+      if (body_len < 11) { set_error("short LSE preset segment"); return false; }
+      maxval_hdr = ((long)body[1] << 8) | body[2];
+      t1_hdr = ((long)body[3] << 8) | body[4];
+      t2_hdr = ((long)body[5] << 8) | body[6];
+      t3_hdr = ((long)body[7] << 8) | body[8];
+      reset_hdr = ((long)body[9] << 8) | body[10];
+    } else if (marker == 0xDD) {
+      set_error("JPEG-LS restart intervals unsupported");
+      return false;
+    } else if (marker == 0xDA) {  // SOS
+      if (body_len < 6) { set_error("short JPEG-LS SOS"); return false; }
+      if (body[0] != 1) { set_error("expected 1 scan component"); return false; }
+      if (body[2] != 0) { set_error("JPEG-LS mapping tables unsupported"); return false; }
+      near = body[3];
+      if (body[4] != 0) { set_error("JPEG-LS interleave unsupported"); return false; }
+      if ((body[5] & 0x0F) != 0) { set_error("JPEG-LS point transform unsupported"); return false; }
+      entropy_at = seg_end;
+      got_sos = true;
+      break;
+    }
+    pos = seg_end;
+  }
+  if (precision < 2 || precision > 16) { set_error("JPEG-LS missing/invalid SOF55"); return false; }
+  if (!got_sos) { set_error("JPEG-LS stream missing SOS"); return false; }
+  if (expect_rows > 0 && (rows != expect_rows || cols != expect_cols)) {
+    set_error("JPEG-LS frame dimensions disagree with DICOM header");
+    return false;
+  }
+  if (rows <= 0 || cols <= 0 || rows > 32768 || cols > 32768) {
+    set_error("implausible JPEG-LS dimensions");
+    return false;
+  }
+  long maxval = maxval_hdr ? maxval_hdr : ((1L << precision) - 1);
+  if (maxval <= 0 || maxval >= (1L << precision)) { set_error("invalid JPEG-LS MAXVAL"); return false; }
+  if (near < 0 || near > maxval / 2) { set_error("invalid JPEG-LS NEAR"); return false; }
+
+  // default thresholds (T.87 C.2.4.1.1.1)
+  long t1, t2, t3, reset = 64;
+  {
+    auto clampv = [&](long i, long j) { return (i > maxval || i < j) ? j : i; };
+    if (maxval >= 128) {
+      long factor = ((maxval < 4095 ? maxval : 4095) + 128) / 256;
+      t1 = clampv(factor * 1 + 2 + 3 * near, near + 1);
+      t2 = clampv(factor * 4 + 3 + 5 * near, t1);
+      t3 = clampv(factor * 17 + 4 + 7 * near, t2);
+    } else {
+      long factor = 256 / (maxval + 1);
+      long v1 = 3 / factor + 3 * near; if (v1 < 2) v1 = 2;
+      long v2 = 7 / factor + 5 * near; if (v2 < 3) v2 = 3;
+      long v3 = 21 / factor + 7 * near; if (v3 < 4) v3 = 4;
+      t1 = clampv(v1, near + 1);
+      t2 = clampv(v2, t1);
+      t3 = clampv(v3, t2);
+    }
+  }
+  if (t1_hdr) t1 = t1_hdr;
+  if (t2_hdr) t2 = t2_hdr;
+  if (t3_hdr) t3 = t3_hdr;
+  if (reset_hdr) reset = reset_hdr;
+  if (!(near + 1 <= t1 && t1 <= t2 && t2 <= t3 && t3 <= maxval)) {
+    set_error("invalid JPEG-LS thresholds");
+    return false;
+  }
+  // T.87 C.2.4.1.1 range; unbounded RESET would let the int32 context
+  // accumulators overflow (UB) before the halving ever triggers
+  if (reset < 3 || reset > (maxval > 255 ? maxval : 255)) {
+    set_error("invalid JPEG-LS RESET");
+    return false;
+  }
+
+  const long quant_step = 2L * near + 1;
+  const long range = (maxval + 2 * near) / quant_step + 1;
+  int qbpp = 1; while ((1L << qbpp) < range) ++qbpp;
+  int bpp = 2; while ((1L << bpp) <= maxval) ++bpp;
+  const int limit = 2 * (bpp > 8 ? 2 * bpp : bpp + 8);
+  const long range_step = range * quant_step;
+
+  static const int J[32] = {0, 0, 0, 0, 1, 1, 1, 1, 2, 2, 2, 2, 3, 3, 3, 3,
+                            4, 4, 5, 5, 6, 6, 7, 7, 8, 9, 10, 11, 12, 13, 14, 15};
+  const int32_t a_init = (int32_t)std::max(2L, (range + 32) >> 6);
+  std::vector<int32_t> A(365, a_init), B(365, 0), C(365, 0), N(365, 1);
+  JlsRunCtx rctx[2] = {{a_init, 1, 0}, {a_init, 1, 0}};
+  int run_index = 0;
+
+  auto quantize = [&](long d) -> int {
+    if (d <= -t3) return -4;
+    if (d <= -t2) return -3;
+    if (d <= -t1) return -2;
+    if (d < -near) return -1;
+    if (d <= near) return 0;
+    if (d < t1) return 1;
+    if (d < t2) return 2;
+    if (d < t3) return 3;
+    return 4;
+  };
+
+  JlsBitReader r{data, len, entropy_at};
+
+  auto decode_value = [&](int k, int lim) -> long {
+    int z = r.read_zero_run(lim);
+    if (z < 0) return -1;
+    if (z >= lim - qbpp - 1) return (long)r.read_bits(qbpp) + 1;
+    if (k == 0) return z;
+    return ((long)z << k) | r.read_bits(k);
+  };
+
+  auto fix_reconstructed = [&](long v) -> long {
+    if (v < -near) v += range_step;
+    else if (v > maxval + near) v -= range_step;
+    if (v < 0) return 0;
+    if (v > maxval) return maxval;
+    return v;
+  };
+
+  auto decode_run_interruption_error = [&](int ctx) -> long {
+    JlsRunCtx& c = rctx[ctx];
+    long temp = c.a + (ctx ? (c.n >> 1) : 0);
+    int k = 0;
+    while (((long)c.n << k) < temp) { if (++k > 32) { r.ok = false; return 0; } }
+    long em = decode_value(k, limit - J[run_index] - 1);
+    if (em < 0) { r.ok = false; return 0; }
+    long tv = em + ctx;
+    int map_bit = (int)(tv & 1);
+    long eabs = (tv + map_bit) >> 1;
+    bool cond = (k != 0) || (2 * c.nn >= c.n);
+    long err = (cond == (map_bit != 0)) ? -eabs : eabs;
+    if (err < 0) ++c.nn;
+    c.a += (int32_t)((em + 1 - ctx) >> 1);
+    if (c.n == (int32_t)reset) { c.a >>= 1; c.n >>= 1; c.nn >>= 1; }
+    ++c.n;
+    return err;
+  };
+
+  out->assign((size_t)rows * cols, 0);
+  std::vector<long> prev((size_t)cols + 2, 0), cur((size_t)cols + 2, 0);
+  for (long y = 0; y < rows; ++y) {
+    prev[cols + 1] = prev[cols];
+    cur[0] = prev[1];
+    long x = 1;
+    while (x <= cols) {
+      if (!r.ok) { set_error("truncated JPEG-LS entropy stream"); return false; }
+      long ra = cur[x - 1], rb = prev[x], rc = prev[x - 1], rd = prev[x + 1];
+      int q1 = quantize(rd - rb), q2 = quantize(rb - rc), q3 = quantize(rc - ra);
+      if (q1 == 0 && q2 == 0 && q3 == 0) {
+        // run mode
+        long remaining = cols - x + 1;
+        long count = 0;
+        bool broke_on_zero = true;
+        while (true) {
+          if (count == remaining) { broke_on_zero = false; break; }
+          int bit = r.read_bit();
+          if (!r.ok) { set_error("truncated JPEG-LS entropy stream"); return false; }
+          if (!bit) break;
+          long seg = 1L << J[run_index];
+          long take = seg < remaining - count ? seg : remaining - count;
+          count += take;
+          if (take == seg && run_index < 31) ++run_index;
+          if (count == remaining) { broke_on_zero = false; break; }
+        }
+        if (broke_on_zero) {
+          int j = J[run_index];
+          if (j) count += r.read_bits(j);
+          if (!r.ok || count >= remaining) { set_error("JPEG-LS run overruns the line"); return false; }
+        }
+        for (long i = 0; i < count; ++i) cur[x + i] = ra;
+        x += count;
+        if (!broke_on_zero) continue;
+        rb = prev[x];
+        int ritype = (std::labs(ra - rb) <= near) ? 1 : 0;
+        long err = decode_run_interruption_error(ritype);
+        if (!r.ok) { set_error("truncated JPEG-LS entropy stream"); return false; }
+        long rx;
+        if (ritype) rx = fix_reconstructed(ra + err * quant_step);
+        else {
+          long sgn = rb < ra ? -1 : 1;
+          rx = fix_reconstructed(rb + sgn * err * quant_step);
+        }
+        cur[x] = rx;
+        ++x;
+        if (run_index > 0) --run_index;
+        continue;
+      }
+      // regular mode
+      long qs = 81L * q1 + 9L * q2 + q3;
+      long sign = 1;
+      if (qs < 0) { sign = -1; qs = -qs; }
+      long px;
+      long mn = ra < rb ? ra : rb, mx = ra < rb ? rb : ra;
+      if (rc >= mx) px = mn;
+      else if (rc <= mn) px = mx;
+      else px = ra + rb - rc;
+      px += sign > 0 ? C[qs] : -C[qs];
+      if (px < 0) px = 0; else if (px > maxval) px = maxval;
+      int32_t a = A[qs], n = N[qs];
+      int k = 0;
+      while (((long)n << k) < a) { if (++k > 32) { set_error("JPEG-LS k overflow"); return false; } }
+      long m = decode_value(k, limit);
+      if (m < 0) { set_error("truncated JPEG-LS entropy stream"); return false; }
+      long err = ((m & 1) == 0) ? (m >> 1) : -((m + 1) >> 1);
+      if (k == 0 && near == 0 && 2 * B[qs] <= -n) err = -err - 1;
+      B[qs] += (int32_t)(err * quant_step);
+      A[qs] += (int32_t)(err >= 0 ? err : -err);
+      if (n == (int32_t)reset) { A[qs] >>= 1; B[qs] >>= 1; N[qs] = n >> 1; }
+      ++N[qs];
+      n = N[qs];
+      if (B[qs] + n <= 0) {
+        B[qs] += n;
+        if (B[qs] <= -n) B[qs] = -n + 1;
+        if (C[qs] > -128) --C[qs];
+      } else if (B[qs] > 0) {
+        B[qs] -= n;
+        if (B[qs] > 0) B[qs] = 0;
+        if (C[qs] < 127) ++C[qs];
+      }
+      cur[x] = fix_reconstructed(px + sign * err * quant_step);
+      ++x;
+    }
+    for (long i = 0; i < cols; ++i)
+      (*out)[(size_t)y * cols + i] = (uint16_t)cur[i + 1];
+    std::swap(prev, cur);
+  }
+  // scan must terminate with EOI (acceptance agreement with the Python
+  // decoder and CharLS); unread bits of the current byte are padding
+  size_t p = r.pos;
+  bool eoi = (r.prev_ff && p < len && data[p] == 0xD9) ||
+             (p + 1 < len && data[p] == 0xFF && data[p + 1] == 0xD9);
+  if (!eoi) { set_error("JPEG-LS stream missing EOI"); return false; }
+  *rows_out = rows;
+  *cols_out = cols;
+  return true;
+}
+
 // Decode one slice into `pixels` (resized), returning rows/cols.
 // Mirrors read_dicom() in dicomlite.py.
 bool decode_dicom(const uint8_t* raw, size_t raw_len,
@@ -588,12 +913,12 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
   }
 
   bool explicit_vr;
-  bool rle = false, jpegll = false;
+  bool rle = false, jpegll = false, jls = false;
   if (transfer_syntax == "1.2.840.10008.1.2.1") explicit_vr = true;
   else if (transfer_syntax == "1.2.840.10008.1.2") explicit_vr = false;
   else if (transfer_syntax == "1.2.840.10008.1.2.5") {
-    // RLE Lossless and JPEG Lossless decode natively; other compressed
-    // syntaxes (baseline JPEG, JPEG-LS, J2K) fall back to the Python
+    // RLE Lossless, JPEG Lossless and JPEG-LS decode natively; other
+    // compressed syntaxes (baseline JPEG, J2K) fall back to the Python
     // reader (cli/runner.py retries parse failures there)
     explicit_vr = true;
     rle = true;
@@ -601,11 +926,15 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
              transfer_syntax == "1.2.840.10008.1.2.4.70") {
     explicit_vr = true;
     jpegll = true;
+  } else if (transfer_syntax == "1.2.840.10008.1.2.4.80" ||
+             transfer_syntax == "1.2.840.10008.1.2.4.81") {
+    explicit_vr = true;
+    jls = true;
   }
   else { set_error("unsupported transfer syntax: " + transfer_syntax); return false; }
 
   DataSet ds;
-  if (!parse_dataset(body, body_len, explicit_vr, &ds, rle || jpegll)) return false;
+  if (!parse_dataset(body, body_len, explicit_vr, &ds, rle || jpegll || jls)) return false;
 
   long rows = 0, cols = 0;
   if (!meta_int(ds, tag(0x0028, 0x0010), &rows) ||
@@ -614,7 +943,7 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
     set_error("missing Rows/Columns/PixelData");
     return false;
   }
-  if ((rle || jpegll) && ds.pixel_data) {
+  if ((rle || jpegll || jls) && ds.pixel_data) {
     set_error("compressed transfer syntax with native PixelData (malformed file)");
     return false;
   }
@@ -649,7 +978,7 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
       return false;
     ds.pixel_data = decomp_buf.data();
     ds.pixel_len = decomp_buf.size();
-  } else if (jpegll) {
+  } else if (jpegll || jls) {
     // single fragment (the common single-frame case) decodes in place; a
     // frame spanning fragments is joined first
     const uint8_t* stream_ptr = ds.fragments[0].first;
@@ -663,9 +992,11 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
     }
     std::vector<uint16_t> samples;
     long jr = 0, jc = 0;
-    if (!jpeg_lossless_decode(stream_ptr, stream_len, rows, cols, &samples,
-                              &jr, &jc))
-      return false;
+    bool ok = jls ? jpegls_decode(stream_ptr, stream_len, rows, cols,
+                                  &samples, &jr, &jc)
+                  : jpeg_lossless_decode(stream_ptr, stream_len, rows, cols,
+                                         &samples, &jr, &jc);
+    if (!ok) return false;
     decomp_buf.resize(samples.size() * (bits / 8));
     if (bits == 16) {
       for (size_t i = 0; i < samples.size(); ++i) {
@@ -675,7 +1006,8 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
     } else {
       for (size_t i = 0; i < samples.size(); ++i) {
         if (samples[i] > 0xFF) {
-          set_error("lossless JPEG precision exceeds BitsAllocated=8");
+          set_error((jls ? "JPEG-LS" : "lossless JPEG") +
+                    std::string(" precision exceeds BitsAllocated=8"));
           return false;
         }
         decomp_buf[i] = (uint8_t)samples[i];

@@ -631,12 +631,12 @@ class CohortProcessor:
         )
         # parse failures retry through the Python reader: its envelope is a
         # superset of the C++ parser's (the C++ side decodes uncompressed
-        # LE, RLE Lossless and JPEG Lossless; baseline JPEG decodes via
-        # PIL in the Python reader only), so a compressed cohort still
-        # flows through the native fast path with per-slice fallback
-        # instead of failing wholesale. The retries run on their own small
-        # pool: a fully-baseline-JPEG batch would otherwise decode
-        # serially on this one thread.
+        # LE, RLE Lossless, JPEG Lossless and JPEG-LS; baseline JPEG
+        # decodes via PIL in the Python reader only), so a compressed
+        # cohort still flows through the native fast path with per-slice
+        # fallback instead of failing wholesale. The retries run on their
+        # own small pool: a fully-baseline-JPEG batch would otherwise
+        # decode serially on this one thread.
         retry_idx = [
             i for i, (o, e) in enumerate(zip(okf, errs))
             if not o and int(e) == 2  # "DICOM parse failed"

