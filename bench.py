@@ -267,6 +267,109 @@ def _bench_student(device, pixels, dims, reps):
     return pixels.shape[0] * reps / (time.perf_counter() - t0)
 
 
+VOLUME_DEPTH = 22
+VOLUME_REPS = 8
+ZSHARD_DEPTH = 16
+ZSHARD_CANVAS = 128
+
+
+def _make_volume(depth, canvas):
+    """One synthetic series stacked into a (depth, canvas, canvas) volume
+    with a waxing/waning lesion, mirroring the cohort generator's shape
+    (BASELINE.json config 4: ~22 slices of 256²)."""
+    import numpy as np
+
+    from nm03_capstone_project_tpu.data.synthetic import phantom_slice
+
+    rad = [0.10 + 0.08 * (1 - abs(2 * i / (depth - 1) - 1)) for i in range(depth)]
+    vol = np.stack(
+        [phantom_slice(canvas, canvas, seed=7, lesion_radius=r) for r in rad]
+    ).astype(np.float32)
+    dims = np.asarray([canvas, canvas], np.int32)
+    return vol, dims
+
+
+def _bench_volume(device, reps):
+    """Per-volume wall for the 3D pipeline (grow3d + morphology), same
+    enqueue-then-sync methodology as the 2D path (VERDICT r3 item 5)."""
+    import jax
+    import jax.numpy as jnp
+
+    from nm03_capstone_project_tpu.config import PipelineConfig
+    from nm03_capstone_project_tpu.pipeline.volume_pipeline import process_volume
+
+    cfg = PipelineConfig()
+    vol, dims = _make_volume(VOLUME_DEPTH, CANVAS)
+    v = jax.device_put(jnp.asarray(vol), device)
+    d = jax.device_put(jnp.asarray(dims), device)
+    fn = jax.jit(
+        lambda vv, dd: process_volume(vv, dd, cfg)["mask"].astype(jnp.int32).sum()
+    )
+    t0 = time.perf_counter()
+    checksum = int(fn(v, d))
+    _log(f"volume: compile+first run {time.perf_counter() - t0:.1f}s "
+         f"(checksum {checksum})")
+    t0 = time.perf_counter()
+    outs = [fn(v, d) for _ in range(reps)]
+    int(outs[-1])
+    per_volume = (time.perf_counter() - t0) / reps
+    return {
+        "ms_per_volume": round(per_volume * 1e3, 2),
+        "depth": VOLUME_DEPTH,
+        "canvas": CANVAS,
+        "mvoxels_per_s": round(
+            VOLUME_DEPTH * CANVAS * CANVAS / per_volume / 1e6, 2
+        ),
+        "checksum": checksum,
+    }
+
+
+def zshard_scaling() -> None:
+    """Relative-scaling curve of the z-sharded volume pipeline over subsets
+    of the (virtual) device set: 1/2/4/8 z-shards on one small volume.
+
+    Runs under JAX_PLATFORMS=cpu with xla_force_host_platform_device_count=8
+    (the parent sets the env), so it is tunnel-independent; on real
+    multi-chip hardware the same code path rides ICI instead.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from nm03_capstone_project_tpu.config import PipelineConfig
+    from nm03_capstone_project_tpu.parallel.mesh import make_mesh
+    from nm03_capstone_project_tpu.parallel.zshard import process_volume_zsharded
+
+    cfg = PipelineConfig()
+    vol, dims = _make_volume(ZSHARD_DEPTH, ZSHARD_CANVAS)
+    v = jnp.asarray(vol)
+    d = jnp.asarray(dims)
+    devices = jax.devices()
+    out: dict = {"depth": ZSHARD_DEPTH, "canvas": ZSHARD_CANVAS, "ms": {}}
+    base_checksum = None
+    for shards in (1, 2, 4, 8):
+        if shards > len(devices):
+            break
+        mesh = make_mesh(axis_names=("z",), devices=devices[:shards])
+        fn = jax.jit(
+            lambda vv, dd, m=mesh: process_volume_zsharded(vv, dd, cfg, m)[
+                "mask"
+            ].astype(jnp.int32).sum()
+        )
+        checksum = int(fn(v, d))  # compile + warm
+        if base_checksum is None:
+            base_checksum = checksum
+        reps = 4
+        t0 = time.perf_counter()
+        outs = [fn(v, d) for _ in range(reps)]
+        int(outs[-1])
+        ms = (time.perf_counter() - t0) / reps * 1e3
+        out["ms"][str(shards)] = round(ms, 2)
+        out.setdefault("checksum_ok", True)
+        out["checksum_ok"] = out["checksum_ok"] and checksum == base_checksum
+        _log(f"zshard {shards}: {ms:.1f} ms/volume (checksum {checksum})")
+    print(_SENTINEL + json.dumps(out), flush=True)
+
+
 def _time_stage(fn, args, reps):
     """Seconds per call: jit, warm up, enqueue ``reps``, one checksum sync."""
     import jax
@@ -448,6 +551,7 @@ def worker(
     want_stages: bool,
     out_path: str | None,
     batches: tuple | None = None,
+    want_volume: bool = False,
 ):
     """Measure on this process's backend.
 
@@ -563,6 +667,17 @@ def worker(
         except Exception as e:  # noqa: BLE001
             emit({"student_error": f"{e!r:.500}"})
             _log(f"student timing failed: {e!r:.500}")
+
+    if want_volume:
+        try:
+            # the 3D path's first perf leg (VERDICT r3 item 5)
+            vol = _bench_volume(dev, VOLUME_REPS)
+            emit({"volume": vol})
+            _log(f"{dev.platform} volume: {vol['ms_per_volume']} ms/volume "
+                 f"({vol['mvoxels_per_s']} Mvoxel/s)")
+        except Exception as e:  # noqa: BLE001
+            emit({"volume_error": f"{e!r:.500}"})
+            _log(f"volume timing failed: {e!r:.500}")
 
     print(_SENTINEL + json.dumps(result), flush=True)
 
@@ -891,7 +1006,8 @@ def _run_measurement(label, worker_args, env_overrides, timeout_s):
 def _copy_optional(out: dict, rec: dict) -> None:
     """Carry a measurement record's optional sections into the emitted JSON."""
     for key in ("stages", "device_kind", "hbm_peak_gbps",
-                "fused_min_traffic_gbps", "profile_dir", "student_tput"):
+                "fused_min_traffic_gbps", "profile_dir", "student_tput",
+                "volume"):
         if key in rec:
             out[key] = rec[key]
 
@@ -976,6 +1092,7 @@ def _measure_accel(deadline=None, cpu_banked=False):
         str(TPU_REPS),
         "--pallas",
         "--stages",
+        "--volume",
         "--batches",
         ",".join(str(b) for b in ACCEL_BATCH_SWEEP),
     ]
@@ -1001,6 +1118,27 @@ def _measure_accel(deadline=None, cpu_banked=False):
 
 
 _CPU_ENV = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": None}
+
+ZSHARD_TIMEOUT_S = 240
+
+
+def _measure_zshard(deadline):
+    """Spawn the z-shard scaling worker on an 8-virtual-device CPU mesh;
+    returns its record or None (skipped under budget pressure / failure)."""
+    remaining = deadline - time.monotonic() - EMIT_RESERVE_S
+    if remaining < 90:
+        _log("zshard scaling: budget too low; skipping")
+        return None
+    env = dict(_CPU_ENV)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    env["XLA_FLAGS"] = flags
+    rc, stdout, _ = _spawn(
+        "zshard scaling", ["--zshard-scaling"], env,
+        min(ZSHARD_TIMEOUT_S, remaining),
+    )
+    return _parse_sentinel(stdout) if rc == 0 else None
 
 
 # abspath: a bare-filename override would give _bank_partial an empty
@@ -1135,6 +1273,12 @@ def main() -> None:
             ["--batches", str(state["accel"].get("xla_batch", BATCH))]
         )
 
+    # z-shard scaling curve: tunnel-independent (virtual CPU mesh), cheap,
+    # and the 3D path's only multi-device perf signal (VERDICT r3 item 5)
+    z = _measure_zshard(deadline)
+    if z is not None:
+        state["meta"]["zshard_scaling"] = z
+
     state["meta"]["elapsed_s"] = round(time.monotonic() - t0, 1)
     _bank_partial(state)
     # nothing left but pure host compose+print: the alarm's job is done, and
@@ -1152,15 +1296,19 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--worker", action="store_true")
     parser.add_argument("--probe", action="store_true")
+    parser.add_argument("--zshard-scaling", action="store_true")
     parser.add_argument("--platform", default=None)
     parser.add_argument("--reps", type=int, default=TPU_REPS)
     parser.add_argument("--pallas", action="store_true")
     parser.add_argument("--stages", action="store_true")
+    parser.add_argument("--volume", action="store_true")
     parser.add_argument("--out", default=None)
     parser.add_argument("--batches", default=str(BATCH), help="comma list to sweep")
     ns = parser.parse_args()
     if ns.probe:
         probe(ns.platform)
+    elif ns.zshard_scaling:
+        zshard_scaling()
     elif ns.worker:
         worker(
             ns.platform,
@@ -1169,6 +1317,7 @@ if __name__ == "__main__":
             ns.stages,
             ns.out,
             tuple(int(b) for b in ns.batches.split(",")),
+            want_volume=ns.volume,
         )
     else:
         main()

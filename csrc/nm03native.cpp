@@ -419,11 +419,14 @@ bool jpeg_lossless_decode(const uint8_t* data, size_t len, long expect_rows,
   JHuffTable tables[2][4];  // [class][id]; lossless scans use class 0
   int sel = 1, pt = 0, table_id = 0;
   bool got_sos = false;
-  while (pos + 4 <= len) {
+  while (pos + 2 <= len) {
     if (data[pos] != 0xFF) { set_error("expected JPEG marker"); return false; }
+    // optional fill bytes (T.81 B.1.1.2): extra 0xFF may pad any marker
+    while (pos + 1 < len && data[pos + 1] == 0xFF) ++pos;
     uint8_t marker = data[pos + 1];
     pos += 2;
     if (marker == 0xD9) break;  // EOI
+    if (pos + 2 > len) { set_error("truncated JPEG marker segment"); return false; }
     size_t seglen = ((size_t)data[pos] << 8) | data[pos + 1];
     size_t seg_end = pos + seglen;
     if (seglen < 2 || seg_end > len) {
@@ -622,11 +625,14 @@ bool jpegls_decode(const uint8_t* data, size_t len, long expect_rows,
   int near = 0;
   size_t entropy_at = 0;
   bool got_sos = false;
-  while (pos + 4 <= len) {
+  while (pos + 2 <= len) {
     if (data[pos] != 0xFF) { set_error("expected JPEG-LS marker"); return false; }
+    // optional fill bytes (T.81 B.1.1.2): extra 0xFF may pad any marker
+    while (pos + 1 < len && data[pos + 1] == 0xFF) ++pos;
     uint8_t marker = data[pos + 1];
     pos += 2;
     if (marker == 0xD9) break;  // EOI before SOS
+    if (pos + 2 > len) { set_error("truncated JPEG-LS segment"); return false; }
     size_t seglen = ((size_t)data[pos] << 8) | data[pos + 1];
     size_t seg_end = pos + seglen;
     if (seglen < 2 || seg_end > len) { set_error("truncated JPEG-LS segment"); return false; }
@@ -865,11 +871,18 @@ bool jpegls_decode(const uint8_t* data, size_t len, long expect_rows,
     std::swap(prev, cur);
   }
   // scan must terminate with EOI (acceptance agreement with the Python
-  // decoder and CharLS); unread bits of the current byte are padding
+  // decoder and CharLS); unread bits of the current byte are padding, and
+  // fill 0xFF bytes may pad before the marker (T.81 B.1.1.2)
   size_t p = r.pos;
-  bool eoi = (r.prev_ff && p < len && data[p] == 0xD9) ||
-             (p + 1 < len && data[p] == 0xFF && data[p + 1] == 0xD9);
-  if (!eoi) { set_error("JPEG-LS stream missing EOI"); return false; }
+  if (!r.prev_ff && (p >= len || data[p] != 0xFF)) {
+    set_error("JPEG-LS stream missing EOI");
+    return false;
+  }
+  while (p < len && data[p] == 0xFF) ++p;
+  if (p >= len || data[p] != 0xD9) {
+    set_error("JPEG-LS stream missing EOI");
+    return false;
+  }
   *rows_out = rows;
   *cols_out = cols;
   return true;

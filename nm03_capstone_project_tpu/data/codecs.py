@@ -252,13 +252,18 @@ def jpeg_lossless_decode(data: bytes, expect_shape=None) -> np.ndarray:
     pt = 0
     table_id = 0
     got_sos = False
-    while pos + 4 <= len(data):
+    while pos + 2 <= len(data):
         if data[pos] != 0xFF:
             raise CodecError(f"expected JPEG marker at {pos}")
+        # optional fill bytes (T.81 B.1.1.2): extra 0xFF may pad any marker
+        while pos + 1 < len(data) and data[pos + 1] == 0xFF:
+            pos += 1
         marker = data[pos + 1]
         pos += 2
         if marker == _EOI:
             break
+        if pos + 2 > len(data):
+            raise CodecError("truncated JPEG marker segment")
         seglen = struct.unpack_from(">H", data, pos)[0]
         seg_end = pos + seglen
         if seg_end > len(data):
@@ -555,13 +560,19 @@ def _jls_parse_header(data: bytes):
     precision = rows = cols = None
     maxval = t1 = t2 = t3 = reset = None
     near = 0
-    while pos + 4 <= len(data):
+    while pos + 2 <= len(data):
         if data[pos] != 0xFF:
             raise CodecError(f"expected JPEG-LS marker at {pos}")
+        # optional fill bytes (T.81 B.1.1.2, inherited by T.87): any number
+        # of extra 0xFF may pad before the marker code
+        while pos + 1 < len(data) and data[pos + 1] == 0xFF:
+            pos += 1
         marker = data[pos + 1]
         pos += 2
         if marker == _EOI:
             break
+        if pos + 2 > len(data):
+            raise CodecError("truncated JPEG-LS marker segment")
         seglen = struct.unpack_from(">H", data, pos)[0]
         seg_end = pos + seglen
         if seglen < 2 or seg_end > len(data):
@@ -864,11 +875,13 @@ def jpegls_decode(data: bytes, expect_shape=None) -> np.ndarray:
         out[y] = cur[1 : cols + 1]
         prev, cur = cur, prev
     # the scan must terminate with EOI (acceptance agreement with CharLS and
-    # the native decoder); unread bits of the current byte are padding
+    # the native decoder); unread bits of the current byte are padding, and
+    # fill 0xFF bytes may pad before the marker (T.81 B.1.1.2)
     p = reader.pos
-    if not (
-        (reader.prev_ff and p < len(data) and data[p] == _EOI)
-        or data[p : p + 2] == bytes((0xFF, _EOI))
-    ):
+    if not reader.prev_ff and (p >= len(data) or data[p] != 0xFF):
+        raise CodecError("JPEG-LS stream missing EOI after scan")
+    while p < len(data) and data[p] == 0xFF:
+        p += 1
+    if p >= len(data) or data[p] != _EOI:
         raise CodecError("JPEG-LS stream missing EOI after scan")
     return out.astype(np.uint16)

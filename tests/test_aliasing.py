@@ -42,6 +42,7 @@ class TestPurity:
 
 
 class TestDonation:
+    @pytest.mark.slow
     def test_donated_batch_fn_matches_undonated(self):
         px, dm = _batch()
         donated = _compiled_batch_fn(CFG)  # donate_argnums=(0,)
@@ -68,6 +69,7 @@ class TestDonation:
         np.testing.assert_array_equal(np.asarray(do), np.asarray(ro))
         np.testing.assert_array_equal(np.asarray(dp), np.asarray(rp))
 
+    @pytest.mark.slow
     def test_donated_buffer_is_consumed(self):
         px, dm = _batch()
         donated = _compiled_batch_fn(CFG)

@@ -69,6 +69,7 @@ class TestModelCheckpointGuards:
         save_params(path, init_unet(jax.random.PRNGKey(0), base=8), meta=meta)
         return path
 
+    @pytest.mark.slow
     def test_norm_clip_mismatch_is_fatal(self, tmp_path):
         path = self._ckpt(
             tmp_path,
@@ -83,6 +84,7 @@ class TestModelCheckpointGuards:
         with pytest.raises(SystemExit, match="clip constants"):
             common.load_model_checkpoint(_ns(model=str(path)), cfg)
 
+    @pytest.mark.slow
     def test_matching_meta_loads(self, tmp_path):
         path = self._ckpt(
             tmp_path,
@@ -96,6 +98,7 @@ class TestModelCheckpointGuards:
         params = common.load_model_checkpoint(_ns(model=str(path)), PipelineConfig())
         assert params is not None
 
+    @pytest.mark.slow
     def test_metaless_checkpoint_loads_permissively(self, tmp_path):
         # older checkpoints without meta: no constants to check against
         path = self._ckpt(tmp_path, None)

@@ -11,6 +11,11 @@ results JSON carries the cluster-wide totals.
 import json
 import textwrap
 from pathlib import Path
+import pytest
+
+
+pytestmark = [pytest.mark.slow, pytest.mark.multiproc]
+
 
 from tests.test_multihost import run_job_with_port_retry
 

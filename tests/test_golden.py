@@ -38,6 +38,7 @@ STAGE_NAMES = (
 
 @pytest.mark.parametrize("seed", SEEDS)
 class TestGoldenStages:
+    @pytest.mark.slow
     def test_stage_renders_match_goldens(self, seed):
         path = GOLDEN_DIR / f"stage_renders_seed{seed}.npz"
         golden = np.load(path)

@@ -126,6 +126,19 @@ class TestHardening:
         with pytest.raises(CodecError, match="1 component"):
             jpegls_decode(data)
 
+    def test_fill_bytes_before_markers_accepted(self):
+        # optional 0xFF fill bytes before any marker are legal (T.81
+        # B.1.1.2, inherited by T.87) — a conformant writer may pad
+        enc = (GOLDEN / "grad8.jls").read_bytes()
+        want = np.load(GOLDEN / "grad8.npy")
+        i = enc.index(b"\xff\xda")
+        padded = (
+            b"\xff\xd8" + b"\xff" * 3 + enc[2:i] + b"\xff" * 2 + enc[i:-2]
+            + b"\xff" + b"\xff\xd9"
+        )
+        got = jpegls_decode(padded)
+        np.testing.assert_array_equal(got.astype(np.uint8), want)
+
     def test_hostile_reset_rejected(self):
         # RESET outside T.87's [3, max(255, MAXVAL)] must be rejected: an
         # unbounded RESET would let the native mirror's int32 context

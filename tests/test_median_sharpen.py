@@ -1,4 +1,5 @@
 import numpy as np
+import pytest
 import scipy.ndimage as ndi
 
 from nm03_capstone_project_tpu.ops import (
@@ -42,6 +43,7 @@ class TestNetworkMedian:
     noise.
     """
 
+    @pytest.mark.slow
     def test_bit_identical_to_sort_oracle(self, rng):
         for size in (3, 5, 7, 9):
             for shape in ((33, 47), (8, 8), (7, 7)):

@@ -10,6 +10,9 @@ land where the teacher's do.
 import numpy as np
 import pytest
 
+pytestmark = [pytest.mark.slow]
+
+
 from nm03_capstone_project_tpu.cli.runner import CohortProcessor
 from nm03_capstone_project_tpu.config import BatchConfig, PipelineConfig
 from nm03_capstone_project_tpu.data.synthetic import write_synthetic_cohort

@@ -10,6 +10,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = [pytest.mark.slow]
+
+
 from nm03_capstone_project_tpu.config import PipelineConfig
 from nm03_capstone_project_tpu.core import pad_to_canvas
 from nm03_capstone_project_tpu.data.synthetic import phantom_series

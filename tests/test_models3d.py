@@ -5,6 +5,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = [pytest.mark.slow]
+
+
 from nm03_capstone_project_tpu.config import PipelineConfig
 from nm03_capstone_project_tpu.data.synthetic import phantom_volume
 from nm03_capstone_project_tpu.models import (

@@ -16,6 +16,11 @@ import subprocess
 import sys
 import textwrap
 from pathlib import Path
+import pytest
+
+
+pytestmark = [pytest.mark.slow, pytest.mark.multiproc]
+
 
 _REPO = Path(__file__).parents[1]
 

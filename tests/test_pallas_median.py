@@ -75,6 +75,7 @@ class TestDispatch:
         want = np.asarray(vector_median_filter(x, 7))
         np.testing.assert_array_equal(got, want)
 
+    @pytest.mark.slow
     def test_pipeline_cfg_use_pallas_runs_on_cpu(self):
         from nm03_capstone_project_tpu.config import PipelineConfig
         from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice

@@ -20,6 +20,7 @@ def small_phantom():
     return phantom_slice(128, 128, seed=3)
 
 
+@pytest.mark.slow
 def test_process_slice_segments_lesion(small_phantom):
     batch = pad_to_canvas([small_phantom], (128, 128))
     out = process_slice(batch.pixels[0], batch.dims[0], CFG)
@@ -57,6 +58,7 @@ def test_stages_variant_contract(small_phantom):
     assert not np.any(seg & ~dil)
 
 
+@pytest.mark.slow
 def test_vmapped_batch_equals_sequential():
     """Formalizes the reference's implicit parallel==sequential invariant."""
     slices = phantom_series(4, 128, 120, seed=7)

@@ -28,6 +28,7 @@ def test_region_grow_simple_blob():
     assert out[26, 26] == 0  # disconnected blob excluded
 
 
+@pytest.mark.slow
 def test_region_grow_matches_oracle_random(rng):
     for trial in range(5):
         img = ndi.gaussian_filter(
@@ -106,6 +107,7 @@ def test_region_grow_8_connectivity():
 class TestJumpAlgorithm:
     """region_grow_jump: O(log) pointer-jumping schedule, identical sets."""
 
+    @pytest.mark.slow
     def test_matches_scipy_oracle_random(self, rng):
         for trial in range(5):
             img = ndi.gaussian_filter(
@@ -135,6 +137,7 @@ class TestJumpAlgorithm:
         assert out.sum() == (img > 0).sum()
 
     @pytest.mark.parametrize("connectivity", [4, 8])
+    @pytest.mark.slow
     def test_bit_identical_to_dilate_path(self, rng, connectivity):
         for trial in range(3):
             img = ndi.gaussian_filter(
@@ -163,6 +166,7 @@ class TestJumpAlgorithm:
         )
         assert dead.sum() == 0
 
+    @pytest.mark.slow
     def test_vmap_matches_per_slice(self, rng):
         imgs = ndi.gaussian_filter(
             rng.random((4, 32, 32)), sigma=1.5, axes=(1, 2)
@@ -188,6 +192,7 @@ class TestJumpAlgorithm:
         with pytest.raises(ValueError, match="mutually exclusive"):
             PipelineConfig(grow_algorithm="jump", use_pallas=True)
 
+    @pytest.mark.slow
     def test_pipeline_with_jump_matches_default(self):
         import dataclasses
 

@@ -15,6 +15,8 @@ import pytest
 from nm03_capstone_project_tpu.cli import train as train_cli
 from nm03_capstone_project_tpu.models import init_unet, load_params, save_params
 
+pytestmark = [pytest.mark.slow]
+
 
 class TestCheckpoint:
     def test_roundtrip_params_and_meta(self, tmp_path):

@@ -145,6 +145,7 @@ class TestRegionGrowJump3D:
             got, _oracle_region_grow(vol, seeds, 0.4, 0.9, connectivity)
         )
 
+    @pytest.mark.slow
     def test_helix_path_through_z(self):
         # a path winding through all three axes: worst case for one-shell
         # growth, routine for the O(log) schedule
@@ -167,6 +168,7 @@ class TestRegionGrowJump3D:
         )
         np.testing.assert_array_equal(got, _oracle_region_grow(vol, seeds, 0.4, 0.6, 6))
 
+    @pytest.mark.slow
     def test_volume_pipeline_with_jump_matches_default(self):
         import dataclasses
 
@@ -196,6 +198,7 @@ class TestRegionGrowJump3D:
 
 
 class TestVolumePipeline:
+    @pytest.mark.slow
     def test_phantom_lesion_segmented_as_one_body(self):
         from nm03_capstone_project_tpu.pipeline.volume_pipeline import process_volume
 
@@ -214,6 +217,7 @@ class TestVolumePipeline:
         per_slice = mask.reshape(mask.shape[0], -1).sum(axis=1)
         assert (per_slice > 0).sum() >= 3
 
+    @pytest.mark.slow
     def test_respects_canvas_padding(self):
         from nm03_capstone_project_tpu.pipeline.volume_pipeline import process_volume
 

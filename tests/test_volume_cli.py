@@ -29,6 +29,7 @@ def _run(tmp_path, *extra):
 
 
 class TestVolumeCLI:
+    @pytest.mark.slow
     def test_end_to_end_jpeg_pairs(self, tmp_path):
         rc, out = _run(tmp_path)
         assert rc == 0
@@ -39,6 +40,7 @@ class TestVolumeCLI:
         assert payload["patients"]["PGBM-0001"]["slices"] == 4
         assert payload["patients"]["PGBM-0001"]["mask_voxels"] > 0
 
+    @pytest.mark.slow
     def test_zsharded_matches_single_device(self, tmp_path):
         if len(jax.devices()) < 8:
             pytest.skip("needs the 8-virtual-device CPU mesh")
@@ -79,6 +81,7 @@ class TestVolumeCLI:
         text = capsys.readouterr().out
         assert text.count("already complete, skipping") == 2
 
+    @pytest.mark.slow
     def test_resume_accounts_for_permanently_bad_slices(self, tmp_path, capsys):
         # a patient with one unreadable slice must still skip on resume
         # (regression: listing-stems vs usable-stems mismatch re-ran forever)
