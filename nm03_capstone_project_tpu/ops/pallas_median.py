@@ -34,12 +34,33 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _pick_tile(h: int, preferred: int = 64) -> int:
-    """Largest row-band size <= preferred that divides h."""
-    t = min(preferred, h)
-    while h % t != 0:
-        t -= 1
-    return t
+def _pick_tile(
+    h: int, w: int = 256, r: int = 3, itemsize: int = 4, preferred: int = 64
+):
+    """Row-band size, or None when no band fits the VMEM budget.
+
+    The band no longer has to divide ``h`` — the wrapper pads the row
+    dimension up to the next band multiple and slices the output back, so a
+    prime ``h`` gets the same wide bands as a friendly one instead of
+    degenerating to a per-row grid (VERDICT r3 item 3: the old divisor
+    search returned tile=1 for prime heights).
+
+    The budget keeps the kernel's scoped VMEM stack inside the ~16 MB
+    Mosaic limit: the presort + merge temporaries cost ~9(2r+1) full-width
+    row copies per band row (calibrated against the measured 17.07 MB
+    scoped allocation at k=7, band rows 70, w 1030 — the 1024² OOM; the
+    model scales with window size and element width rather than
+    hard-coding that point). When even the minimum legal band (8 rows, or
+    ``h`` when h < 8) exceeds the budget — short-but-very-wide canvases —
+    the caller falls back to the XLA path instead of OOMing on chip.
+    """
+    per_band_row = (w + 2 * r) * itemsize * 9 * (2 * r + 1)
+    budget_rows = (10 << 20) // per_band_row - 2 * r
+    if h < 8:
+        return h if budget_rows >= h else None
+    # Mosaic requires the row block be a multiple of the 8-row sublane tile
+    t = (min(preferred, h, budget_rows) // 8) * 8
+    return t if t >= 8 else None
 
 
 def _median_band_kernel(in_ref, out_ref, *, k: int, tile: int, w: int):
@@ -76,15 +97,27 @@ def vector_median_filter_pallas(
     xb = x.reshape((-1,) + x.shape[-2:]) if x.ndim != 2 else x[None]
     b, h, w = xb.shape
     r = size // 2
-    xp = jnp.pad(xb, ((0, 0), (r, r), (r, r)), mode="edge")
-    tile = _pick_tile(h)
+    tile = _pick_tile(h, w, r, x.dtype.itemsize)
+    if tile is None:
+        # no legal band fits the VMEM budget (short-but-very-wide canvas,
+        # or a large window/dtype): the XLA path computes the identical
+        # result without the scoped-stack constraint
+        from nm03_capstone_project_tpu.ops.median import vector_median_filter
+
+        return vector_median_filter(x, size)
+    # pad rows to a band multiple (edge mode, same replication as the halo):
+    # the extra bands read only replicated bottom rows and their output is
+    # sliced off, so results stay bit-identical to the XLA oracle while a
+    # prime h keeps full-width bands instead of a per-row grid
+    h_pad = (-h) % tile
+    xp = jnp.pad(xb, ((0, 0), (r, r + h_pad), (r, r)), mode="edge")
     kernel = functools.partial(_median_band_kernel, k=size, tile=tile, w=w)
     out = pl.pallas_call(
         kernel,
-        grid=(b, h // tile),
+        grid=(b, (h + h_pad) // tile),
         in_specs=[
             pl.BlockSpec(
-                (1, h + 2 * r, w + 2 * r),
+                (1, h + h_pad + 2 * r, w + 2 * r),
                 lambda i, t: (i, 0, 0),
                 memory_space=pltpu.VMEM,
             )
@@ -92,10 +125,10 @@ def vector_median_filter_pallas(
         out_specs=pl.BlockSpec(
             (1, tile, w), lambda i, t: (i, t, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((b, h, w), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, h + h_pad, w), x.dtype),
         interpret=interpret,
     )(xp)
-    return out.reshape(orig_shape)
+    return out[:, :h, :].reshape(orig_shape)
 
 
 def pallas_backend_supported() -> bool:

@@ -21,10 +21,50 @@ from nm03_capstone_project_tpu.ops.pallas_median import (
 
 
 class TestPickTile:
-    def test_divides_evenly(self):
-        for h in (256, 96, 64, 30, 7):
-            t = _pick_tile(h)
-            assert h % t == 0 and 1 <= t <= 64
+    def test_full_band_even_for_prime_heights(self):
+        # the old divisor search degenerated to tile=1 (a per-row grid) on
+        # prime h; the wrapper now pads rows instead (VERDICT r3 item 3).
+        # Bands are sublane-aligned (multiple of 8) unless h itself is tiny.
+        for h in (256, 97, 127, 64):
+            assert _pick_tile(h) == 64
+        assert _pick_tile(30) == 24  # rounded down to the 8-row sublane tile
+        assert _pick_tile(7) == 7  # block rows == array rows is legal
+
+    def test_wide_canvas_shrinks_band_for_vmem(self):
+        # the 1024^2 OOM regression: the band must shrink as w grows so the
+        # kernel's scoped VMEM stack stays inside the 16 MB budget
+        assert _pick_tile(1024, 1024, 3) < 64
+        assert _pick_tile(2048, 2048, 3) >= 8
+        assert _pick_tile(1024, 1024, 3) % 8 == 0
+
+    def test_unfittable_shapes_signal_fallback(self):
+        # short-but-very-wide canvases (and big windows/dtypes) can't fit
+        # even the minimum band: the wrapper must take the XLA path, not OOM
+        assert _pick_tile(8, 20000, 3) is None
+        assert _pick_tile(4, 100000, 3) is None
+        # the budget scales with window size and element width
+        assert (_pick_tile(1024, 1024, 4) or 0) <= _pick_tile(1024, 1024, 3)
+        assert (_pick_tile(1024, 1024, 3, itemsize=8) or 0) <= _pick_tile(
+            1024, 1024, 3, itemsize=4
+        )
+
+    def test_fallback_path_still_bit_exact(self, rng):
+        # a shape _pick_tile refuses must silently produce the XLA result
+        x = rng.random((6, 20000)).astype(np.float32)
+        got = np.asarray(
+            vector_median_filter_pallas(jnp.asarray(x), 7, interpret=True)
+        )
+        want = np.asarray(vector_median_filter(jnp.asarray(x), 7))
+        np.testing.assert_array_equal(got, want)
+
+    def test_prime_height_bit_exact(self, rng):
+        # the row padding must not leak into the kept output rows
+        x = rng.random((97, 61)).astype(np.float32)
+        got = np.asarray(
+            vector_median_filter_pallas(jnp.asarray(x), 7, interpret=True)
+        )
+        want = np.asarray(vector_median_filter(jnp.asarray(x), 7))
+        np.testing.assert_array_equal(got, want)
 
 
 class TestPallasMedianInterpret:
