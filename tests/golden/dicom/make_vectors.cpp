@@ -1,0 +1,108 @@
+// Generate externally-produced DICOM conformance vectors with GDCM.
+//
+// GDCM is an INDEPENDENT, widely-deployed DICOM implementation (the same
+// family of libraries DCMTK-based pipelines interoperate with); the files
+// it writes here pin this repo's Python (data/dicomlite.py) and native
+// (csrc/nm03native.cpp) readers against streams no code in this repo
+// produced (VERDICT r3 item 6). One deterministic 16-bit and one 8-bit
+// pattern, written under: Explicit VR LE, Implicit VR LE, RLE Lossless,
+// and JPEG Lossless SV1 (1.2.840.10008.1.2.4.70).
+//
+// Build + run (from the repo root):
+//   g++ -O2 -std=c++17 tests/golden/dicom/make_vectors.cpp \
+//     -I/usr/include/gdcm-3.0 -lgdcmMSFF -lgdcmDSED -lgdcmCommon \
+//     -o /tmp/make_dicom_vectors && /tmp/make_dicom_vectors tests/golden/dicom
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+#include <gdcmAttribute.h>
+#include <gdcmImage.h>
+#include <gdcmImageChangeTransferSyntax.h>
+#include <gdcmImageWriter.h>
+#include <gdcmImageReader.h>
+#include <gdcmUIDGenerator.h>
+
+static std::vector<uint8_t> pattern16(unsigned rows, unsigned cols) {
+  std::vector<uint8_t> buf(rows * cols * 2);
+  for (unsigned y = 0; y < rows; ++y)
+    for (unsigned x = 0; x < cols; ++x) {
+      // deterministic, full 12-bit range, with flat runs (RLE-friendly)
+      uint16_t v = (uint16_t)(((y / 4) * 251 + (x / 4) * 97 + y * x) % 4096);
+      buf[2 * (y * cols + x)] = (uint8_t)(v & 0xFF);
+      buf[2 * (y * cols + x) + 1] = (uint8_t)(v >> 8);
+    }
+  return buf;
+}
+
+static std::vector<uint8_t> pattern8(unsigned rows, unsigned cols) {
+  std::vector<uint8_t> buf(rows * cols);
+  for (unsigned y = 0; y < rows; ++y)
+    for (unsigned x = 0; x < cols; ++x)
+      buf[y * cols + x] = (uint8_t)((y * 7 + (x / 8) * 31) % 256);
+  return buf;
+}
+
+static bool write_raw(const std::string& path, unsigned rows, unsigned cols,
+                      int bits, const std::vector<uint8_t>& pix,
+                      gdcm::TransferSyntax::TSType ts) {
+  gdcm::ImageWriter w;
+  gdcm::Image& img = w.GetImage();
+  img.SetNumberOfDimensions(2);
+  unsigned int dims[2] = {cols, rows};
+  img.SetDimensions(dims);
+  gdcm::PixelFormat pf(bits == 16 ? gdcm::PixelFormat::UINT16
+                                  : gdcm::PixelFormat::UINT8);
+  img.SetPixelFormat(pf);
+  img.SetPhotometricInterpretation(
+      gdcm::PhotometricInterpretation::MONOCHROME2);
+  img.SetTransferSyntax(gdcm::TransferSyntax(ts));
+  gdcm::DataElement pixeldata(gdcm::Tag(0x7FE0, 0x0010));
+  pixeldata.SetByteValue((const char*)pix.data(), (uint32_t)pix.size());
+  img.SetDataElement(pixeldata);
+  w.SetFileName(path.c_str());
+  return w.Write();
+}
+
+static bool transcode(const std::string& src, const std::string& dst,
+                      gdcm::TransferSyntax::TSType ts) {
+  gdcm::ImageReader r;
+  r.SetFileName(src.c_str());
+  if (!r.Read()) return false;
+  gdcm::ImageChangeTransferSyntax change;
+  change.SetTransferSyntax(gdcm::TransferSyntax(ts));
+  change.SetInput(r.GetImage());
+  if (!change.Change()) return false;
+  gdcm::ImageWriter w;
+  w.SetFileName(dst.c_str());
+  w.SetFile(r.GetFile());
+  w.SetImage(change.GetOutput());
+  return w.Write();
+}
+
+int main(int argc, char** argv) {
+  std::string out = argc > 1 ? argv[1] : ".";
+  const unsigned R = 60, C = 48;  // non-square; GDCM's RLE encoder asserts on odd widths
+  auto p16 = pattern16(R, C);
+  auto p8 = pattern8(R, C);
+  struct Job { const char* name; int bits; gdcm::TransferSyntax::TSType ts; };
+  bool ok = true;
+  ok &= write_raw(out + "/gdcm16_explicit.dcm", R, C, 16,
+                  p16, gdcm::TransferSyntax::ExplicitVRLittleEndian);
+  ok &= write_raw(out + "/gdcm16_implicit.dcm", R, C, 16,
+                  p16, gdcm::TransferSyntax::ImplicitVRLittleEndian);
+  ok &= write_raw(out + "/gdcm8_explicit.dcm", R, C, 8,
+                  p8, gdcm::TransferSyntax::ExplicitVRLittleEndian);
+  ok &= transcode(out + "/gdcm16_explicit.dcm", out + "/gdcm16_rle.dcm",
+                  gdcm::TransferSyntax::RLELossless);
+  ok &= transcode(out + "/gdcm16_explicit.dcm", out + "/gdcm16_jpegll.dcm",
+                  gdcm::TransferSyntax::JPEGLosslessProcess14_1);
+  ok &= transcode(out + "/gdcm8_explicit.dcm", out + "/gdcm8_rle.dcm",
+                  gdcm::TransferSyntax::RLELossless);
+  ok &= transcode(out + "/gdcm8_explicit.dcm", out + "/gdcm8_jpegll.dcm",
+                  gdcm::TransferSyntax::JPEGLosslessProcess14_1);
+  std::printf(ok ? "all vectors written to %s\n" : "FAILED (partial in %s)\n",
+              out.c_str());
+  return ok ? 0 : 1;
+}
