@@ -54,17 +54,21 @@ struct ByteReader {
   size_t pos = 0;
   bool explicit_vr;
   bool ok = true;
+  bool big = false;  // explicit VR big endian (1.2.840.10008.1.2.2)
 
   uint16_t u16() {
     if (pos + 2 > len) { ok = false; return 0; }
-    uint16_t v = (uint16_t)(buf[pos] | (buf[pos + 1] << 8));
+    uint16_t v = big ? (uint16_t)((buf[pos] << 8) | buf[pos + 1])
+                     : (uint16_t)(buf[pos] | (buf[pos + 1] << 8));
     pos += 2;
     return v;
   }
   uint32_t u32() {
     if (pos + 4 > len) { ok = false; return 0; }
-    uint32_t v = (uint32_t)buf[pos] | ((uint32_t)buf[pos + 1] << 8) |
-                 ((uint32_t)buf[pos + 2] << 16) | ((uint32_t)buf[pos + 3] << 24);
+    uint32_t v = big ? (((uint32_t)buf[pos] << 24) | ((uint32_t)buf[pos + 1] << 16) |
+                        ((uint32_t)buf[pos + 2] << 8) | (uint32_t)buf[pos + 3])
+                     : ((uint32_t)buf[pos] | ((uint32_t)buf[pos + 1] << 8) |
+                        ((uint32_t)buf[pos + 2] << 16) | ((uint32_t)buf[pos + 3] << 24));
     pos += 4;
     return v;
   }
@@ -182,8 +186,8 @@ bool read_fragments(ByteReader& r, DataSet* out) {
 }
 
 bool parse_dataset(const uint8_t* buf, size_t len, bool explicit_vr,
-                   DataSet* out, bool encapsulated = false) {
-  ByteReader r{buf, len, 0, explicit_vr};
+                   DataSet* out, bool encapsulated = false, bool big = false) {
+  ByteReader r{buf, len, 0, explicit_vr, true, big};
   while (!r.atend()) {
     Element e = read_element(r);
     if (!r.ok) { set_error("truncated DICOM element structure"); return false; }
@@ -229,14 +233,19 @@ std::string ascii_value(const std::vector<uint8_t>& v) {
   return s.substr(i);
 }
 
-bool meta_int(const DataSet& ds, Tag t, long* out) {
+bool meta_int(const DataSet& ds, Tag t, long* out, bool big = false) {
   auto it = ds.meta.find(t);
   if (it == ds.meta.end()) return false;
   const auto& v = it->second;
-  if (v.size() == 2) { *out = v[0] | (v[1] << 8); return true; }
+  if (v.size() == 2) {
+    *out = big ? ((v[0] << 8) | v[1]) : (v[0] | (v[1] << 8));
+    return true;
+  }
   if (v.size() == 4) {
-    *out = (long)((uint32_t)v[0] | ((uint32_t)v[1] << 8) |
-                  ((uint32_t)v[2] << 16) | ((uint32_t)v[3] << 24));
+    *out = big ? (long)(((uint32_t)v[0] << 24) | ((uint32_t)v[1] << 16) |
+                        ((uint32_t)v[2] << 8) | (uint32_t)v[3])
+               : (long)((uint32_t)v[0] | ((uint32_t)v[1] << 8) |
+                        ((uint32_t)v[2] << 16) | ((uint32_t)v[3] << 24));
     return true;
   }
   try {
@@ -926,9 +935,13 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
   }
 
   bool explicit_vr;
-  bool rle = false, jpegll = false, jls = false;
+  bool rle = false, jpegll = false, jls = false, big = false;
   if (transfer_syntax == "1.2.840.10008.1.2.1") explicit_vr = true;
   else if (transfer_syntax == "1.2.840.10008.1.2") explicit_vr = false;
+  else if (transfer_syntax == "1.2.840.10008.1.2.2") {
+    explicit_vr = true;
+    big = true;
+  }
   else if (transfer_syntax == "1.2.840.10008.1.2.5") {
     // RLE Lossless, JPEG Lossless and JPEG-LS decode natively; other
     // compressed syntaxes (baseline JPEG, J2K) fall back to the Python
@@ -947,11 +960,13 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
   else { set_error("unsupported transfer syntax: " + transfer_syntax); return false; }
 
   DataSet ds;
-  if (!parse_dataset(body, body_len, explicit_vr, &ds, rle || jpegll || jls)) return false;
+  if (!parse_dataset(body, body_len, explicit_vr, &ds, rle || jpegll || jls,
+                     big))
+    return false;
 
   long rows = 0, cols = 0;
-  if (!meta_int(ds, tag(0x0028, 0x0010), &rows) ||
-      !meta_int(ds, tag(0x0028, 0x0011), &cols) ||
+  if (!meta_int(ds, tag(0x0028, 0x0010), &rows, big) ||
+      !meta_int(ds, tag(0x0028, 0x0011), &cols, big) ||
       (!ds.pixel_data && ds.fragments.empty())) {
     set_error("missing Rows/Columns/PixelData");
     return false;
@@ -961,9 +976,9 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
     return false;
   }
   long bits = 16, pixrep = 0, samples = 1;
-  meta_int(ds, tag(0x0028, 0x0100), &bits);
-  meta_int(ds, tag(0x0028, 0x0103), &pixrep);
-  meta_int(ds, tag(0x0028, 0x0002), &samples);
+  meta_int(ds, tag(0x0028, 0x0100), &bits, big);
+  meta_int(ds, tag(0x0028, 0x0103), &pixrep, big);
+  meta_int(ds, tag(0x0028, 0x0002), &samples, big);
   if (samples != 1) { set_error("only monochrome supported"); return false; }
   if (bits != 8 && bits != 16) { set_error("unsupported BitsAllocated"); return false; }
   bool is_signed = pixrep == 1;
@@ -1039,12 +1054,15 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
   const uint8_t* p = ds.pixel_data;
   float* dst = pixels->data();
   size_t n = (size_t)rows * cols;
+  // decoded/compressed buffers are always little-endian sample bytes; only
+  // native big-endian PixelData arrives byte-swapped
+  const int lo = big ? 1 : 0, hi = big ? 0 : 1;
   if (bits == 16 && !is_signed) {
     for (size_t i = 0; i < n; ++i)
-      dst[i] = (float)(uint16_t)(p[2 * i] | (p[2 * i + 1] << 8)) * fslope + fintercept;
+      dst[i] = (float)(uint16_t)(p[2 * i + lo] | (p[2 * i + hi] << 8)) * fslope + fintercept;
   } else if (bits == 16) {
     for (size_t i = 0; i < n; ++i)
-      dst[i] = (float)(int16_t)(p[2 * i] | (p[2 * i + 1] << 8)) * fslope + fintercept;
+      dst[i] = (float)(int16_t)(p[2 * i + lo] | (p[2 * i + hi] << 8)) * fslope + fintercept;
   } else if (!is_signed) {
     for (size_t i = 0; i < n; ++i) dst[i] = (float)p[i] * fslope + fintercept;
   } else {

@@ -13,7 +13,8 @@ archive formats DCMTK additionally reads — VERDICT r2 missing #3):
 
 * Part-10 files (128-byte preamble + ``DICM``) and bare data sets.
 * Explicit and implicit VR little endian transfer syntaxes
-  (1.2.840.10008.1.2.1 / 1.2.840.10008.1.2), uncompressed pixel data.
+  (1.2.840.10008.1.2.1 / 1.2.840.10008.1.2), uncompressed pixel data, and
+  the retired explicit VR big endian (1.2.840.10008.1.2.2).
 * Compressed/encapsulated transfer syntaxes (data/codecs.py):
   **RLE Lossless** (1.2.840.10008.1.2.5) and **JPEG Lossless** processes
   14 / 14-SV1 (1.2.840.10008.1.2.4.57 / .70) decode bit-exactly; baseline
@@ -26,9 +27,9 @@ archive formats DCMTK additionally reads — VERDICT r2 missing #3):
 NOT supported — every rejection raises :class:`DicomParseError` with a
 message naming the remedy (tests/test_data.py covers each branch):
 
-* big endian (1.2.840.10008.1.2.2) and JPEG 2000 (1.2.840.10008.1.2.4.9x)
-  — transcode to explicit VR little endian first (``gdcmconv --raw`` or
-  DCMTK ``dcmdjpeg``/``dcmconv +te``);
+* JPEG 2000 (1.2.840.10008.1.2.4.9x) when the optional GDCM fallback shim
+  (data/gdcm_fallback.py) is unavailable — transcode to explicit VR little
+  endian first (``gdcmconv --raw`` or DCMTK ``dcmdjpeg``/``dcmconv +te``);
 * encapsulated PixelData under an *uncompressed* transfer-syntax UID
   (malformed), color images (SamplesPerPixel != 1), BitsAllocated outside
   {8, 16}.
@@ -50,6 +51,7 @@ import numpy as np
 
 EXPLICIT_VR_LE = "1.2.840.10008.1.2.1"
 IMPLICIT_VR_LE = "1.2.840.10008.1.2"
+EXPLICIT_VR_BE = "1.2.840.10008.1.2.2"  # retired, still in archives
 RLE_LOSSLESS = "1.2.840.10008.1.2.5"
 JPEG_BASELINE = "1.2.840.10008.1.2.4.50"  # 8-bit lossy (process 1)
 JPEG_LOSSLESS = "1.2.840.10008.1.2.4.57"  # process 14, any predictor
@@ -66,6 +68,13 @@ _DECODABLE_ENCAPSULATED = {
     JPEG_LS_LOSSLESS,
     JPEG_LS_NEAR,
 }
+
+# JPEG 2000 family: decoded via the optional GDCM fallback shim when the
+# system provides it, rejected with a transcode remedy otherwise (single
+# source of truth for the UID set lives beside the shim)
+from nm03_capstone_project_tpu.data.gdcm_fallback import (  # noqa: E402
+    J2K_SYNTAXES as _J2K_SYNTAXES,
+)
 
 # VRs whose explicit encoding uses a 2-byte reserved field + 4-byte length
 _LONG_VRS = {b"OB", b"OW", b"OF", b"OD", b"OL", b"SQ", b"UC", b"UR", b"UT", b"UN"}
@@ -97,18 +106,20 @@ class DicomSlice:
 
 
 class _Reader:
-    def __init__(self, buf: bytes, explicit: bool):
+    def __init__(self, buf: bytes, explicit: bool, big: bool = False):
         self.buf = buf
         self.pos = 0
         self.explicit = explicit
+        self._h = ">H" if big else "<H"
+        self._i = ">I" if big else "<I"
 
     def u16(self) -> int:
-        v = struct.unpack_from("<H", self.buf, self.pos)[0]
+        v = struct.unpack_from(self._h, self.buf, self.pos)[0]
         self.pos += 2
         return v
 
     def u32(self) -> int:
-        v = struct.unpack_from("<I", self.buf, self.pos)[0]
+        v = struct.unpack_from(self._i, self.buf, self.pos)[0]
         self.pos += 4
         return v
 
@@ -185,11 +196,12 @@ def _read_fragments(r: "_Reader") -> list:
 
 
 def _parse_dataset(
-    buf: bytes, explicit: bool, want_pixels: bool, encapsulated: bool = False
+    buf: bytes, explicit: bool, want_pixels: bool, encapsulated: bool = False,
+    big: bool = False,
 ) -> Tuple[Dict[Tuple[int, int], bytes], Optional[bytes]]:
     """Returns (meta, pixel_data); pixel_data is ``bytes`` for native
     PixelData, a ``list`` of fragment byte strings when encapsulated."""
-    r = _Reader(buf, explicit)
+    r = _Reader(buf, explicit, big)
     meta: Dict[Tuple[int, int], bytes] = {}
     pixel_data = None
     while not r.atend():
@@ -227,14 +239,14 @@ def _parse_dataset(
     return meta, pixel_data
 
 
-def _meta_int(meta, tag, default=None) -> Optional[int]:
+def _meta_int(meta, tag, default=None, big: bool = False) -> Optional[int]:
     v = meta.get(tag)
     if v is None:
         return default
     if len(v) == 2:
-        return struct.unpack("<H", v)[0]
+        return struct.unpack(">H" if big else "<H", v)[0]
     if len(v) == 4:
-        return struct.unpack("<I", v)[0]
+        return struct.unpack(">I" if big else "<I", v)[0]
     try:
         return int(v.decode("ascii").strip("\x00 "))
     except (UnicodeDecodeError, ValueError):
@@ -375,21 +387,60 @@ def read_dicom(path: str | os.PathLike) -> DicomSlice:
     elif raw[:4] == b"DICM":
         body = raw[4:]
     encapsulated = transfer_syntax in _DECODABLE_ENCAPSULATED
+    big = transfer_syntax == EXPLICIT_VR_BE
+    if transfer_syntax in _J2K_SYNTAXES:
+        # JPEG 2000: the one family without an in-tree codec. Routed through
+        # the optional GDCM shim (data/gdcm_fallback.py) when the system has
+        # it — the same sit-on-a-system-library judgment the reference makes
+        # with DCMTK — else rejected with the transcode remedy below.
+        from nm03_capstone_project_tpu.data import gdcm_fallback
+
+        if gdcm_fallback.available():
+            try:
+                meta, _ = _parse_dataset(
+                    body, explicit=True, want_pixels=False, encapsulated=True
+                )
+            except struct.error as e:
+                raise DicomParseError(
+                    f"truncated DICOM element structure: {e}"
+                ) from e
+            rows = _meta_int(meta, (0x0028, 0x0010))
+            cols = _meta_int(meta, (0x0028, 0x0011))
+            if rows is None or cols is None:
+                raise DicomParseError("missing Rows/Columns")
+            if not (0 < rows <= 32768 and 0 < cols <= 32768) or (
+                rows * cols * 2 > 1 << 28
+            ):
+                raise DicomParseError(
+                    f"implausible compressed-frame dimensions ({rows}, {cols})"
+                )
+            try:
+                pixels, raw_dtype = gdcm_fallback.read_j2k(path, rows, cols)
+            except ValueError as e:
+                raise DicomParseError(str(e)) from e
+            return DicomSlice(
+                pixels=pixels,
+                rows=rows,
+                cols=cols,
+                raw_dtype=raw_dtype,
+                rescale_slope=_meta_float(meta, (0x0028, 0x1053), 1.0),
+                rescale_intercept=_meta_float(meta, (0x0028, 0x1052), 0.0),
+                meta=meta,
+            )
     if (
-        transfer_syntax not in (EXPLICIT_VR_LE, IMPLICIT_VR_LE)
+        transfer_syntax not in (EXPLICIT_VR_LE, IMPLICIT_VR_LE, EXPLICIT_VR_BE)
         and not encapsulated
     ):
         kind = (
-            "big endian"
-            if transfer_syntax == "1.2.840.10008.1.2.2"
-            else "compressed"
+            "compressed"
             if transfer_syntax.startswith("1.2.840.10008.1.2.4")
             else "unrecognized"
         )
         raise DicomParseError(
             f"unsupported ({kind}) transfer syntax {transfer_syntax}: "
-            "supported are uncompressed little endian "
-            f"({EXPLICIT_VR_LE} / {IMPLICIT_VR_LE}), RLE ({RLE_LOSSLESS}), "
+            "supported are uncompressed little/big endian "
+            f"({EXPLICIT_VR_LE} / {IMPLICIT_VR_LE} / {EXPLICIT_VR_BE}), "
+            f"RLE ({RLE_LOSSLESS}), "
             f"JPEG lossless ({JPEG_LOSSLESS} / {JPEG_LOSSLESS_SV1}), "
             f"JPEG-LS ({JPEG_LS_LOSSLESS} / {JPEG_LS_NEAR}) and "
             f"baseline JPEG ({JPEG_BASELINE}); transcode first "
@@ -399,13 +450,14 @@ def read_dicom(path: str | os.PathLike) -> DicomSlice:
     explicit = transfer_syntax != IMPLICIT_VR_LE
     try:
         meta, pixel_data = _parse_dataset(
-            body, explicit, want_pixels=True, encapsulated=encapsulated
+            body, explicit, want_pixels=True, encapsulated=encapsulated,
+            big=big,
         )
     except struct.error as e:
         raise DicomParseError(f"truncated DICOM element structure: {e}") from e
 
-    rows = _meta_int(meta, (0x0028, 0x0010))
-    cols = _meta_int(meta, (0x0028, 0x0011))
+    rows = _meta_int(meta, (0x0028, 0x0010), big=big)
+    cols = _meta_int(meta, (0x0028, 0x0011), big=big)
     if rows is None or cols is None or pixel_data is None:
         raise DicomParseError("missing Rows/Columns/PixelData")
     if encapsulated and not isinstance(pixel_data, list):
@@ -413,16 +465,17 @@ def read_dicom(path: str | os.PathLike) -> DicomSlice:
             f"transfer syntax {transfer_syntax} declares compressed pixels "
             "but PixelData is native/uncompressed (malformed file)"
         )
-    bits = _meta_int(meta, (0x0028, 0x0100), 16)
-    signed = _meta_int(meta, (0x0028, 0x0103), 0) == 1
-    samples = _meta_int(meta, (0x0028, 0x0002), 1)
+    bits = _meta_int(meta, (0x0028, 0x0100), 16, big=big)
+    signed = _meta_int(meta, (0x0028, 0x0103), 0, big=big) == 1
+    samples = _meta_int(meta, (0x0028, 0x0002), 1, big=big)
     if samples != 1:
         raise DicomParseError(
             f"only monochrome supported, SamplesPerPixel={samples}; convert "
             "color/multi-sample images to grayscale before import"
         )
     if bits == 16:
-        dtype = np.dtype("<i2") if signed else np.dtype("<u2")
+        order = ">" if big else "<"
+        dtype = np.dtype(order + ("i2" if signed else "u2"))
     elif bits == 8:
         dtype = np.dtype("i1") if signed else np.dtype("u1")
     else:

@@ -102,6 +102,12 @@ int main(int argc, char** argv) {
                   gdcm::TransferSyntax::RLELossless);
   ok &= transcode(out + "/gdcm8_explicit.dcm", out + "/gdcm8_jpegll.dcm",
                   gdcm::TransferSyntax::JPEGLosslessProcess14_1);
+  ok &= transcode(out + "/gdcm16_explicit.dcm", out + "/gdcm16_bigendian.dcm",
+                  gdcm::TransferSyntax::ExplicitVRBigEndian);
+  ok &= transcode(out + "/gdcm16_explicit.dcm", out + "/gdcm16_j2k.dcm",
+                  gdcm::TransferSyntax::JPEG2000Lossless);
+  ok &= transcode(out + "/gdcm8_explicit.dcm", out + "/gdcm8_j2k.dcm",
+                  gdcm::TransferSyntax::JPEG2000Lossless);
   std::printf(ok ? "all vectors written to %s\n" : "FAILED (partial in %s)\n",
               out.c_str());
   return ok ? 0 : 1;

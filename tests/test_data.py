@@ -152,23 +152,35 @@ class TestImporterEnvelope:
         p.write_bytes(b"\x00" * 128 + b"DICM" + meta + ds)
         return p
 
-    def test_big_endian_rejected_with_remedy(self, tmp_path):
+    def test_malformed_big_endian_contained(self, tmp_path):
+        # big endian now DECODES (tests/test_gdcm_vectors.py pins it against
+        # a GDCM-written file); a little-endian dataset mislabeled with the
+        # BE UID must still fail as a clean DicomParseError, never garbage
         p = self._file_with_ts(tmp_path, "1.2.840.10008.1.2.2")
-        with pytest.raises(DicomParseError, match="big endian.*transcode"):
+        with pytest.raises(DicomParseError):
             read_dicom(p)
 
     @pytest.mark.parametrize(
         "ts",
         [
-            "1.2.840.10008.1.2.4.90",  # JPEG 2000 lossless
-            "1.2.840.10008.1.2.4.91",  # JPEG 2000
+            "1.2.840.10008.1.2.4.100",  # MPEG2 (video — never in envelope)
+            "1.2.840.10008.1.2.1.99",  # deflated explicit VR LE
         ],
     )
     def test_compressed_syntax_rejected_with_remedy(self, tmp_path, ts):
-        # J2K remains out of envelope; RLE / JPEG-lossless / baseline-JPEG
-        # (TestCompressedTransferSyntaxes) and JPEG-LS (tests/test_jpegls.py)
-        # now decode
+        # RLE / JPEG-lossless / baseline-JPEG (TestCompressedTransferSyntaxes),
+        # JPEG-LS (tests/test_jpegls.py) and — via the optional GDCM shim —
+        # JPEG 2000 (tests/test_gdcm_vectors.py) now decode; everything else
+        # still rejects with a remedy
         p = self._file_with_ts(tmp_path, ts)
+        with pytest.raises(DicomParseError, match="transcode"):
+            read_dicom(p)
+
+    def test_j2k_without_gdcm_rejected_with_remedy(self, tmp_path, monkeypatch):
+        import nm03_capstone_project_tpu.data.gdcm_fallback as gf
+
+        monkeypatch.setattr(gf, "available", lambda: False)
+        p = self._file_with_ts(tmp_path, "1.2.840.10008.1.2.4.90")
         with pytest.raises(DicomParseError, match="compressed.*transcode"):
             read_dicom(p)
 

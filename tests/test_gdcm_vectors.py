@@ -34,6 +34,7 @@ def pattern8() -> np.ndarray:
 CASES = [
     ("gdcm16_explicit.dcm", pattern16),
     ("gdcm16_implicit.dcm", pattern16),
+    ("gdcm16_bigendian.dcm", pattern16),
     ("gdcm16_rle.dcm", pattern16),
     ("gdcm16_jpegll.dcm", pattern16),
     ("gdcm8_explicit.dcm", pattern8),
@@ -70,6 +71,42 @@ class TestNativeReader:
         np.testing.assert_array_equal(
             px.astype(np.int64), make().astype(np.int64)
         )
+
+
+class TestJ2KFallback:
+    """JPEG 2000 routes through the optional GDCM shim when present; the
+    transcode-remedy rejection is preserved when it is disabled/absent."""
+
+    @pytest.fixture(scope="class")
+    def fallback(self):
+        from nm03_capstone_project_tpu.data import gdcm_fallback
+
+        if not gdcm_fallback.available():
+            pytest.skip("gdcm fallback unavailable on this host")
+        return gdcm_fallback
+
+    @pytest.mark.parametrize(
+        "name,make", [("gdcm16_j2k.dcm", pattern16), ("gdcm8_j2k.dcm", pattern8)]
+    )
+    def test_j2k_lossless_decodes_bit_exact(self, fallback, name, make):
+        from nm03_capstone_project_tpu.data.dicomlite import read_dicom
+
+        s = read_dicom(GOLDEN / name)
+        np.testing.assert_array_equal(
+            s.pixels.astype(np.int64), make().astype(np.int64)
+        )
+
+    def test_disabled_fallback_rejects_with_remedy(self, monkeypatch):
+        # NM03_NO_GDCM pins the no-GDCM behavior even on hosts that have it
+        import nm03_capstone_project_tpu.data.gdcm_fallback as gf
+        from nm03_capstone_project_tpu.data.dicomlite import (
+            DicomParseError,
+            read_dicom,
+        )
+
+        monkeypatch.setattr(gf, "available", lambda: False)
+        with pytest.raises(DicomParseError, match="transcode"):
+            read_dicom(GOLDEN / "gdcm16_j2k.dcm")
 
 
 def test_all_vectors_present():
