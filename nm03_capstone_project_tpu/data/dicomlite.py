@@ -13,8 +13,9 @@ archive formats DCMTK additionally reads — VERDICT r2 missing #3):
 
 * Part-10 files (128-byte preamble + ``DICM``) and bare data sets.
 * Explicit and implicit VR little endian transfer syntaxes
-  (1.2.840.10008.1.2.1 / 1.2.840.10008.1.2), uncompressed pixel data, and
-  the retired explicit VR big endian (1.2.840.10008.1.2.2).
+  (1.2.840.10008.1.2.1 / 1.2.840.10008.1.2), uncompressed pixel data,
+  the retired explicit VR big endian (1.2.840.10008.1.2.2), and the
+  zlib-deflated dataset form (1.2.840.10008.1.2.1.99).
 * Compressed/encapsulated transfer syntaxes (data/codecs.py):
   **RLE Lossless** (1.2.840.10008.1.2.5) and **JPEG Lossless** processes
   14 / 14-SV1 (1.2.840.10008.1.2.4.57 / .70) decode bit-exactly; baseline
@@ -52,6 +53,7 @@ import numpy as np
 EXPLICIT_VR_LE = "1.2.840.10008.1.2.1"
 IMPLICIT_VR_LE = "1.2.840.10008.1.2"
 EXPLICIT_VR_BE = "1.2.840.10008.1.2.2"  # retired, still in archives
+DEFLATED_EXPLICIT_VR_LE = "1.2.840.10008.1.2.1.99"  # zlib-deflated dataset
 RLE_LOSSLESS = "1.2.840.10008.1.2.5"
 JPEG_BASELINE = "1.2.840.10008.1.2.4.50"  # 8-bit lossy (process 1)
 JPEG_LOSSLESS = "1.2.840.10008.1.2.4.57"  # process 14, any predictor
@@ -72,9 +74,9 @@ _DECODABLE_ENCAPSULATED = {
 # JPEG 2000 family: decoded via the optional GDCM fallback shim when the
 # system provides it, rejected with a transcode remedy otherwise (single
 # source of truth for the UID set lives beside the shim)
-from nm03_capstone_project_tpu.data.gdcm_fallback import (  # noqa: E402
-    J2K_SYNTAXES as _J2K_SYNTAXES,
-)
+from nm03_capstone_project_tpu.data import gdcm_fallback  # noqa: E402
+
+_J2K_SYNTAXES = gdcm_fallback.J2K_SYNTAXES
 
 # VRs whose explicit encoding uses a 2-byte reserved field + 4-byte length
 _LONG_VRS = {b"OB", b"OW", b"OF", b"OD", b"OL", b"SQ", b"UC", b"UR", b"UT", b"UN"}
@@ -386,6 +388,24 @@ def read_dicom(path: str | os.PathLike) -> DicomSlice:
         body = raw[r.pos :]
     elif raw[:4] == b"DICM":
         body = raw[4:]
+    if transfer_syntax == DEFLATED_EXPLICIT_VR_LE:
+        # PS3.5 A.5: everything after the file meta group is one raw
+        # (headerless) zlib-deflate stream of an explicit VR LE dataset.
+        # Bounded inflate: a crafted bomb must hit the same ~2^28 envelope
+        # cap as every other path, as a clean DicomParseError, not an OOM.
+        import zlib
+
+        limit = (1 << 28) + (1 << 20)  # pixel envelope + header slack
+        d = zlib.decompressobj(wbits=-15)
+        try:
+            body = d.decompress(body, limit)
+        except zlib.error as e:
+            raise DicomParseError(f"deflated dataset inflate failed: {e}") from e
+        if d.unconsumed_tail:
+            raise DicomParseError(
+                "deflated dataset exceeds the importer size bound"
+            )
+        transfer_syntax = EXPLICIT_VR_LE
     encapsulated = transfer_syntax in _DECODABLE_ENCAPSULATED
     big = transfer_syntax == EXPLICIT_VR_BE
     if transfer_syntax in _J2K_SYNTAXES:

@@ -108,6 +108,8 @@ int main(int argc, char** argv) {
                   gdcm::TransferSyntax::JPEG2000Lossless);
   ok &= transcode(out + "/gdcm8_explicit.dcm", out + "/gdcm8_j2k.dcm",
                   gdcm::TransferSyntax::JPEG2000Lossless);
+  ok &= transcode(out + "/gdcm16_explicit.dcm", out + "/gdcm16_deflated.dcm",
+                  gdcm::TransferSyntax::DeflatedExplicitVRLittleEndian);
   std::printf(ok ? "all vectors written to %s\n" : "FAILED (partial in %s)\n",
               out.c_str());
   return ok ? 0 : 1;

@@ -164,7 +164,7 @@ class TestImporterEnvelope:
         "ts",
         [
             "1.2.840.10008.1.2.4.100",  # MPEG2 (video — never in envelope)
-            "1.2.840.10008.1.2.1.99",  # deflated explicit VR LE
+            "1.2.840.10008.1.2.4.102",  # MPEG-4 AVC (video)
         ],
     )
     def test_compressed_syntax_rejected_with_remedy(self, tmp_path, ts):

@@ -35,6 +35,7 @@ CASES = [
     ("gdcm16_explicit.dcm", pattern16),
     ("gdcm16_implicit.dcm", pattern16),
     ("gdcm16_bigendian.dcm", pattern16),
+    ("gdcm16_deflated.dcm", pattern16),
     ("gdcm16_rle.dcm", pattern16),
     ("gdcm16_jpegll.dcm", pattern16),
     ("gdcm8_explicit.dcm", pattern8),
@@ -64,7 +65,11 @@ class TestNativeReader:
             pytest.skip("native layer unavailable")
         return native
 
-    @pytest.mark.parametrize("name,make", CASES)
+    # deflated is Python-reader-only (the runner's per-slice retry covers
+    # it on the native path, like baseline JPEG)
+    @pytest.mark.parametrize(
+        "name,make", [c for c in CASES if "deflated" not in c[0]]
+    )
     def test_decodes_gdcm_file_bit_exact(self, native, name, make):
         px = native.read_dicom_native(GOLDEN / name)
         assert px.shape == (ROWS, COLS)
@@ -107,6 +112,31 @@ class TestJ2KFallback:
         monkeypatch.setattr(gf, "available", lambda: False)
         with pytest.raises(DicomParseError, match="transcode"):
             read_dicom(GOLDEN / "gdcm16_j2k.dcm")
+
+
+def test_deflated_bomb_contained(tmp_path):
+    # a ~1 MB deflate stream inflating to 1 GiB must hit the importer's
+    # size bound as a clean DicomParseError, never an OOM
+    import struct
+    import zlib
+
+    from nm03_capstone_project_tpu.data.dicomlite import (
+        DicomParseError,
+        _element,
+        read_dicom,
+    )
+
+    z = zlib.compressobj(9, zlib.DEFLATED, -15)
+    payload = z.compress(b"\x00" * (1 << 30)) + z.flush()
+    meta_elems = _element(0x0002, 0x0010, b"UI", b"1.2.840.10008.1.2.1.99")
+    meta = (
+        _element(0x0002, 0x0000, b"UL", struct.pack("<I", len(meta_elems)))
+        + meta_elems
+    )
+    p = tmp_path / "bomb.dcm"
+    p.write_bytes(b"\x00" * 128 + b"DICM" + meta + payload)
+    with pytest.raises(DicomParseError, match="size bound"):
+        read_dicom(p)
 
 
 def test_all_vectors_present():
