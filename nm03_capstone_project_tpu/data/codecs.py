@@ -739,16 +739,14 @@ def jpegls_decode(data: bytes, expect_shape=None) -> np.ndarray:
             if k > 32:
                 raise CodecError("JPEG-LS run-interruption k overflow")
         em = decode_value(k, limit - _JLS_J[run_index] - 1)
-        # unmap (inverse of T.87 A.7.2.1 mapping; ctx == RItype)
+        # unmap (inverse of T.87 A.7.2.1 mapping; ctx == RItype): the error
+        # is negative exactly when the map bit agrees with the sign
+        # predictor (k != 0 or run of negatives dominating)
         tv = em + ctx
         map_bit = tv & 1
         eabs = (tv + map_bit) >> 1
-        if ((k != 0 or (2 * rNn[ctx] >= n)) and map_bit) or (
-            not (k != 0 or (2 * rNn[ctx] >= n)) and not map_bit
-        ):
-            err = -eabs
-        else:
-            err = eabs
+        predict_neg = k != 0 or 2 * rNn[ctx] >= n
+        err = -eabs if predict_neg == bool(map_bit) else eabs
         if err < 0:
             rNn[ctx] += 1
         rA[ctx] += (em + 1 - ctx) >> 1

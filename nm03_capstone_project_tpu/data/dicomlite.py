@@ -90,6 +90,20 @@ class DicomParseError(ValueError):
     """Raised when a file is not parseable as DICOM."""
 
 
+def _check_frame_bounds(rows, cols, itemsize: int) -> None:
+    """Plausibility bound shared by every decode path (native caps: 32768
+    per axis, 2^28 output bytes) — applied BEFORE any decoder allocates."""
+    if rows is None or cols is None:
+        raise DicomParseError("missing Rows/Columns")
+    if not (0 < rows <= 32768 and 0 < cols <= 32768) or (
+        rows * cols * itemsize > 1 << 28
+    ):
+        raise DicomParseError(
+            f"implausible compressed-frame dimensions ({rows}, {cols}) at "
+            f"{itemsize * 8}-bit"
+        )
+
+
 @dataclasses.dataclass
 class DicomSlice:
     """One decoded 2D slice."""
@@ -278,17 +292,10 @@ def _decode_compressed(
 
     if not fragments:
         raise DicomParseError("encapsulated PixelData has no fragments")
-    # Header plausibility bound BEFORE any decoder allocates: a hostile file
-    # declaring 65535x65535 must fail here, not after rle_decode_frame's
-    # replicate pass expands fragments into a multi-GB host buffer. Same
-    # caps as the native decoder (32768 per axis, 2^28 output bytes).
-    if not (0 < rows <= 32768 and 0 < cols <= 32768) or (
-        rows * cols * dtype.itemsize > 1 << 28
-    ):
-        raise DicomParseError(
-            f"implausible compressed-frame dimensions ({rows}, {cols}) at "
-            f"{dtype.itemsize * 8}-bit"
-        )
+    # a hostile file declaring 65535x65535 must fail here, not after
+    # rle_decode_frame's replicate pass expands fragments into a multi-GB
+    # host buffer
+    _check_frame_bounds(rows, cols, dtype.itemsize)
     try:
         if transfer_syntax == RLE_LOSSLESS:
             if len(fragments) != 1:
@@ -297,24 +304,16 @@ def _decode_compressed(
                     "out of envelope (one slice per file)"
                 )
             arr = codecs.rle_decode_frame(fragments[0], rows, cols, dtype.itemsize)
-        elif transfer_syntax in (JPEG_LOSSLESS, JPEG_LOSSLESS_SV1):
-            arr = codecs.jpeg_lossless_decode(
-                b"".join(fragments), expect_shape=(rows, cols)
-            )
+        elif transfer_syntax in (JPEG_LOSSLESS, JPEG_LOSSLESS_SV1,
+                                 JPEG_LS_LOSSLESS, JPEG_LS_NEAR):
+            jls = transfer_syntax in (JPEG_LS_LOSSLESS, JPEG_LS_NEAR)
+            decode = codecs.jpegls_decode if jls else codecs.jpeg_lossless_decode
+            arr = decode(b"".join(fragments), expect_shape=(rows, cols))
             if dtype.itemsize == 1:
                 if arr.max(initial=0) > 0xFF:
                     raise DicomParseError(
-                        "lossless JPEG precision exceeds BitsAllocated=8"
-                    )
-                arr = arr.astype(np.uint8)
-        elif transfer_syntax in (JPEG_LS_LOSSLESS, JPEG_LS_NEAR):
-            arr = codecs.jpegls_decode(
-                b"".join(fragments), expect_shape=(rows, cols)
-            )
-            if dtype.itemsize == 1:
-                if arr.max(initial=0) > 0xFF:
-                    raise DicomParseError(
-                        "JPEG-LS precision exceeds BitsAllocated=8"
+                        ("JPEG-LS" if jls else "lossless JPEG")
+                        + " precision exceeds BitsAllocated=8"
                     )
                 arr = arr.astype(np.uint8)
         else:  # JPEG_BASELINE — lossy 8-bit, decoded by PIL
@@ -426,14 +425,7 @@ def read_dicom(path: str | os.PathLike) -> DicomSlice:
                 ) from e
             rows = _meta_int(meta, (0x0028, 0x0010))
             cols = _meta_int(meta, (0x0028, 0x0011))
-            if rows is None or cols is None:
-                raise DicomParseError("missing Rows/Columns")
-            if not (0 < rows <= 32768 and 0 < cols <= 32768) or (
-                rows * cols * 2 > 1 << 28
-            ):
-                raise DicomParseError(
-                    f"implausible compressed-frame dimensions ({rows}, {cols})"
-                )
+            _check_frame_bounds(rows, cols, 2)
             try:
                 pixels, raw_dtype = gdcm_fallback.read_j2k(path, rows, cols)
             except ValueError as e:

@@ -21,10 +21,8 @@ the rejection path on hosts where GDCM exists).
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import logging
 import os
-import subprocess
 import threading
 from pathlib import Path
 from typing import Optional
@@ -52,46 +50,18 @@ J2K_SYNTAXES = {
 
 def _compile() -> Optional[Path]:
     try:
-        if not _SRC.exists() or not _GDCM_INCLUDE.is_dir():
-            return None
-        tag = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
-        out = _BUILD_DIR / f"libnm03gdcm-{tag}.so"
-        if out.exists():
-            return out
-        _BUILD_DIR.mkdir(parents=True, exist_ok=True)
-    except OSError as e:
-        # read-only install etc. — degrade to "no fallback", never crash
-        # the importer's DicomParseError contract
-        _log.info("gdcm fallback build dir unavailable: %s", e)
+        if not _GDCM_INCLUDE.is_dir():
+            return None  # no gdcm dev files on this host
+    except OSError:
         return None
-    tmp = out.with_name(f".{out.name}.{os.getpid()}.tmp")
-    cmd = [
-        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-        str(_SRC), f"-I{_GDCM_INCLUDE}",
-        "-lgdcmMSFF", "-lgdcmDSED", "-lgdcmCommon",
-        "-o", str(tmp),
-    ]
-    try:
-        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=180)
-    except (OSError, subprocess.TimeoutExpired) as e:
-        _log.info("gdcm fallback build failed to run: %s", e)
-        return None
-    if proc.returncode != 0:
-        _log.info("gdcm fallback build failed:\n%s", proc.stderr[-1500:])
-        tmp.unlink(missing_ok=True)
-        return None
-    try:
-        os.replace(tmp, out)
-        for old in _BUILD_DIR.glob("libnm03gdcm-*.so"):
-            if old != out:
-                try:
-                    old.unlink()
-                except OSError:
-                    pass
-    except OSError as e:
-        _log.info("gdcm fallback publish failed: %s", e)
-        return None
-    return out
+    from nm03_capstone_project_tpu.native.buildlib import build_shared_library
+
+    return build_shared_library(
+        _SRC, _BUILD_DIR, "nm03gdcm",
+        [f"-I{_GDCM_INCLUDE}", "-lgdcmMSFF", "-lgdcmDSED", "-lgdcmCommon"],
+        _log,
+        failure_level=logging.INFO,  # the shim is optional by design
+    )
 
 
 def _load() -> Optional[ctypes.CDLL]:

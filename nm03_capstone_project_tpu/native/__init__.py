@@ -16,12 +16,10 @@ exists; ``available()`` says which path is active, and
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import os
-import subprocess
 import threading
 from pathlib import Path
-from typing import List, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -38,46 +36,13 @@ _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
 
 
-def _source_hash() -> str:
-    return hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
-
-
 def _compile() -> Optional[Path]:
     """Build the shared library with g++; returns its path or None."""
-    if not _SRC.exists():
-        _log.warning("native source %s not found", _SRC)
-        return None
-    out = _BUILD_DIR / f"libnm03native-{_source_hash()}.so"
-    if out.exists():
-        return out
-    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
-    # compile to a process-private name, then publish atomically so a
-    # concurrent process never CDLL-loads a half-written library
-    tmp = out.with_name(f".{out.name}.{os.getpid()}.tmp")
-    cmd = [
-        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-        str(_SRC), "-o", str(tmp),
-    ]
-    try:
-        proc = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=180
-        )
-    except (OSError, subprocess.TimeoutExpired) as e:
-        _log.warning("native build failed to run: %s", e)
-        return None
-    if proc.returncode != 0:
-        _log.warning("native build failed:\n%s", proc.stderr[-2000:])
-        tmp.unlink(missing_ok=True)
-        return None
-    os.replace(tmp, out)
-    # drop stale builds of older source revisions
-    for old in _BUILD_DIR.glob("libnm03native-*.so"):
-        if old != out:
-            try:
-                old.unlink()
-            except OSError:
-                pass
-    return out
+    from nm03_capstone_project_tpu.native.buildlib import build_shared_library
+
+    return build_shared_library(
+        _SRC, _BUILD_DIR, "nm03native", ["-pthread"], _log
+    )
 
 
 def _load() -> Optional[ctypes.CDLL]:
