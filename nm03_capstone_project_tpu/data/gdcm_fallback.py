@@ -16,6 +16,13 @@ behavior: a DicomParseError naming the transcode remedy.
 
 ``NM03_NO_GDCM=1`` disables the fallback explicitly (tests use it to pin
 the rejection path on hosts where GDCM exists).
+
+12-bit JPEG Extended (1.2.840.10008.1.2.4.51) was evaluated for the same
+routing and deliberately EXCLUDED: GDCM does not round-trip its own .51
+encode (every sample comes back +32768 — a signed-bias quirk in its 12-bit
+DCT path), and this environment has no independent implementation to
+arbitrate whether the fault is encoder- or decoder-side. A clean rejection
+with a transcode remedy is safer than possibly-biased intensities.
 """
 
 from __future__ import annotations
