@@ -1106,6 +1106,11 @@ def _measure_accel(deadline=None, cpu_banked=False):
             # emitted JSON carries the degradation in its error field)
             reserve = EMIT_RESERVE_S
             remaining = deadline - time.monotonic() - reserve
+            if remaining - MIN_CPU_ATTEMPT_S >= MIN_ACCEL_REDUCED_S:
+                # keep a minimal baseline viable when the attempt still fits
+                # beside it — a timeout-killed attempt then degrades to the
+                # CPU record instead of "all measurement workers failed"
+                remaining -= MIN_CPU_ATTEMPT_S
             _log("accel: sacrificing the CPU-baseline reserve for the attempt")
         if remaining < MIN_ACCEL_REDUCED_S:
             _log(f"accel: {remaining:.0f}s left — no room for an attempt; skipping")

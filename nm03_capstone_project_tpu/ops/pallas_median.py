@@ -54,7 +54,10 @@ def _pick_tile(
     ``h`` when h < 8) exceeds the budget — short-but-very-wide canvases —
     the caller falls back to the XLA path instead of OOMing on chip.
     """
-    per_band_row = (w + 2 * r) * itemsize * 9 * (2 * r + 1)
+    # estimate on the LANE-padded width (Mosaic pads the last dim to 128):
+    # a 129-wide band really costs its 256-lane footprint
+    wp = -(-(w + 2 * r) // 128) * 128
+    per_band_row = wp * itemsize * 9 * (2 * r + 1)
     budget_rows = (10 << 20) // per_band_row - 2 * r
     if h < 8:
         return h if budget_rows >= h else None

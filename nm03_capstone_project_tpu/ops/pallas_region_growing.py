@@ -131,6 +131,30 @@ def region_grow_pallas(
     """Drop-in Pallas variant of :func:`.region_growing.region_grow`."""
     if connectivity not in (4, 8):
         raise ValueError(f"connectivity must be 4 or 8, got {connectivity}")
+    h, w = image.shape[-2:]
+    # The fixpoint needs the whole slice resident (band + seeds + out + the
+    # haloed scratch, ~5 slice-sized f32 buffers incl. compiler temps —
+    # measured 20 MB scoped at 1024²). A banded variant makes no sense for
+    # a globally-propagating fixpoint, so slices past the ~16 MB VMEM
+    # budget take the XLA path instead of failing at Mosaic compile time.
+    # Estimate on TILE-PADDED dims (8-row sublanes x 128 lanes): a tall
+    # (5600, 129) slice really costs its (5600, 256) padded footprint.
+    hp = -(-h // 8) * 8
+    wp = -(-w // 128) * 128
+    if not interpret and 5 * hp * wp * 4 > (14 << 20):
+        import logging
+
+        from nm03_capstone_project_tpu.ops.region_growing import region_grow
+
+        # fires at trace time (once per compiled shape), so it cannot spam;
+        # without it a bench of the "pallas path" would silently time XLA
+        logging.getLogger("nm03_tpu.pallas").info(
+            "pallas grow: %dx%d slice exceeds the VMEM budget; XLA path", h, w
+        )
+        return region_grow(
+            image, seeds, low, high, valid=valid, connectivity=connectivity,
+            block_iters=block_iters, max_iters=max_iters,
+        )
     band = (image >= low) & (image <= high)
     if valid is not None:
         band = band & valid

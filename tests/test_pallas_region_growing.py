@@ -102,3 +102,23 @@ class TestDispatch:
             region_grow(x, seeds, valid=valid, block_iters=8, max_iters=256)
         )
         np.testing.assert_array_equal(a, b)
+
+
+def test_oversized_slice_falls_back_to_xla():
+    # the whole-slice fixpoint needs ~5 slice-sized VMEM buffers; past the
+    # budget the wrapper must produce the XLA result, not a Mosaic
+    # compile-time OOM (the 1024^2 regression)
+    import numpy as np
+    import jax.numpy as jnp
+
+    from nm03_capstone_project_tpu.ops.pallas_region_growing import (
+        region_grow_pallas,
+    )
+    from nm03_capstone_project_tpu.ops.region_growing import region_grow
+
+    rng = np.random.default_rng(2)
+    img = jnp.asarray((rng.random((1024, 1024)) * 0.5 + 0.4).astype(np.float32))
+    seeds = jnp.zeros((1024, 1024), bool).at[512, 512].set(True)
+    got = np.asarray(region_grow_pallas(img, seeds, 0.74, 0.91))
+    want = np.asarray(region_grow(img, seeds, 0.74, 0.91))
+    np.testing.assert_array_equal(got, want)
