@@ -982,6 +982,32 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
   if (samples != 1) { set_error("only monochrome supported"); return false; }
   if (bits != 8 && bits != 16) { set_error("unsupported BitsAllocated"); return false; }
   bool is_signed = pixrep == 1;
+  // photometric interpretation (PS3.3 C.7.6.3.1.2), checked BEFORE any
+  // frame decompression: PALETTE COLOR stores LUT indexes (reject);
+  // MONOCHROME1 stores inverted grayscale — normalize to MONOCHROME2 on
+  // the stored values with base = lo+hi of the stored range (unsigned:
+  // 2^BitsStored-1; signed: -1). Mirrors dicomlite.py.
+  std::string pi;
+  {
+    auto it = ds.meta.find(tag(0x0028, 0x0004));
+    if (it != ds.meta.end()) pi = ascii_value(it->second);
+  }
+  if (pi == "PALETTE COLOR") {
+    set_error("PALETTE COLOR images are out of envelope; convert to grayscale");
+    return false;
+  }
+  bool invert = pi == "MONOCHROME1";
+  long invert_base = 0;
+  if (invert) {
+    if (is_signed) {
+      invert_base = -1;
+    } else {
+      long bits_stored = bits;
+      meta_int(ds, tag(0x0028, 0x0101), &bits_stored, big);
+      if (bits_stored < 1 || bits_stored > bits) bits_stored = bits;
+      invert_base = (1L << bits_stored) - 1;
+    }
+  }
 
   size_t expected = (size_t)rows * cols * (bits / 8);
   // Plausibility bound BEFORE any decode-side allocation: the uncompressed
@@ -1057,16 +1083,20 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
   // decoded/compressed buffers are always little-endian sample bytes; only
   // native big-endian PixelData arrives byte-swapped
   const int lo = big ? 1 : 0, hi = big ? 0 : 1;
+  auto store = [&](size_t i, long raw) {
+    if (invert) raw = invert_base - raw;
+    dst[i] = (float)raw * fslope + fintercept;
+  };
   if (bits == 16 && !is_signed) {
     for (size_t i = 0; i < n; ++i)
-      dst[i] = (float)(uint16_t)(p[2 * i + lo] | (p[2 * i + hi] << 8)) * fslope + fintercept;
+      store(i, (long)(uint16_t)(p[2 * i + lo] | (p[2 * i + hi] << 8)));
   } else if (bits == 16) {
     for (size_t i = 0; i < n; ++i)
-      dst[i] = (float)(int16_t)(p[2 * i + lo] | (p[2 * i + hi] << 8)) * fslope + fintercept;
+      store(i, (long)(int16_t)(p[2 * i + lo] | (p[2 * i + hi] << 8)));
   } else if (!is_signed) {
-    for (size_t i = 0; i < n; ++i) dst[i] = (float)p[i] * fslope + fintercept;
+    for (size_t i = 0; i < n; ++i) store(i, (long)p[i]);
   } else {
-    for (size_t i = 0; i < n; ++i) dst[i] = (float)(int8_t)p[i] * fslope + fintercept;
+    for (size_t i = 0; i < n; ++i) store(i, (long)(int8_t)p[i]);
   }
   *rows_out = (int)rows;
   *cols_out = (int)cols;

@@ -90,6 +90,29 @@ class DicomParseError(ValueError):
     """Raised when a file is not parseable as DICOM."""
 
 
+def _photometric(meta) -> str:
+    """PhotometricInterpretation (0028,0004); rejects PALETTE COLOR (its
+    stored values are LUT indexes, not intensities)."""
+    pi = (
+        (meta.get((0x0028, 0x0004)) or b"")
+        .decode("ascii", "replace")
+        .strip("\x00 ")
+    )
+    if pi == "PALETTE COLOR":
+        raise DicomParseError(
+            "PALETTE COLOR images are out of envelope; convert to "
+            "grayscale before import (gdcmconv or dcmconv)"
+        )
+    return pi
+
+
+def _inversion_base(signed: bool, bits_stored: int) -> int:
+    """MONOCHROME1 -> MONOCHROME2 stored-value inversion constant: lo + hi
+    of the stored range (PS3.3 C.7.6.3.1.2 via DCMTK's DicomImage):
+    unsigned [0, 2^b-1] -> 2^b - 1; signed [-2^(b-1), 2^(b-1)-1] -> -1."""
+    return -1 if signed else (1 << bits_stored) - 1
+
+
 def _check_frame_bounds(rows, cols, itemsize: int) -> None:
     """Plausibility bound shared by every decode path (native caps: 32768
     per axis, 2^28 output bytes) — applied BEFORE any decoder allocates."""
@@ -426,17 +449,28 @@ def read_dicom(path: str | os.PathLike) -> DicomSlice:
             rows = _meta_int(meta, (0x0028, 0x0010))
             cols = _meta_int(meta, (0x0028, 0x0011))
             _check_frame_bounds(rows, cols, 2)
+            pi = _photometric(meta)
             try:
                 pixels, raw_dtype = gdcm_fallback.read_j2k(path, rows, cols)
             except ValueError as e:
                 raise DicomParseError(str(e)) from e
+            slope = _meta_float(meta, (0x0028, 0x1053), 1.0)
+            intercept = _meta_float(meta, (0x0028, 0x1052), 0.0)
+            if pi == "MONOCHROME1":
+                # the shim already applied rescale, so invert in rescaled
+                # space: (base - raw)*s + i == base*s + 2i - (raw*s + i)
+                j2k_bits = _meta_int(meta, (0x0028, 0x0100), 16) or 16
+                bits_stored = _meta_int(meta, (0x0028, 0x0101), j2k_bits) or j2k_bits
+                j2k_signed = _meta_int(meta, (0x0028, 0x0103), 0) == 1
+                base = _inversion_base(j2k_signed, bits_stored)
+                pixels = np.float32(base * slope + 2 * intercept) - pixels
             return DicomSlice(
                 pixels=pixels,
                 rows=rows,
                 cols=cols,
                 raw_dtype=raw_dtype,
-                rescale_slope=_meta_float(meta, (0x0028, 0x1053), 1.0),
-                rescale_intercept=_meta_float(meta, (0x0028, 0x1052), 0.0),
+                rescale_slope=slope,
+                rescale_intercept=intercept,
                 meta=meta,
             )
     if (
@@ -485,6 +519,7 @@ def read_dicom(path: str | os.PathLike) -> DicomSlice:
             f"only monochrome supported, SamplesPerPixel={samples}; convert "
             "color/multi-sample images to grayscale before import"
         )
+    pi = _photometric(meta)
     if bits == 16:
         order = ">" if big else "<"
         dtype = np.dtype(order + ("i2" if signed else "u2"))
@@ -507,6 +542,13 @@ def read_dicom(path: str | os.PathLike) -> DicomSlice:
 
     slope = _meta_float(meta, (0x0028, 0x1053), 1.0)
     intercept = _meta_float(meta, (0x0028, 0x1052), 0.0)
+    if pi == "MONOCHROME1":
+        # inverted grayscale (PS3.3 C.7.6.3.1.2: lowest stored value =
+        # white): normalize to MONOCHROME2 semantics on the STORED values,
+        # before rescale, so intensity thresholds mean the same thing on
+        # every file (DCMTK's DicomImage applies the same inversion)
+        bits_stored = _meta_int(meta, (0x0028, 0x0101), bits, big=big) or bits
+        pixels = _inversion_base(signed, bits_stored) - pixels.astype(np.int64)
     out = pixels.astype(np.float32) * np.float32(slope) + np.float32(intercept)
     return DicomSlice(
         pixels=out,

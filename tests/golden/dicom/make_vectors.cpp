@@ -46,7 +46,8 @@ static std::vector<uint8_t> pattern8(unsigned rows, unsigned cols) {
 
 static bool write_raw(const std::string& path, unsigned rows, unsigned cols,
                       int bits, const std::vector<uint8_t>& pix,
-                      gdcm::TransferSyntax::TSType ts) {
+                      gdcm::TransferSyntax::TSType ts,
+                      bool monochrome1 = false) {
   gdcm::ImageWriter w;
   gdcm::Image& img = w.GetImage();
   img.SetNumberOfDimensions(2);
@@ -56,7 +57,8 @@ static bool write_raw(const std::string& path, unsigned rows, unsigned cols,
                                   : gdcm::PixelFormat::UINT8);
   img.SetPixelFormat(pf);
   img.SetPhotometricInterpretation(
-      gdcm::PhotometricInterpretation::MONOCHROME2);
+      monochrome1 ? gdcm::PhotometricInterpretation::MONOCHROME1
+                  : gdcm::PhotometricInterpretation::MONOCHROME2);
   img.SetTransferSyntax(gdcm::TransferSyntax(ts));
   gdcm::DataElement pixeldata(gdcm::Tag(0x7FE0, 0x0010));
   pixeldata.SetByteValue((const char*)pix.data(), (uint32_t)pix.size());
@@ -110,6 +112,9 @@ int main(int argc, char** argv) {
                   gdcm::TransferSyntax::JPEG2000Lossless);
   ok &= transcode(out + "/gdcm16_explicit.dcm", out + "/gdcm16_deflated.dcm",
                   gdcm::TransferSyntax::DeflatedExplicitVRLittleEndian);
+  ok &= write_raw(out + "/gdcm16_mono1.dcm", R, C, 16, p16,
+                  gdcm::TransferSyntax::ExplicitVRLittleEndian,
+                  /*monochrome1=*/true);
   std::printf(ok ? "all vectors written to %s\n" : "FAILED (partial in %s)\n",
               out.c_str());
   return ok ? 0 : 1;

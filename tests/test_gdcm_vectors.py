@@ -114,6 +114,83 @@ class TestJ2KFallback:
             read_dicom(GOLDEN / "gdcm16_j2k.dcm")
 
 
+class TestPhotometricInterpretation:
+    """MONOCHROME1 (inverted grayscale, PS3.3 C.7.6.3.1.2) normalizes to
+    MONOCHROME2 semantics in BOTH readers; PALETTE COLOR rejects loudly
+    (its stored values are LUT indexes, not intensities)."""
+
+    def test_monochrome1_inverts_in_python_reader(self):
+        from nm03_capstone_project_tpu.data.dicomlite import read_dicom
+
+        want = 65535 - pattern16().astype(np.int64)
+        s = read_dicom(GOLDEN / "gdcm16_mono1.dcm")
+        np.testing.assert_array_equal(s.pixels.astype(np.int64), want)
+
+    def test_monochrome1_inverts_in_native_reader(self):
+        from nm03_capstone_project_tpu import native
+
+        if not native.available():
+            pytest.skip("native layer unavailable")
+        want = 65535 - pattern16().astype(np.int64)
+        px = native.read_dicom_native(GOLDEN / "gdcm16_mono1.dcm")
+        np.testing.assert_array_equal(px.astype(np.int64), want)
+
+    def test_signed_monochrome1_inverts_about_minus_one(self, tmp_path):
+        # signed stored range is [-2^(b-1), 2^(b-1)-1], so the inversion
+        # base is lo+hi = -1, NOT 2^b-1 (which would shift outputs by 2^b)
+        import struct
+
+        from nm03_capstone_project_tpu.data.dicomlite import (
+            _element,
+            read_dicom,
+        )
+
+        raw = np.array([[-1000, -1], [0, 1000]], np.int16)
+        ds = (
+            _element(0x0028, 0x0004, b"CS", b"MONOCHROME1")
+            + _element(0x0028, 0x0010, b"US", struct.pack("<H", 2))
+            + _element(0x0028, 0x0011, b"US", struct.pack("<H", 2))
+            + _element(0x0028, 0x0100, b"US", struct.pack("<H", 16))
+            + _element(0x0028, 0x0101, b"US", struct.pack("<H", 16))
+            + _element(0x0028, 0x0103, b"US", struct.pack("<H", 1))
+            + _element(0x7FE0, 0x0010, b"OW", raw.astype("<i2").tobytes())
+        )
+        p = tmp_path / "sm1.dcm"
+        p.write_bytes(b"\x00" * 128 + b"DICM" + ds)
+        s = read_dicom(p)
+        np.testing.assert_array_equal(
+            s.pixels.astype(np.int64), -1 - raw.astype(np.int64)
+        )
+        from nm03_capstone_project_tpu import native
+
+        if native.available():
+            px = native.read_dicom_native(p)
+            np.testing.assert_array_equal(
+                px.astype(np.int64), -1 - raw.astype(np.int64)
+            )
+
+    def test_palette_color_rejected(self, tmp_path):
+        import struct
+
+        from nm03_capstone_project_tpu.data.dicomlite import (
+            DicomParseError,
+            _element,
+            read_dicom,
+        )
+
+        ds = (
+            _element(0x0028, 0x0004, b"CS", b"PALETTE COLOR")
+            + _element(0x0028, 0x0010, b"US", struct.pack("<H", 4))
+            + _element(0x0028, 0x0011, b"US", struct.pack("<H", 4))
+            + _element(0x0028, 0x0100, b"US", struct.pack("<H", 8))
+            + _element(0x7FE0, 0x0010, b"OW", b"\x00" * 16)
+        )
+        p = tmp_path / "pal.dcm"
+        p.write_bytes(b"\x00" * 128 + b"DICM" + ds)
+        with pytest.raises(DicomParseError, match="PALETTE COLOR"):
+            read_dicom(p)
+
+
 def test_deflated_bomb_contained(tmp_path):
     # a ~1 MB deflate stream inflating to 1 GiB must hit the importer's
     # size bound as a clean DicomParseError, never an OOM
