@@ -996,18 +996,21 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
     set_error("PALETTE COLOR images are out of envelope; convert to grayscale");
     return false;
   }
-  bool invert = pi == "MONOCHROME1";
-  long invert_base = 0;
-  if (invert) {
-    if (is_signed) {
-      invert_base = -1;
-    } else {
-      long bits_stored = bits;
-      meta_int(ds, tag(0x0028, 0x0101), &bits_stored, big);
-      if (bits_stored < 1 || bits_stored > bits) bits_stored = bits;
-      invert_base = (1L << bits_stored) - 1;
-    }
+  long bits_stored = bits;
+  meta_int(ds, tag(0x0028, 0x0101), &bits_stored, big);
+  if (bits_stored < 1 || bits_stored > bits) {
+    set_error("BitsStored outside [1, BitsAllocated]");
+    return false;
   }
+  long high_bit = bits_stored - 1;
+  meta_int(ds, tag(0x0028, 0x0102), &high_bit, big);
+  if (high_bit != bits_stored - 1) {
+    // standard layout only (PS3.5 8.1.1); exotic packings would misread
+    set_error("HighBit != BitsStored-1; repack with gdcmconv/dcmconv");
+    return false;
+  }
+  bool invert = pi == "MONOCHROME1";
+  long invert_base = invert ? (is_signed ? -1 : (1L << bits_stored) - 1) : 0;
 
   size_t expected = (size_t)rows * cols * (bits / 8);
   // Plausibility bound BEFORE any decode-side allocation: the uncompressed
@@ -1083,20 +1086,24 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
   // decoded/compressed buffers are always little-endian sample bytes; only
   // native big-endian PixelData arrives byte-swapped
   const int lo = big ? 1 : 0, hi = big ? 0 : 1;
+  // bits above BitsStored are overlay planes / garbage in historical
+  // files: mask (unsigned) or sign-extend from the stored sign bit
+  // (signed), as DCMTK's DicomImage does; no-op when BitsStored ==
+  // BitsAllocated (the sign extension below reproduces the (int16_t) /
+  // (int8_t) casts the raw loops used to apply)
+  const long stored_mask = (bits_stored >= 64) ? -1L : (1L << bits_stored) - 1;
+  const long sign_bit = 1L << (bits_stored - 1);
   auto store = [&](size_t i, long raw) {
+    raw &= stored_mask;
+    if (is_signed) raw = (raw ^ sign_bit) - sign_bit;
     if (invert) raw = invert_base - raw;
     dst[i] = (float)raw * fslope + fintercept;
   };
-  if (bits == 16 && !is_signed) {
+  if (bits == 16) {
     for (size_t i = 0; i < n; ++i)
       store(i, (long)(uint16_t)(p[2 * i + lo] | (p[2 * i + hi] << 8)));
-  } else if (bits == 16) {
-    for (size_t i = 0; i < n; ++i)
-      store(i, (long)(int16_t)(p[2 * i + lo] | (p[2 * i + hi] << 8)));
-  } else if (!is_signed) {
-    for (size_t i = 0; i < n; ++i) store(i, (long)p[i]);
   } else {
-    for (size_t i = 0; i < n; ++i) store(i, (long)(int8_t)p[i]);
+    for (size_t i = 0; i < n; ++i) store(i, (long)p[i]);
   }
   *rows_out = (int)rows;
   *cols_out = (int)cols;

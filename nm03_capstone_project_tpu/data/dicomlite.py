@@ -459,8 +459,13 @@ def read_dicom(path: str | os.PathLike) -> DicomSlice:
             if pi == "MONOCHROME1":
                 # the shim already applied rescale, so invert in rescaled
                 # space: (base - raw)*s + i == base*s + 2i - (raw*s + i)
-                j2k_bits = _meta_int(meta, (0x0028, 0x0100), 16) or 16
-                bits_stored = _meta_int(meta, (0x0028, 0x0101), j2k_bits) or j2k_bits
+                j2k_bits = _meta_int(meta, (0x0028, 0x0100), 16)
+                bits_stored = _meta_int(meta, (0x0028, 0x0101), j2k_bits)
+                if not (1 <= bits_stored <= j2k_bits <= 16):
+                    raise DicomParseError(
+                        f"BitsStored {bits_stored} outside "
+                        f"[1, BitsAllocated={j2k_bits}]"
+                    )
                 j2k_signed = _meta_int(meta, (0x0028, 0x0103), 0) == 1
                 base = _inversion_base(j2k_signed, bits_stored)
                 pixels = np.float32(base * slope + 2 * intercept) - pixels
@@ -542,12 +547,33 @@ def read_dicom(path: str | os.PathLike) -> DicomSlice:
 
     slope = _meta_float(meta, (0x0028, 0x1053), 1.0)
     intercept = _meta_float(meta, (0x0028, 0x1052), 0.0)
+    bits_stored = _meta_int(meta, (0x0028, 0x0101), bits, big=big)
+    if not (1 <= bits_stored <= bits):
+        raise DicomParseError(
+            f"BitsStored {bits_stored} outside [1, BitsAllocated={bits}]"
+        )
+    high_bit = _meta_int(meta, (0x0028, 0x0102), bits_stored - 1, big=big)
+    if high_bit != bits_stored - 1:
+        # standard layout only (PS3.5 8.1.1: HighBit = BitsStored-1);
+        # exotic packings would silently misread, so reject with a remedy
+        raise DicomParseError(
+            f"HighBit {high_bit} != BitsStored-1 ({bits_stored - 1}); "
+            "repack with gdcmconv/dcmconv before import"
+        )
+    if bits_stored < bits:
+        # bits above BitsStored are overlay planes / garbage in historical
+        # files: mask them off (unsigned) or sign-extend from the stored
+        # sign bit (signed), as DCMTK's DicomImage does
+        v = pixels.astype(np.int64) & ((1 << bits_stored) - 1)
+        if signed:
+            sign = 1 << (bits_stored - 1)
+            v = (v ^ sign) - sign
+        pixels = v
     if pi == "MONOCHROME1":
         # inverted grayscale (PS3.3 C.7.6.3.1.2: lowest stored value =
         # white): normalize to MONOCHROME2 semantics on the STORED values,
         # before rescale, so intensity thresholds mean the same thing on
         # every file (DCMTK's DicomImage applies the same inversion)
-        bits_stored = _meta_int(meta, (0x0028, 0x0101), bits, big=big) or bits
         pixels = _inversion_base(signed, bits_stored) - pixels.astype(np.int64)
     out = pixels.astype(np.float32) * np.float32(slope) + np.float32(intercept)
     return DicomSlice(

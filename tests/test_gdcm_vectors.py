@@ -218,3 +218,95 @@ def test_deflated_bomb_contained(tmp_path):
 
 def test_all_vectors_present():
     assert {n for n, _ in CASES} <= {p.name for p in GOLDEN.glob("*.dcm")}
+
+
+class TestStoredBits:
+    """BitsStored < BitsAllocated: high bits are overlay/garbage and must be
+    masked (unsigned) or sign-extended from the stored sign bit (signed), as
+    DCMTK does; exotic HighBit packings reject with a remedy."""
+
+    @staticmethod
+    def _file(tmp_path, raw16, bits_stored, signed=False, high_bit=None):
+        import struct
+
+        from nm03_capstone_project_tpu.data.dicomlite import _element
+
+        ds = (
+            _element(0x0028, 0x0010, b"US", struct.pack("<H", raw16.shape[0]))
+            + _element(0x0028, 0x0011, b"US", struct.pack("<H", raw16.shape[1]))
+            + _element(0x0028, 0x0100, b"US", struct.pack("<H", 16))
+            + _element(0x0028, 0x0101, b"US", struct.pack("<H", bits_stored))
+            + _element(
+                0x0028, 0x0102, b"US",
+                struct.pack("<H", bits_stored - 1 if high_bit is None else high_bit),
+            )
+            + _element(0x0028, 0x0103, b"US", struct.pack("<H", 1 if signed else 0))
+            + _element(0x7FE0, 0x0010, b"OW", raw16.astype("<u2").tobytes())
+        )
+        p = tmp_path / "bs.dcm"
+        p.write_bytes(b"\x00" * 128 + b"DICM" + ds)
+        return p
+
+    def test_unsigned_high_bits_masked(self, tmp_path):
+        from nm03_capstone_project_tpu import native
+        from nm03_capstone_project_tpu.data.dicomlite import read_dicom
+
+        # 12-bit stored with overlay garbage in bits 12-15
+        raw = np.array([[0xF123, 0x0FFF], [0x8000, 0x0001]], np.uint16)
+        want = (raw & 0x0FFF).astype(np.int64)
+        p = self._file(tmp_path, raw, bits_stored=12)
+        np.testing.assert_array_equal(
+            read_dicom(p).pixels.astype(np.int64), want
+        )
+        if native.available():
+            np.testing.assert_array_equal(
+                native.read_dicom_native(p).astype(np.int64), want
+            )
+
+    def test_signed_sign_extends_from_stored_bit(self, tmp_path):
+        from nm03_capstone_project_tpu import native
+        from nm03_capstone_project_tpu.data.dicomlite import read_dicom
+
+        # 12-bit signed: 0x0800 is -2048, garbage high bits ignored
+        raw = np.array([[0xF800, 0x07FF], [0x0800, 0x0000]], np.uint16)
+        want = np.array([[-2048, 2047], [-2048, 0]], np.int64)
+        p = self._file(tmp_path, raw, bits_stored=12, signed=True)
+        np.testing.assert_array_equal(
+            read_dicom(p).pixels.astype(np.int64), want
+        )
+        if native.available():
+            np.testing.assert_array_equal(
+                native.read_dicom_native(p).astype(np.int64), want
+            )
+
+    def test_exotic_high_bit_rejected(self, tmp_path):
+        from nm03_capstone_project_tpu import native
+        from nm03_capstone_project_tpu.data.dicomlite import (
+            DicomParseError,
+            read_dicom,
+        )
+
+        raw = np.zeros((2, 2), np.uint16)
+        p = self._file(tmp_path, raw, bits_stored=12, high_bit=15)
+        with pytest.raises(DicomParseError, match="HighBit"):
+            read_dicom(p)
+        if native.available():
+            with pytest.raises(ValueError, match="HighBit"):
+                native.read_dicom_native(p)
+
+    def test_zero_bits_stored_rejected_by_both_readers(self, tmp_path):
+        # BitsStored=0 must reject identically in both readers — the old
+        # `or bits` coalescing silently accepted it on the Python side
+        from nm03_capstone_project_tpu import native
+        from nm03_capstone_project_tpu.data.dicomlite import (
+            DicomParseError,
+            read_dicom,
+        )
+
+        raw = np.zeros((2, 2), np.uint16)
+        p = self._file(tmp_path, raw, bits_stored=0, high_bit=0)
+        with pytest.raises(DicomParseError, match="BitsStored"):
+            read_dicom(p)
+        if native.available():
+            with pytest.raises(ValueError, match="BitsStored"):
+                native.read_dicom_native(p)
