@@ -1052,6 +1052,17 @@ def _compose(accel, cpu, meta) -> dict:
             base = base if base else cpu["xla_tput"]
             out["cpu_baseline_tput"] = round(base, 2)
             out["vs_baseline"] = round(tput / base, 2)
+            # sections the wedge-first CPU baseline measured but a shed
+            # late-recovery accel attempt didn't: carry them under a
+            # DISTINCT key — cpu-measured stage/volume numbers must never
+            # masquerade as the record's (accelerator) sections
+            diag = {
+                k: cpu[k]
+                for k in ("stages", "volume")
+                if k in cpu and k not in out
+            }
+            if diag:
+                out["cpu_diagnostics"] = diag
         else:
             out["vs_baseline"] = 1.0
             out["error"] = "cpu baseline worker failed; vs_baseline unknown"
@@ -1265,9 +1276,16 @@ def main() -> None:
         # tunnel wedged or attempt lost — bank the CPU baseline first (it
         # cannot touch the tunnel), sweeping every accel batch size so the
         # ratio stays same-program whatever batch later wins on the chip,
-        # and carrying the stage breakdown for diagnosability
+        # and carrying the stage breakdown + volume leg for diagnosability
+        # (sections checkpoint incrementally: if the volume leg overruns
+        # the worker timeout, only it is lost, never the headline). The
+        # extra legs cost ~90 s of LOCAL compute against the vigil budget —
+        # accepted: they are bounded (no tunnel involvement, nothing to
+        # hang on) and a wedged round's record is exactly where the
+        # diagnostics matter most.
         state["cpu"] = _measure_cpu(
-            ["--batches", ",".join(str(b) for b in ACCEL_BATCH_SWEEP), "--stages"]
+            ["--batches", ",".join(str(b) for b in ACCEL_BATCH_SWEEP),
+             "--stages", "--volume"]
         )
         # bank the best-so-far record to a file before entering the vigil:
         # stdout still carries exactly ONE line at the end, but if an

@@ -308,6 +308,7 @@ class TestOrchestrator:
                 "xla_tput": 10.0,
                 "xla_batch": 32,
                 "checksum": 7,
+                "volume": {"ms_per_volume": 9.9},
                 "xla_by_batch": {"32": 10.0, "128": 8.0},
             }
 
@@ -322,8 +323,13 @@ class TestOrchestrator:
             str(b) for b in bench.ACCEL_BATCH_SWEEP
         )
         assert "--stages" in cpu_args
+        # a wedged round's driver record still carries the 3D leg
+        assert "--volume" in cpu_args
         # the late accel record wins, ratioed against the batch-128 CPU entry
         assert out["backend"] == "tpu"
+        # sections only the CPU baseline measured ride along under a
+        # distinct key (never masquerading as accelerator numbers)
+        assert out["cpu_diagnostics"]["volume"] == {"ms_per_volume": 9.9}
         assert out["value"] == 1000.0
         assert out["cpu_baseline_tput"] == 8.0
         assert out["vs_baseline"] == pytest.approx(125.0)
