@@ -325,17 +325,19 @@ def _bench_volume(device, reps):
 
 
 def zshard_scaling() -> None:
-    """Relative-scaling curve of the z-sharded volume pipeline over subsets
-    of the (virtual) device set: 1/2/4/8 z-shards on one small volume.
+    """Relative-scaling curves of the sharded paths over subsets of the
+    (virtual) device set: z-sharded volume AND data-parallel 2D batch at
+    1/2/4/8 shards, checksum-equality asserted across every width.
 
     Runs under JAX_PLATFORMS=cpu with xla_force_host_platform_device_count=8
     (the parent sets the env), so it is tunnel-independent; on real
-    multi-chip hardware the same code path rides ICI instead.
+    multi-chip hardware the same code paths ride ICI instead.
     """
     import jax
     import jax.numpy as jnp
 
     from nm03_capstone_project_tpu.config import PipelineConfig
+    from nm03_capstone_project_tpu.parallel.dp import process_batch_sharded
     from nm03_capstone_project_tpu.parallel.mesh import make_mesh
     from nm03_capstone_project_tpu.parallel.zshard import process_volume_zsharded
 
@@ -343,30 +345,46 @@ def zshard_scaling() -> None:
     vol, dims = _make_volume(ZSHARD_DEPTH, ZSHARD_CANVAS)
     v = jnp.asarray(vol)
     d = jnp.asarray(dims)
+    # dp input: the same stack treated as a 2D batch, dims per slice
+    bd = jnp.broadcast_to(d, (ZSHARD_DEPTH, 2))
     devices = jax.devices()
-    out: dict = {"depth": ZSHARD_DEPTH, "canvas": ZSHARD_CANVAS, "ms": {}}
-    base_checksum = None
+    out: dict = {
+        "depth": ZSHARD_DEPTH,
+        "canvas": ZSHARD_CANVAS,
+        "ms": {},
+        "dp_ms": {},
+    }
+    bases: dict = {}
     for shards in (1, 2, 4, 8):
         if shards > len(devices):
             break
-        mesh = make_mesh(axis_names=("z",), devices=devices[:shards])
-        fn = jax.jit(
-            lambda vv, dd, m=mesh: process_volume_zsharded(vv, dd, cfg, m)[
+        sub = devices[:shards]
+        zmesh = make_mesh(axis_names=("z",), devices=sub)
+        dmesh = make_mesh(axis_names=("data",), devices=sub)
+        zfn = jax.jit(
+            lambda vv, dd, m=zmesh: process_volume_zsharded(vv, dd, cfg, m)[
                 "mask"
             ].astype(jnp.int32).sum()
         )
-        checksum = int(fn(v, d))  # compile + warm
-        if base_checksum is None:
-            base_checksum = checksum
+        # mask_only would DONATE the pixel stack, invalidating it for the
+        # next rep — use the non-donating default path
+        dfn = jax.jit(
+            lambda vv, dd, m=dmesh: process_batch_sharded(vv, dd, cfg, m)[
+                "mask"
+            ].astype(jnp.int32).sum()
+        )
         reps = 4
-        t0 = time.perf_counter()
-        outs = [fn(v, d) for _ in range(reps)]
-        int(outs[-1])
-        ms = (time.perf_counter() - t0) / reps * 1e3
-        out["ms"][str(shards)] = round(ms, 2)
-        out.setdefault("checksum_ok", True)
-        out["checksum_ok"] = out["checksum_ok"] and checksum == base_checksum
-        _log(f"zshard {shards}: {ms:.1f} ms/volume (checksum {checksum})")
+        for key, fn, args in (("ms", zfn, (v, d)), ("dp_ms", dfn, (v, bd))):
+            checksum = int(fn(*args))  # compile + warm
+            agree = checksum == bases.setdefault(key, checksum)
+            t0 = time.perf_counter()
+            outs = [fn(*args) for _ in range(reps)]
+            int(outs[-1])
+            ms = (time.perf_counter() - t0) / reps * 1e3
+            out[key][str(shards)] = round(ms, 2)
+            out.setdefault("checksum_ok", True)
+            out["checksum_ok"] = out["checksum_ok"] and agree
+            _log(f"{key} {shards}: {ms:.1f} ms (checksum {checksum})")
     print(_SENTINEL + json.dumps(out), flush=True)
 
 

@@ -88,7 +88,8 @@ def test_mutations_never_diverge(native, tmp_path, base):
     # files): mutating it swaps envelopes, where the readers differ by
     # design (deflated/baseline are Python-only)
     lo = 300
-    assert raw.find(b"1.2.840.10008.1.2", 128) + 24 < lo
+    uid_at = raw.find(b"1.2.840.10008.1.2", 128)
+    assert uid_at != -1 and uid_at + 24 < lo
     for trial in range(60):
         m = bytearray(raw)
         for _ in range(int(rng.integers(1, 6))):
