@@ -432,6 +432,7 @@ bool jpeg_lossless_decode(const uint8_t* data, size_t len, long expect_rows,
     if (data[pos] != 0xFF) { set_error("expected JPEG marker"); return false; }
     // optional fill bytes (T.81 B.1.1.2): extra 0xFF may pad any marker
     while (pos + 1 < len && data[pos + 1] == 0xFF) ++pos;
+    if (pos + 2 > len) { set_error("truncated JPEG marker segment"); return false; }
     uint8_t marker = data[pos + 1];
     pos += 2;
     if (marker == 0xD9) break;  // EOI
@@ -638,6 +639,7 @@ bool jpegls_decode(const uint8_t* data, size_t len, long expect_rows,
     if (data[pos] != 0xFF) { set_error("expected JPEG-LS marker"); return false; }
     // optional fill bytes (T.81 B.1.1.2): extra 0xFF may pad any marker
     while (pos + 1 < len && data[pos + 1] == 0xFF) ++pos;
+    if (pos + 2 > len) { set_error("truncated JPEG-LS segment"); return false; }
     uint8_t marker = data[pos + 1];
     pos += 2;
     if (marker == 0xD9) break;  // EOI before SOS

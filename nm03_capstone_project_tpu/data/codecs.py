@@ -258,6 +258,8 @@ def jpeg_lossless_decode(data: bytes, expect_shape=None) -> np.ndarray:
         # optional fill bytes (T.81 B.1.1.2): extra 0xFF may pad any marker
         while pos + 1 < len(data) and data[pos + 1] == 0xFF:
             pos += 1
+        if pos + 2 > len(data):
+            raise CodecError("truncated JPEG marker segment")
         marker = data[pos + 1]
         pos += 2
         if marker == _EOI:
@@ -567,6 +569,8 @@ def _jls_parse_header(data: bytes):
         # of extra 0xFF may pad before the marker code
         while pos + 1 < len(data) and data[pos + 1] == 0xFF:
             pos += 1
+        if pos + 2 > len(data):
+            raise CodecError("truncated JPEG-LS marker segment")
         marker = data[pos + 1]
         pos += 2
         if marker == _EOI:

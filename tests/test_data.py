@@ -317,6 +317,18 @@ class TestCompressedTransferSyntaxes:
         with pytest.raises(codecs.CodecError):
             codecs.jpeg_lossless_decode(enc[: len(enc) // 2])
 
+    def test_trailing_fill_bytes_rejected_cleanly(self):
+        # a stream ending in 0xFF fill bytes used to leave the fill-skip
+        # loop at pos+1 == len and raise IndexError past _decode_compressed's
+        # CodecError net (ADVICE r4); both decoders must raise CodecError
+        from nm03_capstone_project_tpu.data import codecs
+
+        for decode in (codecs.jpeg_lossless_decode, codecs.jpegls_decode):
+            with pytest.raises(codecs.CodecError):
+                decode(b"\xff\xd8\xff\xff")
+            with pytest.raises(codecs.CodecError):
+                decode(b"\xff\xd8\xff\xff\xff\xff\xff")
+
     def test_jpeg_stream_without_sos_rejected(self):
         # SOF3+DHT but no scan header: decoding trailing bytes as entropy
         # data under the default predictor/table would be an acceptance

@@ -182,6 +182,18 @@ class TestNativeDicom:
         with pytest.raises(ValueError):
             native.read_dicom_native(p2)
 
+    def test_trailing_fill_bytes_rejected_cleanly(self, tmp_path):
+        """A fragment ending in 0xFF fill bytes used to read one byte past
+        the buffer after the fill-skip loop, making acceptance depend on
+        out-of-bounds memory (ADVICE r4) — must be a clean parse error."""
+        for i, frag in enumerate(
+            [b"\xff\xd8\xff\xff", b"\xff\xd8\xff\xff\xff\xff\xff\xff"]
+        ):
+            p = tmp_path / f"fill{i}.dcm"
+            self._encapsulated_dicom(p, [frag], 8, 8)
+            with pytest.raises(ValueError):
+                native.read_dicom_native(p)
+
     def test_mutation_fuzz_never_crashes(self, tmp_path):
         """Byte-corrupted DICOMs (plain, RLE, JPEG-lossless) must decode or
         raise — never kill the process. Exercises the C-ABI exception
