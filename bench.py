@@ -461,7 +461,9 @@ def _stage_times(device, reps):
     f_sharp = vm(
         lambda p: sharpen(p, cfg.sharpen_gain, cfg.sharpen_sigma, cfg.sharpen_kernel)
     )
-    f_grow = vm(lambda p, d: segment(p, d, cfg))
+    # [0]: segment returns (mask, converged); the stage clock times the mask
+    # (the flag is a byproduct of the same fixpoint loop)
+    f_grow = vm(lambda p, d: segment(p, d, cfg)[0])
     f_post = vm(
         lambda s, d: dilate(cast_uint8(s), cfg.morph_size)
         * valid_mask(d, s.shape[-2:]).astype(jnp.uint8)
@@ -1209,6 +1211,68 @@ def _bank_partial(state) -> None:
         pass
 
 
+# PIPE_BUF-safe budget for the final line: a single write of <= 4096 bytes
+# to a pipe is atomic (POSIX), so a merged (2>&1) stream cannot interleave
+# stderr chatter INSIDE the record; 4000 leaves room for the framing
+# newlines
+_FINAL_LINE_CAP = 4000
+# True only when bench.py runs as the orchestrator script — the emit path
+# then parks fd 2 on /dev/null after the record so nothing (interpreter
+# teardown noise included) can land after the final line. In-process test
+# callers keep their streams.
+_AS_SCRIPT = False
+# fields the final line always keeps, whatever the shedding pressure
+_SLIM_REQUIRED = ("metric", "value", "unit", "vs_baseline", "backend",
+                  "error", "detail")
+
+
+def _slim_record(record: dict) -> dict:
+    """The stdout copy of the record: headline + small fixed fields only.
+
+    The driver reads bench through ``2>&1 | tail -N`` and json-parses the
+    last line, so that line must be small and tear-proof (VERDICT r4 item
+    1). Unbounded diagnostics — probe history with its ps/TCP snapshots —
+    live exclusively in the banked file; the line points at it via
+    ``detail``. If the slim record still exceeds the cap, optional sections
+    are shed largest-first until it fits; the headline fields and the
+    pointer always survive.
+    """
+    slim = {k: v for k, v in record.items() if k != "probe_history"}
+    slim["detail"] = _PARTIAL_PATH
+    while len(json.dumps(slim)) > _FINAL_LINE_CAP:
+        droppable = [k for k in slim if k not in _SLIM_REQUIRED]
+        if not droppable:
+            break
+        slim.pop(max(droppable, key=lambda k: len(json.dumps(slim[k]))))
+    return slim
+
+
+def _emit_final(state) -> None:
+    """Bank the full record, then put exactly ONE short JSON line on stdout.
+
+    The line is framed by newlines and written through a just-flushed
+    stream, so the whole thing reaches the pipe as one <= PIPE_BUF write:
+    atomic, untearable, and — thanks to the LEADING newline — immune to a
+    dangling partial stderr line earlier in a merged (2>&1) stream. In
+    script mode stderr is then parked on /dev/null so no late chatter can
+    land after the record.
+    """
+    _bank_partial(state)  # the on-disk copy carries the full diagnostics
+    record = _compose(state["accel"], state["cpu"], state["meta"])
+    line = json.dumps(_slim_record(record))
+    sys.stderr.flush()
+    sys.stdout.flush()
+    sys.stdout.write("\n" + line + "\n")
+    sys.stdout.flush()
+    if _AS_SCRIPT:
+        try:
+            devnull = os.open(os.devnull, os.O_WRONLY)
+            os.dup2(devnull, 2)
+            os.close(devnull)
+        except OSError:
+            pass
+
+
 def main() -> None:
     # Flow (VERDICT r2 item 1): quick accel probe round; on success, one
     # long-timeout accel attempt. If the tunnel is wedged (or the attempt
@@ -1255,9 +1319,7 @@ def main() -> None:
                 state[key] = merged
         state["meta"]["terminated"] = "signal mid-run; emitted best-so-far"
         state["meta"]["elapsed_s"] = round(time.monotonic() - t0, 1)
-        _bank_partial(state)  # the on-disk copy must match what we emit
-        print(json.dumps(_compose(state["accel"], state["cpu"], state["meta"])),
-              flush=True)
+        _emit_final(state)
         os._exit(0)
 
     old_term = signal.signal(signal.SIGTERM, _on_term)
@@ -1329,12 +1391,11 @@ def main() -> None:
         state["meta"]["zshard_scaling"] = z
 
     state["meta"]["elapsed_s"] = round(time.monotonic() - t0, 1)
-    _bank_partial(state)
-    # nothing left but pure host compose+print: the alarm's job is done, and
-    # cancelling it first means the record can never hit stdout twice
+    # nothing left but pure host bank+compose+write: the alarm's job is
+    # done, and cancelling it first means the record can never hit stdout
+    # twice
     signal.alarm(0)
-    print(json.dumps(_compose(state["accel"], state["cpu"], state["meta"])),
-          flush=True)
+    _emit_final(state)
     # only restore AFTER the record is on stdout — restoring first would
     # reopen the very lost-record window the handler exists to close
     signal.signal(signal.SIGTERM, old_term)
@@ -1354,6 +1415,7 @@ if __name__ == "__main__":
     parser.add_argument("--out", default=None)
     parser.add_argument("--batches", default=str(BATCH), help="comma list to sweep")
     ns = parser.parse_args()
+    _AS_SCRIPT = True
     if ns.probe:
         probe(ns.platform)
     elif ns.zshard_scaling:

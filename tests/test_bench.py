@@ -354,9 +354,12 @@ class TestOrchestrator:
         assert out["value"] == 9.0
         assert "accel measurement" not in calls
 
-    def test_emitted_record_carries_sha_and_probe_history(
+    def test_emitted_record_carries_sha_and_points_at_diagnostics(
         self, monkeypatch, capsys
     ):
+        # VERDICT r4 item 1: probe history (ps/TCP snapshots, unbounded)
+        # lives ONLY in the banked file; the stdout line stays small and
+        # points at it via "detail"
         out, _ = self._run_main(
             monkeypatch,
             capsys,
@@ -364,8 +367,41 @@ class TestOrchestrator:
             cpu={"backend": "cpu", "xla_tput": 8.0, "checksum": 7},
         )
         assert out["git_sha"]  # "unknown" only if git itself is unavailable
-        assert isinstance(out["probe_history"], list)
+        assert "probe_history" not in out
+        assert out["detail"] == str(self.partial_path)
         assert out["elapsed_s"] >= 0
+        banked = json.loads(self.partial_path.read_text())
+        assert isinstance(banked["probe_history"], list)
+
+    def test_final_line_capped_and_newline_framed(self, monkeypatch, capsys):
+        # the final stdout line must stay under the PIPE_BUF atomicity cap
+        # whatever diagnostics accumulate, and must START on a fresh line so
+        # a dangling partial stderr line in a merged stream cannot glue to it
+        accel = {
+            "backend": "tpu", "xla_tput": 100.0, "checksum": 7,
+            # a pathologically large optional section: must be shed from the
+            # line (but kept in the banked file)
+            "stages": {f"stage_{i}": {"ms": i, "note": "x" * 64}
+                       for i in range(200)},
+        }
+        monkeypatch.setattr(bench, "_probe_until_healthy", lambda *a: True)
+        monkeypatch.setattr(bench, "_accel_vigil", lambda *a: False)
+        monkeypatch.setattr(
+            bench, "_run_measurement",
+            lambda label, *a: accel if "accel" in label
+            else {"backend": "cpu", "xla_tput": 8.0, "checksum": 7},
+        )
+        bench.main()
+        raw = capsys.readouterr().out
+        lines = raw.splitlines()
+        assert lines[-1].strip(), "final line must be the record"
+        assert lines[-2] == "", "record must be preceded by a framing newline"
+        assert len(lines[-1]) <= bench._FINAL_LINE_CAP
+        out = json.loads(lines[-1])
+        assert out["value"] == 100.0
+        assert "stages" not in out  # shed from the line...
+        banked = json.loads(self.partial_path.read_text())
+        assert len(banked["stages"]) == 200  # ...but intact on disk
 
     def test_accel_vigil_tcp_open_triggers_early_probe(self, monkeypatch):
         # the vigil's cheap TCP tier must fire the expensive jax probe
@@ -591,3 +627,36 @@ class TestExitPaths:
         rec = self._final_record(out)
         assert rec["metric"] == "slices_per_sec_per_chip"
         assert rec["terminated"].startswith("signal")
+
+    def test_driver_pipe_merged_stderr_last_line_parses(self, tmp_path):
+        # VERDICT r4 item 1, the exact failure mode: the driver runs bench
+        # as `... 2>&1 | tail -100` and json-parses the LAST line. Recreate
+        # that pipeline with hostile stderr: a dangling partial line written
+        # just before bench starts, plus concurrent chatter racing the
+        # merged stream. The record must still be the last line, parseable,
+        # and under the PIPE_BUF atomicity cap.
+        env = os.environ.copy()
+        env.update(self._SCRUB)
+        env["NM03_BENCH_PARTIAL_PATH"] = str(tmp_path / "partial.json")
+        env[bench.VIGIL_BUDGET_ENV] = "1"
+        # a burst of stderr chatter then a DANGLING partial line immediately
+        # before bench starts; bench's own stderr logging (probe attempts,
+        # phase skips) supplies the concurrent chatter racing the merged
+        # stream while it runs
+        script = (
+            "{ for i in $(seq 1 50); do printf 'chatter %d\\n' \"$i\" >&2; done; "
+            "printf 'dangling-partial-stderr-line' >&2; "
+            f"{sys.executable} {_BENCH_PATH}; }} 2>&1 | tail -100"
+        )
+        out = subprocess.run(
+            ["bash", "-c", script], capture_output=True, text=True,
+            env=env, timeout=120,
+        )
+        lines = [l for l in out.stdout.splitlines() if l.strip()]
+        assert lines, "no output through the driver pipe"
+        assert len(lines[-1]) <= 4096, "final line exceeds PIPE_BUF atomicity"
+        rec = json.loads(lines[-1])
+        assert rec["metric"] == "slices_per_sec_per_chip"
+        assert "probe_history" not in rec
+        banked = json.loads((tmp_path / "partial.json").read_text())
+        assert "probe_history" in banked
