@@ -104,6 +104,15 @@ def add_pipeline_args(parser: argparse.ArgumentParser) -> None:
         "whenever dilate converges within its iteration cap; not combinable "
         "with --use-pallas; 2D drivers only)",
     )
+    g.add_argument(
+        "--grow-block-iters", type=int, default=d.grow_block_iters,
+        help="dilation steps per region-growing convergence check",
+    )
+    g.add_argument(
+        "--grow-max-iters", type=int, default=d.grow_max_iters,
+        help="hard cap on region-growing steps; a capped slice is counted "
+        "as truncated in the summary and warned per patient",
+    )
 
 
 def pipeline_config_from_args(args: argparse.Namespace) -> PipelineConfig:
@@ -126,6 +135,8 @@ def pipeline_config_from_args(args: argparse.Namespace) -> PipelineConfig:
         canvas=args.canvas,
         use_pallas=args.use_pallas,
         grow_algorithm=args.grow_algorithm,
+        grow_block_iters=args.grow_block_iters,
+        grow_max_iters=args.grow_max_iters,
     )
 
 

@@ -101,6 +101,11 @@ def stage_renders(padded, dims, cfg) -> dict:
     )
 
     stages = process_slice_stages(padded, dims, cfg)
+    if not bool(np.asarray(stages["grow_converged"])):
+        print(
+            "WARNING: region growing hit its iteration cap; the segmentation "
+            "under-covers (raise --grow-max-iters)"
+        )
 
     def seg_render(m):
         return render_segmentation(

@@ -124,7 +124,7 @@ def _compiled_volume_fn(cfg):
         gray, seg = jax.vmap(lambda p, m: render_pair(p, m, dims, cfg))(
             vol, out["mask"]
         )
-        return out["mask"], gray, seg
+        return out["mask"], gray, seg, out["grow_converged"]
 
     return jax.jit(f)
 
@@ -166,7 +166,11 @@ def _compiled_volume_mask_fn(cfg):
 
     from nm03_capstone_project_tpu.pipeline.volume_pipeline import process_volume
 
-    return jax.jit(lambda vol, dims: process_volume(vol, dims, cfg)["mask"])
+    def f(vol, dims):
+        out = process_volume(vol, dims, cfg)
+        return out["mask"], out["grow_converged"]
+
+    return jax.jit(f)
 
 
 @functools.lru_cache(maxsize=4)
@@ -296,6 +300,7 @@ def run(args: argparse.Namespace) -> int:
         )
 
     ok_patients, results = 0, {}
+    truncated_patients: list = []
     with profile_trace(args.profile_dir):
         for pid in patients:
             try:
@@ -366,6 +371,7 @@ def run(args: argparse.Namespace) -> int:
                     # ranks' collectives pair off-by-one for the rest of the
                     # run (code-review r3).
                     gray = seg = None
+                    conv = None  # None = path without a growing fixpoint
                     if student_fn is not None:
                         volj, dimsj = jnp.asarray(vol), jnp.asarray(dims)
                         maskj = student_fn(volj, dimsj)
@@ -401,8 +407,10 @@ def run(args: argparse.Namespace) -> int:
                         else:
                             maskj = out["mask"][:depth]
                             mask = np.asarray(maskj)
+                        # replicated scalar: addressable on every rank
+                        conv = out["grow_converged"]
                     elif host_render:
-                        maskj = _compiled_volume_mask_fn(cfg)(
+                        maskj, conv = _compiled_volume_mask_fn(cfg)(
                             jnp.asarray(vol), jnp.asarray(dims)
                         )
                         mask = np.asarray(maskj)
@@ -410,13 +418,21 @@ def run(args: argparse.Namespace) -> int:
                         # single program computes mask + renders in one jit;
                         # this branch never runs under z-shard (zshard takes
                         # precedence), so materializing here cannot desync
-                        maskj, grayj, segj = _compiled_volume_fn(cfg)(
+                        maskj, grayj, segj, conv = _compiled_volume_fn(cfg)(
                             jnp.asarray(vol), jnp.asarray(dims)
                         )
                         mask = np.asarray(maskj)
                         if not host_render and i_export:
                             gray = np.asarray(grayj)
                             seg = np.asarray(segj)
+                if conv is not None and not bool(np.asarray(conv)):
+                    truncated_patients.append(pid)
+                    print(
+                        f"WARNING: patient {pid}: region growing hit its "
+                        "iteration cap; the 3D mask under-covers "
+                        "(raise --grow-max-iters)",
+                        file=sys.stderr,
+                    )
                 if not i_export:
                     # global z-shard, rank != 0: compute was cooperative but
                     # rank 0 owns the export/manifest. Learn its outcome
@@ -505,6 +521,7 @@ def run(args: argparse.Namespace) -> int:
                     "slices": depth,
                     "exported": len(done),
                     "mask_voxels": int(mask.sum()),
+                    "grow_truncated": pid in truncated_patients,
                 }
                 print(f"Patient {pid}: {depth} slices, mask {int(mask.sum())} voxels")
             except Exception as e:  # noqa: BLE001 - per-patient containment
@@ -527,6 +544,7 @@ def run(args: argparse.Namespace) -> int:
 
         record = {
             "mode": "volume",
+            "grow_truncated_patients": truncated_patients,
             "backend": jax.devices()[0].platform,  # provenance
             "z_sharded": bool(zshard),
             "z_global": bool(global_zshard),

@@ -31,6 +31,7 @@ def _grow_kernel(
     band_ref,
     seed_ref,
     out_ref,
+    conv_ref,
     scr,
     *,
     h: int,
@@ -80,8 +81,13 @@ def _grow_kernel(
     # iterate until the popcount stops changing
     c0 = jnp.sum(scr[1 : h + 1, 1 : w + 1])
     c1 = run_block(0)
-    jax.lax.while_loop(cond, body, (c0, c1, jnp.int32(block_iters)))
+    prev, cur, _ = jax.lax.while_loop(
+        cond, body, (c0, c1, jnp.int32(block_iters))
+    )
     out_ref[0] = scr[1 : h + 1, 1 : w + 1]
+    # popcount stable at exit == converged; cap-hit mid-growth otherwise
+    # (same definition as region_growing.region_grow, VERDICT r4 item 4)
+    conv_ref[0] = (cur == prev).astype(jnp.int32)
 
 
 @functools.partial(
@@ -95,7 +101,7 @@ def _grow_pallas_batched(
     block_iters: int,
     max_iters: int,
     interpret: bool,
-) -> jax.Array:
+) -> tuple[jax.Array, jax.Array]:
     b, h, w = band.shape
     kernel = functools.partial(
         _grow_kernel,
@@ -106,12 +112,16 @@ def _grow_pallas_batched(
         max_iters=max_iters,
     )
     spec = pl.BlockSpec((1, h, w), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)
+    conv_spec = pl.BlockSpec((1,), lambda i: (i,), memory_space=pltpu.SMEM)
     return pl.pallas_call(
         kernel,
         grid=(b,),
         in_specs=[spec, spec],
-        out_specs=spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, w), jnp.float32),
+        out_specs=(spec, conv_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h, w), jnp.float32),
+            jax.ShapeDtypeStruct((b,), jnp.int32),
+        ),
         scratch_shapes=[pltpu.VMEM((h + 2, w + 2), jnp.float32)],
         interpret=interpret,
     )(band, seeds)
@@ -127,8 +137,11 @@ def region_grow_pallas(
     block_iters: int = 16,
     max_iters: int = 1024,
     interpret: bool = False,
-) -> jax.Array:
-    """Drop-in Pallas variant of :func:`.region_growing.region_grow`."""
+) -> tuple[jax.Array, jax.Array]:
+    """Drop-in Pallas variant of :func:`.region_growing.region_grow`.
+
+    Returns ``(mask, converged)`` with the same convergence definition.
+    """
     if connectivity not in (4, 8):
         raise ValueError(f"connectivity must be 4 or 8, got {connectivity}")
     h, w = image.shape[-2:]
@@ -163,10 +176,12 @@ def region_grow_pallas(
     seedb = (
         seeds.astype(bool).reshape((-1,) + seeds.shape[-2:]).astype(jnp.float32)
     )
-    out = _grow_pallas_batched(
+    out, conv = _grow_pallas_batched(
         bandb, seedb, connectivity, block_iters, max_iters, interpret
     )
-    return out.reshape(orig_shape).astype(jnp.uint8)
+    # scalar converged over the whole call, matching the XLA path's global
+    # popcount loop (per-slice granularity comes from vmapping the caller)
+    return out.reshape(orig_shape).astype(jnp.uint8), jnp.all(conv == 1)
 
 
 def grow_dispatch(
@@ -201,8 +216,12 @@ def grow_dispatch(
     if algorithm == "jump":
         from nm03_capstone_project_tpu.ops.region_growing import region_grow_jump
 
+        # the caller's iteration budget caps this schedule too (as rounds —
+        # O(log) convergence means it effectively never binds, but
+        # --grow-max-iters must not be a silent no-op on the jump path)
         return region_grow_jump(
-            image, seeds, low, high, valid=valid, connectivity=connectivity
+            image, seeds, low, high, valid=valid, connectivity=connectivity,
+            max_rounds=max_iters,
         )
     from nm03_capstone_project_tpu.ops.region_growing import region_grow
 

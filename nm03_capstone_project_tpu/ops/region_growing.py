@@ -43,8 +43,15 @@ def region_grow(
     connectivity: int = 4,
     block_iters: int = 16,
     max_iters: int = 1024,
-) -> jax.Array:
-    """Flood-fill segmentation; returns a uint8 {0,1} mask shaped like image.
+) -> tuple[jax.Array, jax.Array]:
+    """Flood-fill segmentation; returns ``(mask, converged)``.
+
+    ``mask`` is a uint8 {0,1} array shaped like ``image``; ``converged`` is
+    a scalar bool — False means the iteration cap truncated a still-growing
+    region and the mask under-covers the true connected set. FAST's BFS
+    always completes (main_sequential.cpp:232-243), so a capped mask is a
+    divergence the caller must be able to see: drivers count and log it per
+    patient like any other per-slice failure (VERDICT r4 item 4).
 
     Args:
       image: (..., H, W) float intensities.
@@ -79,10 +86,13 @@ def region_grow(
 
     # Run at least one block, then iterate until the popcount stops changing.
     # (popcount equality == set equality here because the region only grows.)
-    region, _, _ = jax.lax.while_loop(
+    region, prev_count, _ = jax.lax.while_loop(
         cond, body, (grow_block(region0), region0.sum(), jnp.int32(block_iters))
     )
-    return region.astype(jnp.uint8)
+    # the loop exits either because the popcount went stable (converged) or
+    # because the cap hit mid-growth; the state distinguishes the two
+    converged = region.sum() == prev_count
+    return region.astype(jnp.uint8), converged
 
 
 def _neighbor_min(labels: jax.Array, band: jax.Array, sentinel, connectivity: int):
@@ -114,8 +124,12 @@ def region_grow_jump(
     connectivity: int = 4,
     max_rounds: int = 256,
     jumps_per_round: int = 2,
-) -> jax.Array:
+) -> tuple[jax.Array, jax.Array]:
     """Flood fill in O(log diameter) rounds via pointer-jumping label merge.
+
+    Returns ``(mask, converged)`` like :func:`region_grow`; ``converged`` is
+    False only when ``max_rounds`` cut the label fixpoint short (with the
+    default 256 on O(log diameter) rounds, effectively never).
 
     Same set semantics as :func:`region_grow` — pixels of the intensity band
     4/8-connected to a seed — so the outputs are bit-identical; only the
@@ -169,9 +183,10 @@ def region_grow_jump(
         _, cur, it = state
         return cur, round_(cur), it + 1
 
-    _, labels, _ = jax.lax.while_loop(
+    prev, labels, _ = jax.lax.while_loop(
         cond, body, (labels0, round_(labels0), jnp.int32(1))
     )
+    converged = jnp.all(prev == labels)
 
     # components whose min-id a seed carries are the grown region
     seed_labels = jnp.where(seeds.astype(bool) & band, labels, sentinel)
@@ -183,4 +198,4 @@ def region_grow_jump(
         .set(False)
     )
     region = band & marked[labels]
-    return region.astype(jnp.uint8)
+    return region.astype(jnp.uint8), converged

@@ -114,8 +114,13 @@ def region_grow_3d(
     connectivity: int = 6,
     block_iters: int = 16,
     max_iters: int = 4096,
-) -> jax.Array:
-    """3D seeded region growing; returns a uint8 {0,1} mask shaped like volume.
+) -> tuple[jax.Array, jax.Array]:
+    """3D seeded region growing; returns ``(mask, converged)``.
+
+    ``mask`` is a uint8 {0,1} array shaped like ``volume``; ``converged`` is
+    a scalar bool, False when ``max_iters`` truncated a still-growing region
+    (VERDICT r4 item 4 — FAST's BFS always completes, so truncation must be
+    visible to callers).
 
     The volumetric extension of the reference's SeededRegionGrowing
     (src/sequential/main_sequential.cpp:232-243): the flood fill is a fixpoint
@@ -154,10 +159,11 @@ def region_grow_3d(
         count = region.sum()
         return grow_block(region), count, iters + block_iters
 
-    region, _, _ = jax.lax.while_loop(
+    region, prev_count, _ = jax.lax.while_loop(
         cond, body, (grow_block(region0), region0.sum(), jnp.int32(block_iters))
     )
-    return region.astype(jnp.uint8)
+    converged = region.sum() == prev_count
+    return region.astype(jnp.uint8), converged
 
 
 def _shift3d(a: jax.Array, off, fill) -> jax.Array:
@@ -184,8 +190,10 @@ def region_grow_jump_3d(
     connectivity: int = 6,
     max_rounds: int = 256,
     jumps_per_round: int = 2,
-) -> jax.Array:
+) -> tuple[jax.Array, jax.Array]:
     """3D flood fill in O(log diameter) rounds via pointer-jumping label merge.
+
+    Returns ``(mask, converged)`` like :func:`region_grow_3d`.
 
     Volumetric twin of :func:`ops.region_growing.region_grow_jump` — same set
     semantics as :func:`region_grow_3d` (identical masks whenever the dilate
@@ -246,9 +254,10 @@ def region_grow_jump_3d(
         _, cur, it = state
         return cur, round_(cur), it + 1
 
-    _, labels, _ = jax.lax.while_loop(
+    prev, labels, _ = jax.lax.while_loop(
         cond, body, (labels0, round_(labels0), jnp.int32(1))
     )
+    converged = jnp.all(prev == labels)
 
     seed_labels = jnp.where(seeds.astype(bool) & band, labels, sentinel)
     marked = (
@@ -259,4 +268,4 @@ def region_grow_jump_3d(
         .set(False)
     )
     region = band & marked[labels]
-    return region.astype(jnp.uint8)
+    return region.astype(jnp.uint8), converged

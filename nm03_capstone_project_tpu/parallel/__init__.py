@@ -24,5 +24,6 @@ from nm03_capstone_project_tpu.parallel.mesh import (  # noqa: F401
     replicated,
 )
 from nm03_capstone_project_tpu.parallel.zshard import (  # noqa: F401
+    process_volume_batch_zsharded,
     process_volume_zsharded,
 )

@@ -59,8 +59,12 @@ def preprocess(
 
 def segment(
     preprocessed: jax.Array, dims: jax.Array, cfg: PipelineConfig = DEFAULT_CONFIG
-) -> jax.Array:
-    """Seeded region growing with the adaptive seed grid; uint8 {0,1} mask."""
+) -> tuple[jax.Array, jax.Array]:
+    """Seeded region growing with the adaptive seed grid.
+
+    Returns ``(mask, converged)``: the uint8 {0,1} mask and a scalar bool
+    that is False when the growing fixpoint hit its iteration cap (an
+    under-covering mask — see ops.region_growing; VERDICT r4 item 4)."""
     canvas_hw = preprocessed.shape[-2:]
     seeds = seed_mask(dims, canvas_hw)
     valid = valid_mask(dims, canvas_hw)
@@ -82,18 +86,20 @@ def process_slice(
 ) -> Dict[str, jax.Array]:
     """Full batch-driver pipeline for one slice (or a batch via vmap).
 
-    Returns {'original', 'mask'}: the untouched input pixels and the final
-    uint8 mask after dilation — the two images the batch drivers export per
-    slice (main_sequential.cpp:254-265).
+    Returns {'original', 'mask', 'grow_converged'}: the untouched input
+    pixels, the final uint8 mask after dilation — the two images the batch
+    drivers export per slice (main_sequential.cpp:254-265) — and the
+    scalar bool from :func:`segment` (False = the growing cap truncated
+    this slice's mask; drivers count and log it per patient).
     """
     pre = preprocess(pixels, dims, cfg)
-    seg = segment(pre, dims, cfg)
+    seg, converged = segment(pre, dims, cfg)
     mask = dilate(cast_uint8(seg), cfg.morph_size)
     # dilation must not spill into the canvas padding — the reference's
     # Dilation runs on the exact-size image and can never write there
     valid = valid_mask(dims, pixels.shape[-2:])
     mask = mask * valid.astype(mask.dtype)
-    return {"original": pixels, "mask": mask}
+    return {"original": pixels, "mask": mask, "grow_converged": converged}
 
 
 def process_slice_stages(
@@ -106,7 +112,7 @@ def process_slice_stages(
     export names of the reference's test driver (test_pipeline.cpp:167-177).
     """
     pre = preprocess(pixels, dims, cfg)
-    seg = segment(pre, dims, cfg)
+    seg, converged = segment(pre, dims, cfg)
     cast = cast_uint8(seg)
     valid = valid_mask(dims, pixels.shape[-2:])
     dilated = dilate(cast, cfg.morph_size) * valid.astype(jnp.uint8)
@@ -116,6 +122,7 @@ def process_slice_stages(
         "segmentation": cast,
         "erosion_result": erode(cast, cfg.morph_size),
         "final_dilated_result": dilated,
+        "grow_converged": converged,
     }
 
 

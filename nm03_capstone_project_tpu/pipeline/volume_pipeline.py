@@ -44,8 +44,11 @@ def process_volume(
       cfg: pipeline hyper-parameters (the reference's 2D contract values
         apply unchanged to each slice's preprocessing).
 
-    Returns {'original', 'mask'}: input volume and the final uint8 3D mask
-    after 6-connected dilation.
+    Returns {'original', 'mask', 'grow_converged'}: input volume, the final
+    uint8 3D mask after 6-connected dilation, and a scalar bool that is
+    False when the growing fixpoint hit its iteration cap (a truncated,
+    under-covering mask — FAST's BFS always completes, so drivers surface
+    this per patient; VERDICT r4 item 4).
     """
     # Per-slice 2D preprocessing — identical math to the batch drivers
     # (main_sequential.cpp:194-208), vmapped over the stack.
@@ -62,11 +65,12 @@ def process_volume(
     valid = jnp.broadcast_to(valid2d, (d,) + valid2d.shape)
 
     if cfg.grow_algorithm == "jump":
-        seg = region_grow_jump_3d(
-            pre, seeds, cfg.grow_low, cfg.grow_high, valid=valid
+        seg, converged = region_grow_jump_3d(
+            pre, seeds, cfg.grow_low, cfg.grow_high, valid=valid,
+            max_rounds=cfg.grow_max_iters,
         )
     else:
-        seg = region_grow_3d(
+        seg, converged = region_grow_3d(
             pre,
             seeds,
             cfg.grow_low,
@@ -77,4 +81,4 @@ def process_volume(
         )
     mask = dilate3d(cast_uint8(seg), cfg.morph_size)
     mask = mask * valid.astype(mask.dtype)
-    return {"original": volume, "mask": mask}
+    return {"original": volume, "mask": mask, "grow_converged": converged}

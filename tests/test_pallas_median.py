@@ -152,8 +152,8 @@ class TestDispatch:
         seeds = jnp.zeros((16, 16), jnp.uint8).at[8, 8].set(1)
         got_m = pr.grow_dispatch(
             x, seeds, 0.0, 1.0, block_iters=8, max_iters=32, use_pallas=True
-        )
-        want_m = region_grow(x, seeds, 0.0, 1.0, block_iters=8, max_iters=32)
+        )[0]
+        want_m = region_grow(x, seeds, 0.0, 1.0, block_iters=8, max_iters=32)[0]
         np.testing.assert_array_equal(np.asarray(got_m), np.asarray(want_m))
 
     def test_tpu_backend_takes_pallas_path(self, monkeypatch):

@@ -47,6 +47,7 @@ def test_stages_variant_contract(small_phantom):
         "segmentation",
         "erosion_result",
         "final_dilated_result",
+        "grow_converged",
     }
     seg = np.asarray(out["segmentation"])
     ero = np.asarray(out["erosion_result"])

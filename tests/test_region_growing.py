@@ -22,7 +22,7 @@ def test_region_grow_simple_blob():
     img[25:30, 25:30] = 0.8  # in band but disconnected from seed
     seeds = np.zeros((32, 32), bool)
     seeds[10, 10] = True
-    out = np.asarray(region_grow(img, seeds, 0.74, 0.91))
+    out = np.asarray(region_grow(img, seeds, 0.74, 0.91)[0])
     expected = oracle_region_grow(img, seeds, 0.74, 0.91)
     np.testing.assert_array_equal(out, expected)
     assert out[26, 26] == 0  # disconnected blob excluded
@@ -38,7 +38,7 @@ def test_region_grow_matches_oracle_random(rng):
         seeds[24, 24] = True
         seeds[10, 35] = True
         lo, hi = 0.45, 0.6
-        out = np.asarray(region_grow(img, seeds, lo, hi, block_iters=8))
+        out = np.asarray(region_grow(img, seeds, lo, hi, block_iters=8)[0])
         expected = oracle_region_grow(img, seeds, lo, hi)
         np.testing.assert_array_equal(out, expected, err_msg=f"trial {trial}")
 
@@ -47,7 +47,7 @@ def test_region_grow_seed_outside_band_is_dead():
     img = np.full((16, 16), 0.5, np.float32)
     seeds = np.zeros((16, 16), bool)
     seeds[8, 8] = True
-    out = np.asarray(region_grow(img, seeds, 0.74, 0.91))
+    out = np.asarray(region_grow(img, seeds, 0.74, 0.91)[0])
     assert out.sum() == 0
 
 
@@ -57,7 +57,7 @@ def test_region_grow_respects_valid_mask():
     seeds[4, 4] = True
     valid = np.zeros((16, 16), bool)
     valid[:8, :8] = True
-    out = np.asarray(region_grow(img, seeds, 0.74, 0.91, valid=valid))
+    out = np.asarray(region_grow(img, seeds, 0.74, 0.91, valid=valid)[0])
     assert out[:8, :8].all()
     assert out[8:, :].sum() == 0 and out[:, 8:].sum() == 0
 
@@ -73,7 +73,7 @@ def test_region_grow_snake_path():
             img[r, 1:] = 0.8
     seeds = np.zeros((24, 24), bool)
     seeds[0, 0] = True
-    out = np.asarray(region_grow(img, seeds, 0.74, 0.91, block_iters=4))
+    out = np.asarray(region_grow(img, seeds, 0.74, 0.91, block_iters=4)[0])
     expected = oracle_region_grow(img, seeds, 0.74, 0.91)
     np.testing.assert_array_equal(out, expected)
     assert out.sum() == (img > 0).sum()  # whole snake reached
@@ -85,11 +85,11 @@ def test_region_grow_vmap_matches_sequential(rng):
     )
     seeds = np.zeros((4, 32, 32), bool)
     seeds[:, 16, 16] = True
-    f = jax.vmap(lambda i, s: region_grow(i, s, 0.45, 0.6, block_iters=8))
+    f = jax.vmap(lambda i, s: region_grow(i, s, 0.45, 0.6, block_iters=8)[0])
     out = np.asarray(f(imgs, seeds))
     for i in range(4):
         np.testing.assert_array_equal(
-            out[i], np.asarray(region_grow(imgs[i], seeds[i], 0.45, 0.6, block_iters=8))
+            out[i], np.asarray(region_grow(imgs[i], seeds[i], 0.45, 0.6, block_iters=8)[0])
         )
 
 
@@ -98,8 +98,8 @@ def test_region_grow_8_connectivity():
     img[0, 0] = img[1, 1] = img[2, 2] = 0.8  # diagonal chain
     seeds = np.zeros((8, 8), bool)
     seeds[0, 0] = True
-    out4 = np.asarray(region_grow(img, seeds, 0.74, 0.91, connectivity=4))
-    out8 = np.asarray(region_grow(img, seeds, 0.74, 0.91, connectivity=8))
+    out4 = np.asarray(region_grow(img, seeds, 0.74, 0.91, connectivity=4)[0])
+    out8 = np.asarray(region_grow(img, seeds, 0.74, 0.91, connectivity=8)[0])
     assert out4.sum() == 1
     assert out8.sum() == 3
 
@@ -116,7 +116,7 @@ class TestJumpAlgorithm:
             seeds = np.zeros((48, 48), bool)
             seeds[24, 24] = True
             seeds[10, 35] = True
-            out = np.asarray(region_grow_jump(img, seeds, 0.45, 0.6))
+            out = np.asarray(region_grow_jump(img, seeds, 0.45, 0.6)[0])
             expected = oracle_region_grow(img, seeds, 0.45, 0.6)
             np.testing.assert_array_equal(out, expected, err_msg=f"trial {trial}")
 
@@ -132,7 +132,7 @@ class TestJumpAlgorithm:
                 img[i, 1:] = 0.8
         seeds = np.zeros((24, 24), bool)
         seeds[0, 0] = True
-        out = np.asarray(region_grow_jump(img, seeds, 0.74, 0.91))
+        out = np.asarray(region_grow_jump(img, seeds, 0.74, 0.91)[0])
         np.testing.assert_array_equal(out, oracle_region_grow(img, seeds, 0.74, 0.91))
         assert out.sum() == (img > 0).sum()
 
@@ -146,10 +146,10 @@ class TestJumpAlgorithm:
             seeds = np.zeros((40, 40), bool)
             seeds[20, 20] = seeds[5, 30] = seeds[33, 7] = True
             a = np.asarray(
-                region_grow(img, seeds, 0.45, 0.6, connectivity=connectivity)
+                region_grow(img, seeds, 0.45, 0.6, connectivity=connectivity)[0]
             )
             b = np.asarray(
-                region_grow_jump(img, seeds, 0.45, 0.6, connectivity=connectivity)
+                region_grow_jump(img, seeds, 0.45, 0.6, connectivity=connectivity)[0]
             )
             np.testing.assert_array_equal(a, b, err_msg=f"trial {trial}")
 
@@ -159,10 +159,10 @@ class TestJumpAlgorithm:
         seeds[4, 4] = True
         valid = np.zeros((16, 16), bool)
         valid[:8, :8] = True
-        out = np.asarray(region_grow_jump(img, seeds, 0.74, 0.91, valid=valid))
+        out = np.asarray(region_grow_jump(img, seeds, 0.74, 0.91, valid=valid)[0])
         assert out[:8, :8].all() and out[8:, :].sum() == 0 and out[:, 8:].sum() == 0
         dead = np.asarray(
-            region_grow_jump(np.full((16, 16), 0.5, np.float32), seeds, 0.74, 0.91)
+            region_grow_jump(np.full((16, 16), 0.5, np.float32), seeds, 0.74, 0.91)[0]
         )
         assert dead.sum() == 0
 
@@ -173,11 +173,11 @@ class TestJumpAlgorithm:
         ).astype(np.float32)
         seeds = np.zeros((4, 32, 32), bool)
         seeds[:, 16, 16] = True
-        f = jax.vmap(lambda i, s: region_grow_jump(i, s, 0.45, 0.6))
+        f = jax.vmap(lambda i, s: region_grow_jump(i, s, 0.45, 0.6)[0])
         out = np.asarray(f(imgs, seeds))
         for i in range(4):
             np.testing.assert_array_equal(
-                out[i], np.asarray(region_grow_jump(imgs[i], seeds[i], 0.45, 0.6))
+                out[i], np.asarray(region_grow_jump(imgs[i], seeds[i], 0.45, 0.6)[0])
             )
 
     def test_rejects_batched_input(self):
@@ -210,3 +210,77 @@ class TestJumpAlgorithm:
         b = process_slice(x, dims, cfg_jump)
         np.testing.assert_array_equal(np.asarray(a["mask"]), np.asarray(b["mask"]))
         assert np.asarray(a["mask"]).sum() > 0
+
+
+class TestConvergedFlag:
+    """VERDICT r4 item 4: a capped (truncated, under-covering) mask must be
+    DETECTED — FAST's BFS always completes (main_sequential.cpp:232-243), so
+    cap-truncation is a divergence the flag has to surface on every path."""
+
+    def _capped_setup(self):
+        # single corner seed in a uniform in-band image: full coverage needs
+        # ~2*N growth steps, so a tiny cap is guaranteed to truncate
+        img = np.full((64, 64), 0.8, np.float32)
+        seeds = np.zeros((64, 64), bool)
+        seeds[0, 0] = True
+        return img, seeds
+
+    def test_capped_regime_detected(self):
+        img, seeds = self._capped_setup()
+        mask, conv = region_grow(img, seeds, 0.74, 0.91, block_iters=4, max_iters=8)
+        assert not bool(conv)
+        assert 0 < np.asarray(mask).sum() < 64 * 64  # truncated, not empty
+
+    def test_full_run_converges(self):
+        img, seeds = self._capped_setup()
+        mask, conv = region_grow(img, seeds, 0.74, 0.91, block_iters=16, max_iters=512)
+        assert bool(conv)
+        assert np.asarray(mask).sum() == 64 * 64
+
+    def test_empty_region_converges(self):
+        # no seed in band: popcount 0 is stable from the first check
+        img = np.full((32, 32), 0.1, np.float32)
+        seeds = np.zeros((32, 32), bool)
+        seeds[5, 5] = True
+        mask, conv = region_grow(img, seeds, 0.74, 0.91)
+        assert bool(conv) and np.asarray(mask).sum() == 0
+
+    def test_jump_schedule_converges_where_dilate_caps(self):
+        # the O(log) schedule finishes the same image inside its default cap
+        img, seeds = self._capped_setup()
+        mask, conv = region_grow_jump(img, seeds, 0.74, 0.91)
+        assert bool(conv)
+        assert np.asarray(mask).sum() == 64 * 64
+
+    def test_vmap_flag_is_per_slice(self):
+        # lane 0 caps, lane 1 converges (empty) — the batched flag must
+        # distinguish them, not reduce over the batch
+        import jax
+
+        img, seeds = self._capped_setup()
+        imgs = np.stack([img, np.full((64, 64), 0.1, np.float32)])
+        seedss = np.stack([seeds, seeds])
+        f = jax.vmap(
+            lambda i, s: region_grow(i, s, 0.74, 0.91, block_iters=4, max_iters=8)
+        )
+        _, conv = f(imgs, seedss)
+        conv = np.asarray(conv)
+        assert not conv[0] and conv[1]
+
+    def test_pipeline_surfaces_flag(self):
+        # the capped single-seed regime reaches process_slice's output dict
+        from nm03_capstone_project_tpu.config import PipelineConfig
+        from nm03_capstone_project_tpu.data.synthetic import phantom_slice
+        from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
+
+        x = np.zeros((128, 128), np.float32)
+        x[:] = phantom_slice(128, 128, seed=3)
+        dims = np.asarray([128, 128], np.int32)
+        ok = process_slice(x, dims, PipelineConfig(canvas=128))
+        assert bool(np.asarray(ok["grow_converged"]))
+        capped = process_slice(
+            x, dims,
+            PipelineConfig(canvas=128, grow_block_iters=1, grow_max_iters=2),
+        )
+        # the phantom lesion needs more than 2 one-ring steps
+        assert not bool(np.asarray(capped["grow_converged"]))

@@ -89,7 +89,7 @@ class TestRegionGrow3D:
                 0.9,
                 connectivity=connectivity,
                 block_iters=4,
-            )
+            )[0]
         )
         want = _oracle_region_grow(vol, seeds, 0.4, 0.9, connectivity)
         np.testing.assert_array_equal(got, want)
@@ -103,7 +103,7 @@ class TestRegionGrow3D:
         seeds = np.zeros_like(vol, dtype=bool)
         seeds[0, 2, 2] = True
         got = np.asarray(
-            region_grow_3d(jnp.asarray(vol), jnp.asarray(seeds), 0.4, 0.6)
+            region_grow_3d(jnp.asarray(vol), jnp.asarray(seeds), 0.4, 0.6)[0]
         )
         assert got[1, 3, 3] == 1  # reached through z
         assert got[2, 6, 6] == 0  # disconnected blob untouched
@@ -118,7 +118,7 @@ class TestRegionGrow3D:
             region_grow_3d(
                 jnp.asarray(vol), jnp.asarray(seeds), 0.4, 0.6,
                 valid=jnp.asarray(valid),
-            )
+            )[0]
         )
         assert got[:, :3, :3].sum() == 18
         assert got[:, 3:, :].sum() == 0 and got[:, :, 3:].sum() == 0
@@ -139,7 +139,7 @@ class TestRegionGrowJump3D:
             region_grow_jump_3d(
                 jnp.asarray(vol), jnp.asarray(seeds), 0.4, 0.9,
                 connectivity=connectivity,
-            )
+            )[0]
         )
         np.testing.assert_array_equal(
             got, _oracle_region_grow(vol, seeds, 0.4, 0.9, connectivity)
@@ -164,7 +164,7 @@ class TestRegionGrowJump3D:
         from nm03_capstone_project_tpu.ops import region_grow_jump_3d
 
         got = np.asarray(
-            region_grow_jump_3d(jnp.asarray(vol), jnp.asarray(seeds), 0.4, 0.6)
+            region_grow_jump_3d(jnp.asarray(vol), jnp.asarray(seeds), 0.4, 0.6)[0]
         )
         np.testing.assert_array_equal(got, _oracle_region_grow(vol, seeds, 0.4, 0.6, 6))
 
@@ -228,3 +228,52 @@ class TestVolumePipeline:
         mask = np.asarray(out["mask"])
         assert mask[:, 64:, :].sum() == 0
         assert mask[:, :, 64:].sum() == 0
+
+
+class TestConvergedFlag3D:
+    """VERDICT r4 item 4, 3D paths: cap-truncation must be detected."""
+
+    def test_capped_detected_and_full_converges(self):
+        import jax.numpy as jnp
+
+        from nm03_capstone_project_tpu.ops import region_grow_3d, region_grow_jump_3d
+
+        vol = np.full((8, 24, 24), 0.8, np.float32)
+        seeds = np.zeros((8, 24, 24), bool)
+        seeds[0, 0, 0] = True
+        mask, conv = region_grow_3d(
+            jnp.asarray(vol), jnp.asarray(seeds), 0.74, 0.91,
+            block_iters=2, max_iters=4,
+        )
+        assert not bool(conv)
+        assert 0 < np.asarray(mask).sum() < vol.size
+        mask2, conv2 = region_grow_3d(
+            jnp.asarray(vol), jnp.asarray(seeds), 0.74, 0.91,
+            block_iters=16, max_iters=512,
+        )
+        assert bool(conv2) and np.asarray(mask2).sum() == vol.size
+        mask3, conv3 = region_grow_jump_3d(
+            jnp.asarray(vol), jnp.asarray(seeds), 0.74, 0.91
+        )
+        assert bool(conv3) and np.asarray(mask3).sum() == vol.size
+
+    def test_process_volume_surfaces_flag(self):
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from nm03_capstone_project_tpu.config import PipelineConfig
+        from nm03_capstone_project_tpu.pipeline.volume_pipeline import process_volume
+        from nm03_capstone_project_tpu.data.synthetic import phantom_series
+
+        cfg = PipelineConfig(canvas=64)
+        series = phantom_series(4, 64, 64, seed=9)
+        vol = np.stack(series).astype(np.float32)
+        dims = jnp.asarray([64, 64], np.int32)
+        out = process_volume(jnp.asarray(vol), dims, cfg)
+        assert bool(np.asarray(out["grow_converged"]))
+        capped_cfg = dataclasses.replace(
+            cfg, grow_block_iters=1, grow_max_iters=2
+        )
+        out2 = process_volume(jnp.asarray(vol), dims, capped_cfg)
+        assert not bool(np.asarray(out2["grow_converged"]))
