@@ -47,6 +47,7 @@ from nm03_capstone_project_tpu.render.export import (
 from nm03_capstone_project_tpu.utils.manifest import (
     STATUS_DONE,
     STATUS_FAILED,
+    STATUS_TRUNCATED,
     Manifest,
 )
 from nm03_capstone_project_tpu.utils.reporter import get_logger
@@ -431,10 +432,14 @@ class CohortProcessor:
                 if stem not in written:
                     raise IOError("JPEG export failed")
                 # after the export check: truncated means "the pair exists
-                # but the mask under-covers" — a failed slice is only failed
+                # but the mask under-covers" — a failed slice is only
+                # failed. Truncated gets its own manifest status so a
+                # --resume rerun with a raised cap recomputes it.
                 if not bool(np.all(np.asarray(conv))):
                     truncated.append(stem)
-                self.manifest.record(patient_id, stem, STATUS_DONE)
+                    self.manifest.record(patient_id, stem, STATUS_TRUNCATED)
+                else:
+                    self.manifest.record(patient_id, stem, STATUS_DONE)
                 ok += 1
             except Exception as e:  # noqa: BLE001 - reference: don't throw here
                 log.warning("error processing file %s: %s", f.name, e)
@@ -660,10 +665,12 @@ class CohortProcessor:
         truncated: List[str] = []
         for s in expected_stems:
             if s in written:
-                self.manifest.record(patient_id, s, STATUS_DONE)
                 ok += 1
                 if not conv_by_stem.get(s, True):
                     truncated.append(s)
+                    self.manifest.record(patient_id, s, STATUS_TRUNCATED)
+                else:
+                    self.manifest.record(patient_id, s, STATUS_DONE)
             else:
                 log.warning("export failed for slice %s", s)
                 self.manifest.record(patient_id, s, STATUS_FAILED)

@@ -75,23 +75,29 @@ def region_grow(
             return dilate(r, 3, shape) & band
         return jax.lax.fori_loop(0, block_iters, step, region)
 
+    # the state carries the CURRENT region's popcount so each convergence
+    # check costs one reduction, not two (cond used to recompute the sum
+    # the body had just evaluated — same shape as zshard's psum loop), and
+    # the converged flag falls out of the carried counts for free
     def cond(state):
-        region, prev_count, iters = state
-        return (region.sum() != prev_count) & (iters < max_iters)
+        _, prev_count, count, iters = state
+        return (count != prev_count) & (iters < max_iters)
 
     def body(state):
-        region, _, iters = state
-        count = region.sum()
-        return grow_block(region), count, iters + block_iters
+        region, _, count, iters = state
+        new_region = grow_block(region)
+        return new_region, count, new_region.sum(), iters + block_iters
 
     # Run at least one block, then iterate until the popcount stops changing.
     # (popcount equality == set equality here because the region only grows.)
-    region, prev_count, _ = jax.lax.while_loop(
-        cond, body, (grow_block(region0), region0.sum(), jnp.int32(block_iters))
+    region1 = grow_block(region0)
+    region, prev_count, count, _ = jax.lax.while_loop(
+        cond, body,
+        (region1, region0.sum(), region1.sum(), jnp.int32(block_iters)),
     )
     # the loop exits either because the popcount went stable (converged) or
-    # because the cap hit mid-growth; the state distinguishes the two
-    converged = region.sum() == prev_count
+    # because the cap hit mid-growth; the carried counts distinguish the two
+    converged = count == prev_count
     return region.astype(jnp.uint8), converged
 
 

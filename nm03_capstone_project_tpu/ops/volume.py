@@ -150,19 +150,23 @@ def region_grow_3d(
 
         return jax.lax.fori_loop(0, block_iters, step, region)
 
+    # carried-count state: one popcount per check, converged for free (the
+    # same loop shape as the 2D op and zshard's psum loop)
     def cond(state):
-        region, prev_count, iters = state
-        return (region.sum() != prev_count) & (iters < max_iters)
+        _, prev_count, count, iters = state
+        return (count != prev_count) & (iters < max_iters)
 
     def body(state):
-        region, _, iters = state
-        count = region.sum()
-        return grow_block(region), count, iters + block_iters
+        region, _, count, iters = state
+        new_region = grow_block(region)
+        return new_region, count, new_region.sum(), iters + block_iters
 
-    region, prev_count, _ = jax.lax.while_loop(
-        cond, body, (grow_block(region0), region0.sum(), jnp.int32(block_iters))
+    region1 = grow_block(region0)
+    region, prev_count, count, _ = jax.lax.while_loop(
+        cond, body,
+        (region1, region0.sum(), region1.sum(), jnp.int32(block_iters)),
     )
-    converged = region.sum() == prev_count
+    converged = count == prev_count
     return region.astype(jnp.uint8), converged
 
 

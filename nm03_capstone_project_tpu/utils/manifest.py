@@ -19,6 +19,10 @@ MANIFEST_NAME = "manifest.json"
 
 STATUS_DONE = "done"
 STATUS_FAILED = "failed"
+# exported pair exists, but the region-growing cap truncated the mask: NOT
+# "done" for --resume purposes, so a rerun with a raised --grow-max-iters
+# actually recomputes it (the warning's advertised remedy)
+STATUS_TRUNCATED = "truncated"
 
 
 class Manifest:

@@ -290,3 +290,12 @@ def test_truncated_masks_counted_not_failed(cohort, tmp_path, mode):
     # the flag costs nothing on the default config: nothing truncates there
     ok = CohortProcessor(cohort, tmp_path / "ok", cfg=CFG, mode=mode)
     assert ok.process_all_patients().as_dict()["slices_truncated"] == 0
+    # truncated gets its own manifest status, so the warning's remedy works:
+    # a --resume rerun with the cap raised recomputes exactly those slices
+    # and the record comes back clean
+    redo = CohortProcessor(
+        cohort, tmp_path / "t", cfg=CFG, mode=mode, resume=True
+    )
+    d2 = redo.process_all_patients().as_dict()
+    assert d2["slices_truncated"] == 0
+    assert d2["slices_ok"] == 8
