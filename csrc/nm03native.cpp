@@ -1348,6 +1348,144 @@ long jpeg_encode_gray(const uint8_t* pix, int h, int w, int quality,
   return (long)o.size();
 }
 
+// ---------------------------------------------------------------------------
+// Host-export renderer — mirrors render/host_render.py operation for
+// operation (same f32 arithmetic, same association order, numpy's
+// round-half-even via nearbyintf, truncating uint8 casts), so the C++ and
+// NumPy paths produce IDENTICAL bytes. The library builds with
+// -ffp-contract=off so the compiler cannot fuse the lerp into FMAs numpy
+// does not use. Reference contract: RenderToImage(Black, 512, 512) +
+// ImageRenderer / SegmentationRenderer({1: White}, 0.6, 1.0, 2)
+// (main_sequential.cpp:49-78).
+// ---------------------------------------------------------------------------
+
+struct LetterboxCoords {
+  std::vector<float> src_y, src_x;
+  std::vector<uint8_t> in_y, in_x;
+};
+
+LetterboxCoords letterbox_coords(int h, int w, int out_size) {
+  LetterboxCoords lc;
+  lc.src_y.resize(out_size); lc.src_x.resize(out_size);
+  lc.in_y.resize(out_size); lc.in_x.resize(out_size);
+  float fh = (float)h, fw = (float)w;
+  float scale = std::min((float)out_size / fh, (float)out_size / fw);
+  float dest_h = fh * scale, dest_w = fw * scale;
+  float off_y = ((float)out_size - dest_h) / 2.0f;
+  float off_x = ((float)out_size - dest_w) / 2.0f;
+  for (int o = 0; o < out_size; ++o) {
+    float fo = (float)o;
+    lc.src_y[o] = (fo - off_y + 0.5f) / scale - 0.5f;
+    lc.src_x[o] = (fo - off_x + 0.5f) / scale - 0.5f;
+    lc.in_y[o] = (fo >= std::floor(off_y)) && (fo < std::ceil(off_y + dest_h));
+    lc.in_x[o] = (fo >= std::floor(off_x)) && (fo < std::ceil(off_x + dest_w));
+  }
+  return lc;
+}
+
+void render_gray_impl(const float* pixels, int stride, int h, int w,
+                      const LetterboxCoords& lc, int out_size,
+                      uint8_t* out) {
+  // auto-window over the true region only
+  float vmin = pixels[0], vmax = pixels[0];
+  for (int y = 0; y < h; ++y)
+    for (int x = 0; x < w; ++x) {
+      float v = pixels[(size_t)y * stride + x];
+      vmin = std::min(vmin, v);
+      vmax = std::max(vmax, v);
+    }
+  float rng = std::max(vmax - vmin, 1e-6f);
+  // per-column sample coordinates are row-invariant: compute once
+  std::vector<int> x0s(out_size), x1s(out_size);
+  std::vector<float> fxs(out_size);
+  for (int ox = 0; ox < out_size; ++ox) {
+    float sx = lc.src_x[ox];
+    x0s[ox] = std::min(std::max((int)std::floor(sx), 0), w - 1);
+    x1s[ox] = std::min(x0s[ox] + 1, w - 1);
+    fxs[ox] = std::min(std::max(sx - (float)x0s[ox], 0.0f), 1.0f);
+  }
+  for (int oy = 0; oy < out_size; ++oy) {
+    uint8_t* orow = out + (size_t)oy * out_size;
+    if (!lc.in_y[oy]) {
+      std::memset(orow, 0, out_size);
+      continue;
+    }
+    float sy = lc.src_y[oy];
+    int y0 = std::min(std::max((int)std::floor(sy), 0), h - 1);
+    int y1 = std::min(y0 + 1, h - 1);
+    float fy = std::min(std::max(sy - (float)y0, 0.0f), 1.0f);
+    const float* r0 = pixels + (size_t)y0 * stride;
+    const float* r1 = pixels + (size_t)y1 * stride;
+    for (int ox = 0; ox < out_size; ++ox) {
+      uint8_t px = 0;
+      if (lc.in_x[ox]) {
+        int x0 = x0s[ox], x1 = x1s[ox];
+        float fx = fxs[ox];
+        // numpy: rows = img[y0]*(1-fy) + img[y1]*fy; out = rows[x0]*(1-fx)
+        //        + rows[x1]*fx — keep the exact association
+        float a = r0[x0] * (1.0f - fy) + r1[x0] * fy;
+        float b = r0[x1] * (1.0f - fy) + r1[x1] * fy;
+        float sampled = a * (1.0f - fx) + b * fx;
+        float g = (sampled - vmin) / rng * 255.0f;
+        g = std::min(std::max(g, 0.0f), 255.0f);
+        px = (uint8_t)g;  // truncation, like astype(uint8)
+      }
+      orow[ox] = px;
+    }
+  }
+}
+
+void render_seg_impl(const uint8_t* mask, int stride, int h, int w,
+                     const LetterboxCoords& lc, int out_size, float opacity,
+                     float border_opacity, int border_radius, uint8_t* out) {
+  // nearest-sampled binary mask, restricted to the letterbox interior
+  std::vector<uint8_t> m((size_t)out_size * out_size);
+  std::vector<int> yy(out_size), xx(out_size);
+  for (int o = 0; o < out_size; ++o) {
+    // numpy np.round rounds half to even: nearbyintf under the default
+    // FE_TONEAREST mode matches it exactly
+    yy[o] = std::min(std::max((int)std::nearbyintf(lc.src_y[o]), 0), h - 1);
+    xx[o] = std::min(std::max((int)std::nearbyintf(lc.src_x[o]), 0), w - 1);
+  }
+  for (int oy = 0; oy < out_size; ++oy)
+    for (int ox = 0; ox < out_size; ++ox)
+      m[(size_t)oy * out_size + ox] =
+          (mask[(size_t)yy[oy] * stride + xx[ox]] > 0) && lc.in_y[oy] &&
+          lc.in_x[ox];
+  // binary erosion, euclidean-disk element of size 2r+1, zero padding —
+  // the same offsets ops.neighborhood.footprint_offsets(size, "disk")
+  // enumerates
+  int size = 2 * border_radius + 1;
+  int r = size / 2;
+  double rad2 = (size / 2.0) * (size / 2.0);
+  std::vector<std::pair<int, int>> offs;
+  for (int dr = -r; dr <= r; ++dr)
+    for (int dc = -r; dc <= r; ++dc)
+      if ((double)(dr * dr + dc * dc) <= rad2) offs.emplace_back(dr, dc);
+  const uint8_t interior_px = (uint8_t)std::min(
+      std::max(opacity * 255.0f, 0.0f), 255.0f);
+  const uint8_t border_px = (uint8_t)std::min(
+      std::max(border_opacity * 255.0f, 0.0f), 255.0f);
+  for (int oy = 0; oy < out_size; ++oy) {
+    for (int ox = 0; ox < out_size; ++ox) {
+      uint8_t cur = m[(size_t)oy * out_size + ox];
+      if (!cur) {  // outside the mask the erosion result is irrelevant
+        out[(size_t)oy * out_size + ox] = 0;
+        continue;
+      }
+      uint8_t interior = 1;
+      for (auto& od : offs) {
+        int y = oy + od.first, x = ox + od.second;
+        uint8_t v = (y >= 0 && y < out_size && x >= 0 && x < out_size)
+                        ? m[(size_t)y * out_size + x]
+                        : 0;
+        if (!v) { interior = 0; break; }
+      }
+      out[(size_t)oy * out_size + ox] = interior ? interior_px : border_px;
+    }
+  }
+}
+
 }  // namespace
 
 // ---------------------------------------------------------------------------
@@ -1443,6 +1581,35 @@ NM03_EXPORT int nm03_load_batch(const char** paths, int n, int canvas_h,
 }
 
 // Baseline JPEG (grayscale). Returns bytes written, or -1 on error.
+// Render the export pair for one slice: letterboxed auto-windowed grayscale
+// + white-overlay segmentation render, byte-identical to the NumPy host
+// renderer (render/host_render.py). pixels is the (canvas_h, canvas_w)
+// padded f32 canvas; (h, w) the slice's true dims; both outputs are
+// (out_size, out_size) uint8. Returns 0 on success.
+NM03_EXPORT int nm03_render_pair(const float* pixels, int canvas_h,
+                                 int canvas_w, const unsigned char* mask,
+                                 int mask_h, int mask_w, int h, int w,
+                                 int out_size, float opacity,
+                                 float border_opacity, int border_radius,
+                                 unsigned char* gray_out,
+                                 unsigned char* seg_out) {
+  try {
+    if (h <= 0 || w <= 0 || h > canvas_h || w > canvas_w || h > mask_h ||
+        w > mask_w || out_size <= 0 || border_radius < 0) {
+      set_error("render: bad dimensions");
+      return 1;
+    }
+    LetterboxCoords lc = letterbox_coords(h, w, out_size);
+    render_gray_impl(pixels, canvas_w, h, w, lc, out_size, gray_out);
+    render_seg_impl(mask, mask_w, h, w, lc, out_size, opacity,
+                    border_opacity, border_radius, seg_out);
+    return 0;
+  } catch (const std::exception& e) {
+    set_error(std::string("render exception: ") + e.what());
+    return 2;
+  }
+}
+
 NM03_EXPORT long nm03_jpeg_encode_gray(const unsigned char* pixels, int h,
                                        int w, int quality, unsigned char* out,
                                        long out_capacity) {

@@ -198,6 +198,7 @@ def run(args: argparse.Namespace) -> int:
     from nm03_capstone_project_tpu.utils.manifest import (
         STATUS_DONE,
         STATUS_FAILED,
+        STATUS_TRUNCATED,
         Manifest,
     )
     from nm03_capstone_project_tpu.utils.profiling import profile_trace
@@ -483,8 +484,16 @@ def run(args: argparse.Namespace) -> int:
                                 [(stems[i], gray[i], seg[i]) for i in range(depth)],
                                 out_root / pid,
                             )
+                        # a cap-truncated volume's pairs exist but the 3D
+                        # mask under-covers: record TRUNCATED so --resume
+                        # with a raised cap recomputes this patient
+                        status = (
+                            STATUS_TRUNCATED
+                            if pid in truncated_patients
+                            else STATUS_DONE
+                        )
                         for stem in done:
-                            manifest.record(pid, stem, STATUS_DONE)
+                            manifest.record(pid, stem, status)
                         manifest.flush()
                         if args.export_mhd:
                             from nm03_capstone_project_tpu.data.imageio import (

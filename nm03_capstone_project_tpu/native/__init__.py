@@ -40,8 +40,11 @@ def _compile() -> Optional[Path]:
     """Build the shared library with g++; returns its path or None."""
     from nm03_capstone_project_tpu.native.buildlib import build_shared_library
 
+    # -ffp-contract=off: the host-export renderer mirrors NumPy's f32
+    # arithmetic operation for operation; letting the compiler contract the
+    # lerp into FMAs would break the byte-identical-render guarantee
     return build_shared_library(
-        _SRC, _BUILD_DIR, "nm03native", ["-pthread"], _log
+        _SRC, _BUILD_DIR, "nm03native", ["-pthread", "-ffp-contract=off"], _log
     )
 
 
@@ -89,6 +92,17 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_ubyte),
             ctypes.c_long,
+        ]
+        lib.nm03_render_pair.restype = ctypes.c_int
+        lib.nm03_render_pair.argtypes = [
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_ubyte),
+            ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_float, ctypes.c_float, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_ubyte),
+            ctypes.POINTER(ctypes.c_ubyte),
         ]
         _lib = lib
         _log.info("native layer loaded (%s)", path.name)
@@ -196,3 +210,39 @@ def encode_jpeg_gray(image: np.ndarray, quality: int = 90) -> bytes:
     if n < 0:
         raise ValueError(f"native JPEG encode failed: {last_error()}")
     return out[:n].tobytes()
+
+
+def render_pair_native(
+    pixels: np.ndarray, mask: np.ndarray, dims, cfg
+) -> "tuple[np.ndarray, np.ndarray]":
+    """C++ twin of render.host_render.host_render_pair — identical bytes.
+
+    ``pixels``: (canvas, canvas) float32 padded slice; ``mask``: uint8 canvas
+    mask; ``dims``: true (h, w). Returns the (gray, seg) uint8 pair at
+    ``cfg.render_size``. Raises RuntimeError when the native layer is
+    unavailable (callers fall back to the NumPy renderer).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    px = np.ascontiguousarray(pixels, np.float32)
+    mk = np.ascontiguousarray(mask, np.uint8)
+    h, w = int(dims[0]), int(dims[1])
+    out = int(cfg.render_size)
+    gray = np.empty((out, out), np.uint8)
+    seg = np.empty((out, out), np.uint8)
+    rc = lib.nm03_render_pair(
+        px.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        px.shape[0], px.shape[1],
+        mk.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        mk.shape[0], mk.shape[1],
+        h, w, out,
+        ctypes.c_float(cfg.overlay_opacity),
+        ctypes.c_float(cfg.overlay_border_opacity),
+        int(cfg.overlay_border_radius),
+        gray.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        seg.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+    )
+    if rc != 0:
+        raise ValueError(f"native render failed: {last_error()}")
+    return gray, seg

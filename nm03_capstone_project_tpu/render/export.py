@@ -26,24 +26,34 @@ _log = get_logger("export")
 def save_jpeg(image: np.ndarray, path: str | os.PathLike, quality: int = 90) -> None:
     """Write a uint8 grayscale (H, W) array as JPEG.
 
-    Prefers the native C++ encoder (csrc/nm03native.cpp — the counterpart of
-    the reference's native ImageFileExporter, main_sequential.cpp:61-73);
-    falls back to PIL when no C++ toolchain is available.
+    Encoder preference is MEASURED, not assumed: PIL rides libjpeg-turbo's
+    SIMD entropy/DCT and encodes a 512x512 render in ~2.4 ms where the
+    in-tree C++ encoder's scalar float DCT takes ~6.6 ms (docs/PERF.md,
+    1-core host) — so PIL is first choice and the C++ encoder
+    (csrc/nm03native.cpp, the counterpart of the reference's native
+    ImageFileExporter, main_sequential.cpp:61-73) is the fallback for
+    PIL-less deployments.
     """
     arr = np.asarray(image)
     if arr.dtype != np.uint8:
         raise ValueError(f"expected uint8 image, got {arr.dtype}")
     Path(path).parent.mkdir(parents=True, exist_ok=True)
 
+    try:
+        from PIL import Image
+    except ImportError:
+        Image = None
+
+    if Image is not None:
+        Image.fromarray(arr, mode="L").save(path, quality=quality)
+        return
+
     from nm03_capstone_project_tpu import native
 
     if arr.ndim == 2 and native.available():
         Path(path).write_bytes(native.encode_jpeg_gray(arr, quality))
         return
-
-    from PIL import Image
-
-    Image.fromarray(arr, mode="L").save(path, quality=quality)
+    raise RuntimeError("no JPEG encoder available (PIL missing, native failed)")
 
 
 def _write_pair(out: Path, stem: str, orig: np.ndarray, proc: np.ndarray) -> str:
@@ -96,13 +106,21 @@ def render_export_pairs(
     here, in the same thread pool that JPEG-encodes them, overlapped with the
     next batch's device compute.
     """
+    from nm03_capstone_project_tpu import native
     from nm03_capstone_project_tpu.render.host_render import host_render_pair
 
     out = Path(out_dir)
+    # the C++ renderer produces byte-identical output to the NumPy one at
+    # ~4x less host time (docs/PERF.md) — and releases the GIL, so the
+    # export pool actually overlaps on multi-core hosts
+    use_native = native.available()
 
     def write_one(item):
         stem, pixels, mask, dims = item
-        gray, seg = host_render_pair(pixels, mask, dims, cfg)
+        if use_native:
+            gray, seg = native.render_pair_native(pixels, mask, dims, cfg)
+        else:
+            gray, seg = host_render_pair(pixels, mask, dims, cfg)
         return _write_pair(out, stem, gray, seg)
 
     return _export_many(write_one, items, out, max_workers)

@@ -59,9 +59,14 @@ class Manifest:
     def patient_accounted(self, patient_id: str, stems) -> bool:
         """Every stem has SOME recorded status (done or failed) — i.e. a
         prior run fully visited this patient; permanently-bad slices must not
-        force an eternal re-run under --resume."""
+        force an eternal re-run under --resume. Truncated stems do NOT count
+        as accounted: their masks under-cover and a rerun (presumably with a
+        raised --grow-max-iters) must recompute them."""
         seen = self.data.get(patient_id, {})
-        return all(s in seen for s in stems) and bool(stems)
+        return (
+            all(s in seen and seen[s] != STATUS_TRUNCATED for s in stems)
+            and bool(stems)
+        )
 
     def flush(self) -> None:
         """Atomic write (tmp + rename) so a crash never corrupts the manifest."""

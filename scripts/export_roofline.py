@@ -1,0 +1,86 @@
+"""Host-roofline for the export leg (VERDICT r4 item 3).
+
+Measures, single-threaded on this host, the per-slice cost of every stage
+the batch drivers' export path pays after the mask returns from the device:
+render (NumPy and C++), JPEG encode (PIL/libjpeg-turbo and the in-tree C++
+encoder), and the file write — then prints the implied single-core ceiling
+in slices/s for the export leg alone. The cohort drivers overlap export
+with device compute, so end-to-end throughput approaches min(device rate,
+this ceiling) on a 1-core host.
+
+Run: env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python scripts/export_roofline.py
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def _time(fn, n=60):
+    fn()  # warm
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return (time.perf_counter() - t0) / n * 1e3
+
+
+def main() -> None:
+    from nm03_capstone_project_tpu import native
+    from nm03_capstone_project_tpu.config import PipelineConfig
+    from nm03_capstone_project_tpu.render.host_render import host_render_pair
+
+    cfg = PipelineConfig()
+    rng = np.random.default_rng(0)
+    h = w = 240  # the synthetic cohort's slice size
+    px = np.zeros((256, 256), np.float32)
+    px[:h, :w] = rng.random((h, w), np.float32) * 4000
+    mask = np.zeros((256, 256), np.uint8)
+    mask[:h, :w] = (rng.random((h, w)) > 0.85).astype(np.uint8)
+    dims = np.asarray([h, w], np.int32)
+
+    out = {}
+    out["render_numpy_ms"] = round(_time(lambda: host_render_pair(px, mask, dims, cfg)), 3)
+    if native.available():
+        out["render_native_ms"] = round(
+            _time(lambda: native.render_pair_native(px, mask, dims, cfg)), 3
+        )
+    gray, seg = host_render_pair(px, mask, dims, cfg)
+
+    from PIL import Image
+
+    def pil_encode():
+        b = io.BytesIO()
+        Image.fromarray(gray, mode="L").save(b, format="jpeg", quality=90)
+
+    out["encode_pil_ms"] = round(_time(pil_encode), 3)
+    if native.available():
+        out["encode_native_ms"] = round(
+            _time(lambda: native.encode_jpeg_gray(gray, 90)), 3
+        )
+
+    with tempfile.TemporaryDirectory() as td:
+        from nm03_capstone_project_tpu.render.export import save_jpeg
+
+        p = Path(td) / "x.jpg"
+
+        def full_write():
+            save_jpeg(gray, p)
+            save_jpeg(seg, p)
+
+        out["write_pair_ms"] = round(_time(full_write), 3)
+
+    render = out.get("render_native_ms", out["render_numpy_ms"])
+    per_slice = render + out["write_pair_ms"]
+    out["export_per_slice_ms"] = round(per_slice, 3)
+    out["export_ceiling_slices_per_s"] = round(1000.0 / per_slice, 1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -120,3 +120,68 @@ class TestDriverModes:
             proc.process_all_patients()
             outs[mode] = digest(out)
         assert outs["sequential"] == outs["parallel"]
+
+
+class TestNativeRenderPair:
+    """csrc nm03_render_pair must be BYTE-identical to the NumPy host
+    renderer — it is the same math, mirrored operation for operation (the
+    library builds with -ffp-contract=off so the compiler cannot fuse the
+    lerp into FMAs NumPy does not use)."""
+
+    def test_byte_identical_random_shapes(self):
+        native = pytest.importorskip(
+            "nm03_capstone_project_tpu.native", reason="native layer"
+        )
+        if not native.available():
+            pytest.skip("native library not buildable here")
+        from nm03_capstone_project_tpu.config import PipelineConfig
+        from nm03_capstone_project_tpu.render.host_render import host_render_pair
+
+        cfg = PipelineConfig()
+        rng = np.random.default_rng(7)
+        for _ in range(10):
+            h = int(rng.integers(90, 250))
+            w = int(rng.integers(90, 250))
+            px = np.zeros((256, 256), np.float32)
+            px[:h, :w] = rng.random((h, w), np.float32) * 4000
+            mask = np.zeros((256, 256), np.uint8)
+            mask[:h, :w] = (rng.random((h, w)) > 0.8).astype(np.uint8)
+            dims = np.asarray([h, w], np.int32)
+            g_np, s_np = host_render_pair(px, mask, dims, cfg)
+            g_nat, s_nat = native.render_pair_native(px, mask, dims, cfg)
+            np.testing.assert_array_equal(g_nat, g_np)
+            np.testing.assert_array_equal(s_nat, s_np)
+
+    def test_blank_and_full_masks(self):
+        native = pytest.importorskip(
+            "nm03_capstone_project_tpu.native", reason="native layer"
+        )
+        if not native.available():
+            pytest.skip("native library not buildable here")
+        from nm03_capstone_project_tpu.config import PipelineConfig
+        from nm03_capstone_project_tpu.render.host_render import host_render_pair
+
+        cfg = PipelineConfig()
+        px = np.zeros((128, 128), np.float32)
+        px[:100, :100] = 7.0  # constant region: windowing guard path
+        dims = np.asarray([100, 100], np.int32)
+        for mask_val in (0, 1):
+            mask = np.full((128, 128), mask_val, np.uint8)
+            g_np, s_np = host_render_pair(px, mask, dims, cfg)
+            g_nat, s_nat = native.render_pair_native(px, mask, dims, cfg)
+            np.testing.assert_array_equal(g_nat, g_np)
+            np.testing.assert_array_equal(s_nat, s_np)
+
+    def test_bad_dims_rejected(self):
+        native = pytest.importorskip(
+            "nm03_capstone_project_tpu.native", reason="native layer"
+        )
+        if not native.available():
+            pytest.skip("native library not buildable here")
+        from nm03_capstone_project_tpu.config import PipelineConfig
+
+        cfg = PipelineConfig()
+        px = np.zeros((64, 64), np.float32)
+        mask = np.zeros((64, 64), np.uint8)
+        with pytest.raises(ValueError, match="render"):
+            native.render_pair_native(px, mask, np.asarray([128, 64]), cfg)

@@ -117,3 +117,30 @@ class TestVolumeCLI:
         payload = json.loads((out / "res2.json").read_text())
         assert "PGBM-0002" in payload["patients"]  # ...but the run continued
         assert "PGBM-0001" not in payload["patients"]
+
+
+class TestVolumeTruncation:
+    def test_truncated_patient_recomputed_on_resume(self, tmp_path, capsys):
+        """A cap-truncated volume records STATUS_TRUNCATED (not DONE), so a
+        --resume rerun with the cap raised recomputes the patient and the
+        record comes back clean (VERDICT r4 item 4, volume driver)."""
+        rc, out = _run(
+            tmp_path, "--grow-block-iters", "1", "--grow-max-iters", "2"
+        )
+        assert rc == 0
+        rec = json.loads((out / "res.json").read_text())
+        assert rec["grow_truncated_patients"], "tiny cap must truncate"
+        capsys.readouterr()
+        rc = volume_cli.main(
+            [
+                "--synthetic", "2", "--synthetic-slices", "4",
+                "--output", str(out),
+                "--results-json", str(out / "res.json"),
+                "--resume",
+            ]
+        )
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "already complete, skipping" not in text
+        rec2 = json.loads((out / "res.json").read_text())
+        assert rec2["grow_truncated_patients"] == []
