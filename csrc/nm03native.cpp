@@ -885,6 +885,11 @@ bool jpegls_decode(const uint8_t* data, size_t len, long expect_rows,
   // decoder and CharLS); unread bits of the current byte are padding, and
   // fill 0xFF bytes may pad before the marker (T.81 B.1.1.2)
   size_t p = r.pos;
+  if (r.prev_ff && p < len && data[p] < 0x80) {
+    // step over the stuffed byte a final 0xFF data byte carries even when
+    // the scan consumed none of its bits (mirrors the Python decoder)
+    ++p;
+  }
   if (!r.prev_ff && (p >= len || data[p] != 0xFF)) {
     set_error("JPEG-LS stream missing EOI");
     return false;

@@ -880,6 +880,11 @@ def jpegls_decode(data: bytes, expect_shape=None) -> np.ndarray:
     # the native decoder); unread bits of the current byte are padding, and
     # fill 0xFF bytes may pad before the marker (T.81 B.1.1.2)
     p = reader.pos
+    if reader.prev_ff and p < len(data) and data[p] < 0x80:
+        # the byte stuffed after a final 0xFF data byte may carry only
+        # padding bits the scan never consumed (our encoder and CharLS
+        # both emit it); step over it before expecting the marker
+        p += 1
     if not reader.prev_ff and (p >= len(data) or data[p] != 0xFF):
         raise CodecError("JPEG-LS stream missing EOI after scan")
     while p < len(data) and data[p] == 0xFF:
@@ -887,3 +892,291 @@ def jpegls_decode(data: bytes, expect_shape=None) -> np.ndarray:
     if p >= len(data) or data[p] != _EOI:
         raise CodecError("JPEG-LS stream missing EOI after scan")
     return out.astype(np.uint16)
+
+
+class _JlsBitWriter:
+    """MSB-first bit writer with T.87 marker-byte stuffing (the encoder
+    mirror of :class:`_JlsBitReader`): after an emitted 0xFF byte the next
+    byte carries only 7 data bits, its MSB a stuffed 0."""
+
+    __slots__ = ("out", "cur", "room", "width")
+
+    def __init__(self):
+        self.out = bytearray()
+        self.cur = 0
+        self.room = 8
+        self.width = 8
+
+    def put_bit(self, b: int) -> None:
+        self.cur = (self.cur << 1) | b
+        self.room -= 1
+        if self.room == 0:
+            self.out.append(self.cur)
+            self.width = 7 if self.cur == 0xFF else 8
+            self.cur = 0
+            self.room = self.width
+
+    def put_bits(self, val: int, n: int) -> None:
+        for i in range(n - 1, -1, -1):
+            self.put_bit((val >> i) & 1)
+
+    def put_zeros(self, n: int) -> None:
+        for _ in range(n):
+            self.put_bit(0)
+
+    def flush(self) -> bytes:
+        if self.room < self.width:  # partial byte: pad with 0 bits
+            self.out.append(self.cur << self.room)
+        if self.out and self.out[-1] == 0xFF:
+            # a trailing 0xFF data byte must be followed by its stuffed
+            # byte even when it carries only padding — CharLS's decoder
+            # refuses the marker in that position (its bit reader fills
+            # ahead), and T.87's stuffing makes the 0x00 unambiguous
+            self.out.append(0x00)
+        return bytes(self.out)
+
+
+def jpegls_encode(image: np.ndarray, precision: int | None = None) -> bytes:
+    """Encode a 2D uint8/uint16 array as lossless JPEG-LS (ITU-T T.87).
+
+    The encoder mirror of :func:`jpegls_decode` — single component, NEAR=0,
+    default thresholds, no interleave/point-transform, the exact envelope
+    both in-tree readers (and CharLS) accept; used by
+    ``write_dicom(..., transfer_syntax=JPEG_LS_LOSSLESS)``. Round trips
+    bit-exactly through :func:`jpegls_decode`, the native reader and CharLS
+    (pinned in tests/test_jpegls.py).
+
+    ``precision``: sample precision P (2-16); default derives the minimum
+    from the data. DICOM callers must pass their BitsStored (PS3.5 A.4.3
+    requires the codestream precision to match it — see write_dicom).
+    """
+    img = np.asarray(image)
+    if img.ndim != 2:
+        raise ValueError(f"expected 2D image, got {img.shape}")
+    if img.dtype not in (np.uint8, np.uint16):
+        raise ValueError(f"expected uint8/uint16, got {img.dtype}")
+    rows, cols = img.shape
+    if rows == 0 or cols == 0 or rows > 32768 or cols > 32768:
+        raise ValueError(f"bad JPEG-LS dimensions ({rows}, {cols})")
+    vmax = int(img.max())
+    if precision is None:
+        precision = max(2, vmax.bit_length())
+    elif not (2 <= precision <= 16) or vmax >= (1 << precision):
+        raise ValueError(
+            f"precision {precision} invalid or too small for max {vmax}"
+        )
+    maxval = (1 << precision) - 1
+    near = 0
+
+    t1, t2, t3, reset = _jls_default_thresholds(maxval, near)
+    range_ = maxval + 1  # (maxval + 2*near) // (2*near + 1) + 1, near=0
+    qbpp = max(1, (range_ - 1).bit_length())
+    bpp = max(2, maxval.bit_length())
+    limit = 2 * (bpp + max(8, bpp))
+    half_range = (range_ + 1) >> 1
+
+    # header: SOI, SOF55, SOS (defaults need no LSE)
+    head = bytearray()
+    head += b"\xff" + bytes([_SOI])
+    head += b"\xff" + bytes([_SOF55])
+    head += struct.pack(">HBHHB", 2 + 1 + 2 + 2 + 1 + 3, precision, rows,
+                        cols, 1)
+    head += bytes([1, 0x11, 0])  # component 1, 1x1 sampling, no Tq
+    head += b"\xff" + bytes([_SOS])
+    head += struct.pack(">HB", 2 + 1 + 2 + 3, 1)
+    head += bytes([1, 0])  # component 1, no mapping table
+    head += bytes([near, 0, 0])  # NEAR, ILV=0, Al/Ah=0
+
+    # context state — identical initialization to the decoder
+    a_init = max(2, (range_ + 32) >> 6)
+    A = [a_init] * 365
+    B = [0] * 365
+    C = [0] * 365
+    N = [1] * 365
+    rA = [a_init, a_init]
+    rN = [1, 1]
+    rNn = [0, 0]
+    run_index = 0
+
+    def quantize(d):
+        if d <= -t3:
+            return -4
+        if d <= -t2:
+            return -3
+        if d <= -t1:
+            return -2
+        if d < -near:
+            return -1
+        if d <= near:
+            return 0
+        if d < t1:
+            return 1
+        if d < t2:
+            return 2
+        if d < t3:
+            return 3
+        return 4
+
+    w = _JlsBitWriter()
+
+    def encode_value(m, k, lim):
+        # inverse of the decoder's decode_value: Golomb prefix + remainder,
+        # escape to qbpp raw bits past the length limit
+        hi = m >> k
+        if hi < lim - qbpp - 1:
+            w.put_zeros(hi)
+            w.put_bit(1)
+            if k:
+                w.put_bits(m & ((1 << k) - 1), k)
+        else:
+            w.put_zeros(lim - qbpp - 1)
+            w.put_bit(1)
+            w.put_bits(m - 1, qbpp)
+
+    def encode_run_interruption(ritype, ix, ra, rb):
+        # T.87 A.7.2 (near=0)
+        if ritype:
+            err = ix - ra
+        else:
+            err = ix - rb
+            if rb < ra:
+                err = -err
+        if err < 0:
+            err += range_
+        if err >= half_range:
+            err -= range_
+        temp = rA[ritype] + ((rN[ritype] >> 1) if ritype else 0)
+        n = rN[ritype]
+        k = 0
+        while (n << k) < temp:
+            k += 1
+        # A.7.2.1 error mapping
+        if k == 0 and err > 0 and 2 * rNn[ritype] < n:
+            emap = 1
+        elif err < 0 and 2 * rNn[ritype] >= n:
+            emap = 1
+        elif err < 0 and k != 0:
+            emap = 1
+        else:
+            emap = 0
+        em = 2 * abs(err) - ritype - emap
+        encode_value(em, k, limit - _JLS_J[run_index] - 1)
+        if err < 0:
+            rNn[ritype] += 1
+        rA[ritype] += (em + 1 - ritype) >> 1
+        if rN[ritype] == reset:
+            rA[ritype] >>= 1
+            rN[ritype] >>= 1
+            rNn[ritype] >>= 1
+        rN[ritype] += 1
+
+    src = img.astype(np.int32)
+    prev = [0] * (cols + 2)
+    cur = [0] * (cols + 2)
+    for y in range(rows):
+        prev[cols + 1] = prev[cols]
+        cur[0] = prev[1]
+        line = src[y]
+        # lossless: the reconstruction IS the source; keep the same padded
+        # row structure as the decoder so the context math matches
+        cur[1 : cols + 1] = line.tolist()
+        x = 1
+        while x <= cols:
+            ra = cur[x - 1]
+            rb = prev[x]
+            rc = prev[x - 1]
+            rd = prev[x + 1]
+            q1 = quantize(rd - rb)
+            q2 = quantize(rb - rc)
+            q3 = quantize(rc - ra)
+            if q1 == 0 and q2 == 0 and q3 == 0:
+                # ---- run mode (T.87 A.7.1) ----
+                remaining = cols - x + 1
+                run_len = 0
+                while run_len < remaining and cur[x + run_len] == ra:
+                    run_len += 1
+                hit_eol = run_len == remaining
+                count = run_len  # the segment loop consumes this copy
+                while count >= (1 << _JLS_J[run_index]):
+                    w.put_bit(1)
+                    count -= 1 << _JLS_J[run_index]
+                    if run_index < 31:
+                        run_index += 1
+                if hit_eol:
+                    if count > 0:
+                        w.put_bit(1)
+                    x += run_len
+                    continue
+                w.put_bit(0)
+                j = _JLS_J[run_index]
+                if j:
+                    w.put_bits(count, j)
+                x += run_len
+                # run-interruption sample (the one that broke the run)
+                ra = cur[x - 1]
+                rb = prev[x]
+                ritype = 1 if ra == rb else 0
+                encode_run_interruption(ritype, cur[x], ra, rb)
+                x += 1
+                if run_index > 0:
+                    run_index -= 1
+                continue
+            # ---- regular mode (T.87 A.4-A.6) ----
+            qs = 81 * q1 + 9 * q2 + q3
+            if qs < 0:
+                sign = -1
+                qi = -qs
+            else:
+                sign = 1
+                qi = qs
+            if rc >= max(ra, rb):
+                px = min(ra, rb)
+            elif rc <= min(ra, rb):
+                px = max(ra, rb)
+            else:
+                px = ra + rb - rc
+            px += C[qi] if sign > 0 else -C[qi]
+            px = 0 if px < 0 else (maxval if px > maxval else px)
+            err = cur[x] - px
+            if sign < 0:
+                err = -err
+            # modulo reduction (A.4.5): the decoder's fix_reconstructed
+            # undoes the wrap
+            if err < 0:
+                err += range_
+            if err >= half_range:
+                err -= range_
+            a = A[qi]
+            n = N[qi]
+            k = 0
+            while (n << k) < a:
+                k += 1
+            # bias-inverted mapping is its own inverse (A.5.2/A.5.3)
+            e = (-err - 1) if (k == 0 and 2 * B[qi] <= -n) else err
+            m = 2 * e if e >= 0 else -2 * e - 1
+            encode_value(m, k, limit)
+            # context update with the REAL error — identical to the decoder
+            B[qi] += err  # err * quant_step, quant_step == 1
+            A[qi] += err if err >= 0 else -err
+            if n == reset:
+                A[qi] >>= 1
+                B[qi] = B[qi] >> 1
+                N[qi] = n >> 1
+            N[qi] += 1
+            n = N[qi]
+            if B[qi] + n <= 0:
+                B[qi] += n
+                if B[qi] <= -n:
+                    B[qi] = -n + 1
+                if C[qi] > -128:
+                    C[qi] -= 1
+            elif B[qi] > 0:
+                B[qi] -= n
+                if B[qi] > 0:
+                    B[qi] = 0
+                if C[qi] < 127:
+                    C[qi] += 1
+            x += 1
+        prev, cur = cur, prev
+    body = w.flush()
+    return bytes(head) + body + b"\xff" + bytes([_EOI])

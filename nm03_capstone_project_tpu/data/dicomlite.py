@@ -627,13 +627,14 @@ def write_dicom(
 ) -> None:
     """Write a monochrome uint16 slice as a Part-10 file.
 
-    ``transfer_syntax`` may be EXPLICIT_VR_LE (native pixels), RLE_LOSSLESS
-    or JPEG_LOSSLESS_SV1 (encapsulated, bit-exact round trip through
-    data/codecs.py — the importer-parity test data for the compressed
-    envelope)."""
+    ``transfer_syntax`` may be EXPLICIT_VR_LE (native pixels), RLE_LOSSLESS,
+    JPEG_LOSSLESS_SV1 or JPEG_LS_LOSSLESS (encapsulated, bit-exact round
+    trip through data/codecs.py — the importer-parity test data for the
+    compressed envelope)."""
     if pixels.ndim != 2:
         raise ValueError(f"expected 2D pixels, got {pixels.shape}")
-    if transfer_syntax not in (EXPLICIT_VR_LE, RLE_LOSSLESS, JPEG_LOSSLESS_SV1):
+    if transfer_syntax not in (EXPLICIT_VR_LE, RLE_LOSSLESS,
+                               JPEG_LOSSLESS_SV1, JPEG_LS_LOSSLESS):
         raise ValueError(f"writer does not support transfer syntax {transfer_syntax}")
     data = np.ascontiguousarray(pixels.astype("<u2"))
     rows, cols = data.shape
@@ -662,6 +663,17 @@ def write_dicom(
             + b"OB\x00\x00"
             + struct.pack("<I", 0xFFFFFFFF)
             + _encapsulate(codecs.jpeg_lossless_encode(data))
+        )
+    elif transfer_syntax == JPEG_LS_LOSSLESS:
+        from nm03_capstone_project_tpu.data import codecs
+
+        pix_elem = (
+            struct.pack("<HH", 0x7FE0, 0x0010)
+            + b"OB\x00\x00"
+            + struct.pack("<I", 0xFFFFFFFF)
+            # precision pinned to BitsStored=16 (PS3.5 A.4.3: codestream
+            # precision must match the dataset's Bits Stored)
+            + _encapsulate(codecs.jpegls_encode(data, precision=16))
         )
     else:
         pix_elem = _element(0x7FE0, 0x0010, b"OW", data.tobytes())

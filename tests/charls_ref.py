@@ -94,6 +94,11 @@ def encode(image: np.ndarray, near: int = 0) -> bytes:
     assert arr.ndim == 2 and arr.dtype in (np.uint8, np.uint16)
     bits = 8 if arr.dtype == np.uint8 else int(arr.max()).bit_length()
     bits = max(bits, 2) if arr.dtype == np.uint16 else 8
+    if arr.dtype == np.uint16 and bits <= 8:
+        # CharLS reads ONE byte per sample when bits_per_sample <= 8: a
+        # uint16 buffer would be encoded as its raw byte stream (low/high
+        # interleave), silently corrupting the oracle
+        arr = np.ascontiguousarray(arr.astype(np.uint8))
     enc = lib.charls_jpegls_encoder_create()
     try:
         info = _FrameInfo(arr.shape[1], arr.shape[0], bits, 1)
