@@ -28,6 +28,7 @@
 #include <cstdio>
 #include <cstring>
 #include <map>
+#include <stdexcept>
 #include <string>
 #include <thread>
 #include <vector>
@@ -1019,6 +1020,37 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
   bool invert = pi == "MONOCHROME1";
   long invert_base = invert ? (is_signed ? -1 : (1L << bits_stored) - 1) : 0;
 
+  // NumberOfFrames (0028,0008), VR IS: digits or absent. Mirrors the
+  // Python reader's _meta_int_str STRICTLY — exactly one optional sign
+  // then ASCII digits; anything else (embedded whitespace stol would
+  // skip, binary-looking bytes) means 1. A positive value too large for
+  // long can never match real data (Python rejects such files at its
+  // size/fragment checks), so it rejects here — acceptance-identical.
+  long nframes = 1;
+  {
+    auto it = ds.meta.find(tag(0x0028, 0x0008));
+    if (it != ds.meta.end()) {
+      std::string s = ascii_value(it->second);
+      std::string body = (!s.empty() && (s[0] == '+' || s[0] == '-'))
+                             ? s.substr(1)
+                             : s;
+      bool digits = !body.empty() &&
+                    body.find_first_not_of("0123456789") == std::string::npos;
+      if (digits) {
+        if (!s.empty() && s[0] == '-') {
+          nframes = 1;  // < 1 clamps to 1, like the Python reader
+        } else {
+          try {
+            nframes = std::max(1L, std::stol(s));
+          } catch (const std::out_of_range&) {
+            set_error("NumberOfFrames implausible");
+            return false;
+          }
+        }
+      }
+    }
+  }
+
   size_t expected = (size_t)rows * cols * (bits / 8);
   // Plausibility bound BEFORE any decode-side allocation: the uncompressed
   // path is implicitly bounded by the file size (pixel_len < expected
@@ -1032,8 +1064,10 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
   }
   std::vector<uint8_t> decomp_buf;  // decoded samples as LE bytes
   if (rle) {
-    if (ds.fragments.size() != 1) {
-      set_error("multi-fragment RLE (multi-frame?) out of envelope");
+    // one fragment per frame (PS3.5 A.4.2); this reader serves frame 0 of
+    // a multi-frame file, like the Python reader's default
+    if ((long)ds.fragments.size() != nframes) {
+      set_error("RLE fragment count disagrees with NumberOfFrames");
       return false;
     }
     if (!rle_decode_frame(ds.fragments[0].first, ds.fragments[0].second,
@@ -1080,7 +1114,17 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
     ds.pixel_data = decomp_buf.data();
     ds.pixel_len = decomp_buf.size();
   }
-  if (ds.pixel_len < expected) { set_error("PixelData truncated"); return false; }
+  // a multi-frame file must carry ALL its declared frames even though
+  // this reader serves only frame 0 — the Python reader enforces the same
+  // (a lying NumberOfFrames is a malformed file, not a short read).
+  // Division, not multiplication: expected * nframes could overflow
+  // size_t and bypass the check (expected >= 1 — rows/cols validated > 0).
+  if (ds.pixel_len < expected ||
+      (!(rle || jpegll || jls) &&
+       ds.pixel_len / expected < (size_t)nframes)) {
+    set_error("PixelData truncated");
+    return false;
+  }
 
   double slope = meta_float(ds, tag(0x0028, 0x1053), 1.0);
   double intercept = meta_float(ds, tag(0x0028, 0x1052), 0.0);

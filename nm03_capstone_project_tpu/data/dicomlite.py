@@ -143,6 +143,29 @@ class DicomSlice:
         v = self.meta.get(tag)
         return v.decode("ascii", "replace").strip("\x00 ") if v is not None else None
 
+    @property
+    def num_frames(self) -> int:
+        """NumberOfFrames (0028,0008); 1 for ordinary single-frame slices.
+
+        The same strict IS parse read_dicom's frame-range check uses, so
+        ``range(s.num_frames)`` is always a valid frame iteration."""
+        return max(1, _meta_int_str(self.meta, (0x0028, 0x0008), 1) or 1)
+
+    @property
+    def window(self) -> Optional[Tuple[float, float]]:
+        """(WindowCenter, WindowWidth) when the archive carries them."""
+        c = self.meta_str((0x0028, 0x1050))
+        w = self.meta_str((0x0028, 0x1051))
+        try:
+            # multi-valued DS (PS3.5: backslash-separated) -> first pair
+            return (
+                (float(c.split("\\")[0]), float(w.split("\\")[0]))
+                if c and w
+                else None
+            )
+        except ValueError:
+            return None
+
 
 class _Reader:
     def __init__(self, buf: bytes, explicit: bool, big: bool = False):
@@ -292,6 +315,26 @@ def _meta_int(meta, tag, default=None, big: bool = False) -> Optional[int]:
         return default
 
 
+def _meta_int_str(meta, tag, default: Optional[int] = None) -> Optional[int]:
+    """Integer-String (IS) tag value. NOT _meta_int: a 2-byte IS like b"3 "
+    would satisfy its len==2 branch and misparse as a binary uint16.
+    Strictly [+-]?digits after pad stripping — int()'s extra tolerance
+    (embedded newlines, unicode digits) would diverge from the native
+    reader's stol on corrupt values, and the differential fuzz holds the
+    two readers to byte-identical acceptance."""
+    v = meta.get(tag)
+    if v is None:
+        return default
+    try:
+        s = v.decode("ascii").strip("\x00 ")
+    except UnicodeDecodeError:
+        return default
+    body = s[1:] if s[:1] in ("+", "-") else s
+    if not body.isdigit():  # exactly one optional sign, then digits
+        return default
+    return int(s)
+
+
 def _meta_float(meta, tag, default: float) -> float:
     v = meta.get(tag)
     if v is None:
@@ -302,14 +345,40 @@ def _meta_float(meta, tag, default: float) -> float:
         return default
 
 
-def _decode_compressed(
-    transfer_syntax: str, fragments: list, rows: int, cols: int, dtype: np.dtype
-) -> np.ndarray:
-    """Decode encapsulated PixelData fragments -> (rows, cols) in ``dtype``.
+def _frame_payload(fragments: list, frame: int, nframes: int) -> bytes:
+    """One frame's concatenated JPEG-family codestream.
 
-    Single-frame contract (one 2D slice per file, the reference importer's
-    setLoadSeries(false)): RLE uses exactly one fragment per frame
-    (PS3.5 §A.4.2); a JPEG frame may span fragments, so those concatenate.
+    Single-frame: all fragments join (a frame may span fragments). Multi-
+    frame: frames are delimited by the fragments that START a codestream
+    (SOI marker), and the group count must match NumberOfFrames.
+    """
+    if nframes <= 1:
+        return b"".join(fragments)
+    groups: list = []
+    for frag in fragments:
+        if frag[:2] == b"\xff\xd8" or not groups:
+            groups.append([frag])
+        else:
+            groups[-1].append(frag)
+    if len(groups) != nframes:
+        raise DicomParseError(
+            f"found {len(groups)} JPEG codestreams for "
+            f"NumberOfFrames={nframes}"
+        )
+    return b"".join(groups[frame])
+
+
+def _decode_compressed(
+    transfer_syntax: str, fragments: list, rows: int, cols: int,
+    dtype: np.dtype, frame: int = 0, nframes: int = 1,
+) -> np.ndarray:
+    """Decode one frame of encapsulated PixelData -> (rows, cols) ``dtype``.
+
+    Single-frame files follow the reference importer's one-slice contract
+    (setLoadSeries(false)); multi-frame files (real-archive shape) select
+    ``frame`` of ``nframes``. RLE uses exactly one fragment per frame
+    (PS3.5 §A.4.2); a JPEG/JPEG-LS frame may span fragments, so frames are
+    delimited by their SOI markers and each frame's fragments concatenate.
     """
     from nm03_capstone_project_tpu.data import codecs
 
@@ -321,17 +390,20 @@ def _decode_compressed(
     _check_frame_bounds(rows, cols, dtype.itemsize)
     try:
         if transfer_syntax == RLE_LOSSLESS:
-            if len(fragments) != 1:
+            if len(fragments) != nframes:
                 raise DicomParseError(
-                    f"{len(fragments)} RLE fragments: multi-frame files are "
-                    "out of envelope (one slice per file)"
+                    f"{len(fragments)} RLE fragments for NumberOfFrames="
+                    f"{nframes}: PS3.5 A.4.2 requires exactly one per frame"
                 )
-            arr = codecs.rle_decode_frame(fragments[0], rows, cols, dtype.itemsize)
+            arr = codecs.rle_decode_frame(
+                fragments[frame], rows, cols, dtype.itemsize
+            )
         elif transfer_syntax in (JPEG_LOSSLESS, JPEG_LOSSLESS_SV1,
                                  JPEG_LS_LOSSLESS, JPEG_LS_NEAR):
             jls = transfer_syntax in (JPEG_LS_LOSSLESS, JPEG_LS_NEAR)
             decode = codecs.jpegls_decode if jls else codecs.jpeg_lossless_decode
-            arr = decode(b"".join(fragments), expect_shape=(rows, cols))
+            payload = _frame_payload(fragments, frame, nframes)
+            arr = decode(payload, expect_shape=(rows, cols))
             if dtype.itemsize == 1:
                 if arr.max(initial=0) > 0xFF:
                     raise DicomParseError(
@@ -349,8 +421,9 @@ def _decode_compressed(
                     "baseline JPEG (1.2.840.10008.1.2.4.50) is 8-bit only, "
                     f"but BitsAllocated={dtype.itemsize * 8}"
                 )
+            payload = _frame_payload(fragments, frame, nframes)
             try:
-                img = Image.open(io.BytesIO(b"".join(fragments)))
+                img = Image.open(io.BytesIO(payload))
                 arr = np.asarray(img.convert("L"), np.uint8)
             except (OSError, ValueError, Image.DecompressionBombError) as e:
                 # PIL raises UnidentifiedImageError (an OSError) on corrupt
@@ -368,11 +441,15 @@ def _decode_compressed(
     return arr.view(dtype) if dtype.itemsize == arr.dtype.itemsize else arr.astype(dtype)
 
 
-def read_dicom(path: str | os.PathLike) -> DicomSlice:
+def read_dicom(path: str | os.PathLike, frame: int = 0) -> DicomSlice:
     """Read one 2D DICOM slice, returning float32 rescaled intensities.
 
     Mirrors the reference importer's contract: exactly one 2D image per file
     (DICOMFileImporter with setLoadSeries(false), test_pipeline.cpp:38-41).
+    Real archives also carry multi-frame files (NumberOfFrames > 1):
+    ``frame`` selects which 2D frame decodes — the default 0 keeps the
+    one-slice contract while letting multi-frame archives import instead of
+    rejecting. The slice's ``num_frames`` property reports the count.
     """
     with open(path, "rb") as f:
         raw = f.read()
@@ -533,17 +610,29 @@ def read_dicom(path: str | os.PathLike) -> DicomSlice:
     else:
         raise DicomParseError(f"unsupported BitsAllocated={bits}")
 
+    nframes = _meta_int_str(meta, (0x0028, 0x0008), 1)
+    if nframes is None or nframes < 1:
+        nframes = 1
+    if not 0 <= frame < nframes:
+        raise DicomParseError(
+            f"frame {frame} out of range (NumberOfFrames={nframes})"
+        )
     if isinstance(pixel_data, list):  # encapsulated fragments
-        pixels = _decode_compressed(transfer_syntax, pixel_data, rows, cols, dtype)
+        pixels = _decode_compressed(
+            transfer_syntax, pixel_data, rows, cols, dtype,
+            frame=frame, nframes=nframes,
+        )
     else:
-        expected = rows * cols * dtype.itemsize
+        fsize = rows * cols * dtype.itemsize
+        expected = fsize * nframes
         if len(pixel_data) < expected:
             raise DicomParseError(
                 f"PixelData has {len(pixel_data)} bytes, expected {expected}"
+                + (f" ({nframes} frames)" if nframes > 1 else "")
             )
-        pixels = np.frombuffer(pixel_data[:expected], dtype=dtype).reshape(
-            rows, cols
-        )
+        pixels = np.frombuffer(
+            pixel_data[frame * fsize : (frame + 1) * fsize], dtype=dtype
+        ).reshape(rows, cols)
 
     slope = _meta_float(meta, (0x0028, 0x1053), 1.0)
     intercept = _meta_float(meta, (0x0028, 0x1052), 0.0)

@@ -83,6 +83,79 @@ static bool transcode(const std::string& src, const std::string& dst,
   return w.Write();
 }
 
+static bool write_multiframe(const std::string& path, unsigned rows,
+                             unsigned cols, unsigned frames,
+                             gdcm::TransferSyntax::TSType ts) {
+  gdcm::ImageWriter w;
+  gdcm::Image& img = w.GetImage();
+  img.SetNumberOfDimensions(3);
+  unsigned int dims[3] = {cols, rows, frames};
+  img.SetDimensions(dims);
+  img.SetPixelFormat(gdcm::PixelFormat(gdcm::PixelFormat::UINT16));
+  img.SetPhotometricInterpretation(
+      gdcm::PhotometricInterpretation::MONOCHROME2);
+  img.SetTransferSyntax(
+      gdcm::TransferSyntax(gdcm::TransferSyntax::ExplicitVRLittleEndian));
+  std::vector<uint8_t> pix;
+  for (unsigned f = 0; f < frames; ++f) {
+    auto p = pattern16(rows, cols);
+    for (size_t i = 0; i < p.size(); i += 2) {
+      // distinct per-frame content: frame index folds into the low byte
+      p[i] = (uint8_t)(p[i] ^ (f * 31));
+    }
+    pix.insert(pix.end(), p.begin(), p.end());
+  }
+  gdcm::DataElement pixeldata(gdcm::Tag(0x7FE0, 0x0010));
+  pixeldata.SetByteValue((const char*)pix.data(), (uint32_t)pix.size());
+  img.SetDataElement(pixeldata);
+  if (ts == gdcm::TransferSyntax::ExplicitVRLittleEndian) {
+    w.SetFileName(path.c_str());
+    return w.Write();
+  }
+  // write raw to temp, transcode to the requested encapsulated syntax
+  std::string tmp = path + ".raw.dcm";
+  w.SetFileName(tmp.c_str());
+  if (!w.Write()) return false;
+  bool ok = transcode(tmp, path, ts);
+  std::remove(tmp.c_str());
+  return ok;
+}
+
+// a vector carrying real-archive presentation tags the importer must NOT
+// trip over: WindowCenter/Width (multi-valued DS) and a stray
+// PlanarConfiguration on a monochrome image
+static bool write_windowed(const std::string& path, unsigned rows,
+                           unsigned cols) {
+  gdcm::ImageWriter w;
+  gdcm::Image& img = w.GetImage();
+  img.SetNumberOfDimensions(2);
+  unsigned int dims[2] = {cols, rows};
+  img.SetDimensions(dims);
+  img.SetPixelFormat(gdcm::PixelFormat(gdcm::PixelFormat::UINT16));
+  img.SetPhotometricInterpretation(
+      gdcm::PhotometricInterpretation::MONOCHROME2);
+  img.SetTransferSyntax(
+      gdcm::TransferSyntax(gdcm::TransferSyntax::ExplicitVRLittleEndian));
+  auto pix = pattern16(rows, cols);
+  gdcm::DataElement pixeldata(gdcm::Tag(0x7FE0, 0x0010));
+  pixeldata.SetByteValue((const char*)pix.data(), (uint32_t)pix.size());
+  img.SetDataElement(pixeldata);
+  gdcm::DataSet& ds = w.GetFile().GetDataSet();
+  gdcm::Attribute<0x0028, 0x1050> wc;
+  const double wcv[2] = {1024.0, 2048.0};
+  wc.SetValues(wcv, 2);
+  gdcm::Attribute<0x0028, 0x1051> ww;
+  const double wwv[2] = {512.0, 1024.0};
+  ww.SetValues(wwv, 2);
+  gdcm::Attribute<0x0028, 0x0006> planar;
+  planar.SetValue(0);
+  ds.Replace(wc.GetAsDataElement());
+  ds.Replace(ww.GetAsDataElement());
+  ds.Replace(planar.GetAsDataElement());
+  w.SetFileName(path.c_str());
+  return w.Write();
+}
+
 int main(int argc, char** argv) {
   std::string out = argc > 1 ? argv[1] : ".";
   const unsigned R = 60, C = 48;  // non-square; GDCM's RLE encoder asserts on odd widths
@@ -115,6 +188,19 @@ int main(int argc, char** argv) {
   ok &= write_raw(out + "/gdcm16_mono1.dcm", R, C, 16, p16,
                   gdcm::TransferSyntax::ExplicitVRLittleEndian,
                   /*monochrome1=*/true);
+  // real-archive shapes (round 5): odd dims, presentation tags, multi-frame
+  const unsigned OR_ = 59, OC = 47;  // both odd (RLE excluded: GDCM's
+                                     // encoder asserts on odd widths)
+  auto podd = pattern16(OR_, OC);
+  ok &= write_raw(out + "/gdcm16_odd.dcm", OR_, OC, 16, podd,
+                  gdcm::TransferSyntax::ExplicitVRLittleEndian);
+  ok &= transcode(out + "/gdcm16_odd.dcm", out + "/gdcm16_odd_jpegll.dcm",
+                  gdcm::TransferSyntax::JPEGLosslessProcess14_1);
+  ok &= write_windowed(out + "/gdcm16_window.dcm", R, C);
+  ok &= write_multiframe(out + "/gdcm16_multiframe.dcm", 32, 28, 3,
+                         gdcm::TransferSyntax::ExplicitVRLittleEndian);
+  ok &= write_multiframe(out + "/gdcm16_multiframe_rle.dcm", 32, 28, 3,
+                         gdcm::TransferSyntax::RLELossless);
   std::printf(ok ? "all vectors written to %s\n" : "FAILED (partial in %s)\n",
               out.c_str());
   return ok ? 0 : 1;

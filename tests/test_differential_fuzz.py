@@ -74,6 +74,14 @@ BASES = [
     "gdcm16_jpegll.dcm",
     "charls16_jpegls.dcm",
     "gdcm8_explicit.dcm",
+    # round-5 real-archive shapes: odd dims, presentation tags, multi-frame
+    # (both readers serve frame 0; the IS NumberOfFrames parse is strictly
+    # mirrored so mutated counts reject identically)
+    "gdcm16_odd.dcm",
+    "gdcm16_odd_jpegll.dcm",
+    "gdcm16_window.dcm",
+    "gdcm16_multiframe.dcm",
+    "gdcm16_multiframe_rle.dcm",
 ]
 
 

@@ -310,3 +310,110 @@ class TestStoredBits:
         if native.available():
             with pytest.raises(ValueError, match="BitsStored"):
                 native.read_dicom_native(p)
+
+
+def pattern16_odd() -> np.ndarray:
+    y, x = np.indices((59, 47))
+    return (((y // 4) * 251 + (x // 4) * 97 + y * x) % 4096).astype(np.uint16)
+
+
+def multiframe_frame(f: int) -> np.ndarray:
+    """The generator's per-frame pattern: frame index XORed into each
+    sample's low byte (make_vectors.cpp write_multiframe)."""
+    y, x = np.indices((32, 28))
+    base = (((y // 4) * 251 + (x // 4) * 97 + y * x) % 4096).astype(np.uint16)
+    return (base & 0xFF00) | ((base & 0xFF) ^ (f * 31))
+
+
+class TestRealArchiveShapes:
+    """Round-5 conformance widening (VERDICT r4 item 7): odd dims,
+    presentation tags, multi-frame — the shapes real TCIA-style archives
+    carry that the synthetic cohort does not."""
+
+    @pytest.mark.parametrize(
+        "name", ["gdcm16_odd.dcm", "gdcm16_odd_jpegll.dcm"]
+    )
+    def test_odd_dims_bit_exact_python(self, name):
+        from nm03_capstone_project_tpu.data.dicomlite import read_dicom
+
+        s = read_dicom(GOLDEN / name)
+        assert s.pixels.shape == (59, 47)
+        np.testing.assert_array_equal(
+            s.pixels.astype(np.int64), pattern16_odd().astype(np.int64)
+        )
+
+    @pytest.mark.parametrize(
+        "name", ["gdcm16_odd.dcm", "gdcm16_odd_jpegll.dcm"]
+    )
+    def test_odd_dims_bit_exact_native(self, name):
+        from nm03_capstone_project_tpu import native
+
+        if not native.available():
+            pytest.skip("native layer unavailable")
+        got = native.read_dicom_native(GOLDEN / name)
+        np.testing.assert_array_equal(
+            got.astype(np.int64), pattern16_odd().astype(np.int64)
+        )
+
+    def test_window_and_planar_tags_do_not_disturb_pixels(self):
+        from nm03_capstone_project_tpu.data.dicomlite import read_dicom
+
+        s = read_dicom(GOLDEN / "gdcm16_window.dcm")
+        np.testing.assert_array_equal(
+            s.pixels.astype(np.int64), pattern16().astype(np.int64)
+        )
+        # multi-valued DS: the first (center, width) pair surfaces
+        assert s.window == (1024.0, 512.0)
+        # a stray PlanarConfiguration on monochrome is presentation noise
+        assert s.meta.get((0x0028, 0x0006)) is not None
+
+    @pytest.mark.parametrize(
+        "name", ["gdcm16_multiframe.dcm", "gdcm16_multiframe_rle.dcm"]
+    )
+    def test_multiframe_every_frame_bit_exact(self, name):
+        from nm03_capstone_project_tpu.data.dicomlite import read_dicom
+
+        for f in range(3):
+            s = read_dicom(GOLDEN / name, frame=f)
+            assert s.num_frames == 3
+            np.testing.assert_array_equal(
+                s.pixels.astype(np.int64),
+                multiframe_frame(f).astype(np.int64),
+                err_msg=f"{name} frame {f}",
+            )
+
+    def test_multiframe_default_is_frame_zero(self):
+        from nm03_capstone_project_tpu.data.dicomlite import read_dicom
+
+        s = read_dicom(GOLDEN / "gdcm16_multiframe.dcm")
+        np.testing.assert_array_equal(
+            s.pixels.astype(np.int64), multiframe_frame(0).astype(np.int64)
+        )
+
+    def test_out_of_range_frame_rejected(self):
+        from nm03_capstone_project_tpu.data.dicomlite import (
+            DicomParseError,
+            read_dicom,
+        )
+
+        with pytest.raises(DicomParseError, match="frame 3 out of range"):
+            read_dicom(GOLDEN / "gdcm16_multiframe.dcm", frame=3)
+        with pytest.raises(DicomParseError, match="out of range"):
+            read_dicom(GOLDEN / "gdcm16_multiframe_rle.dcm", frame=7)
+
+
+class TestMultiframeNative:
+    def test_native_serves_frame_zero(self):
+        """The native reader's contract for multi-frame files: decode frame
+        0 (uncompressed: leading plane; RLE: first fragment) with the frame
+        count validated against the data — identical to the Python reader's
+        default, so the batch loader needs no fallback for these."""
+        from nm03_capstone_project_tpu import native
+        from nm03_capstone_project_tpu.data.dicomlite import read_dicom
+
+        if not native.available():
+            pytest.skip("native layer unavailable")
+        for name in ("gdcm16_multiframe.dcm", "gdcm16_multiframe_rle.dcm"):
+            nat = native.read_dicom_native(GOLDEN / name)
+            py = read_dicom(GOLDEN / name).pixels
+            np.testing.assert_array_equal(nat, py, err_msg=name)
