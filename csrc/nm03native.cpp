@@ -1078,13 +1078,35 @@ bool decode_dicom(const uint8_t* raw, size_t raw_len,
     ds.pixel_len = decomp_buf.size();
   } else if (jpegll || jls) {
     // single fragment (the common single-frame case) decodes in place; a
-    // frame spanning fragments is joined first
-    const uint8_t* stream_ptr = ds.fragments[0].first;
-    size_t stream_len = ds.fragments[0].second;
+    // frame spanning fragments is joined first. Multi-frame files delimit
+    // frames by their SOI-starting fragments — the codestream count must
+    // match NumberOfFrames and frame 0's group decodes, mirroring the
+    // Python reader's _frame_payload exactly (acceptance parity).
+    size_t first_begin = 0, first_end = ds.fragments.size();
+    if (nframes > 1) {
+      long groups = 0;
+      for (size_t i = 0; i < ds.fragments.size(); ++i) {
+        bool soi = ds.fragments[i].second >= 2 &&
+                   ds.fragments[i].first[0] == 0xFF &&
+                   ds.fragments[i].first[1] == 0xD8;
+        if (soi || groups == 0) {
+          ++groups;
+          if (groups == 1) first_begin = i;
+          if (groups == 2) first_end = i;
+        }
+      }
+      if (groups != nframes) {
+        set_error("JPEG codestream count disagrees with NumberOfFrames");
+        return false;
+      }
+    }
+    const uint8_t* stream_ptr = ds.fragments[first_begin].first;
+    size_t stream_len = ds.fragments[first_begin].second;
     std::vector<uint8_t> joined;
-    if (ds.fragments.size() > 1) {
-      for (const auto& f : ds.fragments)
-        joined.insert(joined.end(), f.first, f.first + f.second);
+    if (first_end - first_begin > 1) {
+      for (size_t i = first_begin; i < first_end; ++i)
+        joined.insert(joined.end(), ds.fragments[i].first,
+                      ds.fragments[i].first + ds.fragments[i].second);
       stream_ptr = joined.data();
       stream_len = joined.size();
     }

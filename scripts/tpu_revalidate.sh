@@ -29,10 +29,34 @@ echo "== bench =="
 # wedge-recovery vigil (NM03_BENCH_VIGIL_BUDGET_S) — a mid-run wedge should
 # fail fast here and leave the chip window to the other drivers below.
 # timeout(1) sends SIGTERM, which bench.py catches to emit best-so-far.
+# stdout now carries the SLIM driver line; the FULL record (all legs +
+# probe history) is the atomically-banked results/bench_partial.json —
+# that is what gets stamped as the round's chip artifact. Remove any
+# STALE partial first (bench.py unlinks it too, but only once main()
+# runs — an import-time crash must not let a previous run masquerade
+# as this one), and keep stdout under results/ as the fallback record.
+rm -f results/bench_partial.json
 timeout 1800 env NM03_BENCH_VIGIL_BUDGET_S=600 \
-  python bench.py > "results/bench_tpu_${STAMP}.json" 2>bench_stderr.log \
-  && cat "results/bench_tpu_${STAMP}.json" \
+  python bench.py > "results/bench_stdout_${STAMP}.log" 2>bench_stderr.log \
   || echo "bench failed; see bench_stderr.log"
+if python -c "import json; json.load(open('results/bench_partial.json'))" 2>/dev/null; then
+  cp results/bench_partial.json "results/bench_tpu_${STAMP}.json"
+  echo "banked results/bench_tpu_${STAMP}.json:"
+  tail -c 600 "results/bench_tpu_${STAMP}.json"; echo
+else
+  # no banked record (results/ unwritable mid-run?): the slim stdout line
+  # is the only measurement left — stamp that rather than nothing
+  python - "results/bench_stdout_${STAMP}.log" "results/bench_tpu_${STAMP}.json" <<'PYEOF'
+import json, sys
+try:
+    lines = [l for l in open(sys.argv[1]).read().splitlines() if l.strip()]
+    rec = json.loads(lines[-1])
+    json.dump(rec, open(sys.argv[2], "w"))
+    print("stamped slim stdout record (banked file was missing)")
+except Exception as e:
+    print(f"no record recoverable: {e}")
+PYEOF
+fi
 
 echo "== volume driver =="
 timeout 1200 python -m nm03_capstone_project_tpu.cli.volume \

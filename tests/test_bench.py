@@ -660,3 +660,19 @@ class TestExitPaths:
         assert "probe_history" not in rec
         banked = json.loads((tmp_path / "partial.json").read_text())
         assert "probe_history" in banked
+
+
+def test_make_batch_radius_distribution_is_batch_invariant():
+    """VERDICT r4 weak #5: the sweep generator must give every batch size
+    the same lesion-radius distribution, or xla_by_batch measures lesion
+    scaling (the batched grow fixpoint runs to the LARGEST lesion), not
+    batch scaling — the round-4 'inversion'."""
+    import inspect
+
+    src = inspect.getsource(bench._make_batch)
+    assert "% 32" in src, "radius must cycle, not grow with the raw index"
+    px32, _ = bench._make_batch(32)
+    px256, _ = bench._make_batch(256)
+    # the headline batch is bit-identical to prior rounds' (seeds 0-31,
+    # same radii), so records stay comparable across the fix
+    np.testing.assert_array_equal(px256[:32], px32)

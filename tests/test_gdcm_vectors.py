@@ -417,3 +417,55 @@ class TestMultiframeNative:
             nat = native.read_dicom_native(GOLDEN / name)
             py = read_dicom(GOLDEN / name).pixels
             np.testing.assert_array_equal(nat, py, err_msg=name)
+
+
+class TestMultiframeJpegParity:
+    def test_lying_frame_count_rejected_by_both_readers(self, tmp_path):
+        """A JPEG-lossless file declaring NumberOfFrames=3 over a single
+        codestream must reject in BOTH readers (the codestream count is
+        validated against the header) — acceptance parity, like every other
+        shared-envelope shape."""
+        import struct
+
+        from nm03_capstone_project_tpu import native
+        from nm03_capstone_project_tpu.data import codecs
+        from nm03_capstone_project_tpu.data.dicomlite import (
+            _element,
+            _ITEM,
+            _SEQ_DELIM,
+            DicomParseError,
+            JPEG_LOSSLESS,
+            read_dicom,
+        )
+
+        img = np.arange(64, dtype=np.uint16).reshape(8, 8)
+        frag = codecs.jpeg_lossless_encode(img)
+        if len(frag) % 2:
+            frag += b"\x00"
+        items = struct.pack("<HHI", *_ITEM, 0)
+        items += struct.pack("<HHI", *_ITEM, len(frag)) + frag
+        items += struct.pack("<HHI", *_SEQ_DELIM, 0)
+        meta_elems = _element(0x0002, 0x0010, b"UI", JPEG_LOSSLESS.encode())
+        meta = (
+            _element(0x0002, 0x0000, b"UL", struct.pack("<I", len(meta_elems)))
+            + meta_elems
+        )
+        ds = (
+            _element(0x0028, 0x0002, b"US", struct.pack("<H", 1))
+            + _element(0x0028, 0x0008, b"IS", b"3 ")
+            + _element(0x0028, 0x0010, b"US", struct.pack("<H", 8))
+            + _element(0x0028, 0x0011, b"US", struct.pack("<H", 8))
+            + _element(0x0028, 0x0100, b"US", struct.pack("<H", 16))
+            + _element(0x0028, 0x0103, b"US", struct.pack("<H", 0))
+            + struct.pack("<HH", 0x7FE0, 0x0010)
+            + b"OB\x00\x00"
+            + struct.pack("<I", 0xFFFFFFFF)
+            + items
+        )
+        p = tmp_path / "lying.dcm"
+        p.write_bytes(b"\x00" * 128 + b"DICM" + meta + ds)
+        with pytest.raises(DicomParseError, match="codestream"):
+            read_dicom(p)
+        if native.available():
+            with pytest.raises(ValueError):
+                native.read_dicom_native(p)
