@@ -56,31 +56,41 @@ from nm03_capstone_project_tpu.utils.timing import Timer
 log = get_logger("runner")
 
 
+def guard_pixels(
+    pixels: np.ndarray, name: str, cfg: PipelineConfig
+) -> Optional[np.ndarray]:
+    """Dimension guards for one decoded slice; None signals rejection.
+
+    The min-dimension guard (main_sequential.cpp:189-192) and the
+    canvas-fit guard, shared by the per-file path and the multi-frame
+    expansion (where each frame guards individually)."""
+    h, w = pixels.shape
+    if h < cfg.min_dim or w < cfg.min_dim:
+        # reference: "Image dimensions too small" (main_sequential.cpp:189-192)
+        log.warning("image dimensions too small: %dx%d (%s)", w, h, name)
+        return None
+    if h > cfg.canvas or w > cfg.canvas:
+        log.warning(
+            "slice %s (%dx%d) exceeds canvas %d; raise --canvas",
+            name, w, h, cfg.canvas,
+        )
+        return None
+    return pixels
+
+
 def decode_and_guard(path: Path, cfg: PipelineConfig) -> Optional[np.ndarray]:
     """Decode + guard one slice; None signals failure (null-ptr analog).
 
     The single home of the per-slice containment contract shared by every
     driver: broad catch on decode (the reference skips unreadable images and
-    continues, main_sequential.cpp:288-294), the min-dimension guard
-    (main_sequential.cpp:189-192), and the canvas-fit guard.
+    continues, main_sequential.cpp:288-294) plus :func:`guard_pixels`.
     """
     try:
         s = read_dicom(path)
     except Exception as e:  # noqa: BLE001 - per-slice containment
         log.warning("failed to read %s: %s", path.name, e)
         return None
-    h, w = s.pixels.shape
-    if h < cfg.min_dim or w < cfg.min_dim:
-        # reference: "Image dimensions too small" (main_sequential.cpp:189-192)
-        log.warning("image dimensions too small: %dx%d (%s)", w, h, path.name)
-        return None
-    if h > cfg.canvas or w > cfg.canvas:
-        log.warning(
-            "slice %s (%dx%d) exceeds canvas %d; raise --canvas",
-            path.name, w, h, cfg.canvas,
-        )
-        return None
-    return s.pixels
+    return guard_pixels(s.pixels, path.name, cfg)
 
 
 def _native_available() -> bool:

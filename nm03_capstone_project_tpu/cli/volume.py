@@ -70,38 +70,74 @@ def main(argv=None) -> int:
         return 1
 
 
+def _file_sources(f, cfg):
+    """[(stem, guarded-pixels-or-None)] for one series file, frames expanded.
+
+    Real archives store series both ways — one file per slice AND
+    multi-frame files (NumberOfFrames > 1) whose frames are z-planes; a
+    series may even mix them. Every file expands through the parse-once
+    :func:`dicomlite.read_dicom_frames`: a single-frame file yields its one
+    slice under the plain file stem (the decode happens once — this IS the
+    per-file path), a multi-frame file yields ``<stem>_fNNN`` per frame,
+    and per-frame decode failures contain to that frame (strict=False).
+    """
+    from nm03_capstone_project_tpu.cli.runner import guard_pixels, log
+    from nm03_capstone_project_tpu.data.dicomlite import read_dicom_frames
+
+    try:
+        slices = read_dicom_frames(f, strict=False)
+    except Exception as e:  # noqa: BLE001 - per-file containment
+        log.warning("failed to read %s: %s", f.name, e)
+        return [(f.stem, None)]
+    if len(slices) == 1:
+        s = slices[0]
+        px = guard_pixels(s.pixels, f.name, cfg) if s is not None else None
+        return [(f.stem, px)]
+    out = []
+    for k, s in enumerate(slices):
+        stem = f"{f.stem}_f{k:03d}"
+        if s is None:
+            print(f"  skipping frame {k} of {f.name}", file=sys.stderr)
+            out.append((stem, None))
+        else:
+            out.append((stem, guard_pixels(s.pixels, stem, cfg)))
+    return out
+
+
 def _load_volume(base, patient_id, cfg):
     """Stack one patient's series onto the canvas; (volume, dims, stems).
 
-    Per-slice containment lives in runner.decode_and_guard (shared with the
-    batch drivers); the volume driver adds only the series-uniformity check —
-    a volume needs all slices at one in-plane size.
+    Containment mirrors runner.decode_and_guard (shared guards via
+    guard_pixels); the volume driver adds only the series-uniformity check —
+    a volume needs all slices at one in-plane size. Multi-frame files
+    expand into their frames (see :func:`_file_sources`).
     """
     import numpy as np
 
-    from nm03_capstone_project_tpu.cli.runner import decode_and_guard
     from nm03_capstone_project_tpu.data.discovery import load_dicom_files_for_patient
 
+    files = load_dicom_files_for_patient(base, patient_id)
+    sources = [sf for f in files for sf in _file_sources(f, cfg)]
+
     planes, stems, skipped, hw = [], [], [], None
-    for f in load_dicom_files_for_patient(base, patient_id):
-        px = decode_and_guard(f, cfg)
+    for stem, px in sources:
         if px is None:
-            skipped.append(f.stem)
+            skipped.append(stem)
             continue
         h, w = px.shape
         if hw is None:
             hw = (h, w)
         elif (h, w) != hw:
             print(
-                f"  skipping {f.name}: {w}x{h} != series {hw[1]}x{hw[0]}",
+                f"  skipping {stem}: {w}x{h} != series {hw[1]}x{hw[0]}",
                 file=sys.stderr,
             )
-            skipped.append(f.stem)
+            skipped.append(stem)
             continue
         canvas = np.zeros((cfg.canvas, cfg.canvas), np.float32)
         canvas[:h, :w] = px
         planes.append(canvas)
-        stems.append(f.stem)
+        stems.append(stem)
     if not planes:
         raise ValueError(f"no usable slices for {patient_id}")
     return np.stack(planes), np.asarray(hw, np.int32), stems, skipped

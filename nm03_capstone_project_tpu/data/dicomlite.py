@@ -449,11 +449,51 @@ def read_dicom(path: str | os.PathLike, frame: int = 0) -> DicomSlice:
     Real archives also carry multi-frame files (NumberOfFrames > 1):
     ``frame`` selects which 2D frame decodes — the default 0 keeps the
     one-slice contract while letting multi-frame archives import instead of
-    rejecting. The slice's ``num_frames`` property reports the count.
+    rejecting. The slice's ``num_frames`` property reports the count; use
+    :func:`read_dicom_frames` to materialize a whole stack without
+    re-parsing the file per frame.
     """
     with open(path, "rb") as f:
         raw = f.read()
+    ctx = _open_dataset(raw, path)
+    if isinstance(ctx, DicomSlice):  # J2K shim path (single-frame)
+        if frame != 0:
+            raise DicomParseError(
+                f"frame {frame} out of range (NumberOfFrames=1)"
+            )
+        return ctx
+    return _materialize_frame(ctx, frame)
 
+
+def read_dicom_frames(path: str | os.PathLike, strict: bool = True) -> list:
+    """Every frame of a (possibly multi-frame) file, parsed ONCE.
+
+    Single-frame files return a one-element list; archives that store a
+    whole series as a single multi-frame file expand into their z-stack
+    (the volume driver consumes this). ``strict=False`` substitutes None
+    for frames whose decode fails instead of raising — per-frame
+    containment for drivers that skip-and-continue.
+    """
+    with open(path, "rb") as f:
+        raw = f.read()
+    ctx = _open_dataset(raw, path)
+    if isinstance(ctx, DicomSlice):
+        return [ctx]
+    out = []
+    for k in range(ctx["nframes"]):
+        try:
+            out.append(_materialize_frame(ctx, k))
+        except DicomParseError:
+            if strict:
+                raise
+            out.append(None)
+    return out
+
+
+def _open_dataset(raw: bytes, path) -> "dict | DicomSlice":
+    """Parse preamble/meta/dataset once; the frame-independent half of
+    :func:`read_dicom`. Returns the decode context, or a finished
+    DicomSlice for the GDCM-shimmed J2K path (which decodes whole)."""
     # Part-10 preamble, or a bare dataset
     body = raw
     transfer_syntax = EXPLICIT_VR_LE
@@ -613,6 +653,29 @@ def read_dicom(path: str | os.PathLike, frame: int = 0) -> DicomSlice:
     nframes = _meta_int_str(meta, (0x0028, 0x0008), 1)
     if nframes is None or nframes < 1:
         nframes = 1
+    return {
+        "transfer_syntax": transfer_syntax,
+        "meta": meta,
+        "pixel_data": pixel_data,
+        "rows": rows,
+        "cols": cols,
+        "bits": bits,
+        "signed": signed,
+        "pi": pi,
+        "dtype": dtype,
+        "big": big,
+        "nframes": nframes,
+    }
+
+
+def _materialize_frame(ctx: dict, frame: int) -> DicomSlice:
+    """Decode + post-process ONE frame from an :func:`_open_dataset` context."""
+    transfer_syntax = ctx["transfer_syntax"]
+    meta = ctx["meta"]
+    pixel_data = ctx["pixel_data"]
+    rows, cols = ctx["rows"], ctx["cols"]
+    bits, signed, pi = ctx["bits"], ctx["signed"], ctx["pi"]
+    dtype, big, nframes = ctx["dtype"], ctx["big"], ctx["nframes"]
     if not 0 <= frame < nframes:
         raise DicomParseError(
             f"frame {frame} out of range (NumberOfFrames={nframes})"

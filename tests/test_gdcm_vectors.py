@@ -469,3 +469,23 @@ class TestMultiframeJpegParity:
         if native.available():
             with pytest.raises(ValueError):
                 native.read_dicom_native(p)
+
+
+class TestReadDicomFrames:
+    def test_parse_once_matches_per_frame_reads(self):
+        from nm03_capstone_project_tpu.data.dicomlite import (
+            read_dicom,
+            read_dicom_frames,
+        )
+
+        frames = read_dicom_frames(GOLDEN / "gdcm16_multiframe.dcm")
+        assert len(frames) == 3
+        for k, s in enumerate(frames):
+            want = read_dicom(GOLDEN / "gdcm16_multiframe.dcm", frame=k)
+            np.testing.assert_array_equal(s.pixels, want.pixels)
+
+    def test_single_frame_file_yields_one(self):
+        from nm03_capstone_project_tpu.data.dicomlite import read_dicom_frames
+
+        frames = read_dicom_frames(GOLDEN / "gdcm16_explicit.dcm")
+        assert len(frames) == 1 and frames[0].pixels.shape == (ROWS, COLS)

@@ -6,6 +6,7 @@ per-patient failure containment.
 """
 
 import json
+import pathlib
 
 import jax
 import numpy as np
@@ -144,3 +145,47 @@ class TestVolumeTruncation:
         assert "already complete, skipping" not in text
         rec2 = json.loads((out / "res.json").read_text())
         assert rec2["grow_truncated_patients"] == []
+
+
+class TestMultiframeSeries:
+    def test_single_multiframe_file_expands_to_z_stack(self, tmp_path, capsys):
+        """A series stored as ONE multi-frame file (real-archive shape) is
+        its own z-stack: frames become planes, stems get _fNNN suffixes,
+        and the full driver exports a pair per frame."""
+        import shutil
+
+        golden = (
+            pathlib.Path(__file__).parent / "golden" / "dicom"
+            / "gdcm16_multiframe.dcm"
+        )
+        root = tmp_path / "cohort"
+        series = root / "PGBM-0001" / "seriesA"
+        series.mkdir(parents=True)
+        shutil.copy(golden, series / "1-1.dcm")
+
+        from nm03_capstone_project_tpu.cli.volume import _load_volume
+        from nm03_capstone_project_tpu.config import PipelineConfig
+
+        cfg = PipelineConfig(canvas=64, min_dim=16)
+        vol, dims, stems, skipped = _load_volume(root, "PGBM-0001", cfg)
+        assert vol.shape == (3, 64, 64)
+        assert list(dims) == [32, 28]
+        assert stems == ["1-1_f000", "1-1_f001", "1-1_f002"]
+        assert skipped == []
+        # frames differ (the generator XORs the frame index into low bytes)
+        assert not (vol[0] == vol[1]).all()
+
+        out = tmp_path / "out"
+        rc = volume_cli.main(
+            [
+                "--base-path", str(root),
+                "--output", str(out),
+                "--canvas", "64",
+                "--min-dim", "16",
+                "--results-json", str(out / "res.json"),
+            ]
+        )
+        assert rc == 0
+        jpgs = sorted(p.name for p in (out / "PGBM-0001").glob("*.jpg"))
+        assert len(jpgs) == 6  # 3 frames x (original, processed)
+        assert "1-1_f002_original.jpg" in jpgs
