@@ -91,13 +91,15 @@ def _file_sources(f, cfg):
         return [(f.stem, None)]
     if len(slices) == 1:
         s = slices[0]
-        px = guard_pixels(s.pixels, f.name, cfg) if s is not None else None
-        return [(f.stem, px)]
+        if isinstance(s, Exception):
+            log.warning("failed to read %s: %s", f.name, s)
+            return [(f.stem, None)]
+        return [(f.stem, guard_pixels(s.pixels, f.name, cfg))]
     out = []
     for k, s in enumerate(slices):
         stem = f"{f.stem}_f{k:03d}"
-        if s is None:
-            print(f"  skipping frame {k} of {f.name}", file=sys.stderr)
+        if isinstance(s, Exception):
+            log.warning("skipping frame %d of %s: %s", k, f.name, s)
             out.append((stem, None))
         else:
             out.append((stem, guard_pixels(s.pixels, stem, cfg)))
@@ -117,7 +119,9 @@ def _load_volume(base, patient_id, cfg):
     from nm03_capstone_project_tpu.data.discovery import load_dicom_files_for_patient
 
     files = load_dicom_files_for_patient(base, patient_id)
-    sources = [sf for f in files for sf in _file_sources(f, cfg)]
+    # generator: stream one file's frames at a time — materializing the
+    # whole decoded series AND the canvas stack would double peak memory
+    sources = (sf for f in files for sf in _file_sources(f, cfg))
 
     planes, stems, skipped, hw = [], [], [], None
     for stem, px in sources:

@@ -470,9 +470,10 @@ def read_dicom_frames(path: str | os.PathLike, strict: bool = True) -> list:
 
     Single-frame files return a one-element list; archives that store a
     whole series as a single multi-frame file expand into their z-stack
-    (the volume driver consumes this). ``strict=False`` substitutes None
-    for frames whose decode fails instead of raising — per-frame
-    containment for drivers that skip-and-continue.
+    (the volume driver consumes this). ``strict=False`` substitutes the
+    DicomParseError for frames whose decode fails instead of raising —
+    per-frame containment for drivers that skip-and-continue, with the
+    failure reason preserved.
     """
     with open(path, "rb") as f:
         raw = f.read()
@@ -483,10 +484,12 @@ def read_dicom_frames(path: str | os.PathLike, strict: bool = True) -> list:
     for k in range(ctx["nframes"]):
         try:
             out.append(_materialize_frame(ctx, k))
-        except DicomParseError:
+        except DicomParseError as e:
             if strict:
                 raise
-            out.append(None)
+            # the EXCEPTION stands in for the frame so skip-and-continue
+            # callers can still report WHY a frame was dropped
+            out.append(e)
     return out
 
 
@@ -567,6 +570,14 @@ def _open_dataset(raw: bytes, path) -> "dict | DicomSlice":
             cols = _meta_int(meta, (0x0028, 0x0011))
             _check_frame_bounds(rows, cols, 2)
             pi = _photometric(meta)
+            if (_meta_int_str(meta, (0x0028, 0x0008), 1) or 1) > 1:
+                # the shim decodes whole files; serving frame 0 of a
+                # multi-frame J2K would silently drop planes (and
+                # num_frames would lie about the iteration range)
+                raise DicomParseError(
+                    "multi-frame JPEG 2000 is out of envelope; transcode "
+                    "with gdcmconv --raw first"
+                )
             try:
                 pixels, raw_dtype = gdcm_fallback.read_j2k(path, rows, cols)
             except ValueError as e:

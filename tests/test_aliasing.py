@@ -65,7 +65,7 @@ class TestDonation:
         ref = jax.jit(jax.vmap(one))
         ro, rp = ref(px, dm)
         px2, dm2 = _batch()  # fresh buffers to donate
-        do, dp = donated(px2, dm2)
+        do, dp, _conv = donated(px2, dm2)
         np.testing.assert_array_equal(np.asarray(do), np.asarray(ro))
         np.testing.assert_array_equal(np.asarray(dp), np.asarray(rp))
 

@@ -182,7 +182,8 @@ def test_student_masks_overlap_teacher(cohort, checkpoint, tmp_path):
     student = np.asarray(
         _student_batch_mask(_load(checkpoint), px, dm, CFG)
     ).astype(bool)
-    teacher = np.asarray(_compiled_batch_mask_fn(CFG)(px, dm)).astype(bool)
+    teacher_mask, _conv = _compiled_batch_mask_fn(CFG)(px, dm)
+    teacher = np.asarray(teacher_mask).astype(bool)
     union = (teacher | student).sum()
     assert union > 0
     iou = (teacher & student).sum() / union
