@@ -71,6 +71,7 @@ BATCH = 32
 # amortize dispatch/sync better on the chip; the CPU baseline then reruns
 # at the winning size so vs_baseline stays a same-program ratio
 ACCEL_BATCH_SWEEP = (32, 128, 256)
+SCAN_CHUNK = 8  # batches per dispatch in the scan-amortized leg
 CANVAS = 256
 TPU_REPS = 40
 CPU_REPS = 2
@@ -251,6 +252,52 @@ def _bench_on(device, pixels, dims, reps, use_pallas=False):
     int(results[-1])  # one sync: FIFO order implies all earlier reps finished
     elapsed = time.perf_counter() - t0
     return pixels.shape[0] * reps / elapsed, checksum
+
+
+def _bench_scan_chunk(device, batch, reps, chunk=8):
+    """(slices/sec, checksum) with ``chunk`` batches per SINGLE dispatch.
+
+    The per-dispatch path (_bench_on) pays the tunnel enqueue per rep even
+    with enqueue-then-sync; here a `lax.scan` runs ``chunk`` DISTINCT
+    batches inside one compiled program, so the measured rate is the pure
+    device rate with the dispatch floor amortized to nothing — the
+    latency-bound-vs-device-bound split made explicit (VERDICT r4 weak
+    #5's prescription). Distinct per-iteration inputs stop XLA hoisting
+    the body out of the loop as loop-invariant.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from nm03_capstone_project_tpu.config import PipelineConfig
+    from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_batch
+
+    import numpy as np
+
+    cfg = PipelineConfig()
+    # one generation, `chunk` rolled copies: every scan iteration sees a
+    # genuinely different batch (stops loop-invariant hoisting) with the
+    # identical radius distribution — and identical TOTAL checksum, which
+    # the caller validates against chunk x the per-dispatch checksum
+    px, dm = _make_batch(batch)
+    xs_px = jnp.asarray(np.stack([np.roll(px, c, axis=0) for c in range(chunk)]))
+    xs_dm = jnp.asarray(np.stack([np.roll(dm, c, axis=0) for c in range(chunk)]))
+
+    def step(carry, xd):
+        px, dm = xd
+        mask = process_batch(px, dm, cfg)["mask"]
+        return carry + mask.astype(jnp.int32).sum(), None
+
+    fn = jax.jit(
+        lambda xp, xm: jax.lax.scan(step, jnp.int32(0), (xp, xm))[0]
+    )
+    xs_px = jax.device_put(xs_px, device)
+    xs_dm = jax.device_put(xs_dm, device)
+    checksum = int(fn(xs_px, xs_dm))  # compile + warm sync
+    t0 = time.perf_counter()
+    outs = [fn(xs_px, xs_dm) for _ in range(reps)]
+    int(outs[-1])
+    elapsed = time.perf_counter() - t0
+    return batch * chunk * reps / elapsed, checksum
 
 
 def _bench_student(device, pixels, dims, reps):
@@ -589,6 +636,7 @@ def worker(
     out_path: str | None,
     batches: tuple | None = None,
     want_volume: bool = False,
+    want_scan: bool = False,
 ):
     """Measure on this process's backend.
 
@@ -665,6 +713,32 @@ def worker(
             )
         }
     )
+
+    if want_scan:
+        try:
+            # dispatch-amortized device rate: `chunk` distinct batches per
+            # ONE dispatch via lax.scan — the gap between this and xla_tput
+            # IS the per-dispatch (tunnel) cost enqueueing could not hide
+            s_tput, s_sum = _bench_scan_chunk(
+                dev, batch, max(1, reps // SCAN_CHUNK), chunk=SCAN_CHUNK
+            )
+            # rolled copies => the scan total must equal chunk x the
+            # per-dispatch checksum; a miscompiled/hoisted loop must not
+            # put a wrong rate in the record (same gate as the Pallas leg)
+            agrees = s_sum == SCAN_CHUNK * xla_sum
+            emit({
+                "xla_scan_tput": round(s_tput, 2),
+                "scan_chunk": SCAN_CHUNK,
+                "scan_checksum_ok": agrees,
+            })
+            _log(
+                f"{dev.platform} scan-chunked ({SCAN_CHUNK} batches/dispatch): "
+                f"{s_tput:.2f} slices/s (per-dispatch path: {tput:.2f}; "
+                f"checksum {'matches' if agrees else 'MISMATCH'})"
+            )
+        except Exception as e:  # noqa: BLE001 — never lose the headline
+            emit({"scan_error": f"{e!r:.500}"})
+            _log(f"scan-chunk timing failed: {e!r:.500}")
 
     if want_pallas and on_tpu:
         try:
@@ -1045,7 +1119,8 @@ def _copy_optional(out: dict, rec: dict) -> None:
     """Carry a measurement record's optional sections into the emitted JSON."""
     for key in ("stages", "device_kind", "hbm_peak_gbps",
                 "fused_min_traffic_gbps", "profile_dir", "student_tput",
-                "volume"):
+                "volume", "xla_scan_tput", "scan_chunk",
+                "scan_checksum_ok"):
         if key in rec:
             out[key] = rec[key]
 
@@ -1142,6 +1217,7 @@ def _measure_accel(deadline=None, cpu_banked=False):
         "--pallas",
         "--stages",
         "--volume",
+        "--scan",
         "--batches",
         ",".join(str(b) for b in ACCEL_BATCH_SWEEP),
     ]
@@ -1429,6 +1505,7 @@ if __name__ == "__main__":
     parser.add_argument("--pallas", action="store_true")
     parser.add_argument("--stages", action="store_true")
     parser.add_argument("--volume", action="store_true")
+    parser.add_argument("--scan", action="store_true")
     parser.add_argument("--out", default=None)
     parser.add_argument("--batches", default=str(BATCH), help="comma list to sweep")
     ns = parser.parse_args()
@@ -1446,6 +1523,7 @@ if __name__ == "__main__":
             ns.out,
             tuple(int(b) for b in ns.batches.split(",")),
             want_volume=ns.volume,
+            want_scan=ns.scan,
         )
     else:
         main()

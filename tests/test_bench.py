@@ -51,6 +51,31 @@ class TestWorker:
             merged.update(json.loads(line))
         assert merged["xla_tput"] == res["xla_tput"]
 
+    def test_scan_chunk_leg_measures_and_checksums(self, monkeypatch, capsys):
+        # the dispatch-amortized leg: chunk distinct batches per dispatch,
+        # checksum = chunk x the single-batch checksum (rolled copies);
+        # gated behind --scan so the shed path and the CPU baseline never
+        # pay its compile
+        import jax
+
+        monkeypatch.setattr(bench, "BATCH", 2)
+        monkeypatch.setattr(bench, "CANVAS", 64)
+        monkeypatch.setattr(bench, "SCAN_CHUNK", 3)
+        dev = jax.devices("cpu")[0]
+        _, base_sum = bench._bench_on(dev, *bench._make_batch(2), reps=1)
+        tput, checksum = bench._bench_scan_chunk(dev, 2, reps=1, chunk=3)
+        assert tput > 0
+        assert checksum == 3 * base_sum
+        bench.worker("cpu", reps=1, want_pallas=False, want_stages=False,
+                     out_path=None, want_scan=True)
+        res = _emitted(capsys)
+        assert res["scan_checksum_ok"] is True
+        assert res["xla_scan_tput"] > 0
+        # and OFF by default (the CPU-baseline / shed path)
+        bench.worker("cpu", reps=1, want_pallas=False, want_stages=False,
+                     out_path=None)
+        assert "xla_scan_tput" not in _emitted(capsys)
+
     def test_probe_round_trip(self, capsys):
         bench.probe("cpu")
         assert _emitted(capsys)["backend"] == "cpu"
