@@ -406,36 +406,48 @@ class CohortProcessor:
         else:
             fn = _compiled_slice_fn(self.cfg)
         ok, failed, truncated = 0, [], []
-        # student fns are batched even in sequential mode: their converged
-        # flag is (1,); the classical slice fns emit a scalar — bool() eats
-        # both. Sequential mode is per-slice, so the flag read costs nothing
-        # extra (the mask fetch already syncs the device).
-        for f in files:
-            stem = f.stem
+
+        # One-slice-at-a-time with ONE dispatch in flight: slice N+1's
+        # compute is enqueued (async dispatch) before slice N's results are
+        # fetched and exported, hiding one direction of the per-slice
+        # device round trip (~66 ms each way through the tunnel) that
+        # dominated this driver's wall. Processing and export remain
+        # strictly in slice order with per-slice containment — the
+        # reference's sequential contract (main_sequential.cpp:170-272) is
+        # about ORDER and interleaving, not about stalling the device
+        # between slices (its local GPU has no such round trip to hide).
+        # The timer's "compute" section therefore measures enqueue; the
+        # device wait lands in the fetch inside "export".
+        #
+        # Student fns are batched even in sequential mode: their converged
+        # flag is (1,); the classical slice fns emit a scalar — np.all
+        # eats both.
+        def resolve(p) -> None:
+            nonlocal ok
+            stem = p["stem"]
             try:
-                with self.timer.section("decode"):
-                    pixels = self._read_slice(f)
-                if pixels is None:
-                    raise ValueError("decode/guard failed")
-                padded, dims = self._pad_one(pixels)
+                if "error" in p:
+                    raise p["error"]
+                # the blocking device fetch counts toward "export": that is
+                # where the per-slice device wait lands in this driver's
+                # timing report (the enqueue-only "compute" section cannot
+                # carry it)
                 if host_render:
-                    with self.timer.section("compute"):
-                        mask, conv = fn(padded, dims)
-                        mask = np.asarray(mask)
+                    with self.timer.section("export"):
+                        mask = np.asarray(p["mask_dev"])  # device sync
                     if self.mask_sink is not None:
                         self.mask_sink(patient_id, stem, mask)
                     with self.timer.section("export"):
                         written = render_export_pairs(
-                            [(stem, padded, mask, dims)],
+                            [(stem, p["padded"], mask, p["dims"])],
                             out_dir,
                             self.cfg,
                             max_workers=1,
                         )
                 else:
-                    with self.timer.section("compute"):
-                        orig, proc, conv = fn(padded, dims)
-                        orig, proc = np.asarray(orig), np.asarray(proc)
                     with self.timer.section("export"):
+                        orig = np.asarray(p["orig_dev"])
+                        proc = np.asarray(p["proc_dev"])
                         written = export_pairs(
                             [(stem, orig, proc)], out_dir, max_workers=1
                         )
@@ -445,16 +457,49 @@ class CohortProcessor:
                 # but the mask under-covers" — a failed slice is only
                 # failed. Truncated gets its own manifest status so a
                 # --resume rerun with a raised cap recomputes it.
-                if not bool(np.all(np.asarray(conv))):
+                if not bool(np.all(np.asarray(p["conv"]))):
                     truncated.append(stem)
                     self.manifest.record(patient_id, stem, STATUS_TRUNCATED)
                 else:
                     self.manifest.record(patient_id, stem, STATUS_DONE)
                 ok += 1
-            except Exception as e:  # noqa: BLE001 - reference: don't throw here
-                log.warning("error processing file %s: %s", f.name, e)
+            except Exception as e:  # noqa: BLE001 - reference: don't throw
+                log.warning("error processing file %s: %s", stem, e)
                 self.manifest.record(patient_id, stem, STATUS_FAILED)
                 failed.append(stem)
+
+        pending = None
+        for f in files:
+            stem = f.stem
+            try:
+                with self.timer.section("decode"):
+                    pixels = self._read_slice(f)
+                if pixels is None:
+                    raise ValueError("decode/guard failed")
+                padded, dims = self._pad_one(pixels)
+                with self.timer.section("compute"):
+                    if host_render:
+                        mask_dev, conv = fn(padded, dims)
+                        cur = {
+                            "stem": stem, "mask_dev": mask_dev, "conv": conv,
+                            "padded": padded, "dims": dims,
+                        }
+                    else:
+                        orig_dev, proc_dev, conv = fn(padded, dims)
+                        cur = {
+                            "stem": stem, "orig_dev": orig_dev,
+                            "proc_dev": proc_dev, "conv": conv,
+                        }
+            except Exception as e:  # noqa: BLE001 - reference: don't throw
+                # a decode/dispatch failure rides the pipeline as a record,
+                # so resolve() logs and counts it AFTER the previous slice
+                # completes — failure handling stays in slice order
+                cur = {"stem": stem, "error": e}
+            if pending is not None:
+                resolve(pending)
+            pending = cur
+        if pending is not None:
+            resolve(pending)
         return ok, failed, truncated
 
     def _run_parallel(
