@@ -48,7 +48,11 @@ SURFACE = {
         "find_patient_dirs",
         "load_dicom_files_for_patient",
     ],
-    "nm03_capstone_project_tpu.data.dicomlite": ["read_dicom"],
+    "nm03_capstone_project_tpu.data.dicomlite": [
+        "read_dicom",
+        "read_dicom_frames",
+        "write_dicom",
+    ],
     "nm03_capstone_project_tpu.data.synthetic": [
         "phantom_slice",
         "phantom_series",
@@ -56,6 +60,14 @@ SURFACE = {
         "write_synthetic_cohort",
     ],
     "nm03_capstone_project_tpu.data.prefetch": ["prefetch_to_device"],
+    "nm03_capstone_project_tpu.data.codecs": [
+        "rle_encode_frame",
+        "rle_decode_frame",
+        "jpeg_lossless_encode",
+        "jpeg_lossless_decode",
+        "jpegls_encode",
+        "jpegls_decode",
+    ],
     "nm03_capstone_project_tpu.data.imageio": [
         "write_metaimage",
         "read_metaimage",
@@ -81,6 +93,7 @@ SURFACE = {
         "pad_to_multiple",
         "process_batch_sharded",
         "process_volume_zsharded",
+        "process_volume_batch_zsharded",
         "distributed",
     ],
     "nm03_capstone_project_tpu.parallel.distributed": [
