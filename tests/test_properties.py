@@ -176,3 +176,31 @@ def test_jpeg_lossless_round_trip_any_content(data, hw):
     img = rng.integers(0, 65_536, (h, w), dtype=np.uint16)
     dec = codecs.jpeg_lossless_decode(codecs.jpeg_lossless_encode(img))
     np.testing.assert_array_equal(dec, img)
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    data=st.data(),
+    hw=st.tuples(st.integers(1, 40), st.integers(1, 40)),
+    kind=st.sampled_from(["noise", "runs", "constant", "gradient"]),
+)
+def test_jpegls_round_trip_any_content(data, hw, kind):
+    """JPEG-LS encoder/decoder round trip under hypothesis: noise (regular
+    mode, every Golomb k), runs (run mode + interruptions), constants
+    (EOL-run + trailing-0xFF stuffed-pad edge), gradients (context spread)."""
+    from nm03_capstone_project_tpu.data import codecs
+
+    h, w = hw
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31 - 1)))
+    if kind == "noise":
+        img = rng.integers(0, 65_536, (h, w), dtype=np.uint16)
+    elif kind == "runs":
+        img = np.repeat(
+            rng.integers(0, 65_536, (h, 1), dtype=np.uint16), w, axis=1
+        )
+    elif kind == "constant":
+        img = np.full((h, w), data.draw(st.integers(0, 65_535)), np.uint16)
+    else:
+        img = (np.outer(np.arange(h), np.arange(w)) % 65_536).astype(np.uint16)
+    dec = codecs.jpegls_decode(codecs.jpegls_encode(img))
+    np.testing.assert_array_equal(dec, img)
