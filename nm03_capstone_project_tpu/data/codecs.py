@@ -936,15 +936,21 @@ class _JlsBitWriter:
         return bytes(self.out)
 
 
-def jpegls_encode(image: np.ndarray, precision: int | None = None) -> bytes:
-    """Encode a 2D uint8/uint16 array as lossless JPEG-LS (ITU-T T.87).
+def jpegls_encode(
+    image: np.ndarray, precision: int | None = None, near: int = 0
+) -> bytes:
+    """Encode a 2D uint8/uint16 array as JPEG-LS (ITU-T T.87).
 
-    The encoder mirror of :func:`jpegls_decode` — single component, NEAR=0,
-    default thresholds, no interleave/point-transform, the exact envelope
-    both in-tree readers (and CharLS) accept; used by
-    ``write_dicom(..., transfer_syntax=JPEG_LS_LOSSLESS)``. Round trips
-    bit-exactly through :func:`jpegls_decode`, the native reader and CharLS
-    (pinned in tests/test_jpegls.py).
+    The encoder mirror of :func:`jpegls_decode` — single component, default
+    thresholds, no interleave/point-transform, the exact envelope both
+    in-tree readers (and CharLS) accept; used by
+    ``write_dicom(..., transfer_syntax=JPEG_LS_LOSSLESS / JPEG_LS_NEAR)``.
+    ``near=0`` (lossless) round trips bit-exactly through
+    :func:`jpegls_decode`, the native reader and CharLS; ``near>0``
+    (near-lossless, the DICOM .81 syntax) reconstructs within ±near of the
+    source, and all three decoders produce the IDENTICAL reconstruction
+    (pinned in tests/test_jpegls.py) — the encoder tracks the reconstructed
+    plane, not the source, exactly as T.87 requires.
 
     ``precision``: sample precision P (2-16); default derives the minimum
     from the data. DICOM callers must pass their BitsStored (PS3.5 A.4.3
@@ -966,14 +972,31 @@ def jpegls_encode(image: np.ndarray, precision: int | None = None) -> bytes:
             f"precision {precision} invalid or too small for max {vmax}"
         )
     maxval = (1 << precision) - 1
-    near = 0
+    if not 0 <= near <= min(255, maxval // 2):
+        raise ValueError(f"NEAR {near} outside [0, min(255, maxval//2)]")
 
     t1, t2, t3, reset = _jls_default_thresholds(maxval, near)
-    range_ = maxval + 1  # (maxval + 2*near) // (2*near + 1) + 1, near=0
+    quant_step = 2 * near + 1
+    range_ = (maxval + 2 * near) // quant_step + 1
+    range_step = range_ * quant_step
     qbpp = max(1, (range_ - 1).bit_length())
     bpp = max(2, maxval.bit_length())
     limit = 2 * (bpp + max(8, bpp))
     half_range = (range_ + 1) >> 1
+
+    def fix_reconstructed(v):
+        # wrap into [-NEAR, MAXVAL+NEAR] then clamp — the decoder's A.4.5
+        if v < -near:
+            v += range_step
+        elif v > maxval + near:
+            v -= range_step
+        return 0 if v < 0 else (maxval if v > maxval else v)
+
+    def quantize_err(e):
+        # A.4.4: quantize the prediction error to the near-lossless grid
+        if e > 0:
+            return (near + e) // quant_step
+        return -((near - e) // quant_step)
 
     # header: SOI, SOF55, SOS (defaults need no LSE)
     head = bytearray()
@@ -1034,13 +1057,14 @@ def jpegls_encode(image: np.ndarray, precision: int | None = None) -> bytes:
             w.put_bits(m - 1, qbpp)
 
     def encode_run_interruption(ritype, ix, ra, rb):
-        # T.87 A.7.2 (near=0)
+        # T.87 A.7.2; returns the RECONSTRUCTED sample value
         if ritype:
             err = ix - ra
+            sign = 1
         else:
-            err = ix - rb
-            if rb < ra:
-                err = -err
+            sign = -1 if rb < ra else 1
+            err = (ix - rb) * sign
+        err = quantize_err(err)
         if err < 0:
             err += range_
         if err >= half_range:
@@ -1069,6 +1093,9 @@ def jpegls_encode(image: np.ndarray, precision: int | None = None) -> bytes:
             rN[ritype] >>= 1
             rNn[ritype] >>= 1
         rN[ritype] += 1
+        if ritype:
+            return fix_reconstructed(ra + err * quant_step)
+        return fix_reconstructed(rb + sign * err * quant_step)
 
     src = img.astype(np.int32)
     prev = [0] * (cols + 2)
@@ -1076,10 +1103,10 @@ def jpegls_encode(image: np.ndarray, precision: int | None = None) -> bytes:
     for y in range(rows):
         prev[cols + 1] = prev[cols]
         cur[0] = prev[1]
-        line = src[y]
-        # lossless: the reconstruction IS the source; keep the same padded
-        # row structure as the decoder so the context math matches
-        cur[1 : cols + 1] = line.tolist()
+        line = src[y].tolist()
+        # `cur` holds the RECONSTRUCTED row, built incrementally — at
+        # near=0 it equals the source; at near>0 context modeling and run
+        # detection must see what the decoder will see
         x = 1
         while x <= cols:
             ra = cur[x - 1]
@@ -1093,7 +1120,11 @@ def jpegls_encode(image: np.ndarray, precision: int | None = None) -> bytes:
                 # ---- run mode (T.87 A.7.1) ----
                 remaining = cols - x + 1
                 run_len = 0
-                while run_len < remaining and cur[x + run_len] == ra:
+                while (
+                    run_len < remaining
+                    and abs(line[x + run_len - 1] - ra) <= near
+                ):
+                    cur[x + run_len] = ra  # run samples reconstruct to Ra
                     run_len += 1
                 hit_eol = run_len == remaining
                 count = run_len  # the segment loop consumes this copy
@@ -1115,8 +1146,8 @@ def jpegls_encode(image: np.ndarray, precision: int | None = None) -> bytes:
                 # run-interruption sample (the one that broke the run)
                 ra = cur[x - 1]
                 rb = prev[x]
-                ritype = 1 if ra == rb else 0
-                encode_run_interruption(ritype, cur[x], ra, rb)
+                ritype = 1 if abs(ra - rb) <= near else 0
+                cur[x] = encode_run_interruption(ritype, line[x - 1], ra, rb)
                 x += 1
                 if run_index > 0:
                     run_index -= 1
@@ -1137,9 +1168,10 @@ def jpegls_encode(image: np.ndarray, precision: int | None = None) -> bytes:
                 px = ra + rb - rc
             px += C[qi] if sign > 0 else -C[qi]
             px = 0 if px < 0 else (maxval if px > maxval else px)
-            err = cur[x] - px
+            err = line[x - 1] - px
             if sign < 0:
                 err = -err
+            err = quantize_err(err)
             # modulo reduction (A.4.5): the decoder's fix_reconstructed
             # undoes the wrap
             if err < 0:
@@ -1151,12 +1183,17 @@ def jpegls_encode(image: np.ndarray, precision: int | None = None) -> bytes:
             k = 0
             while (n << k) < a:
                 k += 1
-            # bias-inverted mapping is its own inverse (A.5.2/A.5.3)
-            e = (-err - 1) if (k == 0 and 2 * B[qi] <= -n) else err
+            # bias-inverted mapping is its own inverse (A.5.2/A.5.3);
+            # lossless-only, exactly like the decoder's condition
+            e = (
+                (-err - 1)
+                if (k == 0 and near == 0 and 2 * B[qi] <= -n)
+                else err
+            )
             m = 2 * e if e >= 0 else -2 * e - 1
             encode_value(m, k, limit)
             # context update with the REAL error — identical to the decoder
-            B[qi] += err  # err * quant_step, quant_step == 1
+            B[qi] += err * quant_step
             A[qi] += err if err >= 0 else -err
             if n == reset:
                 A[qi] >>= 1
@@ -1176,6 +1213,7 @@ def jpegls_encode(image: np.ndarray, precision: int | None = None) -> bytes:
                     B[qi] = 0
                 if C[qi] < 127:
                     C[qi] += 1
+            cur[x] = fix_reconstructed(px + sign * err * quant_step)
             x += 1
         prev, cur = cur, prev
     body = w.flush()

@@ -787,18 +787,25 @@ def write_dicom(
     rescale_slope: float = 1.0,
     rescale_intercept: float = 0.0,
     transfer_syntax: str = EXPLICIT_VR_LE,
+    jpegls_near: int = 2,
 ) -> None:
     """Write a monochrome uint16 slice as a Part-10 file.
 
     ``transfer_syntax`` may be EXPLICIT_VR_LE (native pixels), RLE_LOSSLESS,
     JPEG_LOSSLESS_SV1 or JPEG_LS_LOSSLESS (encapsulated, bit-exact round
     trip through data/codecs.py — the importer-parity test data for the
-    compressed envelope)."""
+    compressed envelope), or JPEG_LS_NEAR (near-lossless: stored values
+    reconstruct within ±``jpegls_near`` of the input, identically in every
+    conformant decoder)."""
     if pixels.ndim != 2:
         raise ValueError(f"expected 2D pixels, got {pixels.shape}")
     if transfer_syntax not in (EXPLICIT_VR_LE, RLE_LOSSLESS,
-                               JPEG_LOSSLESS_SV1, JPEG_LS_LOSSLESS):
+                               JPEG_LOSSLESS_SV1, JPEG_LS_LOSSLESS,
+                               JPEG_LS_NEAR):
         raise ValueError(f"writer does not support transfer syntax {transfer_syntax}")
+    if transfer_syntax == JPEG_LS_NEAR and jpegls_near < 1:
+        raise ValueError("JPEG_LS_NEAR requires jpegls_near >= 1 (use "
+                         "JPEG_LS_LOSSLESS for exact storage)")
     data = np.ascontiguousarray(pixels.astype("<u2"))
     rows, cols = data.shape
 
@@ -827,16 +834,17 @@ def write_dicom(
             + struct.pack("<I", 0xFFFFFFFF)
             + _encapsulate(codecs.jpeg_lossless_encode(data))
         )
-    elif transfer_syntax == JPEG_LS_LOSSLESS:
+    elif transfer_syntax in (JPEG_LS_LOSSLESS, JPEG_LS_NEAR):
         from nm03_capstone_project_tpu.data import codecs
 
+        near = jpegls_near if transfer_syntax == JPEG_LS_NEAR else 0
         pix_elem = (
             struct.pack("<HH", 0x7FE0, 0x0010)
             + b"OB\x00\x00"
             + struct.pack("<I", 0xFFFFFFFF)
             # precision pinned to BitsStored=16 (PS3.5 A.4.3: codestream
             # precision must match the dataset's Bits Stored)
-            + _encapsulate(codecs.jpegls_encode(data, precision=16))
+            + _encapsulate(codecs.jpegls_encode(data, precision=16, near=near))
         )
     else:
         pix_elem = _element(0x7FE0, 0x0010, b"OW", data.tobytes())
@@ -857,6 +865,15 @@ def write_dicom(
             _element(0x0028, 0x0103, b"US", struct.pack("<H", 0)),
             _element(0x0028, 0x1052, b"DS", f"{rescale_intercept:g}".encode()),
             _element(0x0028, 0x1053, b"DS", f"{rescale_slope:g}".encode()),
+            # near-lossless storage is LOSSY: PS3.3 C.7.6.1.1.5 mandates
+            # declaring it, or a later transcode to a lossless syntax would
+            # launder the ±near error into data claimed exact
+            (
+                _element(0x0028, 0x2110, b"CS", b"01")
+                + _element(0x0028, 0x2114, b"CS", b"ISO_14495_1 ")
+                if transfer_syntax == JPEG_LS_NEAR
+                else b""
+            ),
             pix_elem,
         ]
     )

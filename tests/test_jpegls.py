@@ -412,3 +412,64 @@ class TestEncoder:
             np.testing.assert_array_equal(
                 native.read_dicom_native(p).astype(np.uint16), img
             )
+
+
+class TestNearLosslessEncoder:
+    """The .81 syntax's encoder half (round 5): near>0 streams whose
+    reconstruction is within ±near of the source and BIT-IDENTICAL across
+    our decoder, the native reader and CharLS."""
+
+    def test_three_way_reconstruction_identity(self, rng):
+        import charls_ref
+
+        from nm03_capstone_project_tpu.data.codecs import jpegls_encode
+
+        if not charls_ref.available():
+            pytest.skip("libcharls unavailable")
+        for t in range(15):
+            h, w = int(rng.integers(1, 40)), int(rng.integers(1, 40))
+            near = int(rng.integers(1, 6))
+            img = rng.integers(0, 4096, (h, w)).astype(np.uint16)
+            enc = jpegls_encode(img, near=near)
+            ours = jpegls_decode(enc)
+            theirs = charls_ref.decode(enc).astype(np.uint16).reshape(img.shape)
+            np.testing.assert_array_equal(ours, theirs)
+            assert (
+                np.abs(ours.astype(np.int64) - img.astype(np.int64)).max()
+                <= near
+            )
+
+    def test_write_dicom_near_syntax_round_trips_both_readers(
+        self, tmp_path, rng
+    ):
+        from nm03_capstone_project_tpu import native
+        from nm03_capstone_project_tpu.data.dicomlite import (
+            JPEG_LS_NEAR,
+            read_dicom,
+            write_dicom,
+        )
+
+        img = rng.integers(0, 4000, (25, 31)).astype(np.uint16)
+        p = tmp_path / "near.dcm"
+        write_dicom(p, img, transfer_syntax=JPEG_LS_NEAR, jpegls_near=3)
+        s = read_dicom(p)
+        # lossy storage must declare itself (PS3.3 C.7.6.1.1.5)
+        assert s.meta_str((0x0028, 0x2110)) == "01"
+        got = s.pixels.astype(np.int64)
+        assert np.abs(got - img.astype(np.int64)).max() <= 3
+        if native.available():
+            nat = native.read_dicom_native(p).astype(np.int64)
+            np.testing.assert_array_equal(nat, got)  # identical reconstruction
+
+    def test_near_zero_requires_lossless_syntax(self, tmp_path, rng):
+        from nm03_capstone_project_tpu.data.dicomlite import (
+            JPEG_LS_NEAR,
+            write_dicom,
+        )
+
+        img = rng.integers(0, 100, (8, 8)).astype(np.uint16)
+        with pytest.raises(ValueError, match="JPEG_LS_LOSSLESS"):
+            write_dicom(
+                tmp_path / "x.dcm", img,
+                transfer_syntax=JPEG_LS_NEAR, jpegls_near=0,
+            )
