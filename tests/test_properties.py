@@ -204,3 +204,22 @@ def test_jpegls_round_trip_any_content(data, hw, kind):
         img = (np.outer(np.arange(h), np.arange(w)) % 65_536).astype(np.uint16)
     dec = codecs.jpegls_decode(codecs.jpegls_encode(img))
     np.testing.assert_array_equal(dec, img)
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    data=st.data(),
+    hw=st.tuples(st.integers(1, 32), st.integers(1, 32)),
+    near=st.integers(1, 7),
+)
+def test_jpegls_near_lossless_bound_holds(data, hw, near):
+    """near>0 encode: every reconstructed sample within ±near of the
+    source, for arbitrary content (T.87's near-lossless guarantee)."""
+    from nm03_capstone_project_tpu.data import codecs
+
+    h, w = hw
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31 - 1)))
+    img = rng.integers(0, 65_536, (h, w), dtype=np.uint16)
+    dec = codecs.jpegls_decode(codecs.jpegls_encode(img, near=near))
+    err = np.abs(dec.astype(np.int64) - img.astype(np.int64))
+    assert int(err.max()) <= near
