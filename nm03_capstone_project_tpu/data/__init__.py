@@ -4,6 +4,7 @@ from nm03_capstone_project_tpu.data.dicomlite import (  # noqa: F401
     DicomParseError,
     DicomSlice,
     read_dicom,
+    read_dicom_frames,
     write_dicom,
 )
 from nm03_capstone_project_tpu.data.imageio import (  # noqa: F401
