@@ -58,6 +58,7 @@ All progress chatter goes to stderr; stdout carries only the JSON line
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import signal
@@ -116,6 +117,24 @@ MIN_ACCEL_REDUCED_S = 150.0
 MIN_CPU_ATTEMPT_S = 60.0
 
 _SENTINEL = "@@BENCH_RESULT@@"
+
+# Observability (--metrics-out / --log-json): the orchestrator's RunContext.
+# Module-level because the SIGTERM/SIGALRM emit path shares it with main();
+# the obs package is deliberately jax-free, so wiring it here keeps the
+# orchestrator's never-imports-jax invariant intact.
+_OBS_CTX = None
+
+
+def _obs_event(event: str, **fields) -> None:
+    if _OBS_CTX is not None:
+        with contextlib.suppress(Exception):  # telemetry never costs a record
+            _OBS_CTX.events.emit(event, **fields)
+
+
+def _obs_span(name: str):
+    if _OBS_CTX is not None:
+        return _OBS_CTX.spans.span(name)
+    return contextlib.nullcontext()
 
 # Qualitative bound per stage, justified by the measured ms next to it:
 # elementwise/render stream HBM with trivial FLOPs/byte (memory-bound on the
@@ -1350,6 +1369,16 @@ def _emit_final(state) -> None:
     script mode stderr is then parked on /dev/null so no late chatter can
     land after the record.
     """
+    if _OBS_CTX is not None:
+        # the banked record embeds the metrics snapshot (phase latency
+        # histograms, phase counters) next to the measured numbers; the
+        # slim stdout line sheds it under size pressure like any optional
+        # section. close() also writes --metrics-out / run_finished.
+        with contextlib.suppress(Exception):
+            state["meta"]["metrics"] = _OBS_CTX.metrics_snapshot()
+            _OBS_CTX.close(
+                status="ok" if state.get("accel") or state.get("cpu") else "error"
+            )
     _bank_partial(state)  # the on-disk copy carries the full diagnostics
     record = _compose(state["accel"], state["cpu"], state["meta"])
     line = json.dumps(_slim_record(record))
@@ -1366,7 +1395,7 @@ def _emit_final(state) -> None:
             pass
 
 
-def main() -> None:
+def main(metrics_out: str | None = None, log_json: str | None = None) -> None:
     # Flow (VERDICT r2 item 1): quick accel probe round; on success, one
     # long-timeout accel attempt. If the tunnel is wedged (or the attempt
     # lost), bank the tunnel-independent CPU baseline IMMEDIATELY, then keep
@@ -1380,6 +1409,18 @@ def main() -> None:
     t0 = time.monotonic()
     budget_s = float(os.environ.get(VIGIL_BUDGET_ENV, VIGIL_BUDGET_DEFAULT_S))
     deadline = t0 + budget_s
+    global _OBS_CTX
+    if _OBS_CTX is None and (metrics_out or log_json):
+        from nm03_capstone_project_tpu.obs import RunContext
+
+        # heartbeat keeps the event stream alive through the (silent) wedge
+        # vigil, so a tail -f can tell "waiting on the tunnel" from "hung"
+        _OBS_CTX = RunContext.create(
+            "bench",
+            metrics_out=metrics_out,
+            log_json=log_json,
+            heartbeat_s=60.0,
+        )
     _PROBE_HISTORY.clear()
     try:
         # a stale banked record from a previous run must not masquerade as
@@ -1440,7 +1481,9 @@ def main() -> None:
     # state is the single source of truth for what has been measured — the
     # SIGTERM handler and the banked on-disk record both read it
     if _probe_until_healthy({}, "accel", t0, deadline):
-        state["accel"] = _measure_accel(deadline)
+        _obs_event("bench_phase", phase="accel_attempt")
+        with _obs_span("accel"):
+            state["accel"] = _measure_accel(deadline)
         # bank before the CPU baseline: a kill during that phase must not
         # cost the already-measured accelerator record
         _bank_partial(state)
@@ -1456,10 +1499,12 @@ def main() -> None:
         # accepted: they are bounded (no tunnel involvement, nothing to
         # hang on) and a wedged round's record is exactly where the
         # diagnostics matter most.
-        state["cpu"] = _measure_cpu(
-            ["--batches", ",".join(str(b) for b in ACCEL_BATCH_SWEEP),
-             "--stages", "--volume"]
-        )
+        _obs_event("bench_phase", phase="cpu_baseline", accel_lost=True)
+        with _obs_span("cpu_baseline"):
+            state["cpu"] = _measure_cpu(
+                ["--batches", ",".join(str(b) for b in ACCEL_BATCH_SWEEP),
+                 "--stages", "--volume"]
+            )
         # bank the best-so-far record to a file before entering the vigil:
         # stdout still carries exactly ONE line at the end, but if an
         # external supervisor hard-kills (SIGKILL) mid-vigil — which no
@@ -1468,18 +1513,25 @@ def main() -> None:
         # now spend whatever budget remains waiting for the tunnel; a late
         # recovery gets a deadline-capped (possibly shed) attempt with no
         # CPU reserve — the baseline above is the only cpu work this path does
+        _obs_event("bench_phase", phase="vigil")
         if _accel_vigil({}, t0, deadline):
-            state["accel"] = _measure_accel(deadline, cpu_banked=True)
+            _obs_event("bench_phase", phase="accel_attempt", late_recovery=True)
+            with _obs_span("accel"):
+                state["accel"] = _measure_accel(deadline, cpu_banked=True)
             _bank_partial(state)
     elif state["accel"]["backend"] != "cpu":
         # accel record in hand: CPU baseline at exactly the winning batch
-        state["cpu"] = _measure_cpu(
-            ["--batches", str(state["accel"].get("xla_batch", BATCH))]
-        )
+        _obs_event("bench_phase", phase="cpu_baseline")
+        with _obs_span("cpu_baseline"):
+            state["cpu"] = _measure_cpu(
+                ["--batches", str(state["accel"].get("xla_batch", BATCH))]
+            )
 
     # z-shard scaling curve: tunnel-independent (virtual CPU mesh), cheap,
     # and the 3D path's only multi-device perf signal (VERDICT r3 item 5)
-    z = _measure_zshard(deadline)
+    _obs_event("bench_phase", phase="zshard_scaling")
+    with _obs_span("zshard_scaling"):
+        z = _measure_zshard(deadline)
     if z is not None:
         state["meta"]["zshard_scaling"] = z
 
@@ -1508,6 +1560,17 @@ if __name__ == "__main__":
     parser.add_argument("--scan", action="store_true")
     parser.add_argument("--out", default=None)
     parser.add_argument("--batches", default=str(BATCH), help="comma list to sweep")
+    parser.add_argument(
+        "--metrics-out", default=None,
+        help="write the orchestrator's metrics snapshot here "
+        "(schema nm03.metrics.v1, docs/OBSERVABILITY.md)",
+    )
+    parser.add_argument(
+        "--log-json", default=None,
+        help="write structured orchestrator events here (bench phases, "
+        "60 s heartbeat through the vigil; schema nm03.events.v1; one run "
+        "per file — truncated at start)",
+    )
     ns = parser.parse_args()
     _AS_SCRIPT = True
     if ns.probe:
@@ -1526,4 +1589,4 @@ if __name__ == "__main__":
             want_scan=ns.scan,
         )
     else:
-        main()
+        main(metrics_out=ns.metrics_out, log_json=ns.log_json)

@@ -69,6 +69,64 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
         help="capture a jax.profiler trace here (view with tensorboard or "
         "Perfetto; in-tree replacement for the reference's perf/Hotspot use)",
     )
+    add_observability_args(parser)
+
+
+def add_observability_args(parser: argparse.ArgumentParser) -> None:
+    """--metrics-out / --log-json / --heartbeat-s (docs/OBSERVABILITY.md).
+
+    Shared by every driver INCLUDING the ones that skip add_common_args
+    (train, bench), so the telemetry surface is uniform across entry points.
+    """
+    g = parser.add_argument_group(
+        "observability", "structured run telemetry (docs/OBSERVABILITY.md)"
+    )
+    g.add_argument(
+        "--metrics-out",
+        default=None,
+        metavar="JSON",
+        help="write the run's metrics snapshot here (counters, gauges, "
+        "per-stage latency histograms; schema nm03.metrics.v1)",
+    )
+    g.add_argument(
+        "--log-json",
+        default=None,
+        metavar="JSONL",
+        help="write structured JSON-lines events here (run id + git SHA on "
+        "every record, one terminal outcome event per patient; schema "
+        "nm03.events.v1; one run per file — truncated at start)",
+    )
+    g.add_argument(
+        "--heartbeat-s",
+        type=float,
+        default=30.0,
+        metavar="SEC",
+        help="heartbeat event period for --log-json streams (uptime + live "
+        "counter totals; 0 disables)",
+    )
+
+
+def make_run_context(
+    args: argparse.Namespace, driver: str, rank: int = 0, argv=None
+):
+    """The driver's RunContext from its parsed flags.
+
+    Only rank 0 gets the file sinks: in a multi-process job every rank would
+    otherwise append to the same ``--log-json`` path (interleaved streams
+    fail the one-run_id-per-stream schema), so the artifacts describe rank
+    0's shard and the collective summary it prints. Non-zero ranks still
+    accumulate metrics in memory for their own results reporting.
+    """
+    from nm03_capstone_project_tpu.obs import RunContext
+
+    sink = rank == 0
+    return RunContext.create(
+        driver,
+        metrics_out=getattr(args, "metrics_out", None) if sink else None,
+        log_json=getattr(args, "log_json", None) if sink else None,
+        heartbeat_s=getattr(args, "heartbeat_s", 0.0) or 0.0,
+        argv=argv,
+    )
 
 
 def add_pipeline_args(parser: argparse.ArgumentParser) -> None:

@@ -7,6 +7,11 @@ shared Qt render target; here the batch is a vmapped leading axis of ONE
 compiled XLA program (decode on an IO thread pool, JPEG encode overlapped
 with the next batch's device compute) — same contract, no threads to guard,
 bit-identical to the sequential driver by construction.
+
+Observability (``--metrics-out`` / ``--log-json`` / ``--heartbeat-s``,
+docs/OBSERVABILITY.md) rides through the shared :func:`sequential.run`:
+outcome counters fire from the IO-pool threads (the registry is
+thread-safe) and every patient gets one terminal ``patient_outcome`` event.
 """
 
 from __future__ import annotations

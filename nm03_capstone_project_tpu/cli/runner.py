@@ -50,8 +50,8 @@ from nm03_capstone_project_tpu.utils.manifest import (
     STATUS_TRUNCATED,
     Manifest,
 )
+from nm03_capstone_project_tpu.obs import RunContext
 from nm03_capstone_project_tpu.utils.reporter import get_logger
-from nm03_capstone_project_tpu.utils.timing import Timer
 
 log = get_logger("runner")
 
@@ -246,6 +246,7 @@ class CohortProcessor:
         process_count: int = 1,
         model_params=None,
         mask_sink=None,
+        obs: RunContext = None,
     ):
         if mode not in ("sequential", "parallel"):
             raise ValueError(f"unknown mode: {mode}")
@@ -274,7 +275,14 @@ class CohortProcessor:
         # thread-safe.
         self.mask_sink = mask_sink
         self._student_fns: dict = {}
-        self.timer = Timer()
+        # observability: drivers pass their flag-configured RunContext; a
+        # library caller gets a sink-less one (metrics/events accumulate in
+        # memory, nothing touches disk). `timer` IS the context's span
+        # recorder, so every section also feeds the per-stage latency
+        # histograms. Counters fire from IO-pool threads in parallel mode;
+        # the registry is thread-safe by design.
+        self.obs = obs if obs is not None else RunContext.create(driver=mode)
+        self.timer = self.obs.spans
         self.out_root.mkdir(parents=True, exist_ok=True)
         manifest_name = (
             "manifest.json"
@@ -388,6 +396,19 @@ class CohortProcessor:
                 "cap; masks under-cover (raise --grow-max-iters): %s",
                 patient_id, len(truncated), ", ".join(truncated[:8]),
             )
+            # structured surfacing of grow_converged=False: WARNING event +
+            # pipeline_grow_truncated_total counter, not just a log line.
+            # Guarded: a telemetry failure here would otherwise mark a
+            # fully-exported patient as failed (sink I/O errors are already
+            # contained in EventLog, but the run's results take no chances)
+            try:
+                self.obs.grow_truncated(
+                    patient_id, count=len(truncated), slices=truncated[:16]
+                )
+            except Exception as e:  # noqa: BLE001 — telemetry never costs a run
+                log.warning(
+                    "patient %s: truncation telemetry failed: %s", patient_id, e
+                )
         self.manifest.flush()
         print(
             f"\nPatient {patient_id} completed. Successfully processed "
@@ -823,6 +844,16 @@ class CohortProcessor:
 
     # -- cohort loop -------------------------------------------------------
 
+    def _emit_outcome(self, pid: str, status: str, **fields) -> None:
+        """Terminal patient telemetry; never raises into the cohort loop
+        (a duplicate pid from a pathological listing, or any emit failure,
+        is logged — telemetry must not alter the run's actual results)."""
+        try:
+            if not self.obs.has_outcome(pid):
+                self.obs.patient_outcome(pid, status, **fields)
+        except Exception as e:  # noqa: BLE001 — telemetry never costs a run
+            log.warning("patient %s: outcome telemetry failed: %s", pid, e)
+
     def process_all_patients(self) -> RunSummary:
         mode_name = self.mode.capitalize()
         print(f"\n=== Starting {mode_name} Processing for All Patients ===\n")
@@ -838,11 +869,24 @@ class CohortProcessor:
         for pid in patients:
             try:
                 result = self.process_patient(pid)
-                summary.patients.append(result)
-                summary.patients_ok += 1
             except Exception as e:  # noqa: BLE001 - reference: move to next patient
                 log.warning("failed to process patient %s: %s", pid, e)
                 summary.patients.append(PatientResult(pid, 0, 0))
+                self._emit_outcome(pid, "failed", error_class=type(e).__name__)
+                continue
+            summary.patients.append(result)
+            summary.patients_ok += 1
+            # the ONE terminal telemetry record of this patient's run —
+            # OUTSIDE the containment try: a telemetry failure must never
+            # double-count the patient in the cohort summary
+            self._emit_outcome(
+                pid,
+                "ok",
+                slices_total=result.total,
+                slices_ok=result.succeeded,
+                slices_failed=len(result.failed_slices),
+                slices_truncated=len(result.truncated_slices),
+            )
         print("\n=== All Processing Completed ===\n")
         print(
             f"Successfully processed {summary.patients_ok}/{len(patients)} patients."

@@ -59,8 +59,10 @@ def run(args: argparse.Namespace, mode: str) -> int:
     )
     from nm03_capstone_project_tpu.utils.profiling import profile_trace
 
+    run_ctx = None
     try:
         rank, world = common.init_distributed(args)
+        run_ctx = common.make_run_context(args, mode, rank=rank)
         base = common.resolve_base_path_sync(
             args, rank, world, tmp_root=Path(args.output)
         )
@@ -75,6 +77,7 @@ def run(args: argparse.Namespace, mode: str) -> int:
             process_rank=rank,
             process_count=world,
             model_params=model_params,
+            obs=run_ctx,
         )
         import time
 
@@ -106,6 +109,9 @@ def run(args: argparse.Namespace, mode: str) -> int:
                     f"across {world} processes."
                 )
 
+        run_ctx.registry.gauge(
+            "nm03_run_wall_seconds", help="end-to-end driver wall clock"
+        ).set(wall_s)
         if args.results_json and rank == 0:
             import jax
 
@@ -118,14 +124,21 @@ def run(args: argparse.Namespace, mode: str) -> int:
                 # export wait, so per-section times don't partition it
                 "wall_s": round(wall_s, 3),
                 "timing_s": proc.timer.report(),
+                # the full observability snapshot rides in the results JSON
+                # too, so one artifact carries outcome counters + stage
+                # latency distributions next to the wall-clock headline
+                "metrics": run_ctx.metrics_snapshot(),
             }
             if cluster is not None:
                 record["cluster"] = cluster  # rank 0's summary/timing above
                 record["process_count"] = world
             write_results_json(args.results_json, record)
+        run_ctx.close(status="ok", wall_s=round(wall_s, 3))
         return 0
     except Exception as e:  # noqa: BLE001 - reference: fatal-error catch in main
         print(f"Fatal error: {e}", file=sys.stderr)
+        if run_ctx is not None:
+            run_ctx.close(status="error", error_class=type(e).__name__)
         return 1
 
 

@@ -50,6 +50,7 @@ def build_parser() -> argparse.ArgumentParser:
         "--profile-dir", default=None,
         help="capture a jax.profiler trace of the training loop here",
     )
+    common.add_observability_args(p)
     common.add_pipeline_args(p)
     common.add_distributed_args(
         p,
@@ -167,6 +168,21 @@ def _load_cohort_volumes(args, cfg, rank=0, world=1):
 
 
 def run(args: argparse.Namespace) -> int:
+    from nm03_capstone_project_tpu.utils.reporter import configure_reporting
+
+    configure_reporting(verbose=args.verbose)
+    rank, world = common.init_distributed(args)
+    run_ctx = common.make_run_context(args, "train", rank=rank)
+    try:
+        rc = _train(args, rank, world, run_ctx)
+        run_ctx.close(status="ok" if rc == 0 else "error")
+        return rc
+    except BaseException as e:  # SystemExit validation paths included
+        run_ctx.close(status="error", error_class=type(e).__name__)
+        raise
+
+
+def _train(args: argparse.Namespace, rank: int, world: int, run_ctx) -> int:
     import numpy as np
 
     import jax
@@ -184,14 +200,12 @@ def run(args: argparse.Namespace) -> int:
         prepare_student_inputs,
     )
     from nm03_capstone_project_tpu.models.checkpoint import load_params, save_params
-    from nm03_capstone_project_tpu.utils.reporter import configure_reporting
     from nm03_capstone_project_tpu.utils.timing import write_results_json
 
     from nm03_capstone_project_tpu.core.image import valid_mask
     from nm03_capstone_project_tpu.utils.profiling import profile_trace
 
-    configure_reporting(verbose=args.verbose)
-    rank, world = common.init_distributed(args)
+    spans = run_ctx.spans
     common.enable_compile_cache()
     cfg = common.pipeline_config_from_args(args)
     if world > 1 and args.model_3d:
@@ -224,7 +238,8 @@ def run(args: argparse.Namespace) -> int:
         params = init_unet(jax.random.PRNGKey(args.seed), base=args.base_channels)
 
     if args.model_3d:
-        volumes, dims = _load_cohort_volumes(args, cfg, rank, world)
+        with spans.span("load_cohort"):
+            volumes, dims = _load_cohort_volumes(args, cfg, rank, world)
         print(
             f"cohort: {volumes.shape[0]} volumes of {args.volume_depth} x "
             f"{cfg.canvas}x{cfg.canvas}"
@@ -233,11 +248,13 @@ def run(args: argparse.Namespace) -> int:
         dm = jnp.asarray(dims)
         print("distilling teacher labels (volumetric pipeline)...")
         # per-volume teacher: 6-connected 3D growing + 3D morphology
-        labels = jnp.stack(
-            [distill_volume(v, d, cfg) for v, d in zip(px, dm)]
-        )
+        with spans.span("distill"):
+            labels = jnp.stack(
+                [distill_volume(v, d, cfg) for v, d in zip(px, dm)]
+            )
     else:
-        pixels, dims = _load_cohort(args, cfg, rank, world)
+        with spans.span("load_cohort"):
+            pixels, dims = _load_cohort(args, cfg, rank, world)
         print(f"cohort: {pixels.shape[0]} slices at {cfg.canvas}x{cfg.canvas}")
         if world > 1:
             # every rank loaded the identical cohort, so this check is
@@ -255,14 +272,15 @@ def run(args: argparse.Namespace) -> int:
         px = jnp.asarray(pixels)
         dm = jnp.asarray(dims)
         print("distilling teacher labels (classical pipeline)...")
-        labels = distill_batch(px, dm, cfg)
+        with spans.span("distill"):
+            labels = distill_batch(px, dm, cfg)
     x = prepare_student_inputs(px, cfg)
 
     apply_fn = apply_unet3d if args.model_3d else None  # None = 2D default
     losses = []
     if not args.eval_only:
         n_dev = len(jax.devices())
-        with profile_trace(args.profile_dir):
+        with profile_trace(args.profile_dir), spans.span("train"):
             if world > 1:
                 # multi-host data parallelism: every host contributes its
                 # local shard to one global batch; gradients psum over the
@@ -348,6 +366,16 @@ def run(args: argparse.Namespace) -> int:
     unit = "volumes" if args.model_3d else "slices"
     if rank == 0:
         print(f"student-vs-teacher IoU over {n_scored} {unit}: {iou:.3f}")
+    run_ctx.registry.gauge(
+        "nm03_train_iou_vs_teacher", help="student-vs-teacher IoU"
+    ).set(iou)
+    if losses:
+        run_ctx.registry.gauge(
+            "nm03_train_final_loss", help="last training-step loss"
+        ).set(float(losses[-1]))
+    run_ctx.events.emit(
+        "train_scored", iou_vs_teacher=iou, n_scored=n_scored, unit=unit
+    )
 
     ckpt = Path(args.output) / "checkpoint"
     if not args.eval_only:
@@ -391,6 +419,7 @@ def run(args: argparse.Namespace) -> int:
                 "steps": 0 if args.eval_only else args.steps,
                 "final_loss": losses[-1] if losses else None,
                 "iou_vs_teacher": iou,
+                "metrics": run_ctx.metrics_snapshot(),
             },
         )
     return 0

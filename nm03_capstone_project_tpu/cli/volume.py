@@ -228,6 +228,25 @@ def _compiled_render_fn(cfg):
 
 
 def run(args: argparse.Namespace) -> int:
+    from nm03_capstone_project_tpu.utils.reporter import configure_reporting
+
+    configure_reporting(verbose=args.verbose)
+    common.enable_compile_cache()
+    common.apply_native_flag(args)
+    cfg = common.pipeline_config_from_args(args)
+    rank, world = common.init_distributed(args)
+    run_ctx = common.make_run_context(args, "volume", rank=rank)
+    try:
+        return _run_inner(args, cfg, rank, world, run_ctx)
+    except Exception as e:
+        run_ctx.close(status="error", error_class=type(e).__name__)
+        raise
+
+
+def _run_inner(args, cfg, rank, world, run_ctx) -> int:
+    """The volume cohort loop, observability-wired (run_ctx owns the spans,
+    per-patient outcome events, and truncation counter; ``run`` closes the
+    context on the fatal-error path, this function on success)."""
     import numpy as np
 
     import jax
@@ -242,14 +261,8 @@ def run(args: argparse.Namespace) -> int:
         Manifest,
     )
     from nm03_capstone_project_tpu.utils.profiling import profile_trace
-    from nm03_capstone_project_tpu.utils.reporter import configure_reporting
-    from nm03_capstone_project_tpu.utils.timing import Timer, write_results_json
+    from nm03_capstone_project_tpu.utils.timing import write_results_json
 
-    configure_reporting(verbose=args.verbose)
-    common.enable_compile_cache()
-    common.apply_native_flag(args)
-    cfg = common.pipeline_config_from_args(args)
-    rank, world = common.init_distributed(args)
     base = common.resolve_base_path_sync(args, rank, world, tmp_root=Path(args.output))
     out_root = Path(args.output)
     model_params = common.load_model_checkpoint(args, cfg, want_3d=True)
@@ -314,7 +327,22 @@ def run(args: argparse.Namespace) -> int:
             f"{'global' if global_zshard else 'local'} devices"
         )
 
-    timer = Timer()
+    # the context's span recorder: same report() the results JSON always
+    # carried, now also feeding stage latency histograms (stage label =
+    # first path component, so per-patient keys stay bounded-cardinality)
+    timer = run_ctx.spans
+
+    def emit_outcome(pid, status, **fields):
+        """Guarded terminal telemetry (runner._emit_outcome's contract): a
+        telemetry failure must never reclassify or fail a patient."""
+        try:
+            if not run_ctx.has_outcome(pid):
+                run_ctx.patient_outcome(pid, status, **fields)
+        except Exception as e:  # noqa: BLE001 — telemetry never costs a run
+            print(
+                f"warning: patient {pid}: outcome telemetry failed: {e}",
+                file=sys.stderr,
+            )
     patients = find_patient_dirs(base)
     if patient_sharded:
         patients = common.shard_patients(patients, rank, world)
@@ -382,6 +410,7 @@ def run(args: argparse.Namespace) -> int:
                 if skip:
                     print(f"Patient {pid}: already complete, skipping")
                     ok_patients += 1
+                    emit_outcome(pid, "ok", skipped=True)
                     continue
 
                 load_error = None
@@ -474,6 +503,17 @@ def run(args: argparse.Namespace) -> int:
                         "(raise --grow-max-iters)",
                         file=sys.stderr,
                     )
+                    # grow_converged=False surfaced structurally, not just on
+                    # stderr: WARNING event + pipeline_grow_truncated_total
+                    # (count=1: the whole volume's fixpoint truncated)
+                    try:
+                        run_ctx.grow_truncated(pid, count=1, scope="volume")
+                    except Exception as e:  # noqa: BLE001
+                        print(
+                            f"warning: patient {pid}: truncation telemetry "
+                            f"failed: {e}",
+                            file=sys.stderr,
+                        )
                 if not i_export:
                     # global z-shard, rank != 0: compute was cooperative but
                     # rank 0 owns the export/manifest. Learn its outcome
@@ -489,6 +529,13 @@ def run(args: argparse.Namespace) -> int:
                             f"Patient {pid}: export failed on the exporting rank",
                             file=sys.stderr,
                         )
+                    emit_outcome(
+                        pid,
+                        "ok" if export_ok else "failed",
+                        slices_total=depth,
+                        grow_truncated=pid in truncated_patients,
+                        error_class=None if export_ok else "RemoteExportError",
+                    )
                     continue
                 export_error, missing = None, []
                 try:
@@ -566,15 +613,30 @@ def run(args: argparse.Namespace) -> int:
                     )
                 else:
                     ok_patients += 1
+                # results first, telemetry second: the run's own artifacts
+                # must be complete before (and regardless of) any outcome
+                # emission
                 results[pid] = {
                     "slices": depth,
                     "exported": len(done),
                     "mask_voxels": int(mask.sum()),
                     "grow_truncated": pid in truncated_patients,
                 }
+                emit_outcome(
+                    pid,
+                    "ok" if not missing else "failed",
+                    slices_total=depth + len(skipped),
+                    slices_ok=len(done),
+                    slices_failed=len(missing) + len(skipped),
+                    slices_truncated=(
+                        len(done) if pid in truncated_patients else 0
+                    ),
+                    grow_truncated=pid in truncated_patients,
+                )
                 print(f"Patient {pid}: {depth} slices, mask {int(mask.sum())} voxels")
             except Exception as e:  # noqa: BLE001 - per-patient containment
                 print(f"Patient {pid} failed: {e}", file=sys.stderr)
+                emit_outcome(pid, "failed", error_class=type(e).__name__)
     print("\n=== All Processing Completed ===\n")
     print(f"Successfully processed {ok_patients}/{len(patients)} patients.")
     cluster = None
@@ -589,8 +651,6 @@ def run(args: argparse.Namespace) -> int:
                 f"{cluster['patients_total']} patients across {world} processes."
             )
     if args.results_json and rank == 0:
-        import jax
-
         record = {
             "mode": "volume",
             "grow_truncated_patients": truncated_patients,
@@ -599,12 +659,15 @@ def run(args: argparse.Namespace) -> int:
             "z_global": bool(global_zshard),
             "patients": results,
             "timings_s": timer.report(),
+            "metrics": run_ctx.metrics_snapshot(),
         }
         if cluster is not None:
             record["cluster"] = cluster
             record["process_count"] = world
         write_results_json(args.results_json, record)
-    return 0 if ok_patients == len(patients) else 1
+    all_ok = ok_patients == len(patients)
+    run_ctx.close(status="ok" if all_ok else "error")
+    return 0 if all_ok else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,47 @@
+"""Observability subsystem: metrics, spans, and structured run telemetry.
+
+Unifies (and supersedes) the scattered timing/profiling/logging fragments:
+
+* :mod:`~nm03_capstone_project_tpu.obs.metrics` — a thread-safe registry of
+  counters, gauges, and bucketed histograms, snapshot-able to JSON and to
+  the Prometheus text exposition format;
+* :mod:`~nm03_capstone_project_tpu.obs.spans` — nested named sections with
+  device sync, ``jax.profiler`` trace annotations, and per-stage latency
+  histograms (absorbing ``utils.timing.Timer``, which is now an alias);
+* :mod:`~nm03_capstone_project_tpu.obs.events` — a JSON-lines event log
+  where every record carries the run id, git SHA, sequence number, and
+  wall + monotonic timestamps, plus the heartbeat thread and the bridge
+  that mirrors package-logger warnings into the stream;
+* :mod:`~nm03_capstone_project_tpu.obs.run` — :class:`RunContext`, the
+  driver-facing facade that owns the per-patient outcome protocol.
+
+Schemas and metric names are documented in docs/OBSERVABILITY.md and
+validated by scripts/check_telemetry.py.
+"""
+
+from nm03_capstone_project_tpu.obs.events import (  # noqa: F401
+    LEVELS,
+    SCHEMA_EVENTS,
+    EventLog,
+    Heartbeat,
+    LogBridge,
+    new_run_id,
+)
+from nm03_capstone_project_tpu.obs.metrics import (  # noqa: F401
+    DEFAULT_LATENCY_BUCKETS,
+    SCHEMA_METRICS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from nm03_capstone_project_tpu.obs.run import (  # noqa: F401
+    GROW_TRUNCATED_TOTAL,
+    PATIENT_OUTCOMES_TOTAL,
+    SLICES_TOTAL,
+    RunContext,
+)
+from nm03_capstone_project_tpu.obs.spans import (  # noqa: F401
+    STAGE_LATENCY_METRIC,
+    SpanRecorder,
+)

@@ -1,0 +1,255 @@
+"""RunContext: the one object a driver wires observability through.
+
+Ties together the metrics registry, the span recorder, and the structured
+event log for one run, and owns the per-patient outcome protocol:
+
+* exactly ONE terminal ``patient_outcome`` event per patient (a second
+  emission for the same patient is a programming error and raises);
+* ``grow_truncated`` WARNING events + the ``pipeline_grow_truncated_total``
+  counter for patients whose region-growing fixpoint hit its iteration cap
+  (the ``grow_converged`` flag the pipeline returns and drivers previously
+  under-surfaced);
+* the ``run_started`` / ``run_finished`` envelope and an optional periodic
+  heartbeat.
+
+Drivers construct one with :meth:`RunContext.create` (``--metrics-out``,
+``--log-json``, ``--heartbeat-s``); library callers get a sink-less context
+by default — metrics still accumulate in memory, events are recorded in the
+in-memory tail, nothing touches disk.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from nm03_capstone_project_tpu.obs.events import EventLog, Heartbeat, LogBridge
+from nm03_capstone_project_tpu.obs.metrics import MetricsRegistry
+from nm03_capstone_project_tpu.obs.spans import SpanRecorder
+
+# canonical metric names (docs/OBSERVABILITY.md documents these)
+PATIENT_OUTCOMES_TOTAL = "nm03_patient_outcomes_total"
+SLICES_TOTAL = "nm03_slices_total"
+GROW_TRUNCATED_TOTAL = "pipeline_grow_truncated_total"
+HEARTBEATS_TOTAL = "nm03_heartbeats_total"
+
+PATIENT_STATUSES = ("ok", "failed")
+
+
+class RunContext:
+    """Shared observability state for one driver run."""
+
+    def __init__(
+        self,
+        driver: str,
+        registry: MetricsRegistry,
+        events: EventLog,
+        spans: SpanRecorder,
+        metrics_out=None,
+        heartbeat: Optional[Heartbeat] = None,
+        log_bridge: Optional[LogBridge] = None,
+    ):
+        self.driver = driver
+        self.registry = registry
+        self.events = events
+        self.spans = spans
+        self.metrics_out = metrics_out
+        self._heartbeat = heartbeat
+        self._log_bridge = log_bridge
+        self._lock = threading.RLock()  # signal-handler reentrancy
+        self._outcomes: Dict[str, str] = {}
+        self._closed = False
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def create(
+        cls,
+        driver: str,
+        metrics_out=None,
+        log_json=None,
+        heartbeat_s: float = 0.0,
+        run_id: Optional[str] = None,
+        argv=None,
+        stream=None,
+    ) -> "RunContext":
+        """Build + start a context; emits ``run_started``.
+
+        ``metrics_out``/``log_json`` are paths (or None); ``stream`` is an
+        alternative writable for the event log (tests). A positive
+        ``heartbeat_s`` starts the heartbeat thread only when the event log
+        has a sink — a sink-less heartbeat would be pure overhead.
+        """
+        events = EventLog(path=log_json, stream=stream, run_id=run_id)
+        registry = MetricsRegistry()
+        spans = SpanRecorder(registry=registry)
+        heartbeat = None
+        if heartbeat_s and heartbeat_s > 0 and events.enabled:
+            heartbeat = Heartbeat(events, heartbeat_s, registry=registry).start()
+        log_bridge = None
+        if events.enabled:
+            # mirror the package logger's WARNING+ into the event stream so
+            # per-slice containment messages become structured records
+            import logging
+
+            from nm03_capstone_project_tpu.utils.reporter import get_logger
+
+            log_bridge = LogBridge(events, level=logging.WARNING)
+            get_logger().addHandler(log_bridge)
+        ctx = cls(
+            driver,
+            registry,
+            events,
+            spans,
+            metrics_out=metrics_out,
+            heartbeat=heartbeat,
+            log_bridge=log_bridge,
+        )
+        started = {"driver": driver}
+        if argv is not None:
+            started["argv"] = list(argv)
+        events.emit("run_started", **started)
+        return ctx
+
+    # -- per-patient telemetry ---------------------------------------------
+
+    def patient_outcome(
+        self,
+        patient_id: str,
+        status: str,
+        *,
+        slices_total: int = 0,
+        slices_ok: int = 0,
+        slices_failed: int = 0,
+        slices_truncated: int = 0,
+        grow_truncated: Optional[bool] = None,
+        error_class: Optional[str] = None,
+        retries: int = 0,
+        **fields,
+    ) -> dict:
+        """The ONE terminal record of a patient's run.
+
+        Increments the outcome counters and emits the ``patient_outcome``
+        event (WARNING when the patient failed or its mask was truncated,
+        INFO otherwise). Raises on a duplicate emission for the same
+        patient — the schema's exactly-once contract is enforced at the
+        source, not just in the validator.
+        """
+        if status not in PATIENT_STATUSES:
+            raise ValueError(f"status {status!r} not in {PATIENT_STATUSES}")
+        pid = str(patient_id)
+        with self._lock:
+            if pid in self._outcomes:
+                raise RuntimeError(
+                    f"duplicate patient_outcome for {pid!r} "
+                    f"(already {self._outcomes[pid]!r})"
+                )
+            self._outcomes[pid] = status
+        if grow_truncated is None:
+            grow_truncated = slices_truncated > 0
+        self.registry.counter(
+            PATIENT_OUTCOMES_TOTAL,
+            help="terminal patient outcomes by status",
+            status=status,
+        ).inc()
+        for n, slice_status in (
+            (slices_ok, "done"),
+            (slices_failed, "failed"),
+            (slices_truncated, "truncated"),
+        ):
+            if n:
+                self.registry.counter(
+                    SLICES_TOTAL,
+                    help="slices by terminal status (truncated slices are "
+                    "also counted done: the pair exists)",
+                    status=slice_status,
+                ).inc(n)
+        level = "WARNING" if (status != "ok" or grow_truncated) else "INFO"
+        return self.events.emit(
+            "patient_outcome",
+            level=level,
+            patient_id=pid,
+            status=status,
+            slices_total=int(slices_total),
+            slices_ok=int(slices_ok),
+            slices_failed=int(slices_failed),
+            slices_truncated=int(slices_truncated),
+            grow_truncated=bool(grow_truncated),
+            error_class=error_class,
+            retries=int(retries),
+            **fields,
+        )
+
+    def has_outcome(self, patient_id: str) -> bool:
+        """True when a terminal outcome was already recorded — exception
+        handlers use this so a failure AFTER the ok-outcome emission cannot
+        trip the exactly-once guard from inside the containment path."""
+        with self._lock:
+            return str(patient_id) in self._outcomes
+
+    def grow_truncated(self, patient_id: str, count: int = 1, **fields) -> dict:
+        """Surface a capped region-growing fixpoint: WARNING event + counter.
+
+        ``count`` is the number of truncated work items — slices in the 2D
+        drivers, 1 (the whole volume) in the volume driver.
+        """
+        self.registry.counter(
+            GROW_TRUNCATED_TOTAL,
+            help="region-growing fixpoints that hit the iteration cap "
+            "(masks under-cover; raise --grow-max-iters)",
+        ).inc(count)
+        return self.events.emit(
+            "grow_truncated",
+            level="WARNING",
+            patient_id=str(patient_id),
+            count=int(count),
+            **fields,
+        )
+
+    # -- export / teardown -------------------------------------------------
+
+    def metrics_snapshot(self) -> dict:
+        return self.registry.snapshot(
+            run_id=self.events.run_id, git_sha=self.events.git_sha
+        )
+
+    def write_metrics(self, path=None) -> None:
+        path = path or self.metrics_out
+        if path:
+            self.registry.write_snapshot(
+                path, run_id=self.events.run_id, git_sha=self.events.git_sha
+            )
+
+    def close(self, status: str = "ok", **fields) -> None:
+        """Stop the heartbeat, write the metrics snapshot, emit the final
+        ``run_finished`` record (always the stream's last), close the log.
+        Idempotent — drivers call it from ``finally``."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
+        if self._log_bridge is not None:
+            from nm03_capstone_project_tpu.utils.reporter import get_logger
+
+            get_logger().removeHandler(self._log_bridge)
+        try:
+            self.write_metrics()
+        except Exception as e:  # noqa: BLE001 — telemetry never costs the run
+            # an unwritable --metrics-out (read-only dir, full disk) must not
+            # turn a successful run into exit 1 at the very end
+            import sys
+
+            print(
+                f"warning: metrics snapshot write failed: {e}", file=sys.stderr
+            )
+        finally:
+            self.events.emit("run_finished", status=status, **fields)
+            self.events.close()
+
+    def __enter__(self) -> "RunContext":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close(status="error" if exc_type else "ok")

@@ -1,0 +1,136 @@
+"""Span API: nested, named sections with device sync and stage histograms.
+
+Supersedes ``utils.timing.Timer`` (which is now an alias of
+:class:`SpanRecorder` for backward compatibility): the same re-entrant
+wall-clock accumulation and optional pytree sync, plus
+
+* ``jax.profiler.TraceAnnotation`` emission so every span shows on the
+  TensorBoard/Perfetto timeline captured by ``--profile-dir``;
+* per-stage latency **histograms** fed into a
+  :class:`~nm03_capstone_project_tpu.obs.metrics.MetricsRegistry` under
+  ``nm03_stage_latency_seconds{stage=...}`` — the stage-level performance
+  attribution the results JSON's flat per-section sums cannot carry
+  (distributions, not just totals);
+* a per-thread nesting stack, so ``span("compute")`` inside
+  ``span("patient")`` records the child's latency under its own stage while
+  the parent keeps accumulating the enclosing wall.
+
+Stage-label cardinality stays bounded even for per-patient section names:
+the histogram label is the FIRST ``/``-component of the span name (the
+volume driver times ``load/<patient>`` per patient; all of those feed one
+``stage="load"`` histogram while ``report()`` keeps the per-patient keys).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Optional
+
+STAGE_LATENCY_METRIC = "nm03_stage_latency_seconds"
+
+
+def _annotation(name: str):
+    """jax.profiler.TraceAnnotation, or a no-op where jax is not LOADED.
+
+    Deliberately keyed on ``sys.modules``, not importability: a process
+    that hasn't imported jax has no profiler to annotate, and importing it
+    here would both charge jax's multi-second import to the first span and
+    violate the bench orchestrator's never-imports-jax invariant.
+    """
+    import sys
+
+    if "jax" not in sys.modules:
+        return contextlib.nullcontext()
+    try:
+        from nm03_capstone_project_tpu.utils.profiling import annotate
+
+        return annotate(name)
+    except Exception:  # noqa: BLE001 — observability must never break a run
+        return contextlib.nullcontext()
+
+
+class SpanRecorder:
+    """Named wall-clock sections; re-entrant accumulation + histograms.
+
+    Drop-in superset of the old ``Timer``: ``section(name, tree=None)``,
+    ``sections``/``counts`` dicts, and ``report()`` behave identically.
+    """
+
+    def __init__(self, registry=None, histogram_name: str = STAGE_LATENCY_METRIC):
+        self.sections: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+        self.registry = registry
+        self.histogram_name = histogram_name
+        self._lock = threading.RLock()  # signal-handler reentrancy
+        self._local = threading.local()
+
+    # -- nesting introspection (per-thread) --------------------------------
+
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    @property
+    def depth(self) -> int:
+        """Current nesting depth on the calling thread."""
+        return len(self._stack())
+
+    def current_path(self) -> str:
+        """``outer/inner`` span path on the calling thread ('' at top level)."""
+        return "/".join(self._stack())
+
+    # -- the span context ---------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, name: str, tree=None, stage: Optional[str] = None):
+        """Time a named section.
+
+        Args:
+          name: section key accumulated in ``sections``/``report()``; may
+            carry a ``/``-suffix for per-item detail (``load/<patient>``).
+          tree: optional pytree synced (``timing.sync``) before the clock
+            stops, so device work enqueued inside the span is charged to it.
+          stage: histogram ``stage`` label override; defaults to the first
+            ``/``-component of ``name`` (bounded cardinality).
+        """
+        stack = self._stack()
+        stack.append(name)
+        t0 = time.perf_counter()
+        try:
+            with _annotation(name):  # stage shows up on the profiler timeline
+                yield
+        finally:
+            # a failing device sync must still pop the nesting stack and
+            # record the section (the old Timer had no stack to corrupt;
+            # this one must not leave phantom nesting behind a raise)
+            try:
+                if tree is not None:
+                    from nm03_capstone_project_tpu.utils.timing import sync
+
+                    sync(tree)
+            finally:
+                dt = time.perf_counter() - t0
+                stack.pop()
+                with self._lock:
+                    self.sections[name] = self.sections.get(name, 0.0) + dt
+                    self.counts[name] = self.counts.get(name, 0) + 1
+                if self.registry is not None:
+                    label = stage if stage is not None else name.split("/", 1)[0]
+                    self.registry.histogram(
+                        self.histogram_name,
+                        help="wall-clock latency per pipeline stage "
+                        "(device-synced where the span passed a tree)",
+                        stage=label,
+                    ).observe(dt)
+
+    # Timer-compat alias: every existing `timer.section(...)` call site and
+    # test keeps working against the span API.
+    section = span
+
+    def report(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(sorted(self.sections.items()))

@@ -5,6 +5,10 @@ The reference profiles *outside* the repo with perf + Hotspot
 section 5 is ``jax.profiler``: traces viewable in TensorBoard/Perfetto,
 captured in-tree via ``--profile-dir`` on any driver, plus named trace
 annotations so pipeline stages show up in the timeline.
+
+The obs span API (docs/OBSERVABILITY.md) calls :func:`annotate` for every
+span, so sections timed for the metrics histograms and sections visible on
+the profiler timeline are the same names by construction.
 """
 
 from __future__ import annotations

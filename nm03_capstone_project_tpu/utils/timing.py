@@ -11,10 +11,8 @@ out-of-tree.
 
 from __future__ import annotations
 
-import contextlib
 import json
 import time
-from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, List
 
@@ -46,30 +44,11 @@ def sync(tree) -> None:
         jax.device_get(probes)
 
 
-@dataclass
-class Timer:
-    """Named wall-clock sections; re-entrant accumulation."""
-
-    sections: Dict[str, float] = field(default_factory=dict)
-    counts: Dict[str, int] = field(default_factory=dict)
-
-    @contextlib.contextmanager
-    def section(self, name: str, tree=None):
-        from nm03_capstone_project_tpu.utils.profiling import annotate
-
-        t0 = time.perf_counter()
-        try:
-            with annotate(name):  # stage shows up on the profiler timeline
-                yield
-        finally:
-            if tree is not None:
-                sync(tree)
-            dt = time.perf_counter() - t0
-            self.sections[name] = self.sections.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
-
-    def report(self) -> Dict[str, float]:
-        return dict(sorted(self.sections.items()))
+# Timer is superseded by (and now aliases) the obs span recorder: same
+# section()/report()/sections/counts contract, plus nested-span tracking and
+# optional per-stage latency histograms when built with a registry. Kept
+# under its old name so existing imports and call sites stay valid.
+from nm03_capstone_project_tpu.obs.spans import SpanRecorder as Timer  # noqa: E402,F401
 
 
 def timeit_sync(fn, *args, warmup: int = 1, iters: int = 5) -> Dict[str, float]:

@@ -1,0 +1,284 @@
+#!/usr/bin/env python
+"""Validate run telemetry artifacts against the documented schemas.
+
+Checks a JSONL event stream (``--events``, schema ``nm03.events.v1``) and/or
+a metrics snapshot (``--metrics``, schema ``nm03.metrics.v1``) as written by
+the CLI drivers' ``--log-json`` / ``--metrics-out`` flags and documented in
+docs/OBSERVABILITY.md. Exits non-zero on any drift, printing one line per
+violation — the CI gate that keeps producers and the documented schema from
+diverging silently.
+
+Usage:
+    python scripts/check_telemetry.py --events run.jsonl --metrics m.json
+    python scripts/check_telemetry.py --events run.jsonl --expect-patients 3
+
+Validated invariants (the contract, not a style check):
+
+events
+  * every line parses as a JSON object with the full run envelope
+    (schema, run_id, git_sha, seq, ts_unix, mono_s, level, event);
+  * one run_id and one git_sha per stream; seq strictly increasing from 0;
+    mono_s non-decreasing; level in the documented set;
+  * first record is ``run_started``; last record is ``run_finished``;
+  * exactly ONE terminal ``patient_outcome`` record per patient_id, with
+    status in {ok, failed}, non-negative slice counts, boolean
+    grow_truncated, integer retries, and error_class string-or-null;
+  * ``grow_truncated`` and failed-patient outcomes carry level WARNING.
+
+metrics
+  * envelope (schema, run_id, git_sha, created_unix, metrics list);
+  * Prometheus-legal metric/label names; one type per metric name;
+  * counters/gauges numeric, counters non-negative;
+  * histogram buckets cumulative non-decreasing, ending in "+Inf" whose
+    count equals the series count; sum numeric.
+
+cross
+  * when both artifacts are given, their run_id and git_sha must match.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+SCHEMA_EVENTS = "nm03.events.v1"
+SCHEMA_METRICS = "nm03.metrics.v1"
+LEVELS = {"DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"}
+ENVELOPE = ("schema", "run_id", "git_sha", "seq", "ts_unix", "mono_s", "level", "event")
+PATIENT_STATUSES = {"ok", "failed"}
+METRIC_TYPES = {"counter", "gauge", "histogram"}
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+class Checker:
+    def __init__(self):
+        self.problems: list[str] = []
+
+    def fail(self, where: str, msg: str) -> None:
+        self.problems.append(f"{where}: {msg}")
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def check_events(path: str, chk: Checker, expect_patients: int | None = None):
+    """Validate one JSONL event stream; returns (run_id, git_sha) or None."""
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        chk.fail(path, f"unreadable: {e}")
+        return None
+    if not lines:
+        chk.fail(path, "empty event stream")
+        return None
+
+    run_id = git_sha = None
+    prev_seq, prev_mono = None, None
+    outcomes: dict[str, int] = {}
+    events_seen: list[str] = []
+    for i, line in enumerate(lines, 1):
+        where = f"{path}:{i}"
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            chk.fail(where, f"not valid JSON: {e}")
+            continue
+        if not isinstance(rec, dict):
+            chk.fail(where, "record is not a JSON object")
+            continue
+        missing = [k for k in ENVELOPE if k not in rec]
+        if missing:
+            chk.fail(where, f"missing envelope keys: {missing}")
+            continue
+        if rec["schema"] != SCHEMA_EVENTS:
+            chk.fail(where, f"schema {rec['schema']!r} != {SCHEMA_EVENTS!r}")
+        if run_id is None:
+            run_id, git_sha = rec["run_id"], rec["git_sha"]
+            if not run_id:
+                chk.fail(where, "empty run_id")
+        else:
+            if rec["run_id"] != run_id:
+                chk.fail(where, f"run_id {rec['run_id']!r} != stream's {run_id!r}")
+            if rec["git_sha"] != git_sha:
+                chk.fail(where, f"git_sha {rec['git_sha']!r} != stream's {git_sha!r}")
+        if not isinstance(rec["seq"], int) or (
+            prev_seq is not None and rec["seq"] <= prev_seq
+        ):
+            chk.fail(where, f"seq {rec['seq']!r} not strictly increasing")
+        prev_seq = rec["seq"] if isinstance(rec["seq"], int) else prev_seq
+        if not _is_num(rec["ts_unix"]):
+            chk.fail(where, f"ts_unix {rec['ts_unix']!r} not numeric")
+        if not _is_num(rec["mono_s"]):
+            chk.fail(where, f"mono_s {rec['mono_s']!r} not numeric")
+        elif prev_mono is not None and rec["mono_s"] < prev_mono:
+            chk.fail(where, f"mono_s {rec['mono_s']} went backwards")
+        else:
+            prev_mono = rec["mono_s"]
+        if rec["level"] not in LEVELS:
+            chk.fail(where, f"level {rec['level']!r} not in {sorted(LEVELS)}")
+        event = rec["event"]
+        events_seen.append(event)
+
+        if event == "patient_outcome":
+            pid = rec.get("patient_id")
+            if not isinstance(pid, str) or not pid:
+                chk.fail(where, "patient_outcome without a patient_id")
+                pid = f"<line {i}>"
+            outcomes[pid] = outcomes.get(pid, 0) + 1
+            if rec.get("status") not in PATIENT_STATUSES:
+                chk.fail(where, f"patient status {rec.get('status')!r} not in "
+                                f"{sorted(PATIENT_STATUSES)}")
+            for k in ("slices_total", "slices_ok", "slices_failed",
+                      "slices_truncated", "retries"):
+                v = rec.get(k)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    chk.fail(where, f"{k} must be a non-negative int, got {v!r}")
+            if not isinstance(rec.get("grow_truncated"), bool):
+                chk.fail(where, "grow_truncated must be a bool")
+            ec = rec.get("error_class")
+            if ec is not None and not isinstance(ec, str):
+                chk.fail(where, f"error_class must be string or null, got {ec!r}")
+            if rec.get("status") == "failed" and rec["level"] != "WARNING":
+                chk.fail(where, "failed patient_outcome must be WARNING level")
+        elif event == "grow_truncated" and rec["level"] != "WARNING":
+            chk.fail(where, "grow_truncated events must be WARNING level")
+
+    if events_seen and events_seen[0] != "run_started":
+        chk.fail(path, f"first event is {events_seen[0]!r}, want 'run_started'")
+    if events_seen and events_seen[-1] != "run_finished":
+        chk.fail(path, f"last event is {events_seen[-1]!r}, want 'run_finished'")
+    for pid, n in sorted(outcomes.items()):
+        if n != 1:
+            chk.fail(path, f"patient {pid!r} has {n} terminal outcomes, want 1")
+    if expect_patients is not None and len(outcomes) != expect_patients:
+        chk.fail(path, f"{len(outcomes)} patients with outcomes, "
+                       f"expected {expect_patients}")
+    return (run_id, git_sha)
+
+
+def _check_histogram(where: str, rec: dict, chk: Checker) -> None:
+    buckets = rec.get("buckets")
+    if not isinstance(buckets, list) or not buckets:
+        chk.fail(where, "histogram without a buckets list")
+        return
+    prev = -1
+    for j, pair in enumerate(buckets):
+        if (not isinstance(pair, list) or len(pair) != 2
+                or not isinstance(pair[0], str)
+                or not isinstance(pair[1], int) or isinstance(pair[1], bool)):
+            chk.fail(where, f"bucket {j} is not [le_string, count]: {pair!r}")
+            return
+        if pair[1] < prev:
+            chk.fail(where, f"bucket counts not cumulative at le={pair[0]}")
+        prev = pair[1]
+    if buckets[-1][0] != "+Inf":
+        chk.fail(where, f"last bucket le is {buckets[-1][0]!r}, want '+Inf'")
+    if not (isinstance(rec.get("count"), int) and not isinstance(rec.get("count"), bool)):
+        chk.fail(where, f"histogram count must be an int, got {rec.get('count')!r}")
+    elif buckets[-1][1] != rec["count"]:
+        chk.fail(where, f"+Inf bucket {buckets[-1][1]} != count {rec['count']}")
+    if not _is_num(rec.get("sum")):
+        chk.fail(where, f"histogram sum must be numeric, got {rec.get('sum')!r}")
+
+
+def check_metrics(path: str, chk: Checker):
+    """Validate one metrics snapshot; returns (run_id, git_sha) or None."""
+    try:
+        with open(path) as f:
+            snap = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        chk.fail(path, f"unreadable or not JSON: {e}")
+        return None
+    if not isinstance(snap, dict):
+        chk.fail(path, "snapshot is not a JSON object")
+        return None
+    if snap.get("schema") != SCHEMA_METRICS:
+        chk.fail(path, f"schema {snap.get('schema')!r} != {SCHEMA_METRICS!r}")
+    if not _is_num(snap.get("created_unix")):
+        chk.fail(path, "created_unix missing or not numeric")
+    metrics = snap.get("metrics")
+    if not isinstance(metrics, list):
+        chk.fail(path, "metrics is not a list")
+        return (snap.get("run_id"), snap.get("git_sha"))
+
+    kind_by_name: dict[str, str] = {}
+    seen: set[tuple] = set()
+    for j, rec in enumerate(metrics):
+        where = f"{path}: metrics[{j}]"
+        if not isinstance(rec, dict):
+            chk.fail(where, "not a JSON object")
+            continue
+        name, kind, labels = rec.get("name"), rec.get("type"), rec.get("labels")
+        if not isinstance(name, str) or not _NAME_RE.match(name or ""):
+            chk.fail(where, f"invalid metric name {name!r}")
+            continue
+        if kind not in METRIC_TYPES:
+            chk.fail(where, f"{name}: type {kind!r} not in {sorted(METRIC_TYPES)}")
+            continue
+        if kind_by_name.setdefault(name, kind) != kind:
+            chk.fail(where, f"{name}: conflicting types "
+                            f"({kind_by_name[name]} vs {kind})")
+        if not isinstance(labels, dict) or not all(
+            isinstance(k, str) and _LABEL_RE.match(k) and isinstance(v, str)
+            for k, v in labels.items()
+        ):
+            chk.fail(where, f"{name}: labels must map legal names to strings")
+            labels = {}
+        key = (name, tuple(sorted(labels.items())))
+        if key in seen:
+            chk.fail(where, f"duplicate series {name}{labels}")
+        seen.add(key)
+        if kind == "histogram":
+            _check_histogram(where, rec, chk)
+        else:
+            v = rec.get("value")
+            if not _is_num(v):
+                chk.fail(where, f"{name}: value must be numeric, got {v!r}")
+            elif kind == "counter" and v < 0:
+                chk.fail(where, f"{name}: counter value {v} is negative")
+    return (snap.get("run_id"), snap.get("git_sha"))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--events", default=None, help="JSONL event stream to validate")
+    ap.add_argument("--metrics", default=None, help="metrics snapshot JSON to validate")
+    ap.add_argument(
+        "--expect-patients", type=int, default=None,
+        help="require exactly N patients with terminal outcome events",
+    )
+    args = ap.parse_args(argv)
+    if not args.events and not args.metrics:
+        ap.error("nothing to check: pass --events and/or --metrics")
+
+    chk = Checker()
+    ev_ident = mt_ident = None
+    if args.events:
+        ev_ident = check_events(args.events, chk, args.expect_patients)
+    if args.metrics:
+        mt_ident = check_metrics(args.metrics, chk)
+    if ev_ident and mt_ident:
+        if mt_ident[0] != ev_ident[0]:
+            chk.fail("cross", f"metrics run_id {mt_ident[0]!r} != "
+                              f"events run_id {ev_ident[0]!r}")
+        if mt_ident[1] != ev_ident[1]:
+            chk.fail("cross", f"metrics git_sha {mt_ident[1]!r} != "
+                              f"events git_sha {ev_ident[1]!r}")
+
+    for p in chk.problems:
+        print(f"DRIFT {p}", file=sys.stderr)
+    if chk.problems:
+        print(f"check_telemetry: {len(chk.problems)} violation(s)", file=sys.stderr)
+        return 1
+    checked = " and ".join(p for p in (args.events, args.metrics) if p)
+    print(f"check_telemetry: OK ({checked})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

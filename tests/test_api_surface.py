@@ -118,6 +118,12 @@ SURFACE = {
         "make_sharded_train_step",
     ],
     "nm03_capstone_project_tpu.models.checkpoint": ["save_params", "load_params"],
+    "nm03_capstone_project_tpu.obs": [
+        "MetricsRegistry",
+        "SpanRecorder",
+        "EventLog",
+        "RunContext",
+    ],
     "nm03_capstone_project_tpu.utils.manifest": ["Manifest"],
     "nm03_capstone_project_tpu.utils.timing": ["Timer", "write_results_json"],
     "nm03_capstone_project_tpu.utils.profiling": ["profile_trace"],

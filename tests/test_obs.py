@@ -1,0 +1,504 @@
+"""Observability subsystem tests.
+
+Covers the ISSUE-1 contract: registry semantics (counter monotonicity,
+histogram bucketing, Prometheus exposition), span nesting + device-sync
+behavior, JSONL event schema round-trip (run id + git SHA on every record,
+exactly one terminal outcome per patient), the drivers' ``--metrics-out`` /
+``--log-json`` wiring on synthetic data, and the scripts/check_telemetry.py
+schema gate (OK on real artifacts, non-zero on drift).
+"""
+
+import io
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from nm03_capstone_project_tpu import obs
+from nm03_capstone_project_tpu.obs import (
+    EventLog,
+    Heartbeat,
+    MetricsRegistry,
+    RunContext,
+    SpanRecorder,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+CHECKER = REPO / "scripts" / "check_telemetry.py"
+
+
+def run_checker(*argv):
+    return subprocess.run(
+        [sys.executable, str(CHECKER), *map(str, argv)],
+        capture_output=True, text=True, timeout=60,
+    )
+
+
+# -- metrics registry ------------------------------------------------------
+
+
+class TestRegistry:
+    def test_counter_monotone(self):
+        r = MetricsRegistry()
+        c = r.counter("nm03_things_total", status="ok")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError, match="cannot decrease"):
+            c.inc(-1)
+        assert c.value == 3.5
+
+    def test_get_or_create_identity_and_label_isolation(self):
+        r = MetricsRegistry()
+        a = r.counter("nm03_x_total", status="ok")
+        b = r.counter("nm03_x_total", status="ok")
+        other = r.counter("nm03_x_total", status="failed")
+        assert a is b and a is not other
+        a.inc()
+        assert other.value == 0
+
+    def test_kind_conflict_rejected(self):
+        r = MetricsRegistry()
+        r.counter("nm03_x_total")
+        with pytest.raises(ValueError, match="already registered"):
+            r.gauge("nm03_x_total")
+
+    def test_name_and_label_hygiene(self):
+        r = MetricsRegistry()
+        with pytest.raises(ValueError, match="invalid metric name"):
+            r.counter("bad-name")
+        with pytest.raises(ValueError, match="invalid label name"):
+            r.counter("nm03_ok_total", **{"bad-label": "x"})
+
+    def test_histogram_bucketing(self):
+        r = MetricsRegistry()
+        h = r.histogram("nm03_lat_seconds", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        cum = h.cumulative()
+        assert [le for le, _ in cum] == ["0.1", "1", "10", "+Inf"]
+        assert [n for _, n in cum] == [1, 3, 4, 5]  # cumulative
+        assert h.count == 5
+        assert h.sum == pytest.approx(56.05)
+        with pytest.raises(ValueError, match="strictly increasing"):
+            r.histogram("nm03_bad_seconds", buckets=(1.0, 1.0))
+
+    def test_snapshot_schema(self):
+        r = MetricsRegistry()
+        r.counter("nm03_c_total", help="c").inc(3)
+        r.gauge("nm03_g").set(-1.5)
+        r.histogram("nm03_h_seconds", buckets=(1.0,)).observe(0.5)
+        snap = r.snapshot(run_id="rid", git_sha="sha")
+        assert snap["schema"] == "nm03.metrics.v1"
+        assert snap["run_id"] == "rid" and snap["git_sha"] == "sha"
+        by_name = {m["name"]: m for m in snap["metrics"]}
+        assert by_name["nm03_c_total"]["value"] == 3
+        assert by_name["nm03_g"]["value"] == -1.5
+        hist = by_name["nm03_h_seconds"]
+        assert hist["buckets"][-1] == ["+Inf", 1] and hist["count"] == 1
+        json.dumps(snap)  # JSON-able end to end
+
+    def test_prometheus_exposition(self):
+        r = MetricsRegistry()
+        r.counter("nm03_c_total", help="things", status="ok").inc(2)
+        r.histogram("nm03_h_seconds", buckets=(0.5,), stage="decode").observe(0.1)
+        text = r.to_prometheus()
+        assert "# TYPE nm03_c_total counter" in text
+        assert 'nm03_c_total{status="ok"} 2' in text
+        assert "# TYPE nm03_h_seconds histogram" in text
+        assert 'nm03_h_seconds_bucket{stage="decode",le="0.5"} 1' in text
+        assert 'nm03_h_seconds_bucket{stage="decode",le="+Inf"} 1' in text
+        assert 'nm03_h_seconds_count{stage="decode"} 1' in text
+
+    def test_thread_safety_under_contention(self):
+        import concurrent.futures as cf
+
+        r = MetricsRegistry()
+        c = r.counter("nm03_n_total")
+
+        def spin(_):
+            for _ in range(1000):
+                c.inc()
+
+        with cf.ThreadPoolExecutor(8) as pool:
+            list(pool.map(spin, range(8)))
+        assert c.value == 8000
+
+
+# -- spans -----------------------------------------------------------------
+
+
+class TestSpans:
+    def test_nesting_and_report(self):
+        s = SpanRecorder()
+        with s.span("outer"):
+            assert s.depth == 1 and s.current_path() == "outer"
+            with s.span("inner"):
+                assert s.depth == 2 and s.current_path() == "outer/inner"
+        assert s.depth == 0
+        with s.span("outer"):  # re-entrant accumulation
+            pass
+        assert s.counts == {"outer": 2, "inner": 1}
+        assert set(s.report()) == {"outer", "inner"}
+        assert s.report()["outer"] >= s.report()["inner"]
+
+    def test_histogram_feeding_with_bounded_stage_label(self):
+        r = MetricsRegistry()
+        s = SpanRecorder(registry=r)
+        for pid in ("P1", "P2", "P3"):
+            with s.span(f"load/{pid}"):
+                pass
+        with s.span("compute"):
+            pass
+        # per-patient section names collapse onto one stage label
+        h = r.get("nm03_stage_latency_seconds", stage="load")
+        assert h is not None and h.count == 3
+        assert r.get("nm03_stage_latency_seconds", stage="compute").count == 1
+        # report() keeps the per-patient keys (Timer contract)
+        assert "load/P1" in s.report()
+
+    def test_sync_called_on_tree(self, monkeypatch):
+        import nm03_capstone_project_tpu.utils.timing as timing
+
+        synced = []
+        monkeypatch.setattr(timing, "sync", lambda tree: synced.append(tree))
+        s = SpanRecorder()
+        with s.span("compute", tree={"a": 1}):
+            pass
+        assert synced == [{"a": 1}]
+
+    def test_timer_alias_is_span_recorder(self):
+        from nm03_capstone_project_tpu.utils.timing import Timer
+
+        t = Timer()
+        assert isinstance(t, SpanRecorder)
+        with t.section("x"):
+            pass
+        assert t.report()["x"] >= 0
+
+
+# -- event log -------------------------------------------------------------
+
+
+class TestEventLog:
+    def test_schema_round_trip(self, tmp_path):
+        path = tmp_path / "e.jsonl"
+        log = EventLog(path=path, run_id="rid", git_sha="sha")
+        log.emit("run_started", driver="test")
+        log.emit("thing", level="WARNING", detail={"k": 1})
+        log.emit("run_finished", status="ok")
+        log.close()
+        records = [json.loads(l) for l in path.read_text().splitlines()]
+        assert len(records) == 3
+        for i, rec in enumerate(records):
+            assert rec["schema"] == "nm03.events.v1"
+            assert rec["run_id"] == "rid" and rec["git_sha"] == "sha"
+            assert rec["seq"] == i
+            assert isinstance(rec["ts_unix"], float)
+            assert isinstance(rec["mono_s"], float)
+        assert records[1]["level"] == "WARNING"
+        assert records[1]["detail"] == {"k": 1}
+        assert [r["seq"] for r in records] == sorted(r["seq"] for r in records)
+
+    def test_envelope_protected(self):
+        log = EventLog(stream=io.StringIO(), run_id="r", git_sha="s")
+        with pytest.raises(ValueError, match="shadow the run envelope"):
+            log.emit("x", seq=99)
+        with pytest.raises(ValueError, match="unknown level"):
+            log.emit("x", level="LOUD")
+
+    def test_sinkless_log_keeps_tail(self):
+        log = EventLog(run_id="r", git_sha="s")
+        assert not log.enabled
+        rec = log.emit("x", a=1)
+        assert rec["a"] == 1 and list(log.tail) == [rec]
+
+    def test_one_run_per_file_truncates(self, tmp_path):
+        # two runs into one path must leave ONE valid stream (the schema
+        # demands a single run_id; appending would fail the validator)
+        path = tmp_path / "e.jsonl"
+        for run_id in ("run-a", "run-b"):
+            log = EventLog(path=path, run_id=run_id, git_sha="s")
+            log.emit("run_started")
+            log.emit("run_finished")
+            log.close()
+        records = [json.loads(l) for l in path.read_text().splitlines()]
+        assert len(records) == 2
+        assert {r["run_id"] for r in records} == {"run-b"}
+
+    def test_sink_write_failure_degrades_not_raises(self, capsys):
+        class ExplodingStream(io.StringIO):
+            def write(self, s):
+                raise OSError("disk full")
+
+        log = EventLog(stream=ExplodingStream(), run_id="r", git_sha="s")
+        rec = log.emit("x")  # must not raise: telemetry never costs the run
+        assert rec["event"] == "x"
+        assert not log.enabled  # sink disabled after the failure
+        log.emit("y")  # subsequent emits keep working sink-less
+        assert [r["event"] for r in log.tail] == ["x", "y"]
+        assert "telemetry sink disabled" in capsys.readouterr().err
+
+    def test_heartbeat_emits_counter_totals(self):
+        stream = io.StringIO()
+        log = EventLog(stream=stream, run_id="r", git_sha="s")
+        reg = MetricsRegistry()
+        reg.counter("nm03_done_total").inc(7)
+        hb = Heartbeat(log, interval_s=0.05, registry=reg).start()
+        time.sleep(0.2)
+        hb.stop()
+        beats = [
+            json.loads(l) for l in stream.getvalue().splitlines()
+            if json.loads(l)["event"] == "heartbeat"
+        ]
+        assert beats and beats[0]["counters"] == {"nm03_done_total": 7}
+        assert beats[0]["uptime_s"] > 0
+
+
+# -- run context -----------------------------------------------------------
+
+
+class TestRunContext:
+    def test_patient_outcome_exactly_once(self):
+        ctx = RunContext.create("test", stream=io.StringIO())
+        ctx.patient_outcome("P1", "ok", slices_total=4, slices_ok=4)
+        with pytest.raises(RuntimeError, match="duplicate patient_outcome"):
+            ctx.patient_outcome("P1", "failed")
+        assert ctx.has_outcome("P1") and not ctx.has_outcome("P2")
+        with pytest.raises(ValueError, match="not in"):
+            ctx.patient_outcome("P2", "exploded")
+        counter = ctx.registry.get(obs.PATIENT_OUTCOMES_TOTAL, status="ok")
+        assert counter.value == 1
+
+    def test_failed_and_truncated_outcomes_are_warnings(self):
+        stream = io.StringIO()
+        ctx = RunContext.create("test", stream=stream)
+        ctx.patient_outcome("P1", "failed", error_class="ValueError")
+        ctx.patient_outcome("P2", "ok", slices_total=3, slices_ok=3,
+                            slices_truncated=2)
+        ctx.grow_truncated("P2", count=2)
+        ctx.close()
+        by_event = {}
+        for line in stream.getvalue().splitlines():
+            rec = json.loads(line)
+            by_event.setdefault(rec["event"], []).append(rec)
+        assert [r["level"] for r in by_event["patient_outcome"]] == [
+            "WARNING", "WARNING"  # failed; truncated
+        ]
+        assert by_event["grow_truncated"][0]["level"] == "WARNING"
+        assert by_event["run_finished"][0] == json.loads(
+            stream.getvalue().splitlines()[-1]
+        )
+        assert ctx.registry.get(obs.GROW_TRUNCATED_TOTAL).value == 2
+
+    def test_close_idempotent_and_writes_metrics(self, tmp_path):
+        m = tmp_path / "m.json"
+        ctx = RunContext.create("test", metrics_out=m)
+        ctx.registry.counter("nm03_x_total").inc()
+        ctx.close()
+        ctx.close()  # second close is a no-op
+        snap = json.loads(m.read_text())
+        assert snap["schema"] == "nm03.metrics.v1"
+        assert snap["run_id"] == ctx.events.run_id
+
+    def test_log_bridge_mirrors_warnings(self, tmp_path):
+        from nm03_capstone_project_tpu.utils.reporter import get_logger
+
+        path = tmp_path / "e.jsonl"
+        ctx = RunContext.create("test", log_json=path)
+        get_logger("runner").warning("failed to read %s: %s", "f.dcm", "boom")
+        ctx.close()
+        logs = [
+            json.loads(l) for l in path.read_text().splitlines()
+            if json.loads(l)["event"] == "log"
+        ]
+        assert logs and logs[0]["level"] == "WARNING"
+        assert "f.dcm" in logs[0]["message"]
+
+
+# -- cohort-runner telemetry on synthetic data ----------------------------
+
+
+@pytest.fixture(scope="module")
+def cohort(tmp_path_factory):
+    from nm03_capstone_project_tpu.data.synthetic import write_synthetic_cohort
+
+    root = tmp_path_factory.mktemp("obs-cohort")
+    write_synthetic_cohort(root, n_patients=2, n_slices=3, height=128, width=128)
+    return root
+
+
+class TestRunnerTelemetry:
+    def test_truncation_surfaced_as_event_and_counter(self, cohort, tmp_path):
+        from nm03_capstone_project_tpu.cli.runner import CohortProcessor
+        from nm03_capstone_project_tpu.config import PipelineConfig
+
+        capped = PipelineConfig(
+            canvas=128, render_size=128, grow_block_iters=1, grow_max_iters=2
+        )
+        ctx = RunContext.create("parallel", stream=io.StringIO())
+        proc = CohortProcessor(
+            cohort, tmp_path / "o", cfg=capped, mode="parallel", obs=ctx
+        )
+        summary = proc.process_all_patients()
+        assert summary.as_dict()["slices_truncated"] > 0
+        assert (
+            ctx.registry.get(obs.GROW_TRUNCATED_TOTAL).value
+            == summary.as_dict()["slices_truncated"]
+        )
+        trunc_events = [
+            r for r in ctx.events.tail if r["event"] == "grow_truncated"
+        ]
+        assert trunc_events and all(r["level"] == "WARNING" for r in trunc_events)
+        outcomes = [r for r in ctx.events.tail if r["event"] == "patient_outcome"]
+        assert len(outcomes) == 2
+        assert all(r["grow_truncated"] for r in outcomes)
+
+    def test_failed_patient_gets_failed_outcome(self, tmp_path):
+        from nm03_capstone_project_tpu.cli.runner import CohortProcessor
+        from nm03_capstone_project_tpu.config import PipelineConfig
+        from nm03_capstone_project_tpu.data.synthetic import write_synthetic_cohort
+
+        root = tmp_path / "c"
+        write_synthetic_cohort(root, 1, n_slices=2, height=128, width=128)
+        (root / "PGBM-0002").mkdir()  # patient with no series -> load failure
+        ctx = RunContext.create("sequential", stream=io.StringIO())
+        proc = CohortProcessor(
+            root, tmp_path / "o",
+            cfg=PipelineConfig(canvas=128, render_size=128),
+            mode="sequential", obs=ctx,
+        )
+        proc.process_all_patients()
+        outcomes = {
+            r["patient_id"]: r
+            for r in ctx.events.tail
+            if r["event"] == "patient_outcome"
+        }
+        assert outcomes["PGBM-0001"]["status"] == "ok"
+        assert outcomes["PGBM-0002"]["status"] == "failed"
+        assert outcomes["PGBM-0002"]["error_class"]
+        assert ctx.registry.get(
+            obs.PATIENT_OUTCOMES_TOTAL, status="failed"
+        ).value == 1
+
+
+# -- CLI smoke + validator -------------------------------------------------
+
+
+class TestCliTelemetry:
+    def test_sequential_artifacts_validate(self, tmp_path):
+        from nm03_capstone_project_tpu.cli import sequential
+
+        m, e = tmp_path / "m.json", tmp_path / "e.jsonl"
+        rc = sequential.main(
+            [
+                "--synthetic", "2", "--synthetic-slices", "2",
+                "--canvas", "128", "--render-size", "128",
+                "--device", "cpu",
+                "--output", str(tmp_path / "out"),
+                "--metrics-out", str(m),
+                "--log-json", str(e),
+                "--results-json", str(tmp_path / "r.json"),
+            ]
+        )
+        assert rc == 0
+
+        # every record carries the run envelope; one terminal outcome/patient
+        records = [json.loads(l) for l in e.read_text().splitlines()]
+        assert all(r["run_id"] == records[0]["run_id"] for r in records)
+        assert all(r["git_sha"] == records[0]["git_sha"] for r in records)
+        assert records[0]["event"] == "run_started"
+        assert records[-1]["event"] == "run_finished"
+        outcomes = [r for r in records if r["event"] == "patient_outcome"]
+        assert sorted(r["patient_id"] for r in outcomes) == [
+            "PGBM-0001", "PGBM-0002"
+        ]
+
+        # metrics: per-stage latency histograms + per-patient outcome counters
+        snap = json.loads(m.read_text())
+        by = {(x["name"], tuple(sorted(x["labels"].items()))): x
+              for x in snap["metrics"]}
+        stages = {k[1][0][1] for k in by if k[0] == "nm03_stage_latency_seconds"}
+        assert {"decode", "compute", "export"} <= stages
+        ok = by[("nm03_patient_outcomes_total", (("status", "ok"),))]
+        assert ok["value"] == 2
+        assert snap["run_id"] == records[0]["run_id"]
+
+        # results JSON embeds the same snapshot
+        results = json.loads((tmp_path / "r.json").read_text())
+        assert results["metrics"]["schema"] == "nm03.metrics.v1"
+
+        # the documented gate passes on real artifacts
+        out = run_checker(
+            "--events", e, "--metrics", m, "--expect-patients", "2"
+        )
+        assert out.returncode == 0, out.stderr
+
+    def test_volume_artifacts_validate(self, tmp_path):
+        from nm03_capstone_project_tpu.cli import volume
+
+        m, e = tmp_path / "m.json", tmp_path / "e.jsonl"
+        rc = volume.main(
+            [
+                "--synthetic", "1", "--synthetic-slices", "3",
+                "--canvas", "128", "--render-size", "128",
+                "--device", "cpu",
+                "--output", str(tmp_path / "out"),
+                "--metrics-out", str(m),
+                "--log-json", str(e),
+            ]
+        )
+        assert rc == 0
+        out = run_checker("--events", e, "--metrics", m, "--expect-patients", "1")
+        assert out.returncode == 0, out.stderr
+        snap = json.loads(m.read_text())
+        names = {x["name"] for x in snap["metrics"]}
+        assert "nm03_patient_outcomes_total" in names
+        assert "nm03_stage_latency_seconds" in names
+
+    def test_checker_rejects_drift(self, tmp_path):
+        # missing envelope key
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text('{"schema": "nm03.events.v1", "event": "x"}\n')
+        assert run_checker("--events", bad).returncode == 1
+
+        # duplicate terminal outcome for one patient
+        log = EventLog(path=tmp_path / "dup.jsonl", run_id="r", git_sha="s")
+        log.emit("run_started")
+        for _ in range(2):
+            log.emit("patient_outcome", patient_id="P1", status="ok",
+                     slices_total=1, slices_ok=1, slices_failed=0,
+                     slices_truncated=0, grow_truncated=False,
+                     error_class=None, retries=0)
+        log.emit("run_finished")
+        log.close()
+        out = run_checker("--events", tmp_path / "dup.jsonl")
+        assert out.returncode == 1 and "terminal outcomes" in out.stderr
+
+        # histogram whose buckets are not cumulative
+        snap = {
+            "schema": "nm03.metrics.v1", "run_id": "r", "git_sha": "s",
+            "created_unix": 1.0,
+            "metrics": [{
+                "name": "nm03_h_seconds", "type": "histogram", "labels": {},
+                "buckets": [["1", 5], ["+Inf", 3]], "sum": 1.0, "count": 3,
+            }],
+        }
+        (tmp_path / "bad_m.json").write_text(json.dumps(snap))
+        out = run_checker("--metrics", tmp_path / "bad_m.json")
+        assert out.returncode == 1 and "cumulative" in out.stderr
+
+        # run_id mismatch across the two artifacts
+        good_snap = dict(snap, metrics=[], run_id="OTHER")
+        (tmp_path / "m2.json").write_text(json.dumps(good_snap))
+        log2 = EventLog(path=tmp_path / "e2.jsonl", run_id="r", git_sha="s")
+        log2.emit("run_started")
+        log2.emit("run_finished")
+        log2.close()
+        out = run_checker(
+            "--events", tmp_path / "e2.jsonl", "--metrics", tmp_path / "m2.json"
+        )
+        assert out.returncode == 1 and "run_id" in out.stderr

@@ -8,8 +8,13 @@ distinct shape costs a jit compile on the CPU backend.
 """
 
 import numpy as np
-import scipy.ndimage as ndi
-from hypothesis import given, settings, strategies as st
+import pytest
+
+# optional dependencies (pyproject [test] extra): without them this module
+# must SKIP, not break collection for the whole suite
+ndi = pytest.importorskip("scipy.ndimage")
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from nm03_capstone_project_tpu.ops.elementwise import clip_intensity, normalize
 from nm03_capstone_project_tpu.ops.median import vector_median_filter
