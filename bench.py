@@ -81,6 +81,18 @@ STAGE_REPS = 48
 PROBE_TIMEOUT_S = 90
 PROBE_ATTEMPTS = 6
 PROBE_BACKOFF_S = 45
+# Vigil probe backoff (r05 lesson: vigil probe 4 burned its full 90 s
+# timeout and the zshard section was then skipped for budget): each
+# consecutive vigil-probe TIMEOUT halves the next probe's timeout down to
+# this floor — a wedged tunnel fails fast, a recovering one still gets a
+# real probe (a healthy backend answers a probe in seconds), and a
+# late-recovery success resets to the full timeout. No hard retry cap: the
+# r03 lesson is that a recovery in the final minutes still wins the round,
+# and with 20 s probes the whole vigil tail costs less than one old probe.
+VIGIL_PROBE_MIN_TIMEOUT_S = 20
+# Wall reserved so the (tunnel-independent) zshard scaling section still
+# runs after a fruitless vigil — r05 skipped it entirely.
+ZSHARD_RESERVE_S = 150.0
 ACCEL_TIMEOUT_S = 900  # ONE attempt; killing mid-compile wedges the tunnel
 CPU_TIMEOUT_S = 420
 # When the initial probe round finds the tunnel wedged, the orchestrator runs
@@ -88,7 +100,10 @@ CPU_TIMEOUT_S = 420
 # accelerator at this spacing until the overall budget is spent — the round-2
 # lesson was that giving up after a 3-minute window forfeited the whole
 # round's TPU record while the orchestrator then idled 7 minutes on CPU work.
-PROBE_VIGIL_SPACING_S = 180
+# Base vigil re-probe cadence: 2x the probe timeout (so probing's wall
+# share stays ~1/3 as the backoff shrinks probes), floored at 60 s; at the
+# full 90 s probe timeout that is the historical 180 s spacing.
+PROBE_VIGIL_SPACING_S = 180  # == 2 * PROBE_TIMEOUT_S; see _accel_vigil
 VIGIL_BUDGET_ENV = "NM03_BENCH_VIGIL_BUDGET_S"
 # Total wall budget for the WHOLE orchestrator run — probe round, accel
 # attempt, CPU baseline, vigil, emit. MUST stay under the driver's 1800 s
@@ -143,11 +158,11 @@ def _obs_span(name: str):
 # cost is sequential sweeps, not bytes (iteration/latency-bound).
 _STAGE_BOUND = {
     "normalize_clip": "memory (VPU elementwise, HBM-limited)",
-    "median7": "compute (VPU Batcher-merge network, column presort)",
+    "median7": "compute (VPU pruned selection network, column presort)",
     "sharpen": "memory (9-tap shifted-add sweeps, HBM-limited)",
     "region_grow": "iteration (sequential one-ring fixpoint sweeps)",
     "cast_dilate": "memory (VPU reduce-window, HBM-limited)",
-    "render": "memory (gather + compositing, HBM-limited)",
+    "render": "memory (fused letterbox resample + integer overlay)",
 }
 # The `jump` growing schedule is out of the stage matrix (round 3): with the
 # pipeline's adaptive seed grid the band path length is bounded by seed
@@ -229,6 +244,44 @@ def _make_batch(batch: int | None = None):
     ).astype(np.float32)
     dims = np.full((batch, 2), CANVAS, np.int32)
     return pixels, dims
+
+
+def _batch_scaling_note(by_batch, best_batch, canvas):
+    """One-sentence attribution when a LARGER batch measures slower than the
+    sweep winner (ISSUE 2 satellite: the r05 record showed 111.61 at batch
+    256 vs 116.09 at 128 with nothing in the output saying why).
+
+    The cause was measured in round 5 (docs/PERF.md): radius distributions
+    are batch-invariant since the r05 generator fix, and the residual fall
+    tracks the working set — a 256-slice f32 canvas batch is 64 MB, past
+    any LLC on this host class — so it is cache footprint, not the grow
+    loop, and not worth chasing. The sweep already picks the best batch
+    for the headline automatically; the note makes the record
+    self-explaining. Returns None when no larger batch fell >3% below the
+    winner.
+    """
+    if not by_batch or best_batch is None:
+        return None
+    best = by_batch.get(str(best_batch))
+    if not best:
+        return None
+    worse = {
+        int(b): v
+        for b, v in by_batch.items()
+        if int(b) > int(best_batch) and v < 0.97 * best
+    }
+    if not worse:
+        return None
+    b = max(worse)
+    mb = b * canvas * canvas * 4 / 1e6
+    pct = round(100.0 * (1 - worse[b] / best), 1)
+    return (
+        f"batch {b} measures {pct}% below the batch-{best_batch} best: a "
+        f"{b}-slice f32 canvas batch is {mb:.0f} MB — past any LLC on this "
+        "host class, so the fall is cache footprint, not the grow loop "
+        "(lesion radii are batch-invariant since the r05 generator fix); "
+        "the sweep picks the best batch for the headline automatically"
+    )
 
 
 def _bench_on(device, pixels, dims, reps, use_pallas=False):
@@ -540,7 +593,7 @@ def _stage_times(device, reps):
             cfg.clip_high,
         )
     )
-    f_med = vm(lambda p: median_filter(p, cfg.median_window))
+    f_med = vm(lambda p: median_filter(p, cfg.median_window, impl=cfg.median_impl))
     f_sharp = vm(
         lambda p: sharpen(p, cfg.sharpen_gain, cfg.sharpen_sigma, cfg.sharpen_kernel)
     )
@@ -612,6 +665,38 @@ def _stage_times(device, reps):
             f"floor {ms - device_ms:.2f}) ({_STAGE_BOUND[name]})"
             + (f" {entry['achieved_gbps']} GB/s" if "achieved_gbps" in entry else "")
         )
+    # attribution extras for the two rebuilt stages (PR 2): the comparator
+    # counts behind the median's pruned selection network, and each fast
+    # path timed against the baseline it replaced — measured at the
+    # reference batch only, so the delta is one extra timing per stage
+    import dataclasses
+
+    from nm03_capstone_project_tpu.ops.selection_network import comparator_counts
+
+    stages["median7"]["comparators"] = comparator_counts(cfg.median_window)
+    f_med_merge = vm(
+        lambda p: median_filter(p, cfg.median_window, impl="merge")
+    )
+    merge_ms = _time_stage(f_med_merge, big["median7"], reps) * 1e3
+    stages["median7"]["merge_baseline_ms_per_batch"] = round(merge_ms, 3)
+    if stages["median7"]["ms_per_batch"] > 0:
+        stages["median7"]["pruned_vs_merge_speedup"] = round(
+            merge_ms / stages["median7"]["ms_per_batch"], 3
+        )
+    cfg_unfused = dataclasses.replace(cfg, render_fused=False)
+    f_render_unf = vm(lambda p, m, d: render_pair(p, m, d, cfg_unfused))
+    unf_ms = _time_stage(f_render_unf, big["render"], reps) * 1e3
+    stages["render"]["unfused_ms_per_batch"] = round(unf_ms, 3)
+    if stages["render"]["ms_per_batch"] > 0:
+        stages["render"]["fused_vs_unfused_speedup"] = round(
+            unf_ms / stages["render"]["ms_per_batch"], 3
+        )
+    _log(
+        "median7 pruned vs merge baseline: "
+        f"{stages['median7']['ms_per_batch']} vs {merge_ms:.2f} ms; "
+        f"render fused vs unfused: {stages['render']['ms_per_batch']} vs "
+        f"{unf_ms:.2f} ms"
+    )
     total = sum(s["ms_per_batch"] for s in stages.values())
     for s in stages.values():
         if total:
@@ -714,6 +799,11 @@ def worker(
             }
         )
     tput, batch, xla_sum, pixels, dims = best
+    if len(batches) > 1:
+        note = _batch_scaling_note(by_batch, batch, CANVAS)
+        if note:
+            emit({"batch_note": note})
+            _log(f"batch scaling: {note}")
     if profile_dir:
         # dedicated traced rep-block at the winning batch, off the clock
         _log(f"capturing profiler trace at batch {batch} into {profile_dir}")
@@ -960,15 +1050,17 @@ def _parse_sentinel(stdout: str):
 _PROBE_HISTORY: list = []
 
 
-def _probe_once(env_overrides, label, t0) -> bool:
+def _probe_once(env_overrides, label, t0, timeout_s=PROBE_TIMEOUT_S) -> bool:
     """One probe attempt, recorded in _PROBE_HISTORY with rc / duration /
-    stderr tail (and, on a timeout, a snapshot of candidate claim-holders)."""
+    stderr tail (and, on a timeout, a snapshot of candidate claim-holders).
+    ``timeout_s`` lets the vigil shrink probe work as timeouts repeat."""
     start = time.monotonic()
-    rc, stdout, stderr = _spawn(label, ["--probe"], env_overrides, PROBE_TIMEOUT_S)
+    rc, stdout, stderr = _spawn(label, ["--probe"], env_overrides, timeout_s)
     entry = {
         "t_offset_s": round(start - t0, 1),
         "rc": rc,
         "duration_s": round(time.monotonic() - start, 1),
+        "timeout_s": timeout_s,
     }
     res = _parse_sentinel(stdout) if rc == 0 else None
     if res is not None:
@@ -1045,13 +1137,22 @@ def _accel_vigil(env_overrides, t0, deadline) -> bool:
     CPU work with no re-probe (VERDICT r2 weak item 1).
 
     Two-tier cadence: the instant TCP relay check runs every 20s, and the
-    expensive jax probe (90s timeout on a dead relay) fires when a relay
-    port opens — so a recovery is caught within seconds — or on the
-    3-minute schedule regardless, as a safety net against the port
-    assumption being wrong.
+    expensive jax probe fires when a relay port opens — so a recovery is
+    caught within seconds — or on the 3-minute schedule regardless, as a
+    safety net against the port assumption being wrong.
+
+    Probe work backs off as timeouts repeat (the r05 lesson: vigil probe 4
+    burned a full 90 s timeout with the budget nearly spent, and the
+    zshard section was then skipped): every consecutive probe TIMEOUT
+    halves the next probe's timeout down to VIGIL_PROBE_MIN_TIMEOUT_S — a
+    fast error (rc != 0) resets the backoff, since the tunnel is at least
+    answering, and a healthy backend answers a probe in seconds, so the
+    shrunken timeout still catches a real recovery. The caller's deadline
+    additionally reserves the zshard section's slot (main()).
     """
     attempt = 0
     last_full_probe = -float("inf")
+    probe_timeout = PROBE_TIMEOUT_S
     while True:
         remaining = deadline - time.monotonic()
         if remaining <= 0:
@@ -1064,9 +1165,16 @@ def _accel_vigil(env_overrides, t0, deadline) -> bool:
         # probe hammer (stamped AFTER the probe so its own duration does
         # not count toward the interval)
         relay_up = any(v == "open" for v in tcp.values()) and since_last >= 60
-        due = since_last >= PROBE_VIGIL_SPACING_S
+        # spacing scales with the backed-off probe cost: a full 90 s probe
+        # keeps the PROBE_VIGIL_SPACING_S (3-minute) cadence, a
+        # halved-down 20 s probe re-probes every minute — the wall share
+        # of probing stays ~1/3 while a late recovery is caught minutes
+        # sooner (and the r05 failure mode of a single probe eating the
+        # tail of the budget cannot recur)
+        spacing = max(probe_timeout * PROBE_VIGIL_SPACING_S // PROBE_TIMEOUT_S, 60)
+        due = since_last >= spacing
         if relay_up or due:
-            if remaining < PROBE_TIMEOUT_S + MIN_ACCEL_REDUCED_S + EMIT_RESERVE_S:
+            if remaining < probe_timeout + MIN_ACCEL_REDUCED_S + EMIT_RESERVE_S:
                 # a probe launched now either overshoots the wall budget or
                 # recovers a tunnel there is no time left to measure on —
                 # both are wasted wall; stop cleanly instead
@@ -1075,11 +1183,18 @@ def _accel_vigil(env_overrides, t0, deadline) -> bool:
             if relay_up:
                 _log(f"vigil: relay TCP open ({tcp}); probing now")
             attempt += 1
-            ok = _probe_once(env_overrides, f"vigil probe {attempt}", t0)
+            ok = _probe_once(
+                env_overrides, f"vigil probe {attempt}", t0, probe_timeout
+            )
             last_full_probe = time.monotonic()
             if ok:
                 _log(f"vigil: tunnel recovered on re-probe {attempt}")
                 return True
+            if _PROBE_HISTORY and _PROBE_HISTORY[-1]["rc"] is None:
+                probe_timeout = max(probe_timeout // 2, VIGIL_PROBE_MIN_TIMEOUT_S)
+                _log(f"vigil: probe timed out; next probe capped at {probe_timeout}s")
+            else:
+                probe_timeout = PROBE_TIMEOUT_S
         time.sleep(min(TCP_VIGIL_SPACING_S, max(deadline - time.monotonic(), 1)))
 
 
@@ -1139,7 +1254,7 @@ def _copy_optional(out: dict, rec: dict) -> None:
     for key in ("stages", "device_kind", "hbm_peak_gbps",
                 "fused_min_traffic_gbps", "profile_dir", "student_tput",
                 "volume", "xla_scan_tput", "scan_chunk",
-                "scan_checksum_ok"):
+                "scan_checksum_ok", "batch_note"):
         if key in rec:
             out[key] = rec[key]
 
@@ -1359,6 +1474,34 @@ def _slim_record(record: dict) -> dict:
     return slim
 
 
+def _record_path_metrics(record) -> None:
+    """Mirror which median/render path the measured pipeline ran (and its
+    comparator counts) into the metrics registry, so a ``--metrics-out``
+    snapshot is self-describing (ISSUE 2 satellite). Delegates to
+    ``RunContext.record_pipeline_paths`` — the single owner of these
+    series — with every value derived from the worker's record (plain
+    dict reads; the orchestrator never imports jax): the stage matrix
+    measures the default PipelineConfig, i.e. the pruned XLA median and
+    the fused render, and a checksum-gated Pallas headline win means the
+    Pallas (shared-plan) path is what the record's number ran.
+    """
+    if _OBS_CTX is None or not record:
+        return
+    with contextlib.suppress(Exception):  # telemetry never costs a record
+        stages = record.get("stages") or {}
+        winning = str(record.get("winning_path", "xla"))
+        _OBS_CTX.record_pipeline_paths(
+            median_impl="pruned",  # PipelineConfig default the worker measures
+            render_fused="fused_vs_unfused_speedup" in (stages.get("render") or {}),
+            # the pallas leg measures PipelineConfig(use_pallas=True), whose
+            # fuse_preprocess default routes the fused kernel on chip
+            fuse_preprocess=winning == "pallas",
+            use_pallas=winning == "pallas",
+            comparators=(stages.get("median7") or {}).get("comparators"),
+            winning_path=winning,
+        )
+
+
 def _emit_final(state) -> None:
     """Bank the full record, then put exactly ONE short JSON line on stdout.
 
@@ -1374,6 +1517,7 @@ def _emit_final(state) -> None:
         # histograms, phase counters) next to the measured numbers; the
         # slim stdout line sheds it under size pressure like any optional
         # section. close() also writes --metrics-out / run_finished.
+        _record_path_metrics(state.get("accel") or state.get("cpu"))
         with contextlib.suppress(Exception):
             state["meta"]["metrics"] = _OBS_CTX.metrics_snapshot()
             _OBS_CTX.close(
@@ -1512,9 +1656,14 @@ def main(metrics_out: str | None = None, log_json: str | None = None) -> None:
         _bank_partial(state)
         # now spend whatever budget remains waiting for the tunnel; a late
         # recovery gets a deadline-capped (possibly shed) attempt with no
-        # CPU reserve — the baseline above is the only cpu work this path does
+        # CPU reserve — the baseline above is the only cpu work this path
+        # does. The vigil's own deadline additionally reserves the zshard
+        # slot (r05 skipped that section entirely after the vigil ate the
+        # tail of the budget); a recovered tunnel's ACCEL attempt still
+        # gets the full deadline — an accelerator record outranks the
+        # virtual-mesh curve.
         _obs_event("bench_phase", phase="vigil")
-        if _accel_vigil({}, t0, deadline):
+        if _accel_vigil({}, t0, deadline - ZSHARD_RESERVE_S):
             _obs_event("bench_phase", phase="accel_attempt", late_recovery=True)
             with _obs_span("accel"):
                 state["accel"] = _measure_accel(deadline, cpu_banked=True)

@@ -120,13 +120,39 @@ def make_run_context(
     from nm03_capstone_project_tpu.obs import RunContext
 
     sink = rank == 0
-    return RunContext.create(
+    ctx = RunContext.create(
         driver,
         metrics_out=getattr(args, "metrics_out", None) if sink else None,
         log_json=getattr(args, "log_json", None) if sink else None,
         heartbeat_s=getattr(args, "heartbeat_s", 0.0) or 0.0,
         argv=argv,
     )
+    if hasattr(args, "median_impl"):
+        # snapshot which median/render paths this run will ACTUALLY use,
+        # plus the comparator counts behind the median network (jax-free
+        # module). A --use-pallas request on a non-TPU backend silently
+        # degrades to the XLA path in every dispatcher, so the recorded
+        # label must resolve the backend the same way — a CPU run must
+        # never be attributed to the Pallas kernels.
+        from nm03_capstone_project_tpu.ops.selection_network import (
+            comparator_counts,
+        )
+
+        use_pallas = getattr(args, "use_pallas", False)
+        if use_pallas:
+            from nm03_capstone_project_tpu.ops.pallas_median import (
+                pallas_backend_supported,
+            )
+
+            use_pallas = pallas_backend_supported()
+        ctx.record_pipeline_paths(
+            median_impl=args.median_impl,
+            render_fused=not getattr(args, "no_render_fuse", False),
+            fuse_preprocess=not getattr(args, "no_preprocess_fuse", False),
+            use_pallas=use_pallas,
+            comparators=comparator_counts(args.median_window),
+        )
+    return ctx
 
 
 def add_pipeline_args(parser: argparse.ArgumentParser) -> None:
@@ -152,6 +178,28 @@ def add_pipeline_args(parser: argparse.ArgumentParser) -> None:
         "--use-pallas",
         action="store_true",
         help="route hot ops through the Pallas TPU kernels",
+    )
+    g.add_argument(
+        "--median-impl",
+        choices=["pruned", "merge", "sort"],
+        default=d.median_impl,
+        help="XLA median implementation: pruned selection network (fast "
+        "default), full odd-even merge baseline, or the sort oracle — all "
+        "bit-identical (ops.selection_network)",
+    )
+    g.add_argument(
+        "--no-preprocess-fuse",
+        action="store_true",
+        help="with --use-pallas on TPU, run median/growing as separate "
+        "Pallas kernels instead of the fused normalize->clip->median->"
+        "sharpen preprocessing kernel",
+    )
+    g.add_argument(
+        "--no-render-fuse",
+        action="store_true",
+        help="render the export pair as two independent device passes "
+        "instead of the fused shared-geometry pass (pixel-identical; the "
+        "unfused path is the comparison baseline bench.py times against)",
     )
     g.add_argument(
         "--grow-algorithm",
@@ -192,6 +240,9 @@ def pipeline_config_from_args(args: argparse.Namespace) -> PipelineConfig:
         render_size=args.render_size,
         canvas=args.canvas,
         use_pallas=args.use_pallas,
+        median_impl=args.median_impl,
+        fuse_preprocess=not args.no_preprocess_fuse,
+        render_fused=not args.no_render_fuse,
         grow_algorithm=args.grow_algorithm,
         grow_block_iters=args.grow_block_iters,
         grow_max_iters=args.grow_max_iters,

@@ -90,6 +90,21 @@ class PipelineConfig:
     # ops.pallas_region_growing) instead of the portable XLA implementations.
     # Defaults False until the caller knows it's on a TPU backend.
     use_pallas: bool = False
+    # XLA median implementation: 'pruned' (the liveness-pruned selection
+    # network, the fast default — ops.selection_network), 'merge' (the full
+    # odd-even merge baseline it is counted/benchmarked against), or 'sort'
+    # (the materialize-and-sort oracle). All bit-identical on real data.
+    median_impl: str = "pruned"
+    # Fuse normalize->clip->median->sharpen into one VMEM-resident Pallas
+    # kernel when running on TPU with use_pallas (one HBM read of the image
+    # instead of four stage round trips); off-TPU the stages compose in XLA
+    # (which fuses them itself) regardless of this flag.
+    fuse_preprocess: bool = True
+    # Fused device render: one jitted pass sharing the letterbox geometry
+    # between the grayscale and segmentation renders, with the mask leg in
+    # uint8 (render.render_pair_fused — pixel-identical to the unfused
+    # pair; False restores the two independent render calls).
+    render_fused: bool = True
 
     def __post_init__(self):
         # Fail at construction (CLI parse time), not deep inside a traced op.
@@ -123,6 +138,11 @@ class PipelineConfig:
                 "grow_algorithm='jump' and use_pallas are mutually exclusive: "
                 "the Pallas grow kernel implements the dilate schedule, so the "
                 "jump request would be silently ignored on TPU — pick one"
+            )
+        if self.median_impl not in ("pruned", "merge", "sort"):
+            raise ValueError(
+                f"median_impl must be 'pruned', 'merge' or 'sort', got "
+                f"{self.median_impl!r}"
             )
 
     @property

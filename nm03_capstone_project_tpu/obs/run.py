@@ -206,6 +206,65 @@ class RunContext:
             **fields,
         )
 
+    # the comparator_counts() keys that are actually op counts — "window"
+    # is the kernel size and must not be emitted under an ops-gauge name
+    _COMPARATOR_COUNT_KEYS = (
+        "merge_minmax_full",
+        "merge_minmax_pruned",
+        "merge_minmax_pruned_shared",
+        "presort_minmax",
+    )
+
+    def record_pipeline_paths(
+        self,
+        median_impl: str,
+        render_fused: bool,
+        fuse_preprocess: bool,
+        use_pallas: bool,
+        comparators: Optional[dict] = None,
+        **extra_labels: str,
+    ) -> None:
+        """Make the metrics snapshot self-describing about which median /
+        render implementation the run ACTUALLY used (ISSUE 2 satellite):
+        an info-style gauge whose labels carry the paths, plus the
+        median's comparator counts when the caller supplies them
+        (pure-Python data from ops.selection_network — this module stays
+        jax-free). The single owner of these series: the CLI drivers and
+        bench.py both emit through here so the label contract cannot
+        drift.
+
+        ``use_pallas`` must already be resolved against the real backend
+        (a --use-pallas request silently degrades off-TPU). When the
+        fused Pallas preprocess runs, it always executes the shared
+        pruned plan — ``median_impl`` is not consulted — so the label is
+        overridden accordingly rather than attributing the run to an
+        implementation that never executed. ``extra_labels`` lets callers
+        add context (bench: ``winning_path``).
+        """
+        if use_pallas:
+            # both the fused preprocess kernel and the standalone band
+            # kernel run the shared pruned plan; median_impl only selects
+            # among the XLA implementations
+            median_impl = "pallas_shared_pruned"
+        self.registry.gauge(
+            "nm03_pipeline_path_info",
+            help="pipeline implementation choices for this run (value is "
+            "always 1; the labels carry the information)",
+            median_impl=str(median_impl),
+            render="fused" if render_fused else "unfused",
+            preprocess="fused_pallas" if (use_pallas and fuse_preprocess) else "xla",
+            use_pallas=str(bool(use_pallas)).lower(),
+            **{k: str(v) for k, v in extra_labels.items()},
+        ).set(1)
+        for key in self._COMPARATOR_COUNT_KEYS:
+            if key in (comparators or {}):
+                self.registry.gauge(
+                    "nm03_median_comparator_minmax_ops",
+                    help="min/max ops per pixel of the median merge phase by "
+                    "network variant (ops.selection_network)",
+                    variant=key,
+                ).set(float(comparators[key]))
+
     # -- export / teardown -------------------------------------------------
 
     def metrics_snapshot(self) -> dict:

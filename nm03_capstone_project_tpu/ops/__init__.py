@@ -31,8 +31,13 @@ from nm03_capstone_project_tpu.ops.elementwise import (  # noqa: F401
 )
 from nm03_capstone_project_tpu.ops.median import (  # noqa: F401
     vector_median_filter,
+    vector_median_filter_merge,
     vector_median_filter_multichannel,
     vector_median_filter_sort,
+)
+from nm03_capstone_project_tpu.ops.selection_network import (  # noqa: F401
+    comparator_counts,
+    median_merge_plan,
 )
 from nm03_capstone_project_tpu.ops.morphology import dilate, erode  # noqa: F401
 from nm03_capstone_project_tpu.ops.neighborhood import extend_edges  # noqa: F401

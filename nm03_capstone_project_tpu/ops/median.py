@@ -6,28 +6,34 @@ edge-preserving denoise stage and one of the two hot per-pixel kernels.
 
 The vector median of a window is the sample minimizing the summed L1 distance
 to all other samples; for single-channel data that minimizer is exactly the
-scalar median sample, so the scalar path computes a median-of-k^2. Three
+scalar median sample, so the scalar path computes a median-of-k^2. The
 implementations share the contract:
 
 * :func:`vector_median_filter` — the default XLA path: **column-presorted
-  Batcher merge network**. The k vertical neighbors are sorted ONCE per
+  pruned selection network**. The k vertical neighbors are sorted ONCE per
   column with a sorting network (shared by all k horizontal windows that
-  read that column — the classic amortization of fast 2D median filters),
-  then the k sorted runs are merged with Batcher odd-even merge networks
-  and the rank-k²//2 element is taken. Runs are padded to powers of two
-  with +inf sentinels that are folded away in Python (a compare-exchange
-  against +inf is a no-op or a swap), so the emitted XLA graph contains
-  only real min/max pairs — several-fold fewer than sorting the full k²
-  window stack, and XLA dead-code-eliminates the pairs that cannot reach
-  the median output.
+  read that column), then the plan from :mod:`.selection_network` merges
+  the sorted columns, replaces the final merge with a rank-k²//2
+  selection, and backward-liveness-prunes every op the median cannot see
+  — 1.64x fewer min/max ops traced than the full odd-even merge tree at
+  k=7 (566 -> 346). The Pallas kernel runs the *shared* variant of the
+  same plan (subtree merges built once and referenced at lane shifts
+  across the k overlapping windows in x — 566 -> 262, 2.16x fewer; see
+  selection_network for why sharing is a Pallas-only win).
+* :func:`vector_median_filter_merge` — the previous default, kept as the
+  comparison baseline: full Batcher odd-even merge of the presorted runs,
+  rank k²//2 read at the end. Selected by ``PipelineConfig``'s
+  ``median_impl='merge'``.
 * :func:`vector_median_filter_sort` — the straightforward sort-the-window
-  implementation; kept as the readable in-repo oracle (SciPy is the
-  external one).
-* ``ops.pallas_median`` (Pallas TPU kernel, pairwise rank selection,
-  VMEM-resident tiles) — selected via ``PipelineConfig.use_pallas``.
+  implementation; the readable in-repo oracle (SciPy is the external one).
+* ``ops.pallas_median`` (Pallas TPU kernel, VMEM-resident tiles) — runs
+  the same pruned plan per row band; selected via
+  ``PipelineConfig.use_pallas``.
 
-All three are bit-identical on real data. (Pathological caveat shared with
-any min/max network: NaNs are unordered and -0.0/+0.0 compare equal, so
+All are bit-identical on real data: the pruned plan is value-equivalent to
+the full network by construction (rank selection is an identity on values,
+liveness only removes dead ops). (Pathological caveat shared with any
+min/max network: NaNs are unordered and -0.0/+0.0 compare equal, so
 windows containing those may differ bitwise from a total-order sort; the
 pipeline's median consumes clipped intensities in [0.68, 4000], where
 neither occurs.)
@@ -38,42 +44,31 @@ the reference inherits.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from nm03_capstone_project_tpu.ops.neighborhood import shifted_stack, window_offsets
+from nm03_capstone_project_tpu.ops.selection_network import (
+    MedianPlan,
+    median_merge_plan,
+    next_pow2 as _next_pow2,  # noqa: F401 — re-exported for callers/tests
+    oddeven_merge_pairs,
+    oddeven_sort_pairs,
+)
 
 _PAD = None  # Python-level +inf sentinel; folded before any op is emitted
 
 
-def _next_pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p
-
-
 def _oddeven_merge_pairs(lo: int, n: int, r: int, pairs: List[Tuple[int, int]]):
-    """Batcher odd-even merge: positions [lo, lo+n) hold two sorted halves."""
-    step = 2 * r
-    if step < n:
-        _oddeven_merge_pairs(lo, n, step, pairs)
-        _oddeven_merge_pairs(lo + r, n, step, pairs)
-        for i in range(lo + r, lo + n - r, step):
-            pairs.append((i, i + r))
-    else:
-        pairs.append((lo, lo + r))
+    """Batcher odd-even merge pair generation (see ops.selection_network)."""
+    oddeven_merge_pairs(lo, n, r, pairs)
 
 
 def _oddeven_sort_pairs(lo: int, n: int, pairs: List[Tuple[int, int]]):
-    """Batcher odd-even mergesort network for positions [lo, lo+n), n = 2^m."""
-    if n > 1:
-        m = n // 2
-        _oddeven_sort_pairs(lo, m, pairs)
-        _oddeven_sort_pairs(lo + m, m, pairs)
-        _oddeven_merge_pairs(lo, n, 1, pairs)
+    """Batcher odd-even sort pair generation (see ops.selection_network)."""
+    oddeven_sort_pairs(lo, n, pairs)
 
 
 def _apply_pairs(vals: List[Optional[jax.Array]], pairs) -> None:
@@ -105,15 +100,59 @@ def _sort_network(vals: List[jax.Array]) -> List[jax.Array]:
     return padded[:n]  # ascending; pads sorted to the tail
 
 
+def _execute_plan(
+    plan: MedianPlan, padded_rows: List[jax.Array], w_out: int
+) -> jax.Array:
+    """Run a selection-network plan over k presorted full-width rows.
+
+    ``padded_rows`` are the ascending vertical-sort outputs, each padded by
+    r = k//2 lanes of edge replication on both sides (the clamp-to-edge
+    window columns), so lane domain [-r, w_out + r) exists for every input.
+    Each plan node is computed ONCE on the lane interval its consumers
+    reach it at (the cross-window sharing: a node referenced at several
+    shifts becomes one slightly wider array, not several re-merges); static
+    slices feed the operands, so XLA sees a pure min/max DAG.
+    """
+    r = plan.k // 2
+    # backward pass: the union of lane shifts each value is consumed at
+    need: Dict[int, set] = {plan.out[0]: {plan.out[1]}}
+    for kind, out, a, ash, b, bsh in reversed(plan.ops):
+        for s in need.get(out, ()):
+            need.setdefault(a, set()).add(s + ash)
+            need.setdefault(b, set()).add(s + bsh)
+    dom = {i: (min(ss), max(ss)) for i, ss in need.items()}
+    arrs: Dict[int, jax.Array] = {}
+    los: Dict[int, int] = {}
+    for i in range(plan.k):
+        lo, hi = dom.get(i, (0, 0))
+        arrs[i] = padded_rows[i][..., lo + r : hi + r + w_out]
+        los[i] = lo
+    for kind, out, a, ash, b, bsh in plan.ops:
+        if out not in dom:  # dead op of an unpruned plan
+            continue
+        lo, hi = dom[out]
+        wn = w_out + hi - lo
+        sa = lo + ash - los[a]
+        sb = lo + bsh - los[b]
+        av = arrs[a][..., sa : sa + wn]
+        bv = arrs[b][..., sb : sb + wn]
+        arrs[out] = jnp.minimum(av, bv) if kind == "min" else jnp.maximum(av, bv)
+        los[out] = lo
+    oi, osh = plan.out
+    s = osh - los[oi]
+    return arrs[oi][..., s : s + w_out]
+
+
 def _merge_runs_take_median(sorted_rows: List[jax.Array], k: int, colslice):
-    """Rank-k²//2 of the k*k window given k vertically-sorted row arrays.
+    """Rank-k²//2 of the k*k window given k vertically-sorted row arrays —
+    the FULL odd-even merge baseline (``median_impl='merge'``).
 
     ``colslice(a, j)`` extracts the j-th (0-based) horizontal window column
-    from a sorted row array — the only step that differs between the XLA
-    path (edge-padded dynamic slice) and the Pallas kernel (static slice of
-    the already-padded VMEM band). Shared so the two paths cannot drift
-    apart: runs are +inf-padded to powers of two (folded in Python by
-    :func:`_apply_pairs`) and merged with a Batcher odd-even merge tree.
+    from a sorted row array. Runs are +inf-padded to powers of two (folded
+    in Python by :func:`_apply_pairs`) and merged with a Batcher odd-even
+    merge tree; XLA dead-code-eliminates the pairs that cannot reach the
+    median output. Kept verbatim as the comparison baseline the pruned
+    plan is counted (and benchmarked) against.
     """
     p_run = _next_pow2(k)  # slots per run, +inf padded
     n_runs = _next_pow2(k)  # number of runs, all-+inf runs appended
@@ -136,11 +175,21 @@ def _merge_runs_take_median(sorted_rows: List[jax.Array], k: int, colslice):
     return med
 
 
+def _presorted_rows(x: jax.Array, k: int) -> List[jax.Array]:
+    """The k ascending vertical neighbors per column (clamp-to-edge),
+    shared across the k horizontal windows that read each column."""
+    r = k // 2
+    rows = shifted_stack(x, [(dr, 0) for dr in range(-r, k - r)], pad_mode="edge")
+    return _sort_network([rows[i] for i in range(k)])
+
+
 def vector_median_filter(x: jax.Array, size: int = 7) -> jax.Array:
     """Median over a size x size clamp-to-edge window (fast XLA path).
 
     ``x`` is (..., H, W) float; returns the same shape/dtype. The median of
     an odd k*k window equals the vector median (L1) for scalar samples.
+    Column presort + the pruned selection network of
+    :func:`.selection_network.median_merge_plan`.
     """
     if size % 2 != 1:
         raise ValueError(f"median window must be odd, got {size}")
@@ -148,11 +197,29 @@ def vector_median_filter(x: jax.Array, size: int = 7) -> jax.Array:
         return x
     k = size
     r = k // 2
+    sorted_rows = _presorted_rows(x, k)
+    pw = [(0, 0)] * (x.ndim - 1) + [(r, r)]
+    padded = [jnp.pad(a, pw, mode="edge") for a in sorted_rows]
+    # unshared plan: shifts only on the k input rows, so the whole merge
+    # stays one elementwise DAG XLA fuses into a register-resident loop
+    # (the shared plan belongs to the Pallas kernel — see selection_network)
+    return _execute_plan(median_merge_plan(k, share=False), padded, x.shape[-1])
 
-    # vertical sort, shared across the k horizontal windows per column:
-    # row-shifted full-width views -> k sorted arrays (16 CEs for k=7)
-    rows = shifted_stack(x, [(dr, 0) for dr in range(-r, k - r)], pad_mode="edge")
-    sorted_rows = _sort_network([rows[i] for i in range(k)])
+
+def vector_median_filter_merge(x: jax.Array, size: int = 7) -> jax.Array:
+    """Median via the full odd-even merge network (the pre-pruning default).
+
+    Bit-identical to :func:`vector_median_filter`; kept as the baseline the
+    comparator-count reduction and the bench stage delta are measured
+    against (``median_impl='merge'``).
+    """
+    if size % 2 != 1:
+        raise ValueError(f"median window must be odd, got {size}")
+    if size == 1:
+        return x
+    k = size
+    r = k // 2
+    sorted_rows = _presorted_rows(x, k)
 
     def colslice(a: jax.Array, j: int) -> jax.Array:
         pw = [(0, 0)] * (a.ndim - 1) + [(r, r)]

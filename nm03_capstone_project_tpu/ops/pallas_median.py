@@ -1,4 +1,5 @@
-"""Pallas TPU kernel for the 7x7 vector median filter.
+"""Pallas TPU kernels for the 7x7 vector median filter and the fused
+normalize -> clip -> median -> sharpen preprocessing stage.
 
 The hot stencil of the pipeline (FAST ``VectorMedianFilter::create(7)``,
 src/test/test_pipeline.cpp:65-66) as a VMEM-resident selection-network
@@ -9,19 +10,45 @@ kernel:
   step produces one row band of output, so the working set — the k sorted
   row views plus the in-flight merge values — stays comfortably under the
   ~16 MB VMEM budget at any canvas size.
-* Selection runs the same column-presorted Batcher merge network as the XLA
-  path (:mod:`.median`, whose pair-generation and +inf-folding machinery is
-  reused verbatim): the k vertical neighbors are sorted once per column (a
-  16-CE network for k=7, shared by the k horizontal windows reading that
-  column), the k sorted runs are merged with odd-even merge networks, and
-  the rank-k²//2 element is the median — a few hundred VPU min/max ops per
-  pixel band, no data-dependent control flow. (An earlier revision selected
-  by all-pairs rank counting: k²(k²-1)/2 = 1176 compares plus two integer
-  adds each — about 7x the work for the same result.)
+* Selection runs the **shared pruned plan** of
+  :mod:`.selection_network`: the k vertical neighbors are sorted once per
+  column (a 16-CE network for k=7, shared by the k horizontal windows
+  reading that column), canonical subtree merges are built once and
+  referenced at lane shifts across the overlapping windows, the final
+  merge is replaced by a rank-k²//2 selection, and dead ops are pruned —
+  262 VPU min/max ops per pixel at k=7 where the odd-even merge tree of
+  earlier revisions cost 566. On VMEM-resident values the op count IS the
+  cost, which is why the kernel takes the shared variant while the XLA
+  path takes the unshared one (see selection_network's docstring for the
+  measured fusion rationale).
 
-The portable XLA implementation (:func:`.median.vector_median_filter`) is the
-oracle; the test suite asserts bit-identical outputs in interpret mode, and
-the wrapper transparently falls back to it off-TPU.
+:func:`fused_preprocess_pallas` extends the same banding to the whole
+preprocessing chain: one kernel reads each input band from HBM once,
+normalizes + clips in registers, runs the median plan, and applies the
+unsharp sharpen (separable gaussian, identical tap order to
+:mod:`.sharpen`) before writing the single f32 output band — one HBM
+read/write of the image instead of four round trips through the four
+stage boundaries. Canvas-boundary halos replicate the *median output*
+edge rows/cols in-kernel (a jnp.where against the row index plus an edge
+concat), reproducing the unfused path's pad-per-stage semantics exactly.
+
+Exactness contract: the median band kernel is **bit-identical** to the
+XLA path (pure min/max — no arithmetic to re-associate). The fused
+preprocess kernel is exact in its windowing/halo semantics but its
+normalize/sharpen *arithmetic* may differ from the unfused composition by
+a few ulp (measured <= 4 across 90 random canvases vs the JITTED
+composition — the thing the pipeline actually runs; the eager evaluation
+of the same code differs from its own jit by up to 8 ulp, i.e. more than
+the kernel does): separately compiled programs contract ``a*b+c`` into
+fma (single rounding) or not depending on the fusion shape — the 1-ulp
+blur variance is then amplified by the unsharp update's cancellation.
+Unobservable from JAX, and the same class of divergence the render
+module documents for its matmul-vs-gather samplers. The test suite pins
+an 8-ulp bound; the bench's checksum gate (mask equality) remains the
+end-to-end guard.
+
+The portable XLA implementations are the oracle; the wrappers
+transparently fall back to them off-TPU.
 """
 
 from __future__ import annotations
@@ -32,6 +59,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from nm03_capstone_project_tpu.ops.selection_network import median_merge_plan
 
 
 def _pick_tile(
@@ -50,9 +79,12 @@ def _pick_tile(
     row copies per band row (calibrated against the measured 17.07 MB
     scoped allocation at k=7, band rows 70, w 1030 — the 1024² OOM; the
     model scales with window size and element width rather than
-    hard-coding that point). When even the minimum legal band (8 rows, or
-    ``h`` when h < 8) exceeds the budget — short-but-very-wide canvases —
-    the caller falls back to the XLA path instead of OOMing on chip.
+    hard-coding that point). The fused preprocess kernel passes the summed
+    halo radius (median + sharpen), which the same model covers: its extra
+    blur temporaries ride inside the 9x factor's slack. When even the
+    minimum legal band (8 rows, or ``h`` when h < 8) exceeds the budget —
+    short-but-very-wide canvases — the caller falls back to the XLA path
+    instead of OOMing on chip.
     """
     # estimate on the LANE-padded width (Mosaic pads the last dim to 128):
     # a 129-wide band really costs its 256-lane footprint
@@ -67,11 +99,8 @@ def _pick_tile(
 
 
 def _median_band_kernel(in_ref, out_ref, *, k: int, tile: int, w: int):
-    """One (tile, w) output band of the k x k median (Batcher selection)."""
-    from nm03_capstone_project_tpu.ops.median import (
-        _merge_runs_take_median,
-        _sort_network,
-    )
+    """One (tile, w) output band of the k x k median (pruned selection)."""
+    from nm03_capstone_project_tpu.ops.median import _execute_plan, _sort_network
 
     r = k // 2
     t = pl.program_id(1)
@@ -80,9 +109,7 @@ def _median_band_kernel(in_ref, out_ref, *, k: int, tile: int, w: int):
     # vertical presort over full-width rows: shared by all k horizontal
     # windows that read each column
     sorted_rows = _sort_network([band[dr : dr + tile, :] for dr in range(k)])
-    out_ref[0] = _merge_runs_take_median(
-        sorted_rows, k, lambda a, j: a[:, j : j + w]
-    )
+    out_ref[0] = _execute_plan(median_merge_plan(k, share=True), sorted_rows, w)
 
 
 @functools.partial(jax.jit, static_argnames=("size", "interpret"))
@@ -134,6 +161,219 @@ def vector_median_filter_pallas(
     return out[:, :h, :].reshape(orig_shape)
 
 
+def _fused_band_kernel(
+    in_ref,
+    out_ref,
+    *,
+    k: int,
+    tile: int,
+    w: int,
+    h: int,
+    taps: tuple,
+    norm_scale: float,
+    norm_low: float,
+    norm_min: float,
+    clip_low: float,
+    clip_high: float,
+    gain: float,
+):
+    """One (tile, w) band of normalize -> clip -> median -> sharpen.
+
+    The input band carries a (rm + rs)-row and rm-col halo (rm = median
+    radius, rs = sharpen radius). The median is computed for the band's
+    rows plus a ±rs halo; rows/cols of that halo falling outside the true
+    canvas are replaced by the median's own edge rows/cols (a where()
+    against the global row index, and an edge concat for columns), exactly
+    reproducing the unfused path where sharpen edge-pads the median
+    OUTPUT — median of replicated input rows is NOT the replicated median
+    row, so computing into the overhang and fixing up is the only band
+    decomposition that stays bit-identical.
+    """
+    from nm03_capstone_project_tpu.ops.median import _execute_plan, _sort_network
+
+    rm = k // 2
+    ks = len(taps)
+    rs = ks // 2
+    t = pl.program_id(1)
+    rows_m = tile + 2 * rs  # median output rows this band produces
+    band = in_ref[0, pl.ds(t * tile, rows_m + 2 * rm), :]
+    # normalize + clip, elementwise in registers (same expressions as
+    # ops.elementwise so results are bitwise equal)
+    xn = jnp.clip(
+        (band - norm_min) * norm_scale + norm_low, clip_low, clip_high
+    )
+    # median over the band: presort + the shared pruned selection plan
+    sorted_rows = _sort_network([xn[dr : dr + rows_m, :] for dr in range(k)])
+    m = _execute_plan(median_merge_plan(k, share=True), sorted_rows, w)
+    # --- canvas-boundary row fixup -------------------------------------
+    # global median row of band row i is t*tile - rs + i; rows outside
+    # [0, h) must hold the edge median row (the unfused path's pad).
+    row_g = t * tile - rs + jax.lax.broadcasted_iota(jnp.int32, (rows_m, 1), 0)
+    m = jnp.where(row_g < 0, m[rs : rs + 1, :], m)  # only band 0 clamps low
+    t_last = (h - 1) // tile  # static: h and tile are Python ints
+    idx_a = (h - 1) - (t_last * tile - rs)
+    if t_last >= 1 and (h - 1) % tile < rs:
+        # the band BEFORE the one holding row h-1 also overhangs: its copy
+        # of row h-1 sits one tile higher in band coordinates
+        idx_b = idx_a + tile
+        bot = jnp.where(t == t_last, m[idx_a : idx_a + 1, :], m[idx_b : idx_b + 1, :])
+    else:
+        bot = m[idx_a : idx_a + 1, :]
+    m = jnp.where(row_g > h - 1, bot, m)
+    # --- sharpen: edge col halo + separable gaussian (exact tap order) --
+    m_wide = jnp.concatenate(
+        [jnp.repeat(m[:, :1], rs, axis=1), m, jnp.repeat(m[:, -1:], rs, axis=1)],
+        axis=1,
+    )
+    acc = None
+    for i in range(ks):
+        term = jnp.float32(taps[i]) * m_wide[i : i + tile, :]
+        acc = term if acc is None else acc + term
+    blur = None
+    for i in range(ks):
+        term = jnp.float32(taps[i]) * acc[:, i : i + w]
+        blur = term if blur is None else blur + term
+    center = m_wide[rs : rs + tile, rs : rs + w]
+    out_ref[0] = center + gain * (center - blur)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "norm_low",
+        "norm_high",
+        "norm_min",
+        "norm_max",
+        "clip_low",
+        "clip_high",
+        "median_window",
+        "sharpen_gain",
+        "sharpen_sigma",
+        "sharpen_kernel",
+        "interpret",
+    ),
+)
+def fused_preprocess_pallas(
+    x: jax.Array,
+    *,
+    norm_low: float = 0.5,
+    norm_high: float = 2.5,
+    norm_min: float = 0.0,
+    norm_max: float = 10000.0,
+    clip_low: float = 0.68,
+    clip_high: float = 4000.0,
+    median_window: int = 7,
+    sharpen_gain: float = 2.0,
+    sharpen_sigma: float = 0.5,
+    sharpen_kernel: int = 9,
+    interpret: bool = False,
+) -> jax.Array:
+    """normalize -> clip -> k x k median -> unsharp sharpen, one kernel.
+
+    ``x`` is the (..., H, W) f32 canvas (already edge-extended for true
+    dims by the pipeline); returns the preprocessed canvas — same
+    windowing/halo semantics as the unfused XLA composition, arithmetic
+    within a few ulp of its jitted form (fma-contraction variance; see
+    the module docstring).
+    Each band is read from HBM once and written once — the four-stage
+    chain's intermediate round trips disappear into VMEM. Falls back to
+    the XLA composition when no band fits the VMEM budget.
+    """
+    from nm03_capstone_project_tpu.ops.sharpen import gaussian_kernel_1d
+
+    if median_window % 2 != 1:
+        raise ValueError(f"median window must be odd, got {median_window}")
+    k = median_window
+    rm = k // 2
+    rs = sharpen_kernel // 2
+    orig_shape = x.shape
+    xb = x.reshape((-1,) + x.shape[-2:]) if x.ndim != 2 else x[None]
+    b, h, w = xb.shape
+    tile = _pick_tile(h, w, rm + rs, x.dtype.itemsize)
+    if tile is None or h <= rs or tile < rs:
+        # no VMEM-legal band, a canvas so short the row-fixup's band
+        # arithmetic degenerates, or a band SMALLER than the sharpen halo
+        # (tile < rs: interior bands would then overhang the canvas and
+        # the two-candidate boundary fixup no longer covers them —
+        # reachable with large sharpen kernels on narrow VMEM budgets):
+        # compose the stages in XLA instead — identical math, just with
+        # materialized stage boundaries
+        return _fused_preprocess_xla(
+            x,
+            norm_low=norm_low,
+            norm_high=norm_high,
+            norm_min=norm_min,
+            norm_max=norm_max,
+            clip_low=clip_low,
+            clip_high=clip_high,
+            median_window=median_window,
+            sharpen_gain=sharpen_gain,
+            sharpen_sigma=sharpen_sigma,
+            sharpen_kernel=sharpen_kernel,
+        )
+    h_pad = (-h) % tile
+    halo = rm + rs
+    xp = jnp.pad(xb, ((0, 0), (halo, halo + h_pad), (rm, rm)), mode="edge")
+    taps = tuple(float(v) for v in gaussian_kernel_1d(sharpen_sigma, sharpen_kernel))
+    scale = (norm_high - norm_low) / (norm_max - norm_min)
+    kernel = functools.partial(
+        _fused_band_kernel,
+        k=k,
+        tile=tile,
+        w=w,
+        h=h,
+        taps=taps,
+        norm_scale=scale,
+        norm_low=norm_low,
+        norm_min=norm_min,
+        clip_low=clip_low,
+        clip_high=clip_high,
+        gain=sharpen_gain,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, (h + h_pad) // tile),
+        in_specs=[
+            pl.BlockSpec(
+                (1, h + h_pad + 2 * halo, w + 2 * rm),
+                lambda i, t: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (1, tile, w), lambda i, t: (i, t, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h + h_pad, w), x.dtype),
+        interpret=interpret,
+    )(xp)
+    return out[:, :h, :].reshape(orig_shape)
+
+
+def _fused_preprocess_xla(
+    x: jax.Array,
+    *,
+    norm_low,
+    norm_high,
+    norm_min,
+    norm_max,
+    clip_low,
+    clip_high,
+    median_window,
+    sharpen_gain,
+    sharpen_sigma,
+    sharpen_kernel,
+) -> jax.Array:
+    """The portable composition of the four stages (XLA fuses what it can)."""
+    from nm03_capstone_project_tpu.ops.elementwise import clip_intensity, normalize
+    from nm03_capstone_project_tpu.ops.median import vector_median_filter
+    from nm03_capstone_project_tpu.ops.sharpen import sharpen
+
+    out = normalize(x, norm_low, norm_high, norm_min, norm_max)
+    out = clip_intensity(out, clip_low, clip_high)
+    out = vector_median_filter(out, median_window)
+    return sharpen(out, sharpen_gain, sharpen_sigma, sharpen_kernel)
+
+
 def pallas_backend_supported() -> bool:
     """True iff the default backend can lower ``pltpu`` kernels.
 
@@ -146,15 +386,32 @@ def pallas_backend_supported() -> bool:
     return is_tpu_backend()
 
 
-def median_filter(x: jax.Array, size: int = 7, use_pallas: bool = False) -> jax.Array:
-    """Dispatch between the Pallas TPU kernel and the portable XLA path.
+def median_filter(
+    x: jax.Array, size: int = 7, use_pallas: bool = False, impl: str = "pruned"
+) -> jax.Array:
+    """Dispatch between the Pallas TPU kernel and the portable XLA paths.
 
-    On non-TPU backends the Pallas request transparently degrades to the XLA
-    implementation (same results), so one PipelineConfig serves tests,
-    CPU fallback and TPU runs.
+    ``impl`` selects the XLA implementation: 'pruned' (the selection
+    network, the default fast path), 'merge' (the full odd-even merge
+    baseline), or 'sort' (the materialize-and-sort oracle) — all
+    bit-identical; the non-default paths exist for comparison timing and
+    debugging (``PipelineConfig.median_impl``). On non-TPU backends a
+    Pallas request transparently degrades to the selected XLA
+    implementation, so one PipelineConfig serves tests, CPU fallback and
+    TPU runs.
     """
     if use_pallas and pallas_backend_supported():
         return vector_median_filter_pallas(x, size)
-    from nm03_capstone_project_tpu.ops.median import vector_median_filter
+    from nm03_capstone_project_tpu.ops.median import (
+        vector_median_filter,
+        vector_median_filter_merge,
+        vector_median_filter_sort,
+    )
 
+    if impl == "merge":
+        return vector_median_filter_merge(x, size)
+    if impl == "sort":
+        return vector_median_filter_sort(x, size)
+    if impl != "pruned":
+        raise ValueError(f"unknown median impl: {impl!r}")
     return vector_median_filter(x, size)

@@ -46,13 +46,41 @@ def preprocess(
     ``pixels`` is (..., H, W) on the static canvas; ``dims`` the true (h, w).
     The slice's true edge is replicated into the canvas padding first so the
     stencil stages see clamp-to-edge boundaries instead of padding zeros.
+
+    On a TPU backend with ``cfg.use_pallas`` and ``cfg.fuse_preprocess``
+    the whole chain runs as one VMEM-resident halo-tiled Pallas kernel
+    (ops.pallas_median.fused_preprocess_pallas — one HBM read of the image
+    instead of four stage round trips); everywhere else the stages compose
+    in XLA, which fuses the elementwise ops into the stencils itself.
     """
     x = extend_edges(pixels, dims)
+    if cfg.use_pallas and cfg.fuse_preprocess:
+        from nm03_capstone_project_tpu.ops.pallas_median import (
+            fused_preprocess_pallas,
+            pallas_backend_supported,
+        )
+
+        if pallas_backend_supported():
+            return fused_preprocess_pallas(
+                x,
+                norm_low=cfg.norm_low,
+                norm_high=cfg.norm_high,
+                norm_min=cfg.norm_intensity_min,
+                norm_max=cfg.norm_intensity_max,
+                clip_low=cfg.clip_low,
+                clip_high=cfg.clip_high,
+                median_window=cfg.median_window,
+                sharpen_gain=cfg.sharpen_gain,
+                sharpen_sigma=cfg.sharpen_sigma,
+                sharpen_kernel=cfg.sharpen_kernel,
+            )
     x = normalize(
         x, cfg.norm_low, cfg.norm_high, cfg.norm_intensity_min, cfg.norm_intensity_max
     )
     x = clip_intensity(x, cfg.clip_low, cfg.clip_high)
-    x = median_filter(x, cfg.median_window, use_pallas=cfg.use_pallas)
+    x = median_filter(
+        x, cfg.median_window, use_pallas=cfg.use_pallas, impl=cfg.median_impl
+    )
     x = sharpen(x, cfg.sharpen_gain, cfg.sharpen_sigma, cfg.sharpen_kernel)
     return x
 

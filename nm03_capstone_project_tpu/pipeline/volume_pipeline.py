@@ -51,7 +51,10 @@ def process_volume(
     this per patient; VERDICT r4 item 4).
     """
     # Per-slice 2D preprocessing — identical math to the batch drivers
-    # (main_sequential.cpp:194-208), vmapped over the stack.
+    # (main_sequential.cpp:194-208), vmapped over the stack. The PR-2 fast
+    # paths flow through cfg unchanged: median_impl selects the pruned
+    # selection network, and use_pallas + fuse_preprocess route the whole
+    # chain through the fused VMEM kernel per slice on TPU.
     pre = jax.vmap(lambda p: preprocess(p, dims, cfg))(volume)
 
     # The reference's adaptive seed grid (test_pipeline.cpp:79-106) is a pure

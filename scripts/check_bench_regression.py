@@ -1,0 +1,156 @@
+#!/usr/bin/env python3
+"""Per-stage bench regression gate.
+
+Diffs a bench record's per-stage ``ms_per_batch`` (the ``stages`` table
+``bench.py`` emits, either standalone or wrapped in a driver capture's
+``parsed`` field, or a ``--results-json`` payload embedding it) against the
+``stage_baseline`` section of BASELINE.json, and exits non-zero when any
+stage regressed by more than ``--threshold`` (default 10%).
+
+Pure stdlib / pure JSON — safe to run in CI or from the bench orchestrator
+host without touching jax. Comparisons are same-backend only: a CPU record
+diffed against a TPU baseline (or vice versa) is meaningless and exits 0
+with a note, so a wedged-tunnel round cannot fail the gate against chip
+numbers.
+
+Exit codes: 0 = no regression (or nothing comparable), 1 = regression,
+2 = unreadable/invalid input.
+
+``--update`` rewrites BASELINE.json's ``stage_baseline`` from the given
+record instead of comparing — run it after a deliberate perf change lands
+so the gate tracks the new floor.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _load_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"check_bench_regression: cannot read {path}: {e}", file=sys.stderr)
+        raise SystemExit(2)
+
+
+def extract_stages(record: dict):
+    """(backend, {stage: ms_per_batch}) from any bench record shape.
+
+    Accepts the bench's own emitted/banked record, a driver capture
+    (``{"parsed": {...}}``), or a results JSON that embedded the record.
+    Returns (None, {}) when no stage table is present.
+    """
+    if not isinstance(record, dict):
+        return None, {}
+    if "stages" not in record and isinstance(record.get("parsed"), dict):
+        record = record["parsed"]
+    stages = record.get("stages")
+    if not isinstance(stages, dict):
+        return None, {}
+    out = {}
+    for name, entry in stages.items():
+        if isinstance(entry, dict) and "ms_per_batch" in entry:
+            out[name] = float(entry["ms_per_batch"])
+    return record.get("backend") or record.get("device_kind"), out
+
+
+def compare(baseline: dict, current: dict, threshold: float):
+    """List of (stage, base_ms, cur_ms, ratio) regressions past threshold."""
+    regressions = []
+    for name, base_ms in baseline.items():
+        cur_ms = current.get(name)
+        if cur_ms is None or base_ms <= 0:
+            continue
+        ratio = cur_ms / base_ms
+        if ratio > 1.0 + threshold:
+            regressions.append((name, base_ms, cur_ms, ratio))
+    return regressions
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("results", help="bench record / driver capture JSON")
+    parser.add_argument(
+        "--baseline",
+        default="BASELINE.json",
+        help="baseline file holding the stage_baseline section",
+    )
+    parser.add_argument(
+        "--threshold",
+        type=float,
+        default=0.10,
+        help="fractional regression that fails the gate (default 0.10)",
+    )
+    parser.add_argument(
+        "--update",
+        action="store_true",
+        help="write the record's stages into the baseline instead of comparing",
+    )
+    args = parser.parse_args(argv)
+
+    record = _load_json(args.results)
+    backend, current = extract_stages(record)
+    if not current:
+        print(
+            f"check_bench_regression: no stage table in {args.results}; "
+            "nothing to gate",
+        )
+        return 0
+
+    base_doc = _load_json(args.baseline)
+    if args.update:
+        base_doc["stage_baseline"] = {
+            "backend": backend,
+            "source": args.results,
+            "ms_per_batch": current,
+        }
+        with open(args.baseline, "w") as f:
+            json.dump(base_doc, f, indent=2)
+            f.write("\n")
+        print(
+            f"check_bench_regression: baseline updated from {args.results} "
+            f"({len(current)} stages, backend {backend})"
+        )
+        return 0
+
+    section = base_doc.get("stage_baseline") or {}
+    base_stages = section.get("ms_per_batch") or {}
+    if not base_stages:
+        print(
+            f"check_bench_regression: {args.baseline} has no stage_baseline "
+            "section; run with --update to seed it",
+        )
+        return 0
+    base_backend = section.get("backend")
+    if base_backend and backend and base_backend != backend:
+        print(
+            f"check_bench_regression: backend mismatch (baseline "
+            f"{base_backend}, record {backend}); cross-backend stage times "
+            "are not comparable — skipping"
+        )
+        return 0
+
+    regressions = compare(base_stages, current, args.threshold)
+    for name, base_ms, cur_ms, ratio in regressions:
+        print(
+            f"REGRESSION {name}: {base_ms:.3f} -> {cur_ms:.3f} ms/batch "
+            f"({(ratio - 1) * 100:.1f}% > {args.threshold * 100:.0f}%)"
+        )
+    improved = [
+        n for n, b in base_stages.items()
+        if n in current and current[n] < b
+    ]
+    print(
+        f"check_bench_regression: {len(regressions)} regression(s) over "
+        f"{args.threshold * 100:.0f}% across {len(base_stages)} baseline "
+        f"stage(s); {len(improved)} improved"
+    )
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

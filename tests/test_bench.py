@@ -443,9 +443,12 @@ class TestOrchestrator:
             lambda: {"p": "open" if now[0] > 100 else "closed(111)"},
         )
 
-        def probe_once(env, label, t0):
+        def probe_once(env, label, t0, timeout_s=bench.PROBE_TIMEOUT_S):
             probes.append(now[0])
-            now[0] += 90  # a probe against a sick tunnel costs its timeout
+            # a probe against a sick tunnel costs its (possibly backed-off)
+            # timeout; record rc=None so the vigil's halving logic engages
+            now[0] += timeout_s
+            bench._PROBE_HISTORY.append({"rc": None})
             return now[0] > 250  # recovers on the third attempt
 
         monkeypatch.setattr(bench, "_probe_once", probe_once)
@@ -685,6 +688,268 @@ class TestExitPaths:
         assert "probe_history" not in rec
         banked = json.loads((tmp_path / "partial.json").read_text())
         assert "probe_history" in banked
+
+
+class TestBatchScalingNote:
+    def test_note_emitted_for_regressing_larger_batch(self):
+        # the r05 record: 116.09 @128 vs 111.61 @256 with no explanation
+        note = bench._batch_scaling_note(
+            {"32": 115.67, "128": 116.09, "256": 111.61}, 128, canvas=256
+        )
+        assert note is not None
+        assert "batch 256" in note and "67 MB" in note
+        assert "cache footprint" in note
+
+    def test_no_note_when_flat_or_best_is_largest(self):
+        assert bench._batch_scaling_note(
+            {"32": 100.0, "128": 101.0, "256": 102.0}, 256, canvas=256
+        ) is None
+        # within 3%: measurement noise, not worth a paragraph
+        assert bench._batch_scaling_note(
+            {"32": 100.0, "128": 100.0, "256": 99.0}, 128, canvas=256
+        ) is None
+        assert bench._batch_scaling_note({}, None, canvas=256) is None
+
+    def test_worker_emits_note_on_sweep(self, monkeypatch, capsys):
+        # tiny sweep on the CPU backend: when a larger batch measures
+        # slower, the sections carry batch_note (can't force the slowdown
+        # deterministically, so stub the measurement)
+        tputs = {2: 50.0, 4: 40.0}
+        monkeypatch.setattr(bench, "CANVAS", 64)
+        monkeypatch.setattr(
+            bench, "_bench_on",
+            lambda dev, px, dm, reps, use_pallas=False: (tputs[px.shape[0]], 7),
+        )
+        bench.worker("cpu", reps=1, want_pallas=False, want_stages=False,
+                     out_path=None, batches=(2, 4))
+        res = _emitted(capsys)
+        assert "batch 4" in res["batch_note"]
+        assert res["xla_batch"] == 2
+
+
+class TestVigilProbeBackoff:
+    def test_consecutive_timeouts_halve_probe_work(self, monkeypatch):
+        # r05: vigil probe 4 burned a full 90 s with the budget nearly
+        # spent. Consecutive timeouts must shrink the probe timeout toward
+        # the floor; a fast-error probe resets it.
+        now = [0.0]
+        monkeypatch.setattr(bench.time, "monotonic", lambda: now[0])
+        monkeypatch.setattr(
+            bench.time, "sleep", lambda s: now.__setitem__(0, now[0] + s)
+        )
+        monkeypatch.setattr(bench, "_tunnel_tcp_probe", lambda: {})
+        timeouts = []
+
+        def probe_once(env, label, t0, timeout_s=bench.PROBE_TIMEOUT_S):
+            timeouts.append(timeout_s)
+            now[0] += timeout_s
+            bench._PROBE_HISTORY.append({"rc": None})  # timeout
+            return False
+
+        monkeypatch.setattr(bench, "_probe_once", probe_once)
+        bench._PROBE_HISTORY.clear()
+        assert not bench._accel_vigil({}, 0.0, 1500.0)
+        assert timeouts[0] == bench.PROBE_TIMEOUT_S
+        assert timeouts[1] == bench.PROBE_TIMEOUT_S // 2
+        # monotone non-increasing down to the floor, never below it
+        assert all(b <= a for a, b in zip(timeouts, timeouts[1:]))
+        assert min(timeouts) == bench.VIGIL_PROBE_MIN_TIMEOUT_S
+        # cheap probes fire on a proportionally tighter cadence, so the
+        # vigil gets MORE chances at a late recovery for the same wall
+        assert len(timeouts) >= 8
+
+    def test_vigil_reserves_the_zshard_slot(self, monkeypatch, capsys):
+        # a fully wedged tunnel must still leave room for the zshard
+        # section (r05 skipped it entirely): the vigil deadline passed by
+        # main() is ZSHARD_RESERVE_S short of the wall budget
+        seen = {}
+
+        def fake_vigil(env, t0, deadline):
+            seen["deadline"] = deadline
+            return False
+
+        monkeypatch.setattr(bench, "_PARTIAL_PATH", "/tmp/bench_partial_t2.json")
+        monkeypatch.setattr(bench, "_probe_until_healthy", lambda *a: False)
+        monkeypatch.setattr(bench, "_accel_vigil", fake_vigil)
+        monkeypatch.setattr(
+            bench, "_run_measurement",
+            lambda *a: {"backend": "cpu", "xla_tput": 9.0, "checksum": 7},
+        )
+        zshard_deadlines = {}
+
+        def fake_zshard(deadline):
+            zshard_deadlines["deadline"] = deadline
+            return {"ms": {"1": 5.0}}
+
+        monkeypatch.setattr(bench, "_measure_zshard", fake_zshard)
+        t0 = bench.time.monotonic()
+        bench.main()
+        out = _emitted(capsys)
+        assert out["zshard_scaling"] == {"ms": {"1": 5.0}}
+        # vigil got ZSHARD_RESERVE_S less than the zshard section
+        assert (
+            zshard_deadlines["deadline"] - seen["deadline"]
+            == pytest.approx(bench.ZSHARD_RESERVE_S, abs=1.0)
+        )
+
+
+class TestStageTableExtras:
+    @pytest.mark.slow
+    def test_stage_table_carries_comparators_and_deltas(self, monkeypatch):
+        # ISSUE 2: the stage table must make the median/render rebuild
+        # attributable — comparator counts and fast-vs-baseline timings
+        monkeypatch.setattr(bench, "BATCH", 4)
+        monkeypatch.setattr(bench, "STAGE_SMALL_BATCH", 2)
+        monkeypatch.setattr(bench, "CANVAS", 64)
+        import jax
+
+        prof = bench._stage_times(jax.devices("cpu")[0], reps=2)
+        med = prof["stages"]["median7"]
+        comp = med["comparators"]
+        assert comp["merge_minmax_pruned"] < comp["merge_minmax_full"]
+        assert (
+            comp["merge_minmax_pruned_shared"] <= comp["merge_minmax_pruned"]
+        )
+        assert med["merge_baseline_ms_per_batch"] > 0
+        assert med["pruned_vs_merge_speedup"] > 0
+        rend = prof["stages"]["render"]
+        assert rend["unfused_ms_per_batch"] > 0
+        assert rend["fused_vs_unfused_speedup"] > 0
+
+    def test_path_metrics_reach_the_snapshot(self, monkeypatch, tmp_path):
+        # --metrics-out must record which median/render path ran plus the
+        # comparator counts (ISSUE 2 satellite)
+        record = {
+            "backend": "cpu",
+            "xla_tput": 10.0,
+            "winning_path": "xla",
+            "stages": {
+                "median7": {
+                    "comparators": {
+                        "merge_minmax_full": 566,
+                        "merge_minmax_pruned": 346,
+                        "merge_minmax_pruned_shared": 262,
+                        "presort_minmax": 32,
+                    }
+                },
+                "render": {"fused_vs_unfused_speedup": 1.4},
+            },
+        }
+        from nm03_capstone_project_tpu.obs import RunContext
+
+        ctx = RunContext.create("bench")
+        monkeypatch.setattr(bench, "_OBS_CTX", ctx)
+        bench._record_path_metrics(record)
+        snap = ctx.metrics_snapshot()
+        series = {
+            (m["name"], tuple(sorted(m.get("labels", {}).items()))): m["value"]
+            for m in snap["metrics"]
+        }
+        assert (
+            "nm03_median_comparator_minmax_ops",
+            (("variant", "merge_minmax_pruned"),),
+        ) in series
+        info = [
+            m for m in snap["metrics"] if m["name"] == "nm03_pipeline_path_info"
+        ]
+        assert info and info[0]["labels"]["render"] == "fused"
+        assert info[0]["labels"]["winning_path"] == "xla"
+
+
+class TestCheckBenchRegression:
+    """scripts/check_bench_regression.py smoke tests (ISSUE 2 satellite)."""
+
+    @staticmethod
+    def _script():
+        import importlib.util as iu
+
+        path = pathlib.Path(__file__).parents[1] / "scripts" / "check_bench_regression.py"
+        spec = iu.spec_from_file_location("cbr", path)
+        mod = iu.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    @staticmethod
+    def _record(ms, backend="cpu"):
+        return {
+            "backend": backend,
+            "stages": {k: {"ms_per_batch": v} for k, v in ms.items()},
+        }
+
+    def test_regression_exits_nonzero(self, tmp_path, capsys):
+        cbr = self._script()
+        base = tmp_path / "BASELINE.json"
+        base.write_text(json.dumps({
+            "stage_baseline": {
+                "backend": "cpu",
+                "ms_per_batch": {"median7": 100.0, "render": 50.0},
+            }
+        }))
+        res = tmp_path / "r.json"
+        res.write_text(json.dumps(self._record({"median7": 120.0, "render": 49.0})))
+        rc = cbr.main([str(res), "--baseline", str(base)])
+        assert rc == 1
+        assert "REGRESSION median7" in capsys.readouterr().out
+
+    def test_within_threshold_and_improvements_pass(self, tmp_path):
+        cbr = self._script()
+        base = tmp_path / "BASELINE.json"
+        base.write_text(json.dumps({
+            "stage_baseline": {
+                "backend": "cpu",
+                "ms_per_batch": {"median7": 100.0, "render": 50.0},
+            }
+        }))
+        res = tmp_path / "r.json"
+        res.write_text(json.dumps(self._record({"median7": 105.0, "render": 20.0})))
+        assert cbr.main([str(res), "--baseline", str(base)]) == 0
+
+    def test_cross_backend_skips(self, tmp_path, capsys):
+        cbr = self._script()
+        base = tmp_path / "BASELINE.json"
+        base.write_text(json.dumps({
+            "stage_baseline": {
+                "backend": "cpu",
+                "ms_per_batch": {"median7": 100.0},
+            }
+        }))
+        res = tmp_path / "r.json"
+        res.write_text(json.dumps(self._record({"median7": 900.0}, backend="tpu")))
+        assert cbr.main([str(res), "--baseline", str(base)]) == 0
+        assert "backend mismatch" in capsys.readouterr().out
+
+    def test_driver_capture_shape_and_update(self, tmp_path):
+        # accepts the BENCH_r*.json {"parsed": {...}} wrapper, and --update
+        # seeds the baseline section
+        cbr = self._script()
+        base = tmp_path / "BASELINE.json"
+        base.write_text(json.dumps({"metric": "x"}))
+        res = tmp_path / "r.json"
+        res.write_text(json.dumps({
+            "parsed": self._record({"median7": 80.0, "render": 40.0})
+        }))
+        assert cbr.main([str(res), "--baseline", str(base), "--update"]) == 0
+        doc = json.loads(base.read_text())
+        assert doc["stage_baseline"]["ms_per_batch"]["median7"] == 80.0
+        # and the seeded baseline then gates
+        worse = tmp_path / "w.json"
+        worse.write_text(json.dumps(self._record({"median7": 100.0})))
+        assert cbr.main([str(worse), "--baseline", str(base)]) == 1
+
+    def test_repo_baseline_is_seeded_and_consistent(self):
+        # the committed BASELINE.json carries the r05 CPU stage floor the
+        # gate diffs against
+        repo = pathlib.Path(__file__).parents[1]
+        doc = json.loads((repo / "BASELINE.json").read_text())
+        section = doc["stage_baseline"]
+        assert section["backend"] == "cpu"
+        assert section["ms_per_batch"]["median7"] == pytest.approx(211.127)
+        cbr = self._script()
+        backend, stages = cbr.extract_stages(
+            json.loads((repo / "BENCH_r05.json").read_text())
+        )
+        assert backend == "cpu"
+        assert stages == section["ms_per_batch"]
 
 
 def test_make_batch_radius_distribution_is_batch_invariant():

@@ -58,6 +58,65 @@ def test_median_matches_scipy_on_full_canvas(data, window):
     np.testing.assert_allclose(got, want, rtol=0, atol=0)
 
 
+@settings(max_examples=20, deadline=None)
+@given(
+    data=st.data(),
+    window=st.sampled_from([3, 5, 7]),
+    dtype=st.sampled_from(["uint8", "float32"]),
+)
+def test_pruned_selection_median_matches_jnp_median(data, window, dtype):
+    """ISSUE 2 satellite: the pruned selection network must equal the
+    jnp.median-based reference on random uint8/f32 images for sizes 3/5/7.
+
+    The reference materializes every window (shifted_stack) and takes
+    jnp.median over the window axis — a completely independent formulation
+    (a sort, not a comparator network), so agreement pins the network's
+    rank selection, its liveness pruning, and the shift/domain bookkeeping
+    of the plan executor at once.
+    """
+    import jax.numpy as jnp
+
+    from nm03_capstone_project_tpu.ops.neighborhood import (
+        shifted_stack,
+        window_offsets,
+    )
+
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31 - 1)))
+    if dtype == "uint8":
+        px = rng.integers(0, 256, (CANVAS, CANVAS)).astype(np.uint8)
+    else:
+        px = (rng.random((CANVAS, CANVAS)) * 4000.0).astype(np.float32)
+    got = np.asarray(vector_median_filter(px, window))
+    stack = shifted_stack(jnp.asarray(px), window_offsets(window), pad_mode="edge")
+    want = np.asarray(jnp.median(stack, axis=0))
+    np.testing.assert_array_equal(got.astype(np.float64), want.astype(np.float64))
+
+
+@settings(max_examples=10, deadline=None)
+@given(data=st.data(), hw=_dims)
+def test_fused_render_pair_is_pixel_exact(data, hw):
+    """ISSUE 2 satellite: the fused render must be pixel-identical to the
+    unfused pair on random images, masks and true dims."""
+    import dataclasses
+
+    from nm03_capstone_project_tpu.config import PipelineConfig
+    from nm03_capstone_project_tpu.render.render import render_pair
+
+    h, w = hw
+    px = _random_canvas(data, h, w) * 900.0
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31 - 1)))
+    mask = np.zeros((CANVAS, CANVAS), np.uint8)
+    mask[:h, :w] = (rng.random((h, w)) < 0.4).astype(np.uint8)
+    dims = np.asarray([h, w], np.int32)
+    # one static render size so every example shares a compile
+    cfg = PipelineConfig(render_size=64)
+    cfg_unfused = dataclasses.replace(cfg, render_fused=False)
+    g1, s1 = render_pair(px, mask, dims, cfg)
+    g2, s2 = render_pair(px, mask, dims, cfg_unfused)
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+
+
 @settings(max_examples=15, deadline=None)
 @given(
     data=st.data(),

@@ -89,6 +89,84 @@ def test_render_overlay_composites():
     assert out[30, 30] > out[10, 10] + 50  # white overlay lifts the lesion
 
 
+class TestFusedRenderPair:
+    """render_pair_fused vs the two independent renders: pixel-identical
+    on both legs, on both sampler paths (ISSUE 2 tentpole)."""
+
+    def _case(self, canvas, th, tw, seed=3):
+        rng = np.random.default_rng(seed)
+        px = np.zeros((canvas, canvas), np.float32)
+        px[:th, :tw] = rng.random((th, tw)).astype(np.float32) * 900
+        mask = np.zeros((canvas, canvas), np.uint8)
+        mask[:th, :tw] = (rng.random((th, tw)) < 0.35).astype(np.uint8)
+        dims = np.asarray([th, tw], np.int32)
+        return px, mask, dims
+
+    def _assert_identical(self, px, mask, dims, render_size=128):
+        import dataclasses
+
+        from nm03_capstone_project_tpu.config import PipelineConfig
+        from nm03_capstone_project_tpu.render.render import render_pair
+
+        cfg = PipelineConfig(render_size=render_size)
+        cfg_unfused = dataclasses.replace(cfg, render_fused=False)
+        g1, s1 = render_pair(px, mask, dims, cfg)
+        g2, s2 = render_pair(px, mask, dims, cfg_unfused)
+        np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+        np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+
+    def test_pixel_identical_gather_path(self, monkeypatch):
+        from nm03_capstone_project_tpu.render import render as rr
+
+        monkeypatch.setattr(rr, "_mxu_backend", lambda: False)
+        for canvas, th, tw in ((128, 100, 80), (128, 128, 128), (64, 33, 64)):
+            self._assert_identical(*self._case(canvas, th, tw))
+
+    def test_pixel_identical_matmul_path(self, monkeypatch):
+        from nm03_capstone_project_tpu.render import render as rr
+
+        monkeypatch.setattr(rr, "_mxu_backend", lambda: True)
+        for canvas, th, tw in ((128, 100, 80), (64, 64, 30)):
+            self._assert_identical(*self._case(canvas, th, tw))
+
+    def test_pixel_identical_under_vmap(self):
+        import jax
+
+        from nm03_capstone_project_tpu.config import PipelineConfig
+        import dataclasses
+
+        from nm03_capstone_project_tpu.render.render import render_pair
+
+        rng = np.random.default_rng(5)
+        px = rng.random((4, 64, 64)).astype(np.float32) * 500
+        mask = (rng.random((4, 64, 64)) < 0.3).astype(np.uint8)
+        dims = np.asarray([[64, 64], [50, 40], [64, 20], [10, 64]], np.int32)
+        cfg = PipelineConfig(render_size=96)
+        cfg_u = dataclasses.replace(cfg, render_fused=False)
+        f = jax.jit(jax.vmap(lambda p, m, d: render_pair(p, m, d, cfg)))
+        fu = jax.jit(jax.vmap(lambda p, m, d: render_pair(p, m, d, cfg_u)))
+        for a, b in zip(f(px, mask, dims), fu(px, mask, dims)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_opacity_u8_matches_device_math(self):
+        # the fused integer leg's precomputed levels vs the f32 alpha path
+        # for awkward opacities (0.6 is the classic: f32(0.6)*255 crosses
+        # 153 only because the f32 product rounds UP)
+        import jax.numpy as jnp
+
+        from nm03_capstone_project_tpu.render.render import _opacity_u8
+
+        for op in (0.0, 0.1, 0.25, 0.6, 0.47, 0.999, 1.0):
+            dev = int(
+                np.asarray(
+                    jnp.clip(
+                        jnp.float32(op) * 255.0, 0, 255
+                    ).astype(jnp.uint8)
+                )
+            )
+            assert _opacity_u8(op) == dev, op
+
+
 def test_save_jpeg_and_export_pairs(tmp_path):
     img = np.zeros((32, 32), np.uint8)
     save_jpeg(img, tmp_path / "a.jpg")
