@@ -106,6 +106,82 @@ def add_observability_args(parser: argparse.ArgumentParser) -> None:
     )
 
 
+def add_resilience_args(parser: argparse.ArgumentParser) -> None:
+    """--retry-max / --retry-backoff-s / --dispatch-timeout-s /
+    --fallback-cpu / --fault-plan (docs/RESILIENCE.md).
+
+    For the batch drivers that dispatch device work per cohort (sequential /
+    parallel). Defaults preserve the unsupervised behavior: no deadline, no
+    fault plan, retries only where a transient device error was previously
+    a hard failure.
+    """
+    from nm03_capstone_project_tpu.resilience import ResilienceConfig
+
+    d = ResilienceConfig()
+    g = parser.add_argument_group(
+        "resilience", "supervised execution + chaos testing (docs/RESILIENCE.md)"
+    )
+    g.add_argument(
+        "--retry-max",
+        type=int,
+        default=d.retry_max,
+        help="retries per transient device/export error (0 disables; a "
+        "per-cause run budget caps the total)",
+    )
+    g.add_argument(
+        "--retry-backoff-s",
+        type=float,
+        default=d.retry_backoff_s,
+        help="initial retry backoff; doubles per attempt with deterministic "
+        "jitter",
+    )
+    g.add_argument(
+        "--dispatch-timeout-s",
+        type=float,
+        default=d.dispatch_timeout_s,
+        metavar="SEC",
+        help="wall-clock deadline per device dispatch batch (0 disables "
+        "supervision). On expiry the dispatch is abandoned and the run "
+        "degrades per --fallback-cpu — the escape hatch for the tunnel "
+        "wedges documented in docs/OPERATIONS.md. Supervision moves the "
+        "result fetch inside the deadline, trading the fetch/compute "
+        "overlap for wedge immunity",
+    )
+    g.add_argument(
+        "--fallback-cpu",
+        action=argparse.BooleanOptionalAction,
+        default=d.fallback_cpu,
+        help="on dispatch deadline expiry or device loss, finish the "
+        "remaining work on the CPU backend (XLA path, Pallas excluded) "
+        "instead of failing it; --no-fallback-cpu fails fast instead — "
+        "either way the run terminates, never wedges",
+    )
+    g.add_argument(
+        "--fault-plan",
+        default=None,
+        metavar="SPEC",
+        help="seeded deterministic fault plan: a JSON file path or inline "
+        "JSON (see resilience.faultinject). Also honored from "
+        "$NM03_FAULT_PLAN when the flag is unset. Chaos testing only — "
+        "injects decode/dispatch/export faults at the planned sites",
+    )
+
+
+def resilience_config_from_args(args: argparse.Namespace):
+    from nm03_capstone_project_tpu.resilience import FaultPlan, ResilienceConfig
+
+    d = ResilienceConfig()
+    return ResilienceConfig(
+        retry_max=getattr(args, "retry_max", d.retry_max),
+        retry_backoff_s=getattr(args, "retry_backoff_s", d.retry_backoff_s),
+        dispatch_timeout_s=getattr(
+            args, "dispatch_timeout_s", d.dispatch_timeout_s
+        ),
+        fallback_cpu=getattr(args, "fallback_cpu", d.fallback_cpu),
+        fault_plan=FaultPlan.from_spec(getattr(args, "fault_plan", None)),
+    )
+
+
 def make_run_context(
     args: argparse.Namespace, driver: str, rank: int = 0, argv=None
 ):
@@ -216,8 +292,13 @@ def add_pipeline_args(parser: argparse.ArgumentParser) -> None:
     )
     g.add_argument(
         "--grow-max-iters", type=int, default=d.grow_max_iters,
-        help="hard cap on region-growing steps; a capped slice is counted "
-        "as truncated in the summary and warned per patient",
+        help="hard cap on region growth, expressed as a RADIUS in pixels "
+        "(dilate steps) for every --grow-algorithm: the dilate schedule "
+        "runs up to this many one-ring steps, while the jump schedule "
+        "derives its pointer-jumping round cap as ceil(log2(N))+2 so the "
+        "same flag value bounds the same growth either way; a capped "
+        "slice is counted as truncated in the summary and warned per "
+        "patient",
     )
 
 

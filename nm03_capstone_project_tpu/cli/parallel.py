@@ -32,6 +32,7 @@ def build_parser() -> argparse.ArgumentParser:
     common.add_batch_args(p)
     common.add_render_stage_arg(p)
     common.add_model_arg(p)
+    common.add_resilience_args(p)
     common.add_distributed_args(
         p,
         "Patients are round-robin sharded across processes, each on its "

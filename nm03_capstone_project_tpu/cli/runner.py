@@ -50,7 +50,18 @@ from nm03_capstone_project_tpu.utils.manifest import (
     STATUS_TRUNCATED,
     Manifest,
 )
-from nm03_capstone_project_tpu.obs import RunContext
+from nm03_capstone_project_tpu.obs import RESILIENCE_RETRIES_TOTAL, RunContext
+from nm03_capstone_project_tpu.resilience import (
+    DispatchSupervisor,
+    FaultPlan,
+    InjectedExportError,
+    InjectedTransientError,
+    PatientJournal,
+    ResilienceConfig,
+    corrupt_bytes,
+    deliver_sigterm,
+    execute_hang,
+)
 from nm03_capstone_project_tpu.utils.reporter import get_logger
 
 log = get_logger("runner")
@@ -247,6 +258,7 @@ class CohortProcessor:
         model_params=None,
         mask_sink=None,
         obs: RunContext = None,
+        resilience: ResilienceConfig = None,
     ):
         if mode not in ("sequential", "parallel"):
             raise ValueError(f"unknown mode: {mode}")
@@ -283,6 +295,20 @@ class CohortProcessor:
         # the registry is thread-safe by design.
         self.obs = obs if obs is not None else RunContext.create(driver=mode)
         self.timer = self.obs.spans
+        # resilience: retry/deadline policies, CPU degradation, chaos layer
+        # (docs/RESILIENCE.md). Defaults are behavior-preserving: no dispatch
+        # deadline, no fault plan (unless NM03_FAULT_PLAN activates one).
+        self.res = resilience if resilience is not None else ResilienceConfig()
+        plan = self.res.fault_plan
+        self.fault_plan = (
+            FaultPlan.from_spec(plan) if plan is not None else FaultPlan.from_env()
+        )
+        self.retry = self.res.make_retry_policy(
+            seed=self.fault_plan.seed if self.fault_plan is not None else 0
+        )
+        self.retry.obs = self.obs
+        self.dispatch = DispatchSupervisor(self.res, retry=self.retry, obs=self.obs)
+        self._fallback_fns: dict = {}
         self.out_root.mkdir(parents=True, exist_ok=True)
         manifest_name = (
             "manifest.json"
@@ -301,16 +327,136 @@ class CohortProcessor:
 
     # -- data loading ------------------------------------------------------
 
-    def _read_slice(self, path: Path) -> Optional[np.ndarray]:
-        """Decode + guard one slice; None signals failure (null-ptr analog)."""
+    def _read_slice(
+        self, path: Path, patient: Optional[str] = None, index: Optional[int] = None
+    ) -> Optional[np.ndarray]:
+        """Decode + guard one slice; None signals failure (null-ptr analog).
+
+        The decode-site chaos hook lives here: an ``error`` rule fails the
+        slice before decode; a ``corrupt`` rule feeds the REAL parser
+        deterministically corrupted file bytes, exercising the actual
+        rejection path rather than a mock.
+        """
+        plan = self.fault_plan
+        if plan is not None and plan.has_site("decode"):
+            rule = plan.fire(
+                "decode", obs=self.obs, patient=patient, stem=path.stem, index=index
+            )
+            if rule is not None:
+                if rule.kind == "error":
+                    log.warning(
+                        "failed to read %s: injected decode fault", path.name
+                    )
+                    return None
+                # kind == "corrupt"
+                from nm03_capstone_project_tpu.data.dicomlite import (
+                    read_dicom_bytes,
+                )
+
+                try:
+                    raw = corrupt_bytes(path.read_bytes(), plan.seed, path.stem)
+                    s = read_dicom_bytes(raw)
+                except Exception as e:  # noqa: BLE001 - per-slice containment
+                    log.warning("failed to read %s: %s", path.name, e)
+                    return None
+                return guard_pixels(s.pixels, path.name, self.cfg)
         return decode_and_guard(path, self.cfg)
+
+    # -- resilience hooks --------------------------------------------------
+
+    def _dispatch_pre(self, patient_id: str, index: int):
+        """Dispatch-site fault hook for the supervisor (None when off)."""
+        plan = self.fault_plan
+        if plan is None or not plan.has_site("dispatch"):
+            return None
+
+        def pre(cancel):
+            rule = plan.fire(
+                "dispatch", obs=self.obs, patient=patient_id, index=index
+            )
+            if rule is None:
+                return
+            if rule.kind == "hang":
+                execute_hang(rule, cancel)
+            else:  # transient
+                raise InjectedTransientError(
+                    f"injected transient device error "
+                    f"(patient {patient_id}, dispatch {index})"
+                )
+
+        return pre
+
+    def _export_fault_hook(self, patient_id: str):
+        """Export-site fault hook threaded into the export layer."""
+        plan = self.fault_plan
+        if plan is None or not plan.has_site("export"):
+            return None
+
+        def hook(stem):
+            rule = plan.fire("export", obs=self.obs, patient=patient_id, stem=stem)
+            if rule is None:
+                return
+            if rule.kind == "sigterm":
+                deliver_sigterm()
+            raise InjectedExportError(f"injected export fault for {stem}")
+
+        return hook
+
+    def _fallback_call(self, batched: bool, host_render: bool):
+        """The CPU degradation target: same outputs as the primary pipeline
+        fn, computed on the CPU backend through the XLA path (Pallas is
+        excluded by construction — the wedge being escaped may BE the
+        accelerator). Takes host arrays only: fetching a device array here
+        could hang on the very wedge that triggered degradation. Built and
+        compiled lazily on first degradation, cached per shape-of-use."""
+        key = (batched, host_render)
+        if key in self._fallback_fns:
+            return self._fallback_fns[key]
+        import dataclasses
+
+        import jax
+
+        cpu = jax.local_devices(backend="cpu")[0]
+        cfg = (
+            dataclasses.replace(self.cfg, use_pallas=False)
+            if self.cfg.use_pallas
+            else self.cfg
+        )
+        if self.model_params is not None:
+            inner = self._student_fn(
+                batched=batched, mesh=None, host_render=host_render, device=cpu
+            )
+        elif batched:
+            inner = (
+                _compiled_batch_mask_fn(cfg) if host_render else _compiled_batch_fn(cfg)
+            )
+        else:
+            inner = (
+                _compiled_slice_mask_fn(cfg) if host_render else _compiled_slice_fn(cfg)
+            )
+
+        def call(px, dm):
+            with jax.default_device(cpu):
+                # commit the inputs to the CPU device explicitly: the batched
+                # fns donate their pixel arg, and donation of an uncommitted
+                # numpy arg is a no-op that warns on every fallback batch
+                out = inner(
+                    jax.device_put(np.asarray(px), cpu),
+                    jax.device_put(np.asarray(dm), cpu),
+                )
+            return tuple(np.asarray(a) for a in out)
+
+        self._fallback_fns[key] = call
+        return call
 
     # -- student deployment ------------------------------------------------
 
-    def _student_fn(self, batched: bool, mesh, host_render: bool):
+    def _student_fn(self, batched: bool, mesh, host_render: bool, device=None):
         """Jitted student-model stand-in for the pipeline fns, cached per
-        (shape-of-use) so each compiles once per processor."""
-        key = (batched, mesh is not None, host_render)
+        (shape-of-use) so each compiles once per processor. ``device`` pins
+        the params to a specific device — the CPU-degradation fallback path
+        (resilience) uses it to keep a second, accelerator-free copy."""
+        key = (batched, mesh is not None, host_render, str(device))
         if key in self._student_fns:
             return self._student_fns[key]
         import jax
@@ -322,6 +468,8 @@ class CohortProcessor:
             params = jax.device_put(
                 self.model_params, NamedSharding(mesh, PartitionSpec())
             )
+        elif device is not None:
+            params = jax.device_put(self.model_params, device)
         else:
             params = jax.device_put(self.model_params)
 
@@ -369,6 +517,18 @@ class CohortProcessor:
         files = load_dicom_files_for_patient(self.base_path, patient_id)
         print(f"Found {len(files)} DICOM files for patient {patient_id}")
 
+        # slice-grain crash-safe resume: the journal records each completed
+        # slice the moment its pair is on disk (the manifest flushes only per
+        # patient), so a kill mid-patient loses at most the slice in flight.
+        # On --resume, fold the journal of the interrupted patient back into
+        # the manifest before computing the todo list.
+        journal = PatientJournal(out_dir)
+        if self.resume:
+            seen = self.manifest.data.get(patient_id, {})
+            for stem, status in journal.entries().items():
+                if stem not in seen:
+                    self.manifest.record(patient_id, stem, status)
+
         todo = []
         already = 0
         for f in files:
@@ -379,9 +539,14 @@ class CohortProcessor:
                 todo.append(f)
 
         if self.mode == "sequential":
-            ok, failed, truncated = self._run_sequential(patient_id, out_dir, todo)
+            ok, failed, truncated = self._run_sequential(
+                patient_id, out_dir, todo, journal
+            )
         else:
-            ok, failed, truncated = self._run_parallel(patient_id, out_dir, todo)
+            ok, failed, truncated = self._run_parallel(
+                patient_id, out_dir, todo, journal
+            )
+        journal.close()
 
         result = PatientResult(
             patient_id=patient_id,
@@ -417,7 +582,7 @@ class CohortProcessor:
         return result
 
     def _run_sequential(
-        self, patient_id: str, out_dir: Path, files: List[Path]
+        self, patient_id: str, out_dir: Path, files: List[Path], journal=None
     ) -> Tuple[int, List[str], List[str]]:
         host_render = self.batch_cfg.render_stage == "host"
         if self.model_params is not None:
@@ -427,6 +592,7 @@ class CohortProcessor:
         else:
             fn = _compiled_slice_fn(self.cfg)
         ok, failed, truncated = 0, [], []
+        export_fault = self._export_fault_hook(patient_id)
 
         # One-slice-at-a-time with ONE dispatch in flight: slice N+1's
         # compute is enqueued (async dispatch) before slice N's results are
@@ -464,13 +630,19 @@ class CohortProcessor:
                             out_dir,
                             self.cfg,
                             max_workers=1,
+                            fault_hook=export_fault,
+                            retry=self.retry,
                         )
                 else:
                     with self.timer.section("export"):
                         orig = np.asarray(p["orig_dev"])
                         proc = np.asarray(p["proc_dev"])
                         written = export_pairs(
-                            [(stem, orig, proc)], out_dir, max_workers=1
+                            [(stem, orig, proc)],
+                            out_dir,
+                            max_workers=1,
+                            fault_hook=export_fault,
+                            retry=self.retry,
                         )
                 if stem not in written:
                     raise IOError("JPEG export failed")
@@ -480,33 +652,62 @@ class CohortProcessor:
                 # --resume rerun with a raised cap recomputes it.
                 if not bool(np.all(np.asarray(p["conv"]))):
                     truncated.append(stem)
-                    self.manifest.record(patient_id, stem, STATUS_TRUNCATED)
+                    status = STATUS_TRUNCATED
                 else:
-                    self.manifest.record(patient_id, stem, STATUS_DONE)
+                    status = STATUS_DONE
+                self.manifest.record(patient_id, stem, status)
+                if journal is not None:
+                    journal.record(stem, status)
                 ok += 1
             except Exception as e:  # noqa: BLE001 - reference: don't throw
                 log.warning("error processing file %s: %s", stem, e)
                 self.manifest.record(patient_id, stem, STATUS_FAILED)
+                if journal is not None:
+                    journal.record(stem, STATUS_FAILED)
                 failed.append(stem)
 
+        # Supervised dispatch (resilience): with a --dispatch-timeout-s the
+        # primary fetches its results INSIDE the deadline (a wedged fetch is
+        # the same wedge as a wedged dispatch), trading the one-in-flight
+        # enqueue overlap for wedge immunity. Unsupervised (the default) the
+        # call is inline and async exactly as before — the supervisor only
+        # adds the transient-error retry policy around it.
+        supervised = self.dispatch.supervised
+
+        def run_dispatch(padded, dims, index):
+            if supervised:
+                primary = lambda: tuple(  # noqa: E731
+                    np.asarray(a) for a in fn(padded, dims)
+                )
+            else:
+                primary = lambda: fn(padded, dims)  # noqa: E731
+            fallback = lambda: self._fallback_call(  # noqa: E731
+                batched=False, host_render=host_render
+            )(padded, dims)
+            return self.dispatch.run(
+                primary,
+                fallback=fallback,
+                pre=self._dispatch_pre(patient_id, index),
+            )
+
         pending = None
-        for f in files:
+        for di, f in enumerate(files):
             stem = f.stem
             try:
                 with self.timer.section("decode"):
-                    pixels = self._read_slice(f)
+                    pixels = self._read_slice(f, patient=patient_id, index=di)
                 if pixels is None:
                     raise ValueError("decode/guard failed")
                 padded, dims = self._pad_one(pixels)
                 with self.timer.section("compute"):
                     if host_render:
-                        mask_dev, conv = fn(padded, dims)
+                        mask_dev, conv = run_dispatch(padded, dims, di)
                         cur = {
                             "stem": stem, "mask_dev": mask_dev, "conv": conv,
                             "padded": padded, "dims": dims,
                         }
                     else:
-                        orig_dev, proc_dev, conv = fn(padded, dims)
+                        orig_dev, proc_dev, conv = run_dispatch(padded, dims, di)
                         cur = {
                             "stem": stem, "orig_dev": orig_dev,
                             "proc_dev": proc_dev, "conv": conv,
@@ -524,7 +725,7 @@ class CohortProcessor:
         return ok, failed, truncated
 
     def _run_parallel(
-        self, patient_id: str, out_dir: Path, files: List[Path]
+        self, patient_id: str, out_dir: Path, files: List[Path], journal=None
     ) -> Tuple[int, List[str], List[str]]:
         import jax
 
@@ -614,11 +815,15 @@ class CohortProcessor:
                             self._decode_batch_native,
                             batches[idx],
                             pad_target(len(batches[idx])),
+                            patient_id,
+                            idx * bs,
                         )
                     else:
                         decode_futures[idx] = [
-                            io_pool.submit(self._read_slice, f)
-                            for f in batches[idx]
+                            io_pool.submit(
+                                self._read_slice, f, patient_id, idx * bs + j
+                            )
+                            for j, f in enumerate(batches[idx])
                         ]
 
             for i in range(depth):
@@ -664,6 +869,12 @@ class CohortProcessor:
                 # each device receives only its shard.
                 if item.get("pixels") is None:
                     return item
+                if self.dispatch.degraded:
+                    # degraded run: the supervisor routes every batch to the
+                    # CPU fallback, so staging onto the (wedged/lost) device
+                    # would be at best wasted and at worst the very hang the
+                    # degradation escaped — keep the batch on the host
+                    return item
                 out = dict(item)
                 out["pixels"] = jax.device_put(out["pixels"], batch_sharding)
                 out["dims"] = jax.device_put(out["dims"], batch_sharding)
@@ -674,26 +885,68 @@ class CohortProcessor:
                     b["pixels_host"], b["dims_host"] = b["pixels"], b["dims"]
                     yield b
 
+            export_fault = self._export_fault_hook(patient_id)
+            supervised = self.dispatch.supervised
+
+            def journal_slice(stem):
+                # slice-grain crash record the moment the pair is on disk
+                # (fires per slice from the export pool threads, so a kill
+                # mid-batch loses at most the slice in flight; the journal
+                # is thread-safe). conv_by_stem is populated before the
+                # batch's export writes begin in both render paths.
+                if journal is not None:
+                    journal.record(
+                        stem,
+                        STATUS_DONE
+                        if conv_by_stem.get(stem, True)
+                        else STATUS_TRUNCATED,
+                    )
+
             # host->HBM double buffering: the next batch's device_put is
             # enqueued while the current batch computes
-            for batch in prefetch_to_device(
-                with_host_refs(staged()), depth=depth, to_device=to_device
+            for bi, batch in enumerate(
+                prefetch_to_device(
+                    with_host_refs(staged()), depth=depth, to_device=to_device
+                )
             ):
                 for s in batch["bad"]:
                     failed.append(s)
                     self.manifest.record(patient_id, s, STATUS_FAILED)
+                    if journal is not None:
+                        journal.record(s, STATUS_FAILED)
                 if not batch["stems"]:
                     continue
+                pix, dm = batch["pixels"], batch["dims"]
+                pxh, dmh = batch["pixels_host"], batch["dims_host"]
+                pre = self._dispatch_pre(patient_id, bi)
+                # degradation target: the same batch recomputed on the CPU
+                # backend from the HOST copies (never the device arrays — a
+                # fetch from the wedged device is the wedge)
+                fallback = lambda pxh=pxh, dmh=dmh: self._fallback_call(  # noqa: E731
+                    batched=True, host_render=host_render
+                )(pxh, dmh)
                 if host_render:
-                    # 'dispatch', not 'compute': this enqueues only — the
-                    # 65 KB/slice mask fetch happens on the IO worker,
-                    # overlapped with the next batch's device compute (the
-                    # device stream is FIFO, so the worker's device_get also
-                    # serves as the batch sync). Device time is therefore
-                    # absorbed by the 'export' wait; compare drivers on the
-                    # results JSON's wall_s, not per-section times.
+                    # 'dispatch', not 'compute': unsupervised this enqueues
+                    # only — the 65 KB/slice mask fetch happens on the IO
+                    # worker, overlapped with the next batch's device compute
+                    # (the device stream is FIFO, so the worker's device_get
+                    # also serves as the batch sync). Device time is
+                    # therefore absorbed by the 'export' wait; compare
+                    # drivers on the results JSON's wall_s, not per-section
+                    # times. SUPERVISED (--dispatch-timeout-s), the fetch
+                    # moves inside the deadline — a wedged fetch is the same
+                    # wedge as a wedged dispatch — trading that overlap for
+                    # wedge immunity.
+                    if supervised:
+                        primary = lambda pix=pix, dm=dm: tuple(  # noqa: E731
+                            np.asarray(a) for a in fn(pix, dm)
+                        )
+                    else:
+                        primary = lambda pix=pix, dm=dm: fn(pix, dm)  # noqa: E731
                     with self.timer.section("dispatch"):
-                        mask_dev, conv_dev = fn(batch["pixels"], batch["dims"])
+                        mask_dev, conv_dev = self.dispatch.run(
+                            primary, fallback=fallback, pre=pre
+                        )
 
                     def fetch_render_export(
                         mask_dev=mask_dev, conv_dev=conv_dev, batch=batch
@@ -714,15 +967,26 @@ class CohortProcessor:
                             )
                             for i, s in enumerate(batch["stems"])
                         ]
-                        return render_export_pairs(items, out_dir, self.cfg, 4)
+                        return render_export_pairs(
+                            items,
+                            out_dir,
+                            self.cfg,
+                            4,
+                            fault_hook=export_fault,
+                            retry=self.retry,
+                            success_hook=journal_slice,
+                        )
 
                     export_futures.append(io_pool.submit(fetch_render_export))
                 else:
                     with self.timer.section("compute"):
-                        orig_b, proc_b, conv_b = fn(batch["pixels"], batch["dims"])
-                        orig_b = np.asarray(orig_b)
-                        proc_b = np.asarray(proc_b)
-                        conv_b = np.asarray(conv_b)
+                        orig_b, proc_b, conv_b = self.dispatch.run(
+                            lambda pix=pix, dm=dm: tuple(
+                                np.asarray(a) for a in fn(pix, dm)
+                            ),
+                            fallback=fallback,
+                            pre=pre,
+                        )
                     for i, s in enumerate(batch["stems"]):
                         conv_by_stem[s] = bool(conv_b[i])
                     items = [
@@ -730,7 +994,15 @@ class CohortProcessor:
                     ]
                     # hand encoding to the IO pool; overlap with next batch compute
                     export_futures.append(
-                        io_pool.submit(export_pairs, items, out_dir, 4)
+                        io_pool.submit(
+                            export_pairs,
+                            items,
+                            out_dir,
+                            4,
+                            fault_hook=export_fault,
+                            retry=self.retry,
+                            success_hook=journal_slice,
+                        )
                     )
                 expected_stems.extend(batch["stems"])
             with self.timer.section("export"):
@@ -750,10 +1022,18 @@ class CohortProcessor:
             else:
                 log.warning("export failed for slice %s", s)
                 self.manifest.record(patient_id, s, STATUS_FAILED)
+                if journal is not None:
+                    journal.record(s, STATUS_FAILED)
                 failed.append(s)
         return ok, failed, truncated
 
-    def _decode_batch_native(self, batch_files: List[Path], pad_to: int) -> dict:
+    def _decode_batch_native(
+        self,
+        batch_files: List[Path],
+        pad_to: int,
+        patient_id: Optional[str] = None,
+        base_index: int = 0,
+    ) -> dict:
         """Decode one batch via the C++ thread-pool loader.
 
         Same output contract as the Python path in ``staged()``: good slices
@@ -772,19 +1052,28 @@ class CohortProcessor:
             min_dim=self.cfg.min_dim,
             threads=threads,
         )
-        # parse failures retry through the Python reader: its envelope is a
-        # superset of the C++ parser's (the C++ side decodes uncompressed
-        # LE, RLE Lossless, JPEG Lossless and JPEG-LS; baseline JPEG
-        # decodes via PIL in the Python reader only), so a compressed
-        # cohort still flows through the native fast path with per-slice
-        # fallback instead of failing wholesale. The retries run on their
-        # own small pool: a fully-baseline-JPEG batch would otherwise
-        # decode serially on this one thread.
+        # parse failures fall back through the Python reader: its envelope
+        # is a superset of the C++ parser's (the C++ side decodes
+        # uncompressed LE, RLE Lossless, JPEG Lossless and JPEG-LS;
+        # baseline JPEG decodes via PIL in the Python reader only), so a
+        # compressed cohort still flows through the native fast path with
+        # per-slice fallback instead of failing wholesale. The fallbacks
+        # run on their own small pool: a fully-baseline-JPEG batch would
+        # otherwise decode serially on this one thread. Accounted through
+        # the resilience retry counter (cause="native_parse") but not
+        # budget-gated: this is a deterministic alternate-decoder path, not
+        # a transient failure, so a large compressed cohort must never
+        # exhaust a budget and start failing slices it used to decode.
         retry_idx = [
             i for i, (o, e) in enumerate(zip(okf, errs))
             if not o and int(e) == 2  # "DICOM parse failed"
         ]
         if retry_idx:
+            self.obs.registry.counter(
+                RESILIENCE_RETRIES_TOTAL,
+                help="supervised retries by cause (resilience.RetryPolicy)",
+                cause="native_parse",
+            ).inc(len(retry_idx))
             with cf.ThreadPoolExecutor(min(threads, len(retry_idx))) as pool:
                 retried = pool.map(
                     lambda i: decode_and_guard(batch_files[i], self.cfg),
@@ -797,10 +1086,32 @@ class CohortProcessor:
                     pixels[i, :h, :w] = px
                     dims[i] = (h, w)
                     okf[i] = True
+        # chaos routing: files a decode-site fault rule selects re-decode
+        # through the Python path, where injection actually happens (the
+        # selector probe is side-effect free; fire() runs in _read_slice)
+        injected_bad: set = set()
+        plan = self.fault_plan
+        if plan is not None and plan.has_site("decode"):
+            for i, f in enumerate(batch_files):
+                if plan.routes_decode(
+                    patient=patient_id, stem=f.stem, index=base_index + i
+                ):
+                    px = self._read_slice(
+                        f, patient=patient_id, index=base_index + i
+                    )
+                    if px is None:
+                        okf[i] = False
+                        injected_bad.add(f.stem)
+                    else:
+                        h, w = px.shape
+                        pixels[i] = 0.0
+                        pixels[i, :h, :w] = px
+                        dims[i] = (h, w)
+                        okf[i] = True
         stems = [f.stem for f in batch_files]
         bad = [s for s, o in zip(stems, okf) if not o]
         for f, o, e in zip(batch_files, okf, errs):
-            if not o:
+            if not o and f.stem not in injected_bad:  # _read_slice logged those
                 log.warning(
                     "failed to decode %s: %s",
                     f.name,

@@ -24,6 +24,7 @@ def build_parser() -> argparse.ArgumentParser:
     common.add_pipeline_args(p)
     common.add_render_stage_arg(p)
     common.add_model_arg(p)
+    common.add_resilience_args(p)
     # run() already handles world>1 (patient shard + collective accounting);
     # without this the advertised `nm03-sequential --distributed` died at
     # argparse (ADVICE r2)
@@ -78,6 +79,7 @@ def run(args: argparse.Namespace, mode: str) -> int:
             process_count=world,
             model_params=model_params,
             obs=run_ctx,
+            resilience=common.resilience_config_from_args(args),
         )
         import time
 

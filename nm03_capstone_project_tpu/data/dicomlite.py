@@ -234,23 +234,53 @@ class _Reader:
                 self.pos += length
 
 
-def _read_fragments(r: "_Reader") -> list:
+class _Fragments(list):
+    """Encapsulated PixelData fragments + frame-boundary metadata.
+
+    A plain list of fragment byte strings (so every existing isinstance and
+    indexing contract holds), annotated with the Basic Offset Table entries
+    and each fragment's item-tag offset — both measured, per PS3.5 §A.4,
+    from the first byte of the first item FOLLOWING the BOT item — so
+    :func:`_frame_payload` can use the BOT as the authoritative frame
+    delimiter instead of guessing from SOI markers.
+    """
+
+    def __init__(self, frags, bot, offsets):
+        super().__init__(frags)
+        self.bot = list(bot)  # [] when the BOT item is empty
+        self.offsets = list(offsets)  # per-fragment item-tag offsets
+
+
+def _read_fragments(r: "_Reader") -> "_Fragments":
     """Encapsulated PixelData: Basic Offset Table item, then one item per
     fragment, closed by a sequence delimiter (PS3.5 §A.4). Returns the
-    fragment byte strings (offset table discarded — single-frame contract)."""
+    fragment byte strings with the BOT preserved (frame-boundary source)."""
     fragments: list = []
+    bot: list = []
+    offsets: list = []
     first = True
+    base = 0
     while not r.atend():
+        tag_pos = r.pos
         group, elem, _vr, length = r.element()
         if (group, elem) == _SEQ_DELIM:
-            return fragments
+            return _Fragments(fragments, bot, offsets)
         if (group, elem) != _ITEM or length == 0xFFFFFFFF:
             raise DicomParseError(
                 f"malformed encapsulated PixelData item ({group:04x},{elem:04x})"
             )
         if length > len(r.buf) - r.pos:
             raise DicomParseError("encapsulated fragment overruns file")
-        if not first:  # the first item is the Basic Offset Table
+        if first:  # the first item is the Basic Offset Table
+            # a non-multiple-of-4 BOT is malformed but must not reject the
+            # file: pre-BOT-support the table was discarded unconditionally,
+            # and single-frame files never need it — treat it as empty so
+            # multi-frame grouping falls back to SOI scanning
+            if length % 4 == 0 and length:
+                bot = list(struct.unpack_from(f"<{length // 4}I", r.buf, r.pos))
+            base = r.pos + length  # offsets count from the byte after the BOT
+        else:
+            offsets.append(tag_pos - base)
             fragments.append(r.buf[r.pos : r.pos + length])
         first = False
         r.pos += length
@@ -348,12 +378,42 @@ def _meta_float(meta, tag, default: float) -> float:
 def _frame_payload(fragments: list, frame: int, nframes: int) -> bytes:
     """One frame's concatenated JPEG-family codestream.
 
-    Single-frame: all fragments join (a frame may span fragments). Multi-
-    frame: frames are delimited by the fragments that START a codestream
-    (SOI marker), and the group count must match NumberOfFrames.
+    Single-frame: all fragments join (a frame may span fragments).
+    Multi-frame: when the file carries a non-empty Basic Offset Table, the
+    BOT is the AUTHORITATIVE frame-boundary source (PS3.5 §A.4: one offset
+    per frame, pointing at the item tag of the frame's first fragment) —
+    SOI-marker scanning is only the fallback for an empty BOT, because a
+    fragment boundary can coincidentally land on bytes that look like an
+    SOI (e.g. inside a COM/APPn segment), mis-splitting the stream.
     """
     if nframes <= 1:
         return b"".join(fragments)
+    bot = getattr(fragments, "bot", None)
+    offsets = getattr(fragments, "offsets", None)
+    if bot:
+        if len(bot) != nframes:
+            raise DicomParseError(
+                f"Basic Offset Table has {len(bot)} entries for "
+                f"NumberOfFrames={nframes}"
+            )
+        starts: list = []
+        for off in bot:
+            try:
+                starts.append(offsets.index(off))
+            except ValueError:
+                raise DicomParseError(
+                    f"Basic Offset Table offset {off} does not fall on a "
+                    "fragment boundary"
+                ) from None
+        if starts[0] != 0 or any(
+            b <= a for a, b in zip(starts, starts[1:])
+        ):
+            raise DicomParseError(
+                "Basic Offset Table offsets are not strictly increasing "
+                "from the first fragment"
+            )
+        bounds = starts + [len(fragments)]
+        return b"".join(fragments[bounds[frame] : bounds[frame + 1]])
     groups: list = []
     for frag in fragments:
         if frag[:2] == b"\xff\xd8" or not groups:
@@ -455,6 +515,19 @@ def read_dicom(path: str | os.PathLike, frame: int = 0) -> DicomSlice:
     """
     with open(path, "rb") as f:
         raw = f.read()
+    return read_dicom_bytes(raw, frame, path=path)
+
+
+def read_dicom_bytes(raw: bytes, frame: int = 0, path="<bytes>") -> DicomSlice:
+    """:func:`read_dicom` from an in-memory byte string.
+
+    The fault-injection layer (resilience.faultinject) decodes
+    deterministically corrupted file images through this entry point so the
+    REAL parser's rejection path is what the chaos tests exercise; also
+    useful anywhere the caller already holds the file bytes. ``path`` is a
+    provenance hint — it must be the real on-disk path for the J2K shim
+    route (the GDCM fallback re-reads the file itself).
+    """
     ctx = _open_dataset(raw, path)
     if isinstance(ctx, DicomSlice):  # J2K shim path (single-frame)
         if frame != 0:

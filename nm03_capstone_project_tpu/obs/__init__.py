@@ -38,6 +38,9 @@ from nm03_capstone_project_tpu.obs.metrics import (  # noqa: F401
 from nm03_capstone_project_tpu.obs.run import (  # noqa: F401
     GROW_TRUNCATED_TOTAL,
     PATIENT_OUTCOMES_TOTAL,
+    PIPELINE_DEGRADED_TOTAL,
+    RESILIENCE_FAULTS_INJECTED_TOTAL,
+    RESILIENCE_RETRIES_TOTAL,
     SLICES_TOTAL,
     RunContext,
 )

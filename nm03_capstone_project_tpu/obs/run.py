@@ -32,6 +32,10 @@ PATIENT_OUTCOMES_TOTAL = "nm03_patient_outcomes_total"
 SLICES_TOTAL = "nm03_slices_total"
 GROW_TRUNCATED_TOTAL = "pipeline_grow_truncated_total"
 HEARTBEATS_TOTAL = "nm03_heartbeats_total"
+# resilience subsystem (docs/RESILIENCE.md; validated by check_telemetry.py)
+RESILIENCE_RETRIES_TOTAL = "resilience_retries_total"
+RESILIENCE_FAULTS_INJECTED_TOTAL = "resilience_faults_injected_total"
+PIPELINE_DEGRADED_TOTAL = "pipeline_degraded_total"
 
 PATIENT_STATUSES = ("ok", "failed")
 
@@ -204,6 +208,46 @@ class RunContext:
             patient_id=str(patient_id),
             count=int(count),
             **fields,
+        )
+
+    # -- resilience telemetry ----------------------------------------------
+
+    def retry(self, cause: str, attempt: int = 1, **fields) -> dict:
+        """One supervised retry: counter (per-cause label) + INFO event."""
+        self.registry.counter(
+            RESILIENCE_RETRIES_TOTAL,
+            help="supervised retries by cause (resilience.RetryPolicy)",
+            cause=str(cause),
+        ).inc()
+        return self.events.emit(
+            "retry", cause=str(cause), attempt=int(attempt), **fields
+        )
+
+    def fault_injected(self, site: str, kind: str, **fields) -> dict:
+        """One fired fault-plan rule: counter (site/kind labels) + event."""
+        self.registry.counter(
+            RESILIENCE_FAULTS_INJECTED_TOTAL,
+            help="faults fired by the seeded fault plan "
+            "(resilience.FaultPlan; zero outside chaos runs)",
+            site=str(site),
+            kind=str(kind),
+        ).inc()
+        return self.events.emit(
+            "fault_injected", site=str(site), kind=str(kind), **fields
+        )
+
+    def degraded(self, cause: str, **fields) -> dict:
+        """The run flipped to its degraded (CPU-fallback) path: WARNING
+        event + ``pipeline_degraded_total`` counter. Emitted once per
+        degradation transition, not per fallback batch."""
+        self.registry.counter(
+            PIPELINE_DEGRADED_TOTAL,
+            help="degradation transitions (dispatch deadline expiry or "
+            "device lost; the run finished on the CPU fallback)",
+            cause=str(cause),
+        ).inc()
+        return self.events.emit(
+            "degraded", level="WARNING", cause=str(cause), **fields
         )
 
     # the comparator_counts() keys that are actually op counts — "window"

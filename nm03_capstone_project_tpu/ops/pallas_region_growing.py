@@ -214,14 +214,21 @@ def grow_dispatch(
             image, seeds, low, high, valid, connectivity, block_iters, max_iters
         )
     if algorithm == "jump":
+        import math
+
         from nm03_capstone_project_tpu.ops.region_growing import region_grow_jump
 
-        # the caller's iteration budget caps this schedule too (as rounds —
-        # O(log) convergence means it effectively never binds, but
-        # --grow-max-iters must not be a silent no-op on the jump path)
+        # ONE flag, one growth budget (ADVICE r5): ``max_iters`` is a growth
+        # RADIUS in pixels — the dilate schedule's unit. Pointer jumping
+        # doubles its reach every round, so the equivalent round cap is
+        # ceil(log2(max_iters)) plus a small margin absorbing the rounds
+        # boundary effects cost without doubling reach. Passing max_iters
+        # straight through (the old behavior) silently gave the jump path a
+        # ~2^max_iters growth budget under the same flag value.
+        max_rounds = math.ceil(math.log2(max(max_iters, 2))) + 2
         return region_grow_jump(
             image, seeds, low, high, valid=valid, connectivity=connectivity,
-            max_rounds=max_iters,
+            max_rounds=max_rounds,
         )
     from nm03_capstone_project_tpu.ops.region_growing import region_grow
 

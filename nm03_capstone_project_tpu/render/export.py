@@ -24,7 +24,7 @@ _log = get_logger("export")
 
 
 def save_jpeg(image: np.ndarray, path: str | os.PathLike, quality: int = 90) -> None:
-    """Write a uint8 grayscale (H, W) array as JPEG.
+    """Write a uint8 grayscale (H, W) array as JPEG, atomically.
 
     Encoder preference is MEASURED, not assumed: PIL rides libjpeg-turbo's
     SIMD entropy/DCT and encodes a 512x512 render in ~2.4 ms where the
@@ -33,27 +33,41 @@ def save_jpeg(image: np.ndarray, path: str | os.PathLike, quality: int = 90) -> 
     (csrc/nm03native.cpp, the counterpart of the reference's native
     ImageFileExporter, main_sequential.cpp:61-73) is the fallback for
     PIL-less deployments.
+
+    Atomic tmp+rename (crash-safe resume contract, docs/RESILIENCE.md):
+    a SIGTERM/kill/ENOSPC mid-encode can leave a stray ``.jpg.tmp`` but
+    never a torn ``.jpg`` — so ``--resume`` may trust every final-named
+    file on disk without re-validating its bytes.
     """
     arr = np.asarray(image)
     if arr.dtype != np.uint8:
         raise ValueError(f"expected uint8 image, got {arr.dtype}")
-    Path(path).parent.mkdir(parents=True, exist_ok=True)
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(path.name + ".tmp")
 
     try:
         from PIL import Image
     except ImportError:
         Image = None
 
-    if Image is not None:
-        Image.fromarray(arr, mode="L").save(path, quality=quality)
-        return
+    try:
+        if Image is not None:
+            # explicit format: the tmp suffix hides the .jpg extension PIL
+            # would otherwise infer the encoder from
+            Image.fromarray(arr, mode="L").save(tmp, format="JPEG", quality=quality)
+        else:
+            from nm03_capstone_project_tpu import native
 
-    from nm03_capstone_project_tpu import native
-
-    if arr.ndim == 2 and native.available():
-        Path(path).write_bytes(native.encode_jpeg_gray(arr, quality))
-        return
-    raise RuntimeError("no JPEG encoder available (PIL missing, native failed)")
+            if arr.ndim != 2 or not native.available():
+                raise RuntimeError(
+                    "no JPEG encoder available (PIL missing, native failed)"
+                )
+            tmp.write_bytes(native.encode_jpeg_gray(arr, quality))
+        os.replace(tmp, path)
+    except BaseException:
+        tmp.unlink(missing_ok=True)
+        raise
 
 
 def _write_pair(out: Path, stem: str, orig: np.ndarray, proc: np.ndarray) -> str:
@@ -62,17 +76,56 @@ def _write_pair(out: Path, stem: str, orig: np.ndarray, proc: np.ndarray) -> str
     return stem
 
 
-def _export_many(write_one, items: Sequence, out_dir, max_workers: int) -> List[str]:
+def _export_many(
+    write_one,
+    items: Sequence,
+    out_dir,
+    max_workers: int,
+    fault_hook=None,
+    retry=None,
+    success_hook=None,
+) -> List[str]:
     """Concurrent per-slice export with containment; the shared scaffold.
 
     ``write_one(item) -> stem`` runs per slice on a thread pool; failures are
     contained and logged per slice (the reference's catch-and-continue at the
     export stage, main_sequential.cpp:267-271). Returns sorted stems written.
+
+    ``fault_hook(stem)`` is the chaos-injection point (resilience.FaultPlan):
+    called before each slice writes, it may raise to simulate export I/O
+    failure. ``retry`` (a resilience.RetryPolicy) retries OSError-class
+    write failures — the transient-disk case — before declaring the slice
+    failed; injected faults are OSErrors too, so persistent fault rules
+    exercise the retry path on their way to a contained failure.
+    ``success_hook(stem)`` fires the moment a slice's pair is on disk —
+    the crash journal's per-slice granularity hook; its own failures are
+    contained (a journaling error must not un-succeed a written slice).
     """
     Path(out_dir).mkdir(parents=True, exist_ok=True)
+
+    def attempt(item):
+        # the hook fires per ATTEMPT, inside the retry: a count-limited
+        # fault rule models a transient disk error (healed by retry), an
+        # unlimited rule a persistent one (retries exhaust, slice fails)
+        if fault_hook is not None:
+            fault_hook(item[0])
+        return write_one(item)
+
+    def one(item):
+        if retry is not None:
+            stem = retry.call(attempt, item, cause="export", retryable=(OSError,))
+        else:
+            stem = attempt(item)
+        if success_hook is not None:
+            try:
+                success_hook(stem)
+            except Exception as e:  # noqa: BLE001 — journal must not cost a slice
+                _log.warning("export success hook failed for %s: %s", stem, e)
+        return stem
+
     done: List[str] = []
     with cf.ThreadPoolExecutor(max_workers=max_workers) as pool:
-        futures = {pool.submit(write_one, item): item[0] for item in items}
+        futures = {pool.submit(one, item): item[0] for item in items}
         for fut in cf.as_completed(futures):
             try:
                 done.append(fut.result())
@@ -85,11 +138,20 @@ def export_pairs(
     items: Sequence[Tuple[str, np.ndarray, np.ndarray]],
     out_dir: str | os.PathLike,
     max_workers: int = 8,
+    fault_hook=None,
+    retry=None,
+    success_hook=None,
 ) -> List[str]:
     """Write (stem, original, processed) triples as JPEG pairs concurrently."""
     out = Path(out_dir)
     return _export_many(
-        lambda it: _write_pair(out, it[0], it[1], it[2]), items, out, max_workers
+        lambda it: _write_pair(out, it[0], it[1], it[2]),
+        items,
+        out,
+        max_workers,
+        fault_hook=fault_hook,
+        retry=retry,
+        success_hook=success_hook,
     )
 
 
@@ -98,6 +160,9 @@ def render_export_pairs(
     out_dir: str | os.PathLike,
     cfg,
     max_workers: int = 8,
+    fault_hook=None,
+    retry=None,
+    success_hook=None,
 ) -> List[str]:
     """Render host-side, then write the JPEG pair, per (stem, pixels, mask, dims).
 
@@ -123,7 +188,15 @@ def render_export_pairs(
             gray, seg = host_render_pair(pixels, mask, dims, cfg)
         return _write_pair(out, stem, gray, seg)
 
-    return _export_many(write_one, items, out, max_workers)
+    return _export_many(
+        write_one,
+        items,
+        out,
+        max_workers,
+        fault_hook=fault_hook,
+        retry=retry,
+        success_hook=success_hook,
+    )
 
 
 def clean_directory(path: str | os.PathLike) -> None:

@@ -1,0 +1,48 @@
+"""Resilience subsystem: supervised execution for the batch drivers.
+
+Four pillars (docs/RESILIENCE.md has the failure-taxonomy → policy → flag →
+metric table):
+
+* :mod:`~nm03_capstone_project_tpu.resilience.policy` — :class:`RetryPolicy`
+  (exponential backoff, deterministic jitter, per-cause run budgets) and
+  :class:`Deadline` (wall-clock budget per device dispatch batch);
+* :mod:`~nm03_capstone_project_tpu.resilience.supervisor` —
+  :class:`DispatchSupervisor`, which abandons a wedged dispatch at its
+  deadline and flips the run to the CPU backend (graceful degradation);
+* :mod:`~nm03_capstone_project_tpu.resilience.faultinject` —
+  :class:`FaultPlan`, the seedable deterministic chaos layer that makes
+  every containment claim a test;
+* :mod:`~nm03_capstone_project_tpu.resilience.journal` —
+  :class:`PatientJournal`, slice-grain crash-safe resume.
+
+jax-free at import time: bench.py's orchestrator (which must never import
+jax) and pure-host tooling can use the policy objects directly.
+"""
+
+from nm03_capstone_project_tpu.resilience.faultinject import (  # noqa: F401
+    ENV_VAR as FAULT_PLAN_ENV,
+    FaultAbandoned,
+    FaultPlan,
+    FaultRule,
+    InjectedDecodeError,
+    InjectedExportError,
+    InjectedTransientError,
+    corrupt_bytes,
+    deliver_sigterm,
+    execute_hang,
+)
+from nm03_capstone_project_tpu.resilience.journal import (  # noqa: F401
+    JOURNAL_NAME,
+    PatientJournal,
+)
+from nm03_capstone_project_tpu.resilience.policy import (  # noqa: F401
+    Deadline,
+    DeadlineExceeded,
+    ResilienceConfig,
+    RetryPolicy,
+    TransientDeviceError,
+    is_retryable,
+)
+from nm03_capstone_project_tpu.resilience.supervisor import (  # noqa: F401
+    DispatchSupervisor,
+)

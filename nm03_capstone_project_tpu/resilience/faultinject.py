@@ -1,0 +1,290 @@
+"""Seedable, deterministic fault injection for the batch drivers.
+
+Every containment claim in this repo ("a corrupt slice never kills a cohort
+run", "an export failure is counted, not propagated", "a wedged dispatch
+degrades to CPU") was previously testable only by monkeypatching internals.
+A :class:`FaultPlan` makes each claim a *chaos test*: a JSON plan names the
+site, the kind of fault, and the exact slice/patient/batch it hits, and the
+drivers consult the plan at their injection points. Zero overhead when off —
+the drivers hold ``None`` and never call in.
+
+Activation (either):
+
+* ``--fault-plan SPEC`` on the batch drivers (CLI flag), or
+* ``NM03_FAULT_PLAN=SPEC`` in the environment (reaches subprocess workers,
+  e.g. bench.py's, without flag plumbing).
+
+``SPEC`` is inline JSON (starts with ``{``) or a path to a JSON file::
+
+    {"seed": 7, "faults": [
+      {"site": "decode",   "kind": "error",    "stem": "1-02"},
+      {"site": "decode",   "kind": "corrupt",  "stem": "1-03"},
+      {"site": "dispatch", "kind": "hang",     "index": 0, "hang_s": 120},
+      {"site": "dispatch", "kind": "transient","count": 2},
+      {"site": "export",   "kind": "io_error", "stem": "1-04"},
+      {"site": "export",   "kind": "sigterm",  "after": 4}
+    ]}
+
+Selectors (``patient``, ``stem``, ``index``) restrict where a rule fires;
+``after`` skips the first N-1 matching checks (1-based ordinal), ``count``
+caps total fires (default unlimited), and ``rate`` fires probabilistically —
+with the draw derived from (plan seed, rule, site, selector values), so the
+same plan against the same cohort injects the same faults regardless of
+thread scheduling or run-to-run ordering.
+
+Kinds by site:
+
+* ``decode``:   ``error`` (raise before decode), ``corrupt`` (feed the real
+  parser deterministically corrupted file bytes — exercises the actual
+  rejection path, not a mock);
+* ``dispatch``: ``transient`` (a retryable :class:`TransientDeviceError`),
+  ``hang`` (block ``hang_s`` seconds, the tunnel-wedge simulation the
+  dispatch deadline exists for);
+* ``export``:   ``io_error`` (raise before the JPEG pair writes),
+  ``sigterm`` (deliver SIGTERM to this process — the crash-safe-resume
+  drill).
+
+Injected faults are observable: every fire increments
+``resilience_faults_injected_total{site,kind}`` and emits a
+``fault_injected`` event when the caller passes its RunContext.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from nm03_capstone_project_tpu.resilience.policy import TransientDeviceError
+
+ENV_VAR = "NM03_FAULT_PLAN"
+
+SITES = ("decode", "dispatch", "export")
+KINDS_BY_SITE = {
+    "decode": ("error", "corrupt"),
+    "dispatch": ("transient", "hang"),
+    "export": ("io_error", "sigterm"),
+}
+
+
+class InjectedDecodeError(RuntimeError):
+    """An injected per-slice decode failure (contained like a real one)."""
+
+
+class InjectedExportError(OSError):
+    """An injected export I/O failure (contained like a real one)."""
+
+
+class InjectedTransientError(TransientDeviceError):
+    """An injected retryable device error."""
+
+
+class FaultAbandoned(RuntimeError):
+    """Raised inside an abandoned (deadline-expired) hang so the orphaned
+    worker thread dies instead of proceeding to the real dispatch."""
+
+
+@dataclass
+class FaultRule:
+    site: str
+    kind: str
+    patient: Optional[str] = None
+    stem: Optional[str] = None
+    index: Optional[int] = None
+    after: Optional[int] = None  # fire from the Nth matching check (1-based)
+    count: Optional[int] = None  # max fires; None = unlimited
+    rate: Optional[float] = None  # per-check probability (seeded draw)
+    hang_s: float = 60.0
+    # mutable bookkeeping (guarded by the plan's lock)
+    _seen: int = field(default=0, repr=False)
+    _fired: int = field(default=0, repr=False)
+
+    def validate(self) -> "FaultRule":
+        if self.site not in SITES:
+            raise ValueError(f"unknown fault site {self.site!r} (want {SITES})")
+        if self.kind not in KINDS_BY_SITE[self.site]:
+            raise ValueError(
+                f"kind {self.kind!r} invalid for site {self.site!r} "
+                f"(want one of {KINDS_BY_SITE[self.site]})"
+            )
+        if self.rate is not None and not 0.0 <= self.rate <= 1.0:
+            raise ValueError(f"rate must be in [0, 1], got {self.rate}")
+        if self.hang_s < 0:
+            raise ValueError(f"hang_s must be >= 0, got {self.hang_s}")
+        return self
+
+    def selectors_match(self, patient=None, stem=None, index=None) -> bool:
+        """Selector-only match (no ordinal/count/rate state consulted)."""
+        if self.patient is not None and self.patient != patient:
+            return False
+        if self.stem is not None and self.stem != stem:
+            return False
+        if self.index is not None and self.index != index:
+            return False
+        return True
+
+
+class FaultPlan:
+    """A parsed, thread-safe fault plan; drivers hold ``None`` when off."""
+
+    def __init__(self, rules: List[FaultRule], seed: int = 0):
+        self.rules = [r.validate() for r in rules]
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._sites = frozenset(r.site for r in self.rules)
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_spec(cls, spec) -> Optional["FaultPlan"]:
+        """Build from a dict, inline-JSON string, or path; None stays None."""
+        if spec is None:
+            return None
+        if isinstance(spec, cls):
+            return spec
+        if isinstance(spec, str):
+            text = spec if spec.lstrip().startswith("{") else None
+            if text is None:
+                with open(spec) as f:
+                    text = f.read()
+            try:
+                spec = json.loads(text)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"fault plan is not valid JSON: {e}") from e
+        if not isinstance(spec, dict):
+            raise ValueError(f"fault plan must be a JSON object, got {type(spec)}")
+        known = {"site", "kind", "patient", "stem", "index", "after",
+                 "count", "rate", "hang_s"}
+        rules = []
+        for i, entry in enumerate(spec.get("faults", [])):
+            if not isinstance(entry, dict):
+                raise ValueError(f"faults[{i}] is not an object")
+            unknown = set(entry) - known
+            if unknown:
+                raise ValueError(f"faults[{i}] has unknown keys {sorted(unknown)}")
+            rules.append(FaultRule(**entry))
+        return cls(rules, seed=spec.get("seed", 0))
+
+    @classmethod
+    def from_env(cls, environ=os.environ) -> Optional["FaultPlan"]:
+        return cls.from_spec(environ.get(ENV_VAR) or None)
+
+    # -- matching ----------------------------------------------------------
+
+    def has_site(self, site: str) -> bool:
+        return site in self._sites
+
+    def routes_decode(self, patient=None, stem=None, index=None) -> bool:
+        """Selector-only decode-site probe, side-effect free.
+
+        The native batch loader uses this to route fault-matched files
+        through the Python decode path (where injection actually happens)
+        without consuming the rule's ordinal/count state.
+        """
+        return any(
+            r.site == "decode" and r.selectors_match(patient, stem, index)
+            for r in self.rules
+        )
+
+    def _draw(self, rule_idx: int, rule: FaultRule, patient, stem, index) -> bool:
+        # keyed, not sequential: the draw depends only on the plan seed and
+        # the check's identity, so IO-pool thread interleaving cannot change
+        # which slices a rate rule hits
+        rng = random.Random(
+            f"{self.seed}:{rule_idx}:{rule.site}:{patient}:{stem}:{index}"
+        )
+        return rng.random() < rule.rate
+
+    def fire(self, site: str, obs=None, patient=None, stem=None, index=None):
+        """Return the first rule firing at this check site, else None.
+
+        Consumes ordinal (``after``) and budget (``count``) state; emits the
+        ``resilience_faults_injected_total`` counter + ``fault_injected``
+        event through ``obs`` when given. The caller maps rule.kind to the
+        actual fault (raise / hang / corrupt / SIGTERM).
+        """
+        if site not in self._sites:
+            return None
+        hit = None
+        with self._lock:
+            for i, r in enumerate(self.rules):
+                if r.site != site or not r.selectors_match(patient, stem, index):
+                    continue
+                r._seen += 1
+                if r.after is not None and r._seen < r.after:
+                    continue
+                if r.count is not None and r._fired >= r.count:
+                    continue
+                if r.rate is not None and not self._draw(i, r, patient, stem, index):
+                    continue
+                r._fired += 1
+                hit = r
+                break
+        if hit is not None and obs is not None:
+            try:
+                obs.fault_injected(
+                    site=site, kind=hit.kind,
+                    patient=patient, stem=stem, index=index,
+                )
+            except Exception:  # noqa: BLE001 — telemetry never blocks a fault
+                pass
+        return hit
+
+    def fired_total(self) -> int:
+        with self._lock:
+            return sum(r._fired for r in self.rules)
+
+
+# -- fault actions ----------------------------------------------------------
+
+
+def corrupt_bytes(raw: bytes, seed: int, key: str = "") -> bytes:
+    """Deterministically corrupt a DICOM file image in memory.
+
+    Overwrites a 64-byte window over the Part-10 magic and the start of the
+    file meta group with seeded garbage AND truncates the tail (so even a
+    parse that realigns onto valid elements hits a PixelData length
+    overrun) — the *real* parser exercises its rejection path on every
+    input, without touching the file on disk.
+    """
+    rng = random.Random(f"{seed}:corrupt:{key}")
+    start = min(128, max(0, len(raw) - 1))
+    garbage = bytes(rng.randrange(1, 255) for _ in range(64))
+    out = raw[:start] + garbage + raw[start + len(garbage):]
+    return out[: max(192, len(out) // 2)]
+
+
+def execute_hang(rule: FaultRule, cancel: Optional[threading.Event] = None) -> None:
+    """Simulate a wedged dispatch: block for ``rule.hang_s`` seconds.
+
+    When the supervisor abandons the dispatch (deadline expiry) it sets
+    ``cancel``; this raises :class:`FaultAbandoned` so the orphaned worker
+    thread exits promptly instead of sleeping out the hang and then running
+    the real dispatch whose results nobody will read.
+    """
+    t_end = time.monotonic() + rule.hang_s
+    while time.monotonic() < t_end:
+        if cancel is not None:
+            if cancel.wait(timeout=0.05):
+                raise FaultAbandoned("hang abandoned by dispatch supervisor")
+        else:
+            time.sleep(min(0.05, max(t_end - time.monotonic(), 0.0)))
+
+
+def deliver_sigterm() -> None:
+    """The crash drill: deliver SIGTERM to this process and wait to die.
+
+    The sleep guarantees the injection point is a hard interruption (the
+    default SIGTERM disposition terminates the process before the sleep
+    ends); if a test harness traps SIGTERM instead, the fault degrades to a
+    raised :class:`InjectedExportError` so the run cannot sail on.
+    """
+    import signal
+
+    os.kill(os.getpid(), signal.SIGTERM)
+    time.sleep(10.0)
+    raise InjectedExportError("SIGTERM fault delivered but process survived")

@@ -1,0 +1,198 @@
+"""Deadline-guarded dispatch with one-way CPU degradation.
+
+The tunnel failure mode docs/OPERATIONS.md documents — a device dispatch
+that never returns, holding the chip claim for hours — cannot be handled by
+containment (there is no exception to catch) or by retry (the call never
+comes back). The :class:`DispatchSupervisor` handles it the only way a
+client can: run the dispatch on an expendable worker thread, give it a
+wall-clock :class:`~.policy.Deadline`, and when the deadline expires,
+*abandon* the thread (daemonized, cancel-signalled) and flip the rest of
+the run to the CPU backend so the cohort finishes instead of wedging.
+
+The degradation ladder, in order:
+
+1. dispatch succeeds — the normal path;
+2. dispatch raises a retryable (transient/XLA-runtime) error — retried
+   under the :class:`~.policy.RetryPolicy` within the same deadline;
+3. retries exhausted, or the deadline expires — the supervisor marks the
+   run degraded (``pipeline_degraded_total`` + a WARNING ``degraded``
+   event, once per run) and reruns the work through the caller-supplied
+   CPU fallback; every later dispatch goes straight to the fallback;
+4. with ``--no-fallback-cpu``, step 3 raises :class:`DeadlineExceeded`
+   into the per-patient containment instead — the run still finishes, by
+   failing fast rather than by degrading.
+
+With ``dispatch_timeout_s == 0`` (the default) no worker threads exist and
+dispatches run inline on the caller's thread — the legacy path, except that
+transient device errors now retry under the policy instead of failing the
+slice/batch outright.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from nm03_capstone_project_tpu.resilience.policy import (
+    Deadline,
+    DeadlineExceeded,
+    ResilienceConfig,
+    RetryPolicy,
+    is_retryable,
+)
+
+
+class DispatchSupervisor:
+    """Supervises every device-touching step of one driver run."""
+
+    def __init__(
+        self,
+        cfg: ResilienceConfig,
+        retry: Optional[RetryPolicy] = None,
+        obs=None,
+    ):
+        self.cfg = cfg
+        self.retry = retry or cfg.make_retry_policy()
+        self.obs = obs
+        self._lock = threading.Lock()
+        self.degraded = False
+        self.degraded_cause: Optional[str] = None
+
+    @property
+    def supervised(self) -> bool:
+        return self.cfg.dispatch_timeout_s > 0
+
+    # -- the one entry point -----------------------------------------------
+
+    def run(
+        self,
+        primary: Callable[[], object],
+        fallback: Optional[Callable[[], object]] = None,
+        pre: Optional[Callable[[Optional[threading.Event]], None]] = None,
+        label: str = "dispatch",
+    ):
+        """Run ``primary()`` under supervision; degrade to ``fallback()``.
+
+        ``primary`` must perform the dispatch AND the device fetch, returning
+        host-side results — the fetch is as wedgeable as the dispatch, so it
+        must live inside the deadline. ``fallback`` recomputes the same
+        result on the CPU backend from host-side inputs (never from device
+        arrays: fetching those could hang on the very wedge being escaped).
+        ``pre`` is the fault-injection hook; it receives the attempt's
+        cancel event so an injected hang dies with the abandoned thread.
+        """
+        if self.degraded:
+            if fallback is not None and self.cfg.fallback_cpu:
+                return fallback()
+            raise DeadlineExceeded(
+                f"device path degraded ({self.degraded_cause}) and CPU "
+                "fallback is disabled"
+            )
+        if not self.supervised:
+            # inline path: no threads, no deadline — the retry policy sits
+            # between a transient device error and failure, and exhausted
+            # retries still degrade to the CPU fallback (device-lost
+            # without a deadline is still device-lost)
+            def attempt():
+                if pre is not None:
+                    pre(None)
+                return primary()
+
+            try:
+                return self.retry.call(attempt, cause=label, obs=self.obs)
+            except BaseException as e:  # noqa: BLE001 — classified below
+                if is_retryable(e):
+                    return self._degrade(
+                        label, "device_lost", fallback, timeout_s=0.0, error=e
+                    )
+                raise
+
+        deadline = Deadline.start(self.cfg.dispatch_timeout_s)
+        attempt = 0
+        while True:
+            status, value = self._attempt(primary, pre, deadline)
+            if status == "ok":
+                return value
+            if status == "timeout":
+                return self._degrade(
+                    label, "deadline", fallback, timeout_s=deadline.budget_s
+                )
+            err = value  # status == "err"
+            if not is_retryable(err):
+                raise err  # deterministic failure: per-slice containment's job
+            attempt += 1
+            delay = self.retry.delay_s(label, attempt)
+            if (
+                attempt > self.retry.retry_max
+                or not self.retry.try_acquire(label)
+                or delay >= deadline.remaining()
+            ):
+                return self._degrade(
+                    label,
+                    "device_lost",
+                    fallback,
+                    timeout_s=deadline.budget_s,
+                    error=err,
+                )
+            if self.obs is not None:
+                self.obs.retry(
+                    cause=label,
+                    attempt=attempt,
+                    error_class=type(err).__name__,
+                    backoff_s=round(delay, 4),
+                )
+            time.sleep(delay)
+
+    # -- internals ---------------------------------------------------------
+
+    def _attempt(self, primary, pre, deadline: Deadline):
+        box: dict = {}
+        cancel = threading.Event()
+
+        def work():
+            try:
+                if pre is not None:
+                    pre(cancel)
+                box["out"] = primary()
+            except BaseException as e:  # noqa: BLE001 — crosses the thread
+                box["err"] = e
+
+        t = threading.Thread(target=work, daemon=True, name="nm03-dispatch")
+        t.start()
+        t.join(timeout=max(deadline.remaining(), 0.0))
+        if t.is_alive():
+            # abandon, never kill: killing a client mid-TPU-op can wedge the
+            # tunnel for the next user (docs/OPERATIONS.md). The daemon
+            # thread dies with the process; injected hangs honor `cancel`.
+            cancel.set()
+            return ("timeout", None)
+        if "err" in box:
+            return ("err", box["err"])
+        return ("ok", box.get("out"))
+
+    def _degrade(self, label, cause, fallback, timeout_s: float, error=None):
+        first = False
+        with self._lock:
+            if not self.degraded:
+                self.degraded = True
+                self.degraded_cause = cause
+                first = True
+        if first and self.obs is not None:
+            try:
+                self.obs.degraded(
+                    cause=cause,
+                    site=label,
+                    timeout_s=timeout_s,
+                    error_class=type(error).__name__ if error else None,
+                )
+            except Exception:  # noqa: BLE001 — telemetry never costs the run
+                pass
+        if fallback is not None and self.cfg.fallback_cpu:
+            return fallback()
+        if error is not None:
+            raise error
+        raise DeadlineExceeded(
+            f"{label} exceeded its {timeout_s:.1f}s deadline and CPU "
+            "fallback is disabled"
+        )

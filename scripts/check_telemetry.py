@@ -23,14 +23,25 @@ events
   * exactly ONE terminal ``patient_outcome`` record per patient_id, with
     status in {ok, failed}, non-negative slice counts, boolean
     grow_truncated, integer retries, and error_class string-or-null;
-  * ``grow_truncated`` and failed-patient outcomes carry level WARNING.
+  * ``grow_truncated`` and failed-patient outcomes carry level WARNING;
+  * resilience events (docs/RESILIENCE.md): ``degraded`` is WARNING with a
+    non-empty ``cause``; ``retry`` carries a non-empty ``cause`` and a
+    positive integer ``attempt``; ``fault_injected`` carries non-empty
+    ``site`` and ``kind`` strings.
 
 metrics
   * envelope (schema, run_id, git_sha, created_unix, metrics list);
   * Prometheus-legal metric/label names; one type per metric name;
   * counters/gauges numeric, counters non-negative;
   * histogram buckets cumulative non-decreasing, ending in "+Inf" whose
-    count equals the series count; sum numeric.
+    count equals the series count; sum numeric;
+  * resilience counters carry their documented labels:
+    ``resilience_retries_total{cause}``,
+    ``resilience_faults_injected_total{site,kind}``,
+    ``pipeline_degraded_total{cause}``;
+  * ``--expect-counter NAME=MIN`` (repeatable) requires the summed value
+    of NAME's series to be at least MIN — the chaos suite's assertion
+    hook (e.g. ``--expect-counter pipeline_degraded_total=1``).
 
 cross
   * when both artifacts are given, their run_id and git_sha must match.
@@ -49,6 +60,12 @@ LEVELS = {"DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"}
 ENVELOPE = ("schema", "run_id", "git_sha", "seq", "ts_unix", "mono_s", "level", "event")
 PATIENT_STATUSES = {"ok", "failed"}
 METRIC_TYPES = {"counter", "gauge", "histogram"}
+# resilience counters and the labels each series MUST carry
+RESILIENCE_LABELS = {
+    "resilience_retries_total": ("cause",),
+    "resilience_faults_injected_total": ("site", "kind"),
+    "pipeline_degraded_total": ("cause",),
+}
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 
@@ -147,6 +164,21 @@ def check_events(path: str, chk: Checker, expect_patients: int | None = None):
                 chk.fail(where, "failed patient_outcome must be WARNING level")
         elif event == "grow_truncated" and rec["level"] != "WARNING":
             chk.fail(where, "grow_truncated events must be WARNING level")
+        elif event == "degraded":
+            if rec["level"] != "WARNING":
+                chk.fail(where, "degraded events must be WARNING level")
+            if not isinstance(rec.get("cause"), str) or not rec.get("cause"):
+                chk.fail(where, "degraded event needs a non-empty cause string")
+        elif event == "retry":
+            if not isinstance(rec.get("cause"), str) or not rec.get("cause"):
+                chk.fail(where, "retry event needs a non-empty cause string")
+            a = rec.get("attempt")
+            if not isinstance(a, int) or isinstance(a, bool) or a < 1:
+                chk.fail(where, f"retry attempt must be a positive int, got {a!r}")
+        elif event == "fault_injected":
+            for k in ("site", "kind"):
+                if not isinstance(rec.get(k), str) or not rec.get(k):
+                    chk.fail(where, f"fault_injected needs a non-empty {k} string")
 
     if events_seen and events_seen[0] != "run_started":
         chk.fail(path, f"first event is {events_seen[0]!r}, want 'run_started'")
@@ -186,8 +218,12 @@ def _check_histogram(where: str, rec: dict, chk: Checker) -> None:
         chk.fail(where, f"histogram sum must be numeric, got {rec.get('sum')!r}")
 
 
-def check_metrics(path: str, chk: Checker):
-    """Validate one metrics snapshot; returns (run_id, git_sha) or None."""
+def check_metrics(path: str, chk: Checker, expect_counters=None):
+    """Validate one metrics snapshot; returns (run_id, git_sha) or None.
+
+    ``expect_counters``: {name: min_total} — the summed value across NAME's
+    series must be >= min_total (chaos-suite assertions).
+    """
     try:
         with open(path) as f:
             snap = json.load(f)
@@ -208,6 +244,7 @@ def check_metrics(path: str, chk: Checker):
 
     kind_by_name: dict[str, str] = {}
     seen: set[tuple] = set()
+    counter_sums: dict[str, float] = {}
     for j, rec in enumerate(metrics):
         where = f"{path}: metrics[{j}]"
         if not isinstance(rec, dict):
@@ -233,6 +270,12 @@ def check_metrics(path: str, chk: Checker):
         if key in seen:
             chk.fail(where, f"duplicate series {name}{labels}")
         seen.add(key)
+        if name in RESILIENCE_LABELS:
+            if kind != "counter":
+                chk.fail(where, f"{name}: must be a counter, is {kind}")
+            missing_l = [k for k in RESILIENCE_LABELS[name] if k not in labels]
+            if missing_l:
+                chk.fail(where, f"{name}: missing required labels {missing_l}")
         if kind == "histogram":
             _check_histogram(where, rec, chk)
         else:
@@ -241,6 +284,12 @@ def check_metrics(path: str, chk: Checker):
                 chk.fail(where, f"{name}: value must be numeric, got {v!r}")
             elif kind == "counter" and v < 0:
                 chk.fail(where, f"{name}: counter value {v} is negative")
+            if kind == "counter" and _is_num(v):
+                counter_sums[name] = counter_sums.get(name, 0.0) + v
+    for name, want in sorted((expect_counters or {}).items()):
+        got = counter_sums.get(name, 0.0)
+        if got < want:
+            chk.fail(path, f"counter {name} totals {got}, expected >= {want}")
     return (snap.get("run_id"), snap.get("git_sha"))
 
 
@@ -252,16 +301,31 @@ def main(argv=None) -> int:
         "--expect-patients", type=int, default=None,
         help="require exactly N patients with terminal outcome events",
     )
+    ap.add_argument(
+        "--expect-counter", action="append", default=[], metavar="NAME=MIN",
+        help="require the summed value of counter NAME to be >= MIN "
+        "(repeatable; chaos-suite assertions, e.g. "
+        "pipeline_degraded_total=1)",
+    )
     args = ap.parse_args(argv)
     if not args.events and not args.metrics:
         ap.error("nothing to check: pass --events and/or --metrics")
+    expect_counters = {}
+    for spec in args.expect_counter:
+        name, _, val = spec.partition("=")
+        try:
+            expect_counters[name] = float(val)
+        except ValueError:
+            ap.error(f"--expect-counter wants NAME=MIN, got {spec!r}")
+    if expect_counters and not args.metrics:
+        ap.error("--expect-counter needs --metrics")
 
     chk = Checker()
     ev_ident = mt_ident = None
     if args.events:
         ev_ident = check_events(args.events, chk, args.expect_patients)
     if args.metrics:
-        mt_ident = check_metrics(args.metrics, chk)
+        mt_ident = check_metrics(args.metrics, chk, expect_counters)
     if ev_ident and mt_ident:
         if mt_ident[0] != ev_ident[0]:
             chk.fail("cross", f"metrics run_id {mt_ident[0]!r} != "

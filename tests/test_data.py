@@ -359,6 +359,126 @@ class TestCompressedTransferSyntaxes:
             )
 
 
+class TestBasicOffsetTable:
+    """ISSUE 3 satellite: a non-empty Basic Offset Table is the
+    AUTHORITATIVE frame-boundary source for encapsulated multi-frame
+    PixelData; SOI-marker scanning is only the empty-BOT fallback — a
+    fragment boundary can coincidentally land on FF D8 bytes (e.g. inside
+    a COM segment) and mis-split the stream."""
+
+    @staticmethod
+    def _mf_file(tmp_path, name, fragments, bot_entries, nframes=2):
+        import struct as st
+
+        from nm03_capstone_project_tpu.data.dicomlite import (
+            JPEG_BASELINE,
+            _element,
+        )
+
+        item = lambda b: st.pack("<HHI", 0xFFFE, 0xE000, len(b)) + b  # noqa: E731
+        bot = (
+            st.pack(f"<{len(bot_entries)}I", *bot_entries)
+            if bot_entries
+            else b""
+        )
+        pixeldata = (
+            st.pack("<HH", 0x7FE0, 0x0010)
+            + b"OB\x00\x00"
+            + st.pack("<I", 0xFFFFFFFF)
+            + item(bot)
+            + b"".join(item(f) for f in fragments)
+            + st.pack("<HHI", 0xFFFE, 0xE0DD, 0)
+        )
+        meta_elems = _element(0x0002, 0x0010, b"UI", JPEG_BASELINE.encode())
+        meta = (
+            _element(0x0002, 0x0000, b"UL", st.pack("<I", len(meta_elems)))
+            + meta_elems
+        )
+        ds = (
+            _element(0x0028, 0x0008, b"IS", str(nframes).encode())
+            + _element(0x0028, 0x0010, b"US", st.pack("<H", 64))
+            + _element(0x0028, 0x0011, b"US", st.pack("<H", 64))
+            + _element(0x0028, 0x0100, b"US", st.pack("<H", 8))
+            + _element(0x0028, 0x0103, b"US", st.pack("<H", 0))
+            + pixeldata
+        )
+        p = tmp_path / name
+        p.write_bytes(b"\x00" * 128 + b"DICM" + meta + ds)
+        return p
+
+    @staticmethod
+    def _frames():
+        """Two baseline-JPEG frames; frame 0 carries a COM segment whose
+        payload is the two bytes FF D8, and is split into fragments exactly
+        at that payload — so the second fragment coincidentally starts with
+        an SOI marker."""
+        import io
+        import struct as st
+
+        from PIL import Image
+
+        def jpeg(arr):
+            buf = io.BytesIO()
+            Image.fromarray(arr, "L").save(buf, "JPEG", quality=95)
+            return buf.getvalue()
+
+        img0 = np.tile(np.arange(64, dtype=np.uint8) * 2, (64, 1))
+        img1 = np.ascontiguousarray(img0.T)
+        s0, s1 = jpeg(img0), jpeg(img1)
+        com = b"\xff\xfe" + st.pack(">H", 4) + b"\xff\xd8"
+        s0 = s0[:2] + com + s0[2:]  # SOI, COM(FF D8), rest
+        s0 += b"\x00" * (len(s0) % 2)
+        s1 += b"\x00" * (len(s1) % 2)
+        frag_a, frag_b = s0[:6], s0[6:]  # split INSIDE the COM payload
+        assert frag_b[:2] == b"\xff\xd8"  # the coincidental SOI
+        return (img0, img1), (frag_a, frag_b, s1)
+
+    def test_bot_authoritative_over_soi_scan(self, tmp_path):
+        from nm03_capstone_project_tpu.data.dicomlite import read_dicom_frames
+
+        (img0, img1), (a, b, c) = self._frames()
+        # PS3.5 A.4: BOT entries point at each frame's first-fragment item
+        # tag, measured from the byte after the BOT item
+        bot = [0, 8 + len(a) + 8 + len(b)]
+        p = self._mf_file(tmp_path, "bot.dcm", [a, b, c], bot)
+        frames = read_dicom_frames(p)
+        assert len(frames) == 2
+        for fr, img in zip(frames, (img0, img1)):
+            assert np.abs(fr.pixels - img.astype(np.float32)).max() < 8  # lossy
+
+    def test_empty_bot_falls_back_to_soi_scan(self, tmp_path):
+        from nm03_capstone_project_tpu.data.dicomlite import read_dicom_frames
+
+        (img0, img1), (a, b, c) = self._frames()
+        # without the BOT the COM trick mis-splits into 3 "codestreams":
+        # the SOI fallback must reject rather than decode garbage ...
+        p = self._mf_file(tmp_path, "nobot.dcm", [a, b, c], [])
+        with pytest.raises(DicomParseError, match="3 JPEG codestreams"):
+            read_dicom_frames(p)
+        # ... and still groups correctly when boundaries are honest
+        p2 = self._mf_file(tmp_path, "clean.dcm", [a + b, c], [])
+        frames = read_dicom_frames(p2)
+        assert len(frames) == 2
+        for fr, img in zip(frames, (img0, img1)):
+            assert np.abs(fr.pixels - img.astype(np.float32)).max() < 8
+
+    def test_bot_entry_count_mismatch_rejected(self, tmp_path):
+        from nm03_capstone_project_tpu.data.dicomlite import read_dicom_frames
+
+        _, (a, b, c) = self._frames()
+        p = self._mf_file(tmp_path, "short.dcm", [a, b, c], [0])
+        with pytest.raises(DicomParseError, match="Basic Offset Table has 1"):
+            read_dicom_frames(p)
+
+    def test_bot_off_boundary_offset_rejected(self, tmp_path):
+        from nm03_capstone_project_tpu.data.dicomlite import read_dicom_frames
+
+        _, (a, b, c) = self._frames()
+        p = self._mf_file(tmp_path, "off.dcm", [a, b, c], [0, 2])
+        with pytest.raises(DicomParseError, match="fragment boundary"):
+            read_dicom_frames(p)
+
+
 class TestImporterEnvelopeMinimal:
     @staticmethod
     def _minimal_ds(tmp_path, name, *, rows=True, pixel=True, samples=1,

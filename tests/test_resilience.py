@@ -1,0 +1,616 @@
+"""Chaos suite for the resilience subsystem (ISSUE 3).
+
+Three layers:
+
+* unit — RetryPolicy / Deadline schedules and budgets, FaultPlan parsing,
+  matching and seeded determinism, the crash journal's torn-line replay,
+  atomic JPEG export;
+* driver chaos — both batch drivers under seeded fault plans: failed
+  counts equal the plan, no partial/truncated files on disk, injected
+  dispatch hangs degrade to the CPU fallback and the cohort still
+  finishes (the acceptance test that hangs/crashes on pre-resilience
+  main), transient device errors retry;
+* crash drill — ``kill -TERM`` mid-run (delivered deterministically by
+  the fault plan) followed by ``--resume`` converges to the uninterrupted
+  run's exact output set, with no torn files at any point;
+
+plus the telemetry gate: a chaos run's ``--metrics-out`` / ``--log-json``
+artifacts validate under scripts/check_telemetry.py including the new
+resilience counter/event rules and ``--expect-counter`` assertions.
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from nm03_capstone_project_tpu.cli.runner import CohortProcessor
+from nm03_capstone_project_tpu.config import BatchConfig, PipelineConfig
+from nm03_capstone_project_tpu.data.synthetic import write_synthetic_cohort
+from nm03_capstone_project_tpu.obs import RunContext
+from nm03_capstone_project_tpu.resilience import (
+    Deadline,
+    DeadlineExceeded,
+    FaultPlan,
+    PatientJournal,
+    ResilienceConfig,
+    RetryPolicy,
+    TransientDeviceError,
+    is_retryable,
+)
+
+CFG = PipelineConfig(canvas=128, render_size=128)
+BCFG = BatchConfig(batch_size=3, io_workers=2)
+CHECKER = Path(__file__).resolve().parents[1] / "scripts" / "check_telemetry.py"
+
+
+@pytest.fixture(scope="module")
+def cohort(tmp_path_factory):
+    root = tmp_path_factory.mktemp("chaos-cohort")
+    write_synthetic_cohort(root, n_patients=2, n_slices=4, height=128, width=120)
+    return root
+
+
+def digest_tree(root) -> str:
+    h = hashlib.sha256()
+    for p in sorted(Path(root).rglob("*.jpg")):
+        h.update(p.name.encode())
+        h.update(p.read_bytes())
+    return h.hexdigest()
+
+
+def assert_no_torn_files(root):
+    """The crash-safety invariant: no stray tmp files, every final-named
+    JPEG on disk is structurally complete."""
+    from PIL import Image
+
+    assert not list(Path(root).rglob("*.tmp"))
+    for p in Path(root).rglob("*.jpg"):
+        with Image.open(p) as img:
+            img.verify()  # raises on a truncated/torn stream
+
+
+# -- policies ---------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_schedule_deterministic_and_bounded(self):
+        mk = lambda: RetryPolicy(  # noqa: E731
+            retry_max=3, backoff_s=0.1, multiplier=2.0, jitter=0.5, seed=7
+        )
+        a, b = mk(), mk()
+        d = [a.delay_s("x", n) for n in (1, 2, 3, 99)]
+        assert d == [b.delay_s("x", n) for n in (1, 2, 3, 99)]
+        for n, delay in zip((1, 2, 3), d):
+            base = min(0.1 * 2 ** (n - 1), a.max_backoff_s)
+            assert base * 0.5 <= delay <= base
+        assert d[3] <= a.max_backoff_s
+        # jitter is per-cause: two causes see different schedules
+        assert a.delay_s("x", 1) != a.delay_s("y", 1)
+
+    def test_retries_only_retryable_then_succeeds(self):
+        p = RetryPolicy(retry_max=2, backoff_s=0.0)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise TransientDeviceError("blip")
+            return "ok"
+
+        assert p.call(flaky, cause="t") == "ok"
+        assert len(calls) == 3
+
+        def det():
+            calls.append(1)
+            raise ValueError("deterministic")
+
+        calls.clear()
+        with pytest.raises(ValueError):
+            p.call(det, cause="t")
+        assert len(calls) == 1  # no retry spent on a deterministic failure
+
+    def test_per_cause_budget_exhausts(self):
+        p = RetryPolicy(retry_max=10, backoff_s=0.0, budget_per_cause=2)
+
+        def always():
+            raise TransientDeviceError("down")
+
+        with pytest.raises(TransientDeviceError):
+            p.call(always, cause="c")
+        assert p.spent("c") == 2  # budget, not retry_max, bound the attempts
+        # a different cause has its own budget
+        with pytest.raises(TransientDeviceError):
+            p.call(always, cause="other")
+        assert p.spent("other") == 2
+
+    def test_retry_events_flow_through_obs(self):
+        ctx = RunContext.create("test")
+        p = RetryPolicy(retry_max=1, backoff_s=0.0, obs=ctx)
+        calls = []
+
+        def once():
+            calls.append(1)
+            if len(calls) == 1:
+                raise TransientDeviceError("blip")
+            return 1
+
+        assert p.call(once, cause="dispatch") == 1
+        assert ctx.registry.get(
+            "resilience_retries_total", cause="dispatch"
+        ).value == 1
+        retries = [r for r in ctx.events.tail if r["event"] == "retry"]
+        assert retries and retries[0]["attempt"] == 1
+
+    def test_is_retryable_classification(self):
+        XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+        assert is_retryable(TransientDeviceError("x"))
+        assert is_retryable(XlaRuntimeError("device lost"))
+        assert not is_retryable(ValueError("x"))
+        assert is_retryable(ValueError("x"), extra=(ValueError,))
+
+    def test_deadline(self):
+        d = Deadline.start(0.0)
+        assert not d.enabled and d.remaining() == float("inf")
+        d = Deadline(budget_s=0.5, started_mono=time.monotonic() - 1.0)
+        assert d.expired() and d.remaining() < 0
+        with pytest.raises(DeadlineExceeded):
+            d.check("dispatch")
+        assert not Deadline.start(60.0).expired()
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(retry_max=-1)
+        with pytest.raises(ValueError):
+            RetryPolicy(jitter=2.0)
+        with pytest.raises(ValueError):
+            RetryPolicy(multiplier=0.5)
+
+
+# -- fault plan -------------------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_parse_forms(self, tmp_path):
+        spec = {"seed": 5, "faults": [{"site": "decode", "kind": "error"}]}
+        for form in (
+            spec,
+            json.dumps(spec),
+            tmp_path / "plan.json",
+        ):
+            if isinstance(form, Path):
+                form.write_text(json.dumps(spec))
+                form = str(form)
+            plan = FaultPlan.from_spec(form)
+            assert plan.seed == 5 and len(plan.rules) == 1
+        assert FaultPlan.from_spec(None) is None
+        assert FaultPlan.from_env({}) is None
+        assert FaultPlan.from_env({"NM03_FAULT_PLAN": json.dumps(spec)}).seed == 5
+
+    def test_validation_rejects_garbage(self):
+        with pytest.raises(ValueError, match="site"):
+            FaultPlan.from_spec({"faults": [{"site": "nope", "kind": "error"}]})
+        with pytest.raises(ValueError, match="invalid for site"):
+            FaultPlan.from_spec({"faults": [{"site": "decode", "kind": "hang"}]})
+        with pytest.raises(ValueError, match="unknown keys"):
+            FaultPlan.from_spec({"faults": [{"site": "decode", "kind": "error", "x": 1}]})
+        with pytest.raises(ValueError, match="JSON"):
+            FaultPlan.from_spec("{not json")
+
+    def test_selectors_and_count(self):
+        plan = FaultPlan.from_spec(
+            {"faults": [
+                {"site": "export", "kind": "io_error", "stem": "1-02", "count": 1},
+            ]}
+        )
+        assert plan.fire("export", stem="1-01") is None  # selector mismatch
+        assert plan.fire("export", stem="1-02") is not None
+        assert plan.fire("export", stem="1-02") is None  # count spent
+        assert plan.fired_total() == 1
+        # patient selector composes with stem
+        p2 = FaultPlan.from_spec(
+            {"faults": [
+                {"site": "decode", "kind": "error", "patient": "P1", "stem": "s"},
+            ]}
+        )
+        assert p2.fire("decode", patient="P2", stem="s") is None
+        assert p2.fire("decode", patient="P1", stem="s") is not None
+
+    def test_ordinal_after_is_deterministic_in_order(self):
+        plan = FaultPlan.from_spec(
+            {"faults": [{"site": "export", "kind": "io_error", "after": 3}]}
+        )
+        fired = [plan.fire("export", stem=f"s{i}") is not None for i in range(5)]
+        assert fired == [False, False, True, True, True]
+
+    def test_rate_keyed_draw_is_schedule_independent(self):
+        spec = {"seed": 9, "faults": [{"site": "decode", "kind": "error", "rate": 0.5}]}
+        stems = [f"s{i}" for i in range(40)]
+        p1, p2 = FaultPlan.from_spec(spec), FaultPlan.from_spec(spec)
+        hit1 = {s for s in stems if p1.fire("decode", stem=s)}
+        # same plan, reversed check order: the SAME stems are hit
+        hit2 = {s for s in reversed(stems) if p2.fire("decode", stem=s)}
+        assert hit1 == hit2
+        assert 0 < len(hit1) < len(stems)
+
+    def test_site_probes_and_routing(self):
+        plan = FaultPlan.from_spec(
+            {"faults": [{"site": "decode", "kind": "error", "patient": "P1"}]}
+        )
+        assert plan.has_site("decode") and not plan.has_site("dispatch")
+        assert plan.fire("dispatch", index=0) is None
+        # routes_decode is the side-effect-free selector probe
+        assert plan.routes_decode(patient="P1", stem="anything")
+        assert not plan.routes_decode(patient="P2", stem="anything")
+        assert plan.fired_total() == 0  # probing consumed nothing
+
+
+# -- journal ----------------------------------------------------------------
+
+
+class TestJournal:
+    def test_record_replay(self, tmp_path):
+        j = PatientJournal(tmp_path / "P1")
+        j.record("1-01", "done")
+        j.record("1-02", "failed")
+        j.record("1-02", "done")  # last status wins
+        j.close()
+        assert PatientJournal(tmp_path / "P1").entries() == {
+            "1-01": "done", "1-02": "done"
+        }
+
+    def test_torn_tail_line_skipped(self, tmp_path):
+        j = PatientJournal(tmp_path / "P1")
+        j.record("1-01", "done")
+        j.close()
+        with open(j.path, "a") as f:
+            f.write('{"stem": "1-02", "sta')  # crash mid-append
+        assert PatientJournal(tmp_path / "P1").entries() == {"1-01": "done"}
+
+    def test_missing_journal_is_empty(self, tmp_path):
+        assert PatientJournal(tmp_path / "nope").entries() == {}
+
+
+# -- atomic export ----------------------------------------------------------
+
+
+class TestAtomicExport:
+    def test_write_is_atomic_and_clean(self, tmp_path):
+        from nm03_capstone_project_tpu.render.export import save_jpeg
+
+        img = np.zeros((32, 32), np.uint8)
+        save_jpeg(img, tmp_path / "a.jpg")
+        assert (tmp_path / "a.jpg").exists()
+        assert not list(tmp_path.glob("*.tmp"))
+
+    def test_no_tmp_left_on_encoder_failure(self, tmp_path, monkeypatch):
+        from PIL import Image
+
+        from nm03_capstone_project_tpu.render.export import save_jpeg
+
+        def boom(self, *a, **k):
+            raise IOError("disk full")
+
+        monkeypatch.setattr(Image.Image, "save", boom)
+        with pytest.raises(IOError):
+            save_jpeg(np.zeros((8, 8), np.uint8), tmp_path / "b.jpg")
+        assert list(tmp_path.iterdir()) == []  # neither b.jpg nor a tmp
+
+
+# -- driver chaos -----------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["sequential", "parallel"])
+def test_seeded_faults_contained_and_counted(cohort, tmp_path, mode):
+    """Failed counts equal the plan; no partial files; counters match."""
+    plan = FaultPlan.from_spec({"seed": 3, "faults": [
+        {"site": "decode", "kind": "error", "patient": "PGBM-0001", "stem": "1-02"},
+        {"site": "decode", "kind": "corrupt", "patient": "PGBM-0002", "stem": "1-01"},
+        {"site": "export", "kind": "io_error", "stem": "1-04"},
+    ]})
+    res = ResilienceConfig(retry_max=2, retry_backoff_s=0.0, fault_plan=plan)
+    out = tmp_path / mode
+    proc = CohortProcessor(
+        cohort, out, cfg=CFG, batch_cfg=BCFG, mode=mode, resilience=res
+    )
+    summary = proc.process_all_patients()
+    d = summary.as_dict()
+    assert d["patients_ok"] == 2  # containment holds under chaos
+    by_pid = {p.patient_id: p for p in summary.patients}
+    assert sorted(by_pid["PGBM-0001"].failed_slices) == ["1-02", "1-04"]
+    assert sorted(by_pid["PGBM-0002"].failed_slices) == ["1-01", "1-04"]
+    assert d["slices_ok"] == 4 and d["slices_total"] == 8
+    # exactly the surviving slices have pairs on disk, none torn
+    assert len(list(out.rglob("*.jpg"))) == 2 * 4
+    assert_no_torn_files(out)
+    # the crash journal recorded every completed slice (per-slice grain in
+    # BOTH drivers — the parallel path journals from the export pool)
+    j1 = PatientJournal(out / "PGBM-0001").entries()
+    assert {s for s, st in j1.items() if st == "done"} == {"1-01", "1-03"}
+    # the injected-fault and retry counters match the plan arithmetic:
+    # each persistent export fault burns 1 attempt + retry_max retries
+    reg = proc.obs.registry
+    assert reg.get(
+        "resilience_faults_injected_total", site="decode", kind="error"
+    ).value == 1
+    assert reg.get(
+        "resilience_faults_injected_total", site="decode", kind="corrupt"
+    ).value == 1
+    assert reg.get(
+        "resilience_faults_injected_total", site="export", kind="io_error"
+    ).value == 2 * (1 + res.retry_max)
+    assert reg.get("resilience_retries_total", cause="export").value == (
+        2 * res.retry_max
+    )
+    assert not proc.dispatch.degraded
+    assert reg.get("pipeline_degraded_total", cause="deadline") is None
+
+
+def test_transient_export_fault_healed_by_retry(cohort, tmp_path):
+    """A count-limited export fault models a transient disk error: the
+    retry heals it and the slice still succeeds."""
+    plan = FaultPlan.from_spec({"faults": [
+        {"site": "export", "kind": "io_error", "stem": "1-03", "count": 1},
+    ]})
+    res = ResilienceConfig(retry_max=2, retry_backoff_s=0.0, fault_plan=plan)
+    proc = CohortProcessor(
+        cohort, tmp_path / "heal", cfg=CFG, mode="sequential", resilience=res
+    )
+    summary = proc.process_all_patients()
+    assert summary.succeeded_slices == 8  # nothing lost
+    assert proc.obs.registry.get(
+        "resilience_retries_total", cause="export"
+    ).value == 1
+
+
+def test_transient_device_errors_retried_not_fatal(cohort, tmp_path):
+    plan = FaultPlan.from_spec({"faults": [
+        {"site": "dispatch", "kind": "transient", "count": 2},
+    ]})
+    res = ResilienceConfig(retry_max=2, retry_backoff_s=0.0, fault_plan=plan)
+    proc = CohortProcessor(
+        cohort, tmp_path / "t", cfg=CFG, mode="sequential", resilience=res
+    )
+    summary = proc.process_all_patients()
+    assert summary.succeeded_slices == 8
+    assert proc.obs.registry.get(
+        "resilience_retries_total", cause="dispatch"
+    ).value == 2
+    assert not proc.dispatch.degraded
+
+
+@pytest.mark.parametrize("mode", ["sequential", "parallel"])
+def test_dispatch_hang_degrades_to_cpu_and_finishes(cohort, tmp_path, mode):
+    """ACCEPTANCE: a seeded dispatch hang + --dispatch-timeout-s finishes
+    the whole cohort on the CPU fallback, bounded by the deadline, with the
+    degradation in metrics + events. On pre-resilience main this test
+    cannot pass: the resilience knobs do not exist and an injected
+    300-second hang would stall the driver far past the wall bound."""
+    plan = FaultPlan.from_spec({"seed": 1, "faults": [
+        {"site": "dispatch", "kind": "hang", "index": 0, "hang_s": 300},
+    ]})
+    res = ResilienceConfig(
+        dispatch_timeout_s=1.0, fallback_cpu=True, fault_plan=plan,
+        retry_backoff_s=0.0,
+    )
+    ctx = RunContext.create(mode)
+    out = tmp_path / mode
+    proc = CohortProcessor(
+        cohort, out, cfg=CFG, batch_cfg=BCFG, mode=mode, obs=ctx, resilience=res
+    )
+    t0 = time.monotonic()
+    summary = proc.process_all_patients()
+    wall = time.monotonic() - t0
+    assert wall < 120  # a 300 s hang NOT abandoned would blow this bound
+    assert summary.patients_ok == 2 and summary.succeeded_slices == 8
+    assert proc.dispatch.degraded and proc.dispatch.degraded_cause == "deadline"
+    assert ctx.registry.get("pipeline_degraded_total", cause="deadline").value == 1
+    degraded = [r for r in ctx.events.tail if r["event"] == "degraded"]
+    assert len(degraded) == 1  # once per transition, not per batch
+    assert degraded[0]["level"] == "WARNING"
+    assert ctx.registry.get(
+        "resilience_faults_injected_total", site="dispatch", kind="hang"
+    ).value == 1
+    # the degraded run's outputs are identical to an unfaulted run's
+    ref = CohortProcessor(
+        cohort, tmp_path / f"ref-{mode}", cfg=CFG, batch_cfg=BCFG, mode=mode
+    )
+    ref.process_all_patients()
+    assert digest_tree(out) == digest_tree(tmp_path / f"ref-{mode}")
+    assert_no_torn_files(out)
+
+
+def test_no_fallback_cpu_fails_fast_instead_of_wedging(cohort, tmp_path):
+    plan = FaultPlan.from_spec({"faults": [
+        {"site": "dispatch", "kind": "hang", "index": 0, "hang_s": 300},
+    ]})
+    res = ResilienceConfig(
+        dispatch_timeout_s=0.5, fallback_cpu=False, fault_plan=plan,
+    )
+    proc = CohortProcessor(
+        cohort, tmp_path / "ff", cfg=CFG, mode="sequential", resilience=res
+    )
+    t0 = time.monotonic()
+    summary = proc.process_all_patients()
+    assert time.monotonic() - t0 < 60
+    # the run TERMINATES (every dispatch fails fast after degradation) —
+    # never wedges; patients are visited, slices fail
+    assert len(summary.patients) == 2
+    assert summary.succeeded_slices == 0
+
+
+def test_fault_plan_cli_flag_and_env(cohort, tmp_path, monkeypatch):
+    """--fault-plan and NM03_FAULT_PLAN both reach the processor."""
+    from nm03_capstone_project_tpu.cli import common, sequential
+
+    spec = json.dumps({"faults": [{"site": "decode", "kind": "error", "stem": "1-01"}]})
+    args = sequential.build_parser().parse_args(
+        ["--synthetic", "1", "--fault-plan", spec]
+    )
+    res = common.resilience_config_from_args(args)
+    assert res.fault_plan is not None and res.fault_plan.rules[0].stem == "1-01"
+    assert args.fallback_cpu is True
+    args2 = sequential.build_parser().parse_args(
+        ["--synthetic", "1", "--no-fallback-cpu", "--dispatch-timeout-s", "7",
+         "--retry-max", "5"]
+    )
+    res2 = common.resilience_config_from_args(args2)
+    assert (res2.fallback_cpu, res2.dispatch_timeout_s, res2.retry_max) == (
+        False, 7.0, 5
+    )
+    # env activation (no flag): the processor picks it up
+    monkeypatch.setenv("NM03_FAULT_PLAN", spec)
+    proc = CohortProcessor(cohort, tmp_path / "env", cfg=CFG, mode="sequential")
+    assert proc.fault_plan is not None
+    monkeypatch.delenv("NM03_FAULT_PLAN")
+
+
+# -- crash drill ------------------------------------------------------------
+
+
+def _driver_env():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def test_sigterm_then_resume_converges(tmp_path):
+    """ACCEPTANCE: kill -TERM mid-run (delivered deterministically by the
+    fault plan before the 4th slice's export) + --resume yields the same
+    final manifest/output set as an uninterrupted run, with no torn files
+    at any point, and without re-exporting the journaled slices."""
+    cohort = tmp_path / "cohort"
+    write_synthetic_cohort(cohort, n_patients=1, n_slices=6, height=128, width=128)
+    out = tmp_path / "out"
+    plan = json.dumps(
+        {"faults": [{"site": "export", "kind": "sigterm", "after": 4}]}
+    )
+    base_cmd = [
+        sys.executable, "-m", "nm03_capstone_project_tpu.cli.sequential",
+        "--base-path", str(cohort), "--output", str(out),
+        "--canvas", "128", "--render-size", "128", "--device", "cpu",
+    ]
+    r = subprocess.run(
+        base_cmd + ["--fault-plan", plan],
+        env=_driver_env(), capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode != 0, f"run survived its own SIGTERM: {r.stdout}"
+
+    # crash-safety invariants at the point of death
+    assert_no_torn_files(out)
+    jpgs = sorted(out.rglob("*.jpg"))
+    assert len(jpgs) == 2 * 3  # exactly the 3 journaled slices' pairs
+    journal = PatientJournal(out / "PGBM-0001").entries()
+    assert len(journal) == 3 and set(journal.values()) == {"done"}
+    stamps = {p: p.stat().st_mtime for p in jpgs}
+
+    # resume (drill over: no fault plan) completes the cohort
+    r2 = subprocess.run(
+        base_cmd + ["--resume"],
+        env=_driver_env(), capture_output=True, text=True, timeout=600,
+    )
+    assert r2.returncode == 0, r2.stderr
+    assert_no_torn_files(out)
+    for p, mtime in stamps.items():
+        assert p.stat().st_mtime == mtime, f"{p.name} was re-exported"
+
+    # converges to the uninterrupted run's exact outputs + manifest
+    ref = tmp_path / "ref"
+    proc = CohortProcessor(cohort, ref, cfg=CFG, mode="sequential")
+    proc.process_all_patients()
+    assert digest_tree(out) == digest_tree(ref)
+    manifest = json.loads((out / "manifest.json").read_text())
+    assert manifest == json.loads((ref / "manifest.json").read_text())
+    assert set(manifest["PGBM-0001"].values()) == {"done"}
+    assert len(manifest["PGBM-0001"]) == 6
+
+
+# -- telemetry gate ---------------------------------------------------------
+
+
+def test_chaos_artifacts_validate_with_expectations(cohort, tmp_path):
+    """A chaos run's artifacts pass check_telemetry including the new
+    resilience event rules and --expect-counter assertions (satellite 6)."""
+    from nm03_capstone_project_tpu.cli import sequential
+
+    plan = json.dumps({"seed": 2, "faults": [
+        {"site": "decode", "kind": "error", "stem": "1-02"},
+        {"site": "dispatch", "kind": "hang", "index": 0, "hang_s": 300},
+    ]})
+    m, e = tmp_path / "m.json", tmp_path / "e.jsonl"
+    rc = sequential.main([
+        "--base-path", str(cohort), "--output", str(tmp_path / "out"),
+        "--canvas", "128", "--render-size", "128", "--device", "cpu",
+        "--fault-plan", plan, "--dispatch-timeout-s", "1", "--fallback-cpu",
+        "--retry-backoff-s", "0",
+        "--metrics-out", str(m), "--log-json", str(e),
+    ])
+    assert rc == 0
+
+    events = [json.loads(line) for line in e.read_text().splitlines()]
+    kinds = {r["event"] for r in events}
+    assert {"degraded", "fault_injected"} <= kinds
+    deg = next(r for r in events if r["event"] == "degraded")
+    assert deg["level"] == "WARNING" and deg["cause"] == "deadline"
+
+    check = subprocess.run(
+        [sys.executable, str(CHECKER), "--events", str(e), "--metrics", str(m),
+         "--expect-patients", "2",
+         "--expect-counter", "pipeline_degraded_total=1",
+         "--expect-counter", "resilience_faults_injected_total=3"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert check.returncode == 0, check.stderr
+
+    # the checker REJECTS drifted resilience telemetry
+    bad = dict(events[0])
+    bad.update(event="degraded", level="INFO", cause="")
+    drift = tmp_path / "drift.jsonl"
+    drift.write_text(
+        "\n".join(json.dumps(r) for r in events[:-1] + [bad, events[-1]]) + "\n"
+    )
+    # fix seq ordering for the injected record
+    records = [json.loads(line) for line in drift.read_text().splitlines()]
+    for i, r in enumerate(records):
+        r["seq"], r["mono_s"] = i, float(i)
+    drift.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    check2 = subprocess.run(
+        [sys.executable, str(CHECKER), "--events", str(drift)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert check2.returncode != 0
+    assert "degraded" in check2.stderr
+    # and fails an unmet counter expectation
+    check3 = subprocess.run(
+        [sys.executable, str(CHECKER), "--metrics", str(m),
+         "--expect-counter", "pipeline_degraded_total=99"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert check3.returncode != 0
+
+
+def test_resume_after_chaos_reprocesses_only_failures(cohort, tmp_path):
+    """An injected-fault run + a clean --resume run heals the cohort: the
+    failed slices (and only those) are recomputed."""
+    out = tmp_path / "heal"
+    plan = FaultPlan.from_spec({"faults": [
+        {"site": "export", "kind": "io_error", "stem": "1-02"},
+    ]})
+    res = ResilienceConfig(retry_max=0, fault_plan=plan)
+    proc = CohortProcessor(
+        cohort, out, cfg=CFG, mode="sequential", resilience=res
+    )
+    assert proc.process_all_patients().succeeded_slices == 6
+    stamps = {p: p.stat().st_mtime for p in out.rglob("*.jpg")}
+    proc2 = CohortProcessor(cohort, out, cfg=CFG, mode="sequential", resume=True)
+    summary = proc2.process_all_patients()
+    assert summary.succeeded_slices == 8
+    for p, mtime in stamps.items():
+        assert p.stat().st_mtime == mtime  # done slices untouched
+    assert len(list(out.rglob("*.jpg"))) == 16
