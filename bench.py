@@ -1065,11 +1065,18 @@ def _probe_once(env_overrides, label, t0, timeout_s=PROBE_TIMEOUT_S) -> bool:
     res = _parse_sentinel(stdout) if rc == 0 else None
     if res is not None:
         entry["backend"] = res["backend"]
+        entry["wedge_state"] = "healthy"
     else:
         entry["stderr_tail"] = (stderr or "")[-400:]
         if rc is None:  # timeout = wedge; record who might hold the claim
+            # the explicit wedge-state tag the evidence chain reads: a
+            # probe TIMEOUT is the tunnel-wedge signature (a fast error is
+            # the backend at least answering) — VERDICT r5 evidence gap
+            entry["wedge_state"] = "wedged"
             entry["claim_holders"] = _claim_holder_snapshot()
             entry["tunnel_tcp"] = _tunnel_tcp_probe()
+        else:
+            entry["wedge_state"] = "error"
     _PROBE_HISTORY.append(entry)
     return res is not None
 
@@ -1260,14 +1267,31 @@ def _copy_optional(out: dict, rec: dict) -> None:
 
 
 def _compose(accel, cpu, meta) -> dict:
-    """Fold the accel/cpu worker records into the one emitted JSON object."""
+    """Fold the accel/cpu worker records into the one emitted JSON object.
+
+    Backend honesty (VERDICT r5 evidence-chain gap): the record always
+    carries ``backend_requested`` (what this bench run was trying to
+    measure — the environment's accelerator) next to ``backend_actual``
+    (what the winning worker actually ran on), so a CPU-fallback record
+    can never masquerade as a chip number even if a reader only keeps the
+    headline fields. ``backend`` remains as the legacy alias of
+    ``backend_actual``. ``wedge_observed`` summarizes the probe history's
+    per-entry ``wedge_state`` tags.
+    """
     out = {
         "metric": "slices_per_sec_per_chip",
         "value": 0.0,
         "unit": "slices/s",
         "vs_baseline": 0.0,
+        # the orchestrator always *requests* the accelerator; only the
+        # actually-measured backend may differ
+        "backend_requested": "accelerator",
     }
     out.update(meta)
+    history = meta.get("probe_history") or []
+    out["wedge_observed"] = any(
+        e.get("wedge_state") == "wedged" for e in history
+    )
     if accel is not None:
         tput = accel["xla_tput"]
         # only a result-identical pallas run may win the headline number —
@@ -1278,7 +1302,7 @@ def _compose(accel, cpu, meta) -> dict:
         else:
             out["winning_path"] = "xla"
         out["value"] = round(tput, 2)
-        out["backend"] = accel["backend"]
+        out["backend"] = out["backend_actual"] = accel["backend"]
         if "xla_batch" in accel:
             out["batch"] = accel["xla_batch"]
         if "xla_by_batch" in accel:
@@ -1314,7 +1338,7 @@ def _compose(accel, cpu, meta) -> dict:
             out["error"] = "cpu baseline worker failed; vs_baseline unknown"
     elif cpu is not None:
         out["value"] = round(cpu["xla_tput"], 2)
-        out["backend"] = "cpu"
+        out["backend"] = out["backend_actual"] = "cpu"
         out["vs_baseline"] = 1.0
         if "xla_batch" in cpu:
             out["batch"] = cpu["xla_batch"]
@@ -1323,7 +1347,7 @@ def _compose(accel, cpu, meta) -> dict:
         _copy_optional(out, cpu)
         out["error"] = "accelerator worker failed; cpu fallback measured"
     else:
-        out["backend"] = "none"
+        out["backend"] = out["backend_actual"] = "none"
         out["error"] = "all measurement workers failed; see stderr"
     return out
 
@@ -1449,7 +1473,10 @@ _FINAL_LINE_CAP = 4000
 # callers keep their streams.
 _AS_SCRIPT = False
 # fields the final line always keeps, whatever the shedding pressure
+# (backend_requested/actual are the honesty pair: the slim line must never
+# shed the evidence that a number was NOT measured on the chip)
 _SLIM_REQUIRED = ("metric", "value", "unit", "vs_baseline", "backend",
+                  "backend_requested", "backend_actual", "wedge_observed",
                   "error", "detail")
 
 

@@ -117,9 +117,24 @@ def run(args: argparse.Namespace, mode: str) -> int:
         if args.results_json and rank == 0:
             import jax
 
+            # backend honesty (bench-evidence contract): requested is the
+            # --device flag, actual is what the run finished on — a PR-3
+            # one-way degradation means the tail of the cohort ran on the
+            # CPU fallback, and the record must say so rather than let a
+            # degraded run masquerade as a chip number
+            platform = jax.devices()[0].platform
+            degraded = proc.dispatch.degraded
             record = {
                 "mode": mode,
-                "backend": jax.devices()[0].platform,  # provenance
+                "backend": platform,  # legacy alias of backend_actual
+                "backend_requested": args.device,
+                "backend_actual": "cpu" if degraded else platform,
+                "backend_degraded": bool(degraded),
+                **(
+                    {"backend_degraded_cause": proc.dispatch.degraded_cause}
+                    if degraded
+                    else {}
+                ),
                 "summary": summary.as_dict(),
                 # wall_s is the number to compare across drivers/modes:
                 # in the parallel driver device compute overlaps the

@@ -651,10 +651,16 @@ def _run_inner(args, cfg, rank, world, run_ctx) -> int:
                 f"{cluster['patients_total']} patients across {world} processes."
             )
     if args.results_json and rank == 0:
+        platform = jax.devices()[0].platform
         record = {
             "mode": "volume",
             "grow_truncated_patients": truncated_patients,
-            "backend": jax.devices()[0].platform,  # provenance
+            "backend": platform,  # legacy alias of backend_actual
+            # backend honesty (bench-evidence contract): a --device tpu
+            # request that initialized on cpu is visible as requested !=
+            # actual, not silently recorded as a chip run
+            "backend_requested": args.device,
+            "backend_actual": platform,
             "z_sharded": bool(zshard),
             "z_global": bool(global_zshard),
             "patients": results,

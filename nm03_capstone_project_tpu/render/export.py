@@ -26,48 +26,55 @@ _log = get_logger("export")
 def save_jpeg(image: np.ndarray, path: str | os.PathLike, quality: int = 90) -> None:
     """Write a uint8 grayscale (H, W) array as JPEG, atomically.
 
-    Encoder preference is MEASURED, not assumed: PIL rides libjpeg-turbo's
-    SIMD entropy/DCT and encodes a 512x512 render in ~2.4 ms where the
-    in-tree C++ encoder's scalar float DCT takes ~6.6 ms (docs/PERF.md,
-    1-core host) — so PIL is first choice and the C++ encoder
-    (csrc/nm03native.cpp, the counterpart of the reference's native
-    ImageFileExporter, main_sequential.cpp:61-73) is the fallback for
-    PIL-less deployments.
+    Encoding is :func:`encode_jpeg_bytes` (the single home of the
+    measured PIL-first / C++-fallback encoder preference — docs/PERF.md,
+    and the r5 changelog note in docs/API.md on why the preference order
+    changes JPEG bytes).
 
     Atomic tmp+rename (crash-safe resume contract, docs/RESILIENCE.md):
     a SIGTERM/kill/ENOSPC mid-encode can leave a stray ``.jpg.tmp`` but
     never a torn ``.jpg`` — so ``--resume`` may trust every final-named
     file on disk without re-validating its bytes.
     """
-    arr = np.asarray(image)
-    if arr.dtype != np.uint8:
-        raise ValueError(f"expected uint8 image, got {arr.dtype}")
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     tmp = path.with_name(path.name + ".tmp")
-
     try:
-        from PIL import Image
-    except ImportError:
-        Image = None
-
-    try:
-        if Image is not None:
-            # explicit format: the tmp suffix hides the .jpg extension PIL
-            # would otherwise infer the encoder from
-            Image.fromarray(arr, mode="L").save(tmp, format="JPEG", quality=quality)
-        else:
-            from nm03_capstone_project_tpu import native
-
-            if arr.ndim != 2 or not native.available():
-                raise RuntimeError(
-                    "no JPEG encoder available (PIL missing, native failed)"
-                )
-            tmp.write_bytes(native.encode_jpeg_gray(arr, quality))
+        tmp.write_bytes(encode_jpeg_bytes(image, quality))
         os.replace(tmp, path)
     except BaseException:
         tmp.unlink(missing_ok=True)
         raise
+
+
+def encode_jpeg_bytes(image: np.ndarray, quality: int = 90) -> bytes:
+    """Encode a uint8 grayscale (H, W) array to JPEG bytes, in memory.
+
+    The ONE home of the encoder preference (PIL first for libjpeg-turbo,
+    the C++ encoder as the PIL-less fallback — measured in docs/PERF.md).
+    :func:`save_jpeg` composes this for disk exports; the serving path
+    builds HTTP bodies from it directly — a response is a fully-encoded
+    buffer or nothing, so a torn JPEG can never be served (the online
+    analog of save_jpeg's atomic tmp+rename discipline).
+    """
+    arr = np.asarray(image)
+    if arr.dtype != np.uint8:
+        raise ValueError(f"expected uint8 image, got {arr.dtype}")
+    try:
+        from PIL import Image
+    except ImportError:
+        Image = None
+    if Image is not None:
+        import io
+
+        buf = io.BytesIO()
+        Image.fromarray(arr, mode="L").save(buf, format="JPEG", quality=quality)
+        return buf.getvalue()
+    from nm03_capstone_project_tpu import native
+
+    if arr.ndim != 2 or not native.available():
+        raise RuntimeError("no JPEG encoder available (PIL missing, native failed)")
+    return bytes(native.encode_jpeg_gray(arr, quality))
 
 
 def _write_pair(out: Path, stem: str, orig: np.ndarray, proc: np.ndarray) -> str:

@@ -1,0 +1,52 @@
+"""Online serving subsystem: the always-warm request path.
+
+The batch drivers answer "process this cohort"; this package answers
+"process whatever arrives, now" — the ROADMAP's heavy-traffic north star.
+Four pieces, each alone testable:
+
+* :mod:`~nm03_capstone_project_tpu.serving.queue` — bounded admission
+  with load-shedding backpressure (:class:`AdmissionQueue`);
+* :mod:`~nm03_capstone_project_tpu.serving.batcher` — dynamic request
+  coalescing into padded, bucket-shaped batches
+  (:class:`DynamicBatcher`);
+* :mod:`~nm03_capstone_project_tpu.serving.executor` — one warm compiled
+  executable per batch bucket, dispatched through the PR-3
+  :class:`~nm03_capstone_project_tpu.resilience.DispatchSupervisor`
+  (:class:`WarmExecutor`);
+* :mod:`~nm03_capstone_project_tpu.serving.server` — the stdlib HTTP
+  front end (``nm03-serve``): ``POST /v1/segment``, ``/healthz``,
+  ``/readyz``, ``/metrics``, SIGTERM graceful drain.
+
+:mod:`~nm03_capstone_project_tpu.serving.loadgen` (``nm03-loadgen``)
+closes the loop: a closed/open-loop generator whose p50/p95/p99 +
+throughput report puts serving numbers in the bench evidence chain.
+"""
+
+from nm03_capstone_project_tpu.serving.batcher import DynamicBatcher  # noqa: F401
+from nm03_capstone_project_tpu.serving.executor import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    WarmExecutor,
+)
+from nm03_capstone_project_tpu.serving.metrics import (  # noqa: F401
+    SERVING_BATCHES_TOTAL,
+    SERVING_BATCH_SIZE,
+    SERVING_DEGRADED,
+    SERVING_INFLIGHT,
+    SERVING_QUEUE_WAIT_SECONDS,
+    SERVING_READY,
+    SERVING_REQUESTS_TOTAL,
+    SERVING_REQUEST_SECONDS,
+    SERVING_SHED_TOTAL,
+)
+from nm03_capstone_project_tpu.serving.queue import (  # noqa: F401
+    AdmissionQueue,
+    QueueClosed,
+    QueueFull,
+    ServeRequest,
+)
+from nm03_capstone_project_tpu.serving.server import (  # noqa: F401
+    RequestRejected,
+    ServingApp,
+    make_http_server,
+    serve_in_thread,
+)

@@ -1,0 +1,167 @@
+"""Dynamic batcher: coalesce in-flight requests into warm-bucket batches.
+
+The TPU pipeline is vmapped and compiled per batch shape; a single-slice
+request uses a sliver of the chip. The batcher closes that gap the way
+continuous-batching servers do (PAPERS.md — Orca/vLLM insight, applied to
+a fixed-shape vision pipeline): requests that arrive within one short wait
+window ride the SAME executable call, padded up to the smallest warm
+bucket. Under load, batches fill to the cap and the window never waits;
+at low load, a request waits at most ``max_wait_s`` before running alone —
+the standard latency/throughput knob.
+
+One batcher thread owns all device dispatch. That is a design choice, not
+a limitation: the pipeline saturates a single accelerator per batch, so a
+second in-flight batch would only queue behind the first on the device
+stream — keeping dispatch single-threaded makes supervision (PR 3) and
+accounting trivially race-free while costing nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from nm03_capstone_project_tpu.serving.executor import WarmExecutor
+from nm03_capstone_project_tpu.serving.metrics import (
+    BATCH_SIZE_BUCKETS,
+    LATENCY_BUCKETS,
+    SERVING_BATCHES_TOTAL,
+    SERVING_BATCH_SIZE,
+    SERVING_QUEUE_WAIT_SECONDS,
+)
+from nm03_capstone_project_tpu.serving.queue import AdmissionQueue, ServeRequest
+from nm03_capstone_project_tpu.utils.reporter import get_logger
+
+log = get_logger("serving")
+
+
+class DynamicBatcher:
+    """The single consumer of the admission queue.
+
+    Lifecycle: ``start()`` spawns the daemon thread; ``join()`` (after the
+    queue is closed) blocks until every admitted request has been answered
+    — the graceful-drain contract: close the door, finish the room.
+    """
+
+    def __init__(
+        self,
+        queue: AdmissionQueue,
+        executor: WarmExecutor,
+        max_wait_s: float = 0.01,
+        max_batch: Optional[int] = None,
+        obs=None,
+    ):
+        if max_wait_s < 0:
+            raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
+        self.queue = queue
+        self.executor = executor
+        self.max_wait_s = float(max_wait_s)
+        self.max_batch = int(max_batch or executor.max_batch)
+        if self.max_batch > executor.max_batch:
+            raise ValueError(
+                f"max_batch {self.max_batch} exceeds the largest warm "
+                f"bucket {executor.max_batch}"
+            )
+        self.obs = obs
+        self._thread = threading.Thread(
+            target=self._run, name="nm03-serve-batcher", daemon=True
+        )
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "DynamicBatcher":
+        self._started = True
+        self._thread.start()
+        return self
+
+    def join(self, timeout_s: Optional[float] = None) -> bool:
+        """Wait for the batcher to drain (queue must be closed first)."""
+        if not self._started:
+            return True
+        self._thread.join(timeout=timeout_s)
+        return not self._thread.is_alive()
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def _run(self) -> None:
+        while True:
+            batch = self.queue.get_batch(self.max_batch, self.max_wait_s)
+            if not batch:  # closed and empty: drain complete
+                return
+            try:
+                self.execute(batch)
+            except BaseException as e:  # noqa: BLE001 — the loop must survive
+                # execute() already failed the requests; a raise escaping it
+                # is a batcher bug — log, answer anything still waiting, and
+                # keep serving (one poisoned batch must not kill the loop)
+                log.warning("batcher: batch execution raised: %s", e)
+                for r in batch:
+                    if not r.done.is_set():
+                        r.fail(e)
+
+    # -- the batch path ----------------------------------------------------
+
+    def pad_batch(self, reqs: List[ServeRequest]):
+        """Pad ``reqs`` into the smallest warm bucket's canvas stack.
+
+        Same layout contract as the batch drivers' ``_pad_stack``: slices
+        compacted into the leading rows, dead lanes zero with ``min_dim``
+        dims (their outputs are simply never read back out).
+        """
+        cfg = self.executor.cfg
+        bucket = self.executor.bucket_for(len(reqs))
+        c = cfg.canvas
+        pixels = np.zeros((bucket, c, c), np.float32)
+        dims = np.full((bucket, 2), cfg.min_dim, np.int32)
+        for i, r in enumerate(reqs):
+            h, w = r.dims
+            pixels[i, :h, :w] = r.pixels
+            dims[i] = (h, w)
+        return pixels, dims
+
+    def execute(self, reqs: List[ServeRequest]) -> None:
+        """Run one coalesced batch and answer every request in it."""
+        now = time.monotonic()
+        reg = self.obs.registry if self.obs is not None else None
+        for r in reqs:
+            r.queue_wait_s = max(now - r.t_admitted, 0.0)
+        if reg is not None:
+            wait_h = reg.histogram(
+                SERVING_QUEUE_WAIT_SECONDS,
+                help="admission-to-dispatch wait per request",
+                buckets=LATENCY_BUCKETS,
+            )
+            for r in reqs:
+                wait_h.observe(r.queue_wait_s)
+            reg.histogram(
+                SERVING_BATCH_SIZE,
+                help="coalesced (pre-padding) batch sizes",
+                buckets=BATCH_SIZE_BUCKETS,
+            ).observe(len(reqs))
+            reg.counter(
+                SERVING_BATCHES_TOTAL,
+                help="device batches dispatched by the serving batcher",
+            ).inc()
+        pixels, dims = self.pad_batch(reqs)
+        try:
+            mask_b, conv_b = self.executor.run_batch(pixels, dims)
+        except BaseException as e:  # noqa: BLE001 — per-batch containment
+            # the PR-3 ladder is exhausted (deterministic failure, or
+            # degraded with --no-fallback-cpu): every rider fails with the
+            # same cause; the HTTP layer maps it to a 500
+            log.warning("serve dispatch failed for %d request(s): %s", len(reqs), e)
+            for r in reqs:
+                r.fail(e)
+            return
+        for i, r in enumerate(reqs):
+            h, w = r.dims
+            r.mask = np.asarray(mask_b[i][:h, :w])
+            r.converged = bool(np.asarray(conv_b[i]))
+            r.batch_size = len(reqs)
+            r.done.set()

@@ -1,0 +1,265 @@
+"""Warm per-bucket executables behind the PR-3 dispatch supervision.
+
+The r05 bench showed per-batch dispatch overhead — not device FLOPs — is
+what a cold path pays on every call: tracing, compilation, and executable
+lookup all sit between an arriving request and the chip. An online service
+cannot amortize that over a cohort, so this executor compiles ONE
+executable per batch-size bucket at startup (``warmup``) and serve-time
+dispatch is a dictionary lookup plus an XLA execute — the always-warm
+model that makes dynamic batching worth doing at all.
+
+Supervision is inherited, not reimplemented: every batch dispatch runs
+through the PR-3 :class:`DispatchSupervisor`, so online traffic gets the
+same deadline guard, transient-error retry, and one-way CPU degradation
+as the batch drivers — a wedged accelerator turns into slower responses
+and a not-ready ``/readyz``, never a hung service. The CPU fallback
+recomputes from the host arrays the batcher already holds (fetching from
+a wedged device would BE the wedge).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from nm03_capstone_project_tpu.config import PipelineConfig
+from nm03_capstone_project_tpu.resilience import (
+    DispatchSupervisor,
+    FaultPlan,
+    InjectedTransientError,
+    ResilienceConfig,
+    execute_hang,
+)
+
+DEFAULT_BUCKETS: Tuple[int, ...] = (1, 2, 4, 8, 16)
+
+
+class WarmExecutor:
+    """One compiled ``slice_pipeline`` executable per (batch-bucket, config).
+
+    ``buckets`` is the ascending list of batch sizes an executable exists
+    for; a coalesced batch is padded up to the smallest bucket that fits
+    (:meth:`bucket_for`), so the compile-shape set is fixed at startup and
+    serve-time traffic can never trigger a recompile stall.
+    """
+
+    def __init__(
+        self,
+        cfg: PipelineConfig,
+        buckets: Tuple[int, ...] = DEFAULT_BUCKETS,
+        resilience: Optional[ResilienceConfig] = None,
+        obs=None,
+        fault_plan: Optional[FaultPlan] = None,
+    ):
+        if not buckets or list(buckets) != sorted(set(int(b) for b in buckets)):
+            raise ValueError(
+                f"buckets must be strictly increasing, got {buckets}"
+            )
+        if any(b < 1 for b in buckets):
+            raise ValueError(f"buckets must be >= 1, got {buckets}")
+        self.cfg = cfg
+        self.buckets: Tuple[int, ...] = tuple(int(b) for b in buckets)
+        self.obs = obs
+        self.res = resilience if resilience is not None else ResilienceConfig()
+        self.fault_plan = fault_plan
+        retry = self.res.make_retry_policy(
+            seed=fault_plan.seed if fault_plan is not None else 0
+        )
+        retry.obs = obs
+        self.supervisor = DispatchSupervisor(self.res, retry=retry, obs=obs)
+        self._compiled: Dict[int, object] = {}
+        self._fallback_fn = None
+        self._lock = threading.Lock()
+        self._dispatch_seq = itertools.count()
+        self.warm = False
+
+    # -- state -------------------------------------------------------------
+
+    @property
+    def degraded(self) -> bool:
+        """True once the one-way CPU degradation has tripped (PR 3)."""
+        return self.supervisor.degraded
+
+    @property
+    def degraded_cause(self) -> Optional[str]:
+        return self.supervisor.degraded_cause
+
+    @property
+    def max_batch(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest warm bucket that fits ``n`` requests."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"batch of {n} exceeds the largest bucket {self.buckets[-1]}"
+        )
+
+    # -- compilation -------------------------------------------------------
+
+    def _build(self, bucket: int):
+        """Compile the mask-only vmapped pipeline for one bucket shape.
+
+        AOT (``jit(...).lower(...).compile()``) so the executable exists
+        the moment warmup returns — serve-time calls never trace. Falls
+        back to a plain jitted callable (first call compiles) on backends
+        where AOT lowering is unavailable.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
+
+        cfg = self.cfg
+
+        def one(px, dm):
+            out = process_slice(px, dm, cfg)
+            return out["mask"], out["grow_converged"]
+
+        # no donation: a supervised retry re-runs the primary with the SAME
+        # host arrays, and serving's per-batch HBM footprint is tiny
+        fn = jax.jit(jax.vmap(one))
+        c = cfg.canvas
+        try:
+            return fn.lower(
+                jax.ShapeDtypeStruct((bucket, c, c), jnp.float32),
+                jax.ShapeDtypeStruct((bucket, 2), jnp.int32),
+            ).compile()
+        except Exception:  # noqa: BLE001 — AOT is an optimization, not a contract
+            return fn
+
+    def _get_compiled(self, bucket: int):
+        with self._lock:
+            fn = self._compiled.get(bucket)
+        if fn is not None:
+            return fn
+        fn = self._build(bucket)
+        with self._lock:
+            self._compiled.setdefault(bucket, fn)
+            return self._compiled[bucket]
+
+    def warmup(self) -> Dict[int, float]:
+        """Compile + execute every bucket once; {bucket: seconds}.
+
+        The execute (on zeros) is part of warmup on purpose: first-run
+        allocator/executable setup must be paid here, behind ``/readyz``,
+        not by the first unlucky request.
+        """
+        c = self.cfg.canvas
+        timings: Dict[int, float] = {}
+        for b in self.buckets:
+            t0 = time.perf_counter()
+            fn = self._get_compiled(b)
+            px = np.zeros((b, c, c), np.float32)
+            dm = np.full((b, 2), self.cfg.min_dim, np.int32)
+            mask, conv = fn(px, dm)
+            np.asarray(mask), np.asarray(conv)  # block until executed
+            timings[b] = round(time.perf_counter() - t0, 3)
+        if self.obs is not None:
+            for b, s in timings.items():
+                self.obs.registry.gauge(
+                    "serving_warmup_seconds",
+                    help="startup compile+first-execute time per batch bucket",
+                    bucket=str(b),
+                ).set(s)
+        self.warm = True
+        return timings
+
+    # -- degradation target ------------------------------------------------
+
+    def _fallback_call(self):
+        """CPU recompute of the same batch from host arrays (PR-3 ladder).
+
+        One jitted callable shared across buckets — XLA retraces per bucket
+        shape, which is acceptable on the degraded path (correct-but-slower
+        is the contract; the service flips not-ready either way).
+        """
+        if self._fallback_fn is not None:
+            return self._fallback_fn
+        import dataclasses
+
+        import jax
+
+        from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
+
+        cpu = jax.local_devices(backend="cpu")[0]
+        cfg = (
+            dataclasses.replace(self.cfg, use_pallas=False)
+            if self.cfg.use_pallas
+            else self.cfg
+        )
+
+        def one(px, dm):
+            out = process_slice(px, dm, cfg)
+            return out["mask"], out["grow_converged"]
+
+        inner = jax.jit(jax.vmap(one))
+
+        def call(px, dm):
+            with jax.default_device(cpu):
+                out = inner(
+                    jax.device_put(np.asarray(px), cpu),
+                    jax.device_put(np.asarray(dm), cpu),
+                )
+            return tuple(np.asarray(a) for a in out)
+
+        self._fallback_fn = call
+        return call
+
+    # -- chaos hook --------------------------------------------------------
+
+    def _pre(self, index: int):
+        """Dispatch-site fault hook (resilience.FaultPlan); None when off."""
+        plan = self.fault_plan
+        if plan is None or not plan.has_site("dispatch"):
+            return None
+
+        def pre(cancel):
+            rule = plan.fire("dispatch", obs=self.obs, index=index)
+            if rule is None:
+                return
+            if rule.kind == "hang":
+                execute_hang(rule, cancel)
+            else:  # transient
+                raise InjectedTransientError(
+                    f"injected transient device error (serve dispatch {index})"
+                )
+
+        return pre
+
+    # -- the serve-time entry point ----------------------------------------
+
+    def run_batch(self, pixels: np.ndarray, dims: np.ndarray):
+        """Execute one bucket-padded batch under supervision.
+
+        ``pixels`` is (bucket, canvas, canvas) float32, ``dims`` (bucket, 2)
+        int32 — already padded by the batcher. Returns host-side
+        ``(mask, converged)`` arrays. Raises only when the PR-3 ladder is
+        exhausted (deterministic error, or degraded with fallback disabled);
+        the batcher fails the batch's requests with it.
+        """
+        bucket = int(pixels.shape[0])
+        fn = self._get_compiled(bucket)
+        index = next(self._dispatch_seq)
+
+        def primary():
+            # fetch INSIDE the supervised call: a wedged fetch is the same
+            # wedge as a wedged dispatch (supervisor contract)
+            mask, conv = fn(pixels, dims)
+            return np.asarray(mask), np.asarray(conv)
+
+        def fallback():
+            return self._fallback_call()(pixels, dims)
+
+        return self.supervisor.run(
+            primary,
+            fallback=fallback,
+            pre=self._pre(index),
+            label="serve_dispatch",
+        )

@@ -1,0 +1,312 @@
+"""``nm03-loadgen``: closed/open-loop load generator for ``nm03-serve``.
+
+The bench evidence chain (BENCH_r*.json, docs/PERF.md) measures the batch
+pipeline; this tool measures the SERVING path — queue wait, coalescing,
+shed behavior — with the numbers capacity planning needs: p50/p95/p99
+end-to-end latency, sustained throughput, status mix, and the observed
+batch-size distribution (from the server's ``X-Nm03-Batch-Size`` header,
+the direct evidence that dynamic batching coalesced anything).
+
+Two traffic models:
+
+* **closed loop** (default): ``--concurrency`` workers, each with one
+  request outstanding — throughput is offered-load-limited, the classic
+  saturation probe;
+* **open loop** (``--rate R``): requests fire on a fixed schedule no
+  matter how the server is doing — the model that actually exposes queue
+  growth and shedding (closed loops self-throttle and hide both).
+
+``--self-serve`` brings up an in-process server (ephemeral port) first —
+the zero-setup smoke: ``nm03-loadgen --self-serve --requests 40``. Pure
+stdlib HTTP client; payloads are synthetic phantom slices sent as raw
+float32 arrays (``--dicom`` sends real Part-10 bytes through the full
+parser path instead).
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import io
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+import numpy as np
+
+
+def _percentile(sorted_vals: List[float], p: float) -> float:
+    """Nearest-rank percentile on an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1, int(round(p / 100.0 * len(sorted_vals))) - 1))
+    return sorted_vals[k]
+
+
+class LoadResult:
+    """Thread-safe accumulator for per-request observations."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.latencies_s: List[float] = []
+        self.statuses: collections.Counter = collections.Counter()
+        self.batch_sizes: collections.Counter = collections.Counter()
+        self.errors: List[str] = []
+
+    def record(self, status: str, latency_s: float, batch_size: int = 0,
+               error: str = "") -> None:
+        with self._lock:
+            self.statuses[status] += 1
+            if status == "ok":
+                self.latencies_s.append(latency_s)
+                if batch_size:
+                    self.batch_sizes[batch_size] += 1
+            elif error and len(self.errors) < 20:
+                self.errors.append(error)
+
+    def summary(self, wall_s: float, mode: str) -> dict:
+        lat = sorted(self.latencies_s)
+        n_ok = len(lat)
+        total = sum(self.statuses.values())
+        out = {
+            "schema": "nm03.loadgen.v1",
+            "mode": mode,
+            "requests_total": total,
+            "requests_ok": n_ok,
+            "statuses": dict(sorted(self.statuses.items())),
+            "wall_s": round(wall_s, 3),
+            "throughput_rps": round(n_ok / wall_s, 2) if wall_s > 0 else 0.0,
+            "latency_ms": {
+                "p50": round(_percentile(lat, 50) * 1e3, 2),
+                "p95": round(_percentile(lat, 95) * 1e3, 2),
+                "p99": round(_percentile(lat, 99) * 1e3, 2),
+                "mean": round(sum(lat) / n_ok * 1e3, 2) if n_ok else 0.0,
+                "max": round(lat[-1] * 1e3, 2) if n_ok else 0.0,
+            },
+            # {batch_size: ok-request count}: >1 keys = coalescing happened
+            "batch_sizes": {str(k): v for k, v in sorted(self.batch_sizes.items())},
+            "max_observed_batch": max(self.batch_sizes) if self.batch_sizes else 0,
+        }
+        if self.errors:
+            out["error_sample"] = self.errors[:5]
+        return out
+
+
+def _make_payloads(height: int, width: int, n_distinct: int, dicom: bool):
+    """Pre-build request bodies (payload build must not pollute latency).
+
+    Raw mode sends little-endian float32 with the dims in headers; DICOM
+    mode writes real Part-10 bytes so the server exercises the actual
+    parser. A few distinct phantoms (lesion radius varies with seed) keep
+    the server from serving one memoized answer shape.
+    """
+    from nm03_capstone_project_tpu.data.synthetic import phantom_slice
+
+    payloads = []
+    for i in range(n_distinct):
+        img = phantom_slice(height, width, seed=i)
+        if dicom:
+            from nm03_capstone_project_tpu.data.dicomlite import write_dicom
+
+            import os
+            import tempfile
+
+            fd, path = tempfile.mkstemp(suffix=".dcm")
+            os.close(fd)
+            try:
+                write_dicom(path, np.clip(img, 0, 65535).astype(np.uint16))
+                with open(path, "rb") as f:
+                    body = f.read()
+            finally:
+                os.unlink(path)
+            headers = {"Content-Type": "application/dicom"}
+        else:
+            body = img.astype("<f4").tobytes()
+            headers = {
+                "Content-Type": "application/octet-stream",
+                "X-Nm03-Height": str(height),
+                "X-Nm03-Width": str(width),
+            }
+        payloads.append((body, headers))
+    return payloads
+
+
+def _one_request(url: str, body: bytes, headers: dict, timeout_s: float,
+                 result: LoadResult) -> None:
+    t0 = time.monotonic()
+    req = urllib.request.Request(url, data=body, headers=headers, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            resp.read()
+            bs = int(resp.headers.get("X-Nm03-Batch-Size", 0))
+            result.record("ok", time.monotonic() - t0, batch_size=bs)
+    except urllib.error.HTTPError as e:
+        e.read()
+        status = {503: "shed", 504: "timeout"}.get(e.code, f"http_{e.code}")
+        result.record(status, time.monotonic() - t0, error=f"HTTP {e.code}")
+    except Exception as e:  # noqa: BLE001 — a load test records, never dies
+        result.record("error", time.monotonic() - t0, error=str(e))
+
+
+def run_load(
+    url: str,
+    payloads,
+    n_requests: int,
+    concurrency: int,
+    rate_rps: float,
+    timeout_s: float,
+    result: Optional[LoadResult] = None,
+) -> dict:
+    """Drive the load; returns the summary dict."""
+    result = result if result is not None else LoadResult()
+    t_start = time.monotonic()
+    if rate_rps and rate_rps > 0:
+        # open loop: fixed schedule, one thread per in-flight request —
+        # send times never wait on responses, so queue growth is visible
+        threads = []
+        interval = 1.0 / rate_rps
+        for i in range(n_requests):
+            target = t_start + i * interval
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            body, headers = payloads[i % len(payloads)]
+            t = threading.Thread(
+                target=_one_request, args=(url, body, headers, timeout_s, result),
+                daemon=True,
+            )
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=timeout_s + 5)
+        mode = f"open_loop@{rate_rps}rps"
+    else:
+        # closed loop: `concurrency` workers pulling a shared counter
+        counter = iter(range(n_requests))
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                with lock:
+                    i = next(counter, None)
+                if i is None:
+                    return
+                body, headers = payloads[i % len(payloads)]
+                _one_request(url, body, headers, timeout_s, result)
+
+        workers = [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(max(1, concurrency))
+        ]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join(timeout=n_requests * (timeout_s + 5))
+        mode = f"closed_loop@c{concurrency}"
+    return result.summary(time.monotonic() - t_start, mode)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nm03-loadgen", description=__doc__.strip().splitlines()[0]
+    )
+    p.add_argument(
+        "--url", default="http://127.0.0.1:8077", help="server base URL"
+    )
+    p.add_argument("--requests", type=int, default=100, help="total requests")
+    p.add_argument(
+        "--concurrency", type=int, default=8,
+        help="closed-loop workers (ignored with --rate)",
+    )
+    p.add_argument(
+        "--rate", type=float, default=0.0, metavar="RPS",
+        help="open-loop arrival rate; 0 = closed loop",
+    )
+    p.add_argument(
+        "--mode", choices=["mask", "jpeg"], default="mask",
+        help="response payload: mask summary (cheap; throughput probe) or "
+        "the full JPEG pair (the end-user path)",
+    )
+    p.add_argument("--height", type=int, default=128, help="phantom slice height")
+    p.add_argument("--width", type=int, default=128, help="phantom slice width")
+    p.add_argument(
+        "--dicom", action="store_true",
+        help="send real Part-10 DICOM bytes (full parser path) instead of "
+        "raw float32 arrays",
+    )
+    p.add_argument(
+        "--distinct", type=int, default=4, help="distinct pre-built payloads"
+    )
+    p.add_argument("--timeout-s", type=float, default=30.0, help="per-request timeout")
+    p.add_argument(
+        "--warmup", type=int, default=4,
+        help="unmeasured warmup requests before the run",
+    )
+    p.add_argument(
+        "--results-json", default=None,
+        help="write the summary JSON here (the serving evidence artifact)",
+    )
+    p.add_argument(
+        "--self-serve", action="store_true",
+        help="bring up an in-process server on an ephemeral port first "
+        "(zero-setup smoke; tier-1 safe with small --requests on "
+        "JAX_PLATFORMS=cpu)",
+    )
+    p.add_argument(
+        "--self-serve-args", default="",
+        help="extra nm03-serve flags for --self-serve, space-separated "
+        '(e.g. "--canvas 128 --max-wait-ms 25")',
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    httpd = app = None
+    url = args.url
+    if args.self_serve:
+        from nm03_capstone_project_tpu.serving import server as serving_server
+
+        serve_args = serving_server.build_parser().parse_args(
+            ["--device", "cpu", *args.self_serve_args.split()]
+        )
+        from nm03_capstone_project_tpu.cli.common import apply_device_env
+
+        apply_device_env("cpu")
+        app = serving_server.app_from_args(serve_args)
+        httpd, _, port = serving_server.serve_in_thread(app)
+        url = f"http://127.0.0.1:{port}"
+        print(f"loadgen: self-serve listening on {url}", flush=True)
+
+    endpoint = f"{url}/v1/segment?output={args.mode}"
+    payloads = _make_payloads(args.height, args.width, args.distinct, args.dicom)
+    if args.warmup > 0:
+        warm = LoadResult()  # discarded: compile/cache effects stay out
+        run_load(endpoint, payloads, args.warmup, min(args.warmup, 4), 0.0,
+                 args.timeout_s, warm)
+    summary = run_load(
+        endpoint, payloads, args.requests, args.concurrency, args.rate,
+        args.timeout_s,
+    )
+    summary["endpoint"] = endpoint
+    if args.self_serve and app is not None:
+        app.begin_drain(reason="loadgen_done")
+        httpd.shutdown()
+        httpd.server_close()
+        app.close(status="ok")
+        summary["server_status"] = app.status()
+    if args.results_json:
+        from nm03_capstone_project_tpu.utils.timing import write_results_json
+
+        write_results_json(args.results_json, summary)
+    print(json.dumps(summary, indent=2))
+    # exit non-zero when nothing succeeded: a load test that measured no
+    # requests is a failed measurement, whatever the server said
+    return 0 if summary["requests_ok"] > 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

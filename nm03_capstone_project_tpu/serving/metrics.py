@@ -1,0 +1,42 @@
+"""Serving metric names and bucket layouts (docs/OBSERVABILITY.md).
+
+One module owns every serving series name so the producer (server/batcher/
+executor), the validator invocations in tests, and the docs cannot drift
+apart. All series live in the ordinary PR-1 obs registry — ``/metrics`` is
+just ``MetricsRegistry.to_prometheus()`` over the run's registry, so the
+batch-era counters (``resilience_retries_total``, ``pipeline_degraded_total``
+...) appear next to these when serving traffic exercises those paths.
+"""
+
+from __future__ import annotations
+
+# -- counters ---------------------------------------------------------------
+# terminal request outcomes by status: ok | error | shed | invalid | timeout
+SERVING_REQUESTS_TOTAL = "serving_requests_total"
+# admissions refused by backpressure (queue full or draining); also counted
+# in serving_requests_total{status="shed"} — this unlabeled counter is the
+# single number capacity alerts watch
+SERVING_SHED_TOTAL = "serving_shed_total"
+# dispatched device batches (post-coalescing; requests/batches = mean batch)
+SERVING_BATCHES_TOTAL = "serving_batches_total"
+
+# -- gauges -----------------------------------------------------------------
+SERVING_INFLIGHT = "serving_inflight"  # admitted, not yet responded
+SERVING_READY = "serving_ready"  # 1 = warmed + admitting, 0 otherwise
+SERVING_DEGRADED = "serving_degraded"  # 1 = one-way CPU degradation tripped
+
+# -- histograms -------------------------------------------------------------
+SERVING_QUEUE_WAIT_SECONDS = "serving_queue_wait_seconds"
+SERVING_BATCH_SIZE = "serving_batch_size"
+SERVING_REQUEST_SECONDS = "serving_request_seconds"  # end-to-end, admission->response built
+
+# Online latencies live in the millisecond-to-seconds band, not the
+# multi-minute cohort band DEFAULT_LATENCY_BUCKETS covers.
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+# Coalesced batch sizes; bucketed at the warm-executable sizes.
+BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+
+REQUEST_STATUSES = ("ok", "error", "shed", "invalid", "timeout")

@@ -1,0 +1,618 @@
+"""The online segmentation service: HTTP front end + lifecycle.
+
+``nm03-serve`` turns the batch pipeline into a long-running service:
+
+* ``POST /v1/segment`` — one slice in (DICOM bytes or a raw float32
+  array), segmentation out (JPEG pair or mask summary, JSON envelope);
+* ``GET /healthz`` — liveness (the process is up);
+* ``GET /readyz`` — readiness: 200 only when warmed, admitting, and NOT
+  degraded to the CPU fallback — a load balancer drains a degraded
+  replica while its in-flight work still completes;
+* ``GET /metrics`` — Prometheus text exposition straight from the PR-1
+  obs registry; ``GET /metrics.json`` — the ``nm03.metrics.v1`` snapshot
+  (same schema ``check_telemetry.py --metrics`` validates).
+
+Dependency-free by design: stdlib ``ThreadingHTTPServer`` — one daemon
+thread per connection doing decode/render/encode host work, all device
+dispatch funneled through the single batcher thread. This is deliberately
+the same layering as the batch drivers (IO pool around one device stream),
+re-derived for open-loop traffic.
+
+Graceful drain (SIGTERM): admissions stop immediately (503 +
+``Retry-After``), the batcher finishes every admitted batch, metrics and
+events flush through the normal ``RunContext.close`` path, and only then
+does the listener exit — reusing the PR-3 discipline that a response, like
+an exported JPEG, is either complete or not sent at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import signal
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+import numpy as np
+
+from nm03_capstone_project_tpu.config import PipelineConfig
+from nm03_capstone_project_tpu.serving.batcher import DynamicBatcher
+from nm03_capstone_project_tpu.serving.executor import DEFAULT_BUCKETS, WarmExecutor
+from nm03_capstone_project_tpu.serving.metrics import (
+    LATENCY_BUCKETS,
+    SERVING_DEGRADED,
+    SERVING_INFLIGHT,
+    SERVING_READY,
+    SERVING_REQUESTS_TOTAL,
+    SERVING_REQUEST_SECONDS,
+    SERVING_SHED_TOTAL,
+)
+from nm03_capstone_project_tpu.serving.queue import (
+    AdmissionQueue,
+    QueueClosed,
+    QueueFull,
+    ServeRequest,
+)
+from nm03_capstone_project_tpu.utils.reporter import get_logger
+
+log = get_logger("serving")
+
+RETRY_AFTER_S = 1  # the shed hint: capacity problems clear in ~one window
+
+
+class RequestRejected(ValueError):
+    """A request refused before admission; carries the HTTP status."""
+
+    def __init__(self, http_status: int, message: str, status_label: str = "invalid"):
+        super().__init__(message)
+        self.http_status = http_status
+        self.status_label = status_label
+
+
+class ServingApp:
+    """Everything behind the HTTP handler: queue, batcher, executor, state."""
+
+    def __init__(
+        self,
+        cfg: PipelineConfig = None,
+        queue_capacity: int = 64,
+        buckets=DEFAULT_BUCKETS,
+        max_wait_s: float = 0.01,
+        max_batch: Optional[int] = None,
+        request_timeout_s: float = 60.0,
+        jpeg_quality: int = 90,
+        resilience=None,
+        fault_plan=None,
+        obs=None,
+    ):
+        from nm03_capstone_project_tpu.obs import RunContext
+
+        self.cfg = cfg if cfg is not None else PipelineConfig()
+        self.obs = obs if obs is not None else RunContext.create(driver="serve")
+        self.queue = AdmissionQueue(queue_capacity)
+        self.executor = WarmExecutor(
+            self.cfg,
+            buckets=tuple(buckets),
+            resilience=resilience,
+            obs=self.obs,
+            fault_plan=fault_plan,
+        )
+        self.batcher = DynamicBatcher(
+            self.queue,
+            self.executor,
+            max_wait_s=max_wait_s,
+            max_batch=max_batch,
+            obs=self.obs,
+        )
+        self.request_timeout_s = float(request_timeout_s)
+        self.jpeg_quality = int(jpeg_quality)
+        self.draining = False
+        self._drain_lock = threading.Lock()
+        self._drained = threading.Event()
+        self._t0 = time.monotonic()
+        self.registry = self.obs.registry
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> dict:
+        """Warm every bucket, start the batcher; {bucket: warmup seconds}."""
+        timings = self.executor.warmup()
+        self.batcher.start()
+        self.registry.gauge(
+            SERVING_READY, help="1 = warmed and admitting, 0 otherwise"
+        ).set(1)
+        self.obs.events.emit(
+            "serving_ready",
+            buckets=list(self.executor.buckets),
+            warmup_s=timings,
+        )
+        return timings
+
+    @property
+    def ready(self) -> bool:
+        return (
+            self.executor.warm and not self.draining and not self.executor.degraded
+        )
+
+    def status(self) -> dict:
+        return {
+            "ready": self.ready,
+            "warm": self.executor.warm,
+            "draining": self.draining,
+            "degraded": self.executor.degraded,
+            "degraded_cause": self.executor.degraded_cause,
+            "queue_depth": len(self.queue),
+            "queue_capacity": self.queue.capacity,
+            "buckets": list(self.executor.buckets),
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+        }
+
+    def begin_drain(self, reason: str = "sigterm", timeout_s: float = 120.0) -> bool:
+        """Stop admissions, finish in-flight work, flush telemetry.
+
+        Idempotent; safe from a signal-spawned thread. Returns True when
+        the batcher fully drained inside ``timeout_s``.
+        """
+        with self._drain_lock:
+            if self.draining:
+                return self._drained.wait(timeout=timeout_s)
+            self.draining = True
+        self.registry.gauge(
+            SERVING_READY, help="1 = warmed and admitting, 0 otherwise"
+        ).set(0)
+        self.obs.events.emit(
+            "serving_drain", level="WARNING", reason=reason,
+            queue_depth=len(self.queue),
+        )
+        self.queue.close()
+        drained = self.batcher.join(timeout_s=timeout_s)
+        if not drained:
+            # a wedged drain still must answer whoever is parked on wait():
+            # fail the un-popped tail so handler threads return 500, not 504
+            for r in self.queue.drain_pending():
+                r.fail(RuntimeError("server drain timed out"))
+            log.warning("drain: batcher did not finish inside %.0fs", timeout_s)
+        # flush the artifacts through the normal path (atomic snapshot
+        # write); the event stream stays open until close() so the final
+        # run_finished record remains the stream's last
+        try:
+            self.obs.write_metrics()
+        except Exception as e:  # noqa: BLE001 — telemetry never blocks a drain
+            log.warning("drain: metrics flush failed: %s", e)
+        self._drained.set()
+        return drained
+
+    def close(self, status: str = "ok") -> None:
+        self.obs.close(status=status)
+
+    # -- request plumbing (HTTP-free, directly testable) -------------------
+
+    def _count_request(self, status: str) -> None:
+        self.registry.counter(
+            SERVING_REQUESTS_TOTAL,
+            help="terminal serving request outcomes by status",
+            status=status,
+        ).inc()
+
+    def decode_request(self, body: bytes, content_type: str) -> np.ndarray:
+        """Body -> float32 (h, w) raw-intensity slice, or RequestRejected.
+
+        ``application/dicom`` bodies go through the REAL parser
+        (``dicomlite.read_dicom_bytes``); anything else is treated as a raw
+        little-endian float32 array described by X-Nm03-Height/Width (the
+        loadgen's cheap path). Decode runs on the handler thread so a
+        malformed body is a 400 before any batch slot is spent on it.
+        """
+        ct = (content_type or "").split(";")[0].strip().lower()
+        if ct == "application/dicom":
+            from nm03_capstone_project_tpu.data.dicomlite import read_dicom_bytes
+
+            try:
+                return np.asarray(read_dicom_bytes(body).pixels, np.float32)
+            except Exception as e:  # noqa: BLE001 — parser rejection -> 400
+                raise RequestRejected(400, f"DICOM parse failed: {e}") from e
+        raise RequestRejected(
+            415,
+            f"unsupported content type {ct!r} (want application/dicom or "
+            "application/octet-stream with X-Nm03-Height/X-Nm03-Width)",
+        )
+
+    def decode_raw(self, body: bytes, height: int, width: int) -> np.ndarray:
+        expected = height * width * 4
+        if len(body) != expected:
+            raise RequestRejected(
+                400,
+                f"raw body is {len(body)} bytes; {height}x{width} float32 "
+                f"needs {expected}",
+            )
+        return (
+            np.frombuffer(body, dtype="<f4").reshape(height, width).astype(np.float32)
+        )
+
+    def guard_pixels(self, pixels: np.ndarray) -> Tuple[int, int]:
+        h, w = int(pixels.shape[0]), int(pixels.shape[1])
+        if h < self.cfg.min_dim or w < self.cfg.min_dim:
+            raise RequestRejected(
+                400,
+                f"image {w}x{h} below the minimum dimension {self.cfg.min_dim}",
+            )
+        if h > self.cfg.canvas or w > self.cfg.canvas:
+            raise RequestRejected(
+                413,
+                f"image {w}x{h} exceeds the serving canvas {self.cfg.canvas} "
+                "(start the server with a larger --canvas)",
+            )
+        return h, w
+
+    def submit(self, pixels: np.ndarray) -> ServeRequest:
+        """Admit one decoded slice; QueueFull/QueueClosed shed at the door."""
+        h, w = self.guard_pixels(pixels)
+        req = ServeRequest(
+            request_id=uuid.uuid4().hex[:12], pixels=pixels, dims=(h, w)
+        )
+        self.queue.put(req)  # raises QueueFull / QueueClosed
+        self.registry.gauge(
+            SERVING_INFLIGHT, help="admitted requests not yet responded"
+        ).inc()
+        return req
+
+    def segment(self, pixels: np.ndarray, render: bool = True) -> dict:
+        """The full request path minus HTTP: admit, wait, build the payload.
+
+        Raises RequestRejected (guards), QueueFull/QueueClosed (shed), or
+        TimeoutError; any executor error raises as-is. Always settles the
+        inflight gauge and the status counter.
+        """
+        t_start = time.monotonic()
+        try:
+            req = self.submit(pixels)
+        except (QueueFull, QueueClosed):
+            self.registry.counter(
+                SERVING_SHED_TOTAL,
+                help="admissions refused by backpressure (full or draining)",
+            ).inc()
+            self._count_request("shed")
+            raise
+        except RequestRejected:
+            self._count_request("invalid")  # guard failure at admission
+            raise
+        try:
+            if not req.wait(self.request_timeout_s):
+                self._count_request("timeout")
+                raise TimeoutError(
+                    f"request {req.request_id} timed out after "
+                    f"{self.request_timeout_s:.0f}s"
+                )
+            if req.error is not None:
+                self._count_request("error")
+                raise req.error
+        finally:
+            self.registry.gauge(
+                SERVING_INFLIGHT, help="admitted requests not yet responded"
+            ).dec()
+        payload = {
+            "request_id": req.request_id,
+            "shape": [req.dims[0], req.dims[1]],
+            "grow_converged": req.converged,
+            "batch_size": req.batch_size,
+            "queue_wait_s": round(req.queue_wait_s, 6),
+            "degraded": self.executor.degraded,
+            "mask_pixels": int(np.count_nonzero(req.mask)),
+        }
+        if render:
+            from nm03_capstone_project_tpu.render.export import encode_jpeg_bytes
+            from nm03_capstone_project_tpu.render.host_render import host_render_pair
+
+            dims = np.asarray(req.dims, np.int32)
+            gray, seg = host_render_pair(pixels, req.mask, dims, self.cfg)
+            payload["original_jpeg_b64"] = base64.b64encode(
+                encode_jpeg_bytes(gray, self.jpeg_quality)
+            ).decode("ascii")
+            payload["processed_jpeg_b64"] = base64.b64encode(
+                encode_jpeg_bytes(seg, self.jpeg_quality)
+            ).decode("ascii")
+        self.registry.histogram(
+            SERVING_REQUEST_SECONDS,
+            help="end-to-end request latency (admission to payload built)",
+            buckets=LATENCY_BUCKETS,
+        ).observe(time.monotonic() - t_start)
+        self._count_request("ok")
+        self.registry.gauge(
+            SERVING_DEGRADED, help="1 = one-way CPU degradation tripped"
+        ).set(1 if self.executor.degraded else 0)
+        return payload
+
+
+# -- the HTTP layer ---------------------------------------------------------
+
+
+def make_handler(app: ServingApp):
+    class Handler(BaseHTTPRequestHandler):
+        server_version = "nm03-serve/1.0"
+        protocol_version = "HTTP/1.1"
+
+        # route per-request chatter to the package logger at DEBUG, not
+        # stderr — a load test must not serialize on console writes
+        def log_message(self, fmt, *args):  # noqa: A003
+            log.debug("%s %s", self.address_string(), fmt % args)
+
+        def _reply(self, status: int, body: dict, headers=()):
+            data = json.dumps(body).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in headers:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _reply_text(self, status: int, text: str, content_type: str):
+            data = text.encode()
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            path = urlsplit(self.path).path
+            if path == "/healthz":
+                self._reply(
+                    200,
+                    {"status": "alive",
+                     "uptime_s": round(time.monotonic() - app._t0, 3)},
+                )
+            elif path == "/readyz":
+                st = app.status()
+                self._reply(200 if st["ready"] else 503, st)
+            elif path == "/metrics":
+                self._reply_text(
+                    200, app.registry.to_prometheus(), "text/plain; version=0.0.4"
+                )
+            elif path == "/metrics.json":
+                self._reply_text(
+                    200,
+                    json.dumps(app.obs.metrics_snapshot(), indent=1),
+                    "application/json",
+                )
+            else:
+                self._reply(404, {"error": f"unknown path {path}"})
+
+        def do_POST(self):  # noqa: N802
+            split = urlsplit(self.path)
+            if split.path != "/v1/segment":
+                self._reply(404, {"error": f"unknown path {split.path}"})
+                return
+            query = parse_qs(split.query)
+            render = query.get("output", ["jpeg"])[0] != "mask"
+            # decode phase: every rejection here is counted "invalid" ONCE
+            # (segment() owns counting from admission onward)
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                cap = app.cfg.canvas * app.cfg.canvas * 4 + 65536
+                if length <= 0:
+                    raise RequestRejected(400, "empty body")
+                if length > cap:
+                    raise RequestRejected(
+                        413, f"body of {length} bytes exceeds the {cap} cap"
+                    )
+                body = self.rfile.read(length)
+                h_hdr = self.headers.get("X-Nm03-Height")
+                w_hdr = self.headers.get("X-Nm03-Width")
+                if h_hdr is not None and w_hdr is not None:
+                    pixels = app.decode_raw(body, int(h_hdr), int(w_hdr))
+                else:
+                    pixels = app.decode_request(
+                        body, self.headers.get("Content-Type", "")
+                    )
+            except RequestRejected as e:
+                app._count_request("invalid")
+                self._reply(e.http_status, {"error": str(e)})
+                return
+            except (ValueError, OverflowError) as e:  # bad int headers etc.
+                app._count_request("invalid")
+                self._reply(400, {"error": str(e)})
+                return
+            try:
+                payload = app.segment(pixels, render=render)
+            except RequestRejected as e:  # guard failures (counted inside)
+                self._reply(e.http_status, {"error": str(e)})
+            except (QueueFull, QueueClosed) as e:
+                self._reply(
+                    503,
+                    {"error": str(e), "draining": app.draining},
+                    headers=[("Retry-After", str(RETRY_AFTER_S))],
+                )
+            except TimeoutError as e:
+                self._reply(504, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — per-request containment
+                log.warning("request failed: %s", e)
+                self._reply(
+                    500, {"error": str(e), "error_class": type(e).__name__}
+                )
+            else:
+                self._reply(
+                    200,
+                    payload,
+                    headers=[
+                        ("X-Nm03-Batch-Size", str(payload["batch_size"])),
+                        ("X-Nm03-Request-Id", payload["request_id"]),
+                    ],
+                )
+
+    return Handler
+
+
+def make_http_server(
+    app: ServingApp, host: str = "127.0.0.1", port: int = 0
+) -> ThreadingHTTPServer:
+    """Bind (port 0 = ephemeral); ``.server_address`` carries the real port."""
+    httpd = ThreadingHTTPServer((host, port), make_handler(app))
+    httpd.daemon_threads = True
+    return httpd
+
+
+def serve_in_thread(app: ServingApp, host: str = "127.0.0.1", port: int = 0):
+    """Start + warm a server on a daemon thread; (httpd, thread, port).
+
+    The loadgen's self-serve mode and the loopback tests use this; the CLI
+    path (:func:`main`) serves on the main thread instead.
+    """
+    httpd = make_http_server(app, host, port)
+    app.start()
+    t = threading.Thread(
+        target=httpd.serve_forever, name="nm03-serve-http", daemon=True
+    )
+    t.start()
+    return httpd, t, httpd.server_address[1]
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from nm03_capstone_project_tpu.cli import common
+
+    p = argparse.ArgumentParser(
+        prog="nm03-serve", description=__doc__.strip().splitlines()[0]
+    )
+    g = p.add_argument_group("serving", "online service knobs (docs/OPERATIONS.md)")
+    g.add_argument("--host", default="127.0.0.1", help="bind address")
+    g.add_argument(
+        "--port", type=int, default=8077, help="bind port (0 = ephemeral)"
+    )
+    g.add_argument(
+        "--port-file",
+        default=None,
+        metavar="PATH",
+        help="write the bound port here once listening (ephemeral-port "
+        "orchestration; written atomically)",
+    )
+    g.add_argument(
+        "--queue-capacity",
+        type=int,
+        default=64,
+        help="bounded admission queue; past this, requests shed with 503 + "
+        "Retry-After instead of waiting",
+    )
+    g.add_argument(
+        "--max-wait-ms",
+        type=float,
+        default=10.0,
+        help="dynamic-batching window: how long the first request of a "
+        "batch waits for riders (the latency/throughput knob)",
+    )
+    g.add_argument(
+        "--buckets",
+        default=",".join(str(b) for b in DEFAULT_BUCKETS),
+        help="comma list of warm batch-size buckets (each is one compiled "
+        "executable; a coalesced batch pads to the smallest that fits)",
+    )
+    g.add_argument(
+        "--request-timeout-s",
+        type=float,
+        default=60.0,
+        help="per-request wall budget from admission to response",
+    )
+    g.add_argument(
+        "--jpeg-quality", type=int, default=90, help="JPEG encoder quality"
+    )
+    g.add_argument(
+        "--device",
+        choices=["auto", "tpu", "cpu"],
+        default="auto",
+        help="compute backend (cpu uses the host XLA backend)",
+    )
+    g.add_argument("--verbose", action="store_true", help="enable INFO logging")
+    common.add_pipeline_args(p)
+    common.add_resilience_args(p)
+    common.add_observability_args(p)
+    return p
+
+
+def app_from_args(args: argparse.Namespace, obs=None) -> ServingApp:
+    from nm03_capstone_project_tpu.cli import common
+    from nm03_capstone_project_tpu.resilience import FaultPlan
+
+    cfg = common.pipeline_config_from_args(args)
+    res = common.resilience_config_from_args(args)
+    plan = res.fault_plan if res.fault_plan is not None else FaultPlan.from_env()
+    buckets = tuple(int(b) for b in str(args.buckets).split(",") if b.strip())
+    return ServingApp(
+        cfg=cfg,
+        queue_capacity=args.queue_capacity,
+        buckets=buckets,
+        max_wait_s=args.max_wait_ms / 1000.0,
+        request_timeout_s=args.request_timeout_s,
+        jpeg_quality=args.jpeg_quality,
+        resilience=res,
+        fault_plan=plan,
+        obs=obs,
+    )
+
+
+def _write_port_file(path: str, port: int) -> None:
+    import os
+
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        f.write(f"{port}\n")
+    os.replace(tmp, path)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from nm03_capstone_project_tpu.cli import common
+    from nm03_capstone_project_tpu.utils.reporter import configure_reporting
+
+    common.apply_device_env(args.device)
+    configure_reporting(verbose=args.verbose)
+    from nm03_capstone_project_tpu.obs import RunContext
+
+    run_ctx = RunContext.create(
+        "serve",
+        metrics_out=args.metrics_out,
+        log_json=args.log_json,
+        heartbeat_s=args.heartbeat_s or 0.0,
+        argv=argv,
+    )
+    app = app_from_args(args, obs=run_ctx)
+    httpd = make_http_server(app, args.host, args.port)
+    port = httpd.server_address[1]
+    timings = app.start()
+    if args.port_file:
+        _write_port_file(args.port_file, port)
+    print(
+        f"nm03-serve: listening on {args.host}:{port} "
+        f"(buckets {list(app.executor.buckets)}, warmup {timings})",
+        flush=True,
+    )
+
+    def _drain_and_stop(signum, frame):
+        # the handler must return fast; drain on a helper thread, then
+        # stop the accept loop so serve_forever returns on the main thread
+        def work():
+            app.begin_drain(reason=signal.Signals(signum).name.lower())
+            httpd.shutdown()
+
+        threading.Thread(target=work, name="nm03-serve-drain", daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _drain_and_stop)
+    signal.signal(signal.SIGINT, _drain_and_stop)
+    try:
+        httpd.serve_forever()
+    finally:
+        httpd.server_close()
+        app.begin_drain(reason="exit")  # idempotent; no-op after a signal drain
+        app.close(status="ok")
+    print("nm03-serve: drained and stopped", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

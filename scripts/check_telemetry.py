@@ -41,7 +41,11 @@ metrics
     ``pipeline_degraded_total{cause}``;
   * ``--expect-counter NAME=MIN`` (repeatable) requires the summed value
     of NAME's series to be at least MIN — the chaos suite's assertion
-    hook (e.g. ``--expect-counter pipeline_degraded_total=1``).
+    hook (e.g. ``--expect-counter pipeline_degraded_total=1``);
+  * ``--expect-histogram NAME=MINCOUNT`` (repeatable) requires the summed
+    observation count across NAME's histogram series to be at least
+    MINCOUNT — the serving load/chaos smoke's assertion hook (e.g.
+    ``--expect-histogram serving_queue_wait_seconds=10``).
 
 cross
   * when both artifacts are given, their run_id and git_sha must match.
@@ -218,11 +222,15 @@ def _check_histogram(where: str, rec: dict, chk: Checker) -> None:
         chk.fail(where, f"histogram sum must be numeric, got {rec.get('sum')!r}")
 
 
-def check_metrics(path: str, chk: Checker, expect_counters=None):
+def check_metrics(path: str, chk: Checker, expect_counters=None,
+                  expect_histograms=None):
     """Validate one metrics snapshot; returns (run_id, git_sha) or None.
 
     ``expect_counters``: {name: min_total} — the summed value across NAME's
     series must be >= min_total (chaos-suite assertions).
+    ``expect_histograms``: {name: min_count} — the summed observation count
+    across NAME's histogram series must be >= min_count (and NAME must
+    actually be a histogram).
     """
     try:
         with open(path) as f:
@@ -245,6 +253,7 @@ def check_metrics(path: str, chk: Checker, expect_counters=None):
     kind_by_name: dict[str, str] = {}
     seen: set[tuple] = set()
     counter_sums: dict[str, float] = {}
+    histogram_counts: dict[str, int] = {}
     for j, rec in enumerate(metrics):
         where = f"{path}: metrics[{j}]"
         if not isinstance(rec, dict):
@@ -278,6 +287,9 @@ def check_metrics(path: str, chk: Checker, expect_counters=None):
                 chk.fail(where, f"{name}: missing required labels {missing_l}")
         if kind == "histogram":
             _check_histogram(where, rec, chk)
+            c = rec.get("count")
+            if isinstance(c, int) and not isinstance(c, bool):
+                histogram_counts[name] = histogram_counts.get(name, 0) + c
         else:
             v = rec.get("value")
             if not _is_num(v):
@@ -290,6 +302,16 @@ def check_metrics(path: str, chk: Checker, expect_counters=None):
         got = counter_sums.get(name, 0.0)
         if got < want:
             chk.fail(path, f"counter {name} totals {got}, expected >= {want}")
+    for name, want in sorted((expect_histograms or {}).items()):
+        if name not in histogram_counts and kind_by_name.get(name) is not None:
+            chk.fail(path, f"{name} is a {kind_by_name[name]}, not a histogram")
+            continue
+        got = histogram_counts.get(name, 0)
+        if got < want:
+            chk.fail(
+                path,
+                f"histogram {name} observation count {got}, expected >= {want}",
+            )
     return (snap.get("run_id"), snap.get("git_sha"))
 
 
@@ -307,25 +329,42 @@ def main(argv=None) -> int:
         "(repeatable; chaos-suite assertions, e.g. "
         "pipeline_degraded_total=1)",
     )
+    ap.add_argument(
+        "--expect-histogram", action="append", default=[],
+        metavar="NAME=MINCOUNT",
+        help="require the summed observation count of histogram NAME to be "
+        ">= MINCOUNT (repeatable; serving load/chaos assertions, e.g. "
+        "serving_queue_wait_seconds=10)",
+    )
     args = ap.parse_args(argv)
     if not args.events and not args.metrics:
         ap.error("nothing to check: pass --events and/or --metrics")
-    expect_counters = {}
-    for spec in args.expect_counter:
-        name, _, val = spec.partition("=")
-        try:
-            expect_counters[name] = float(val)
-        except ValueError:
-            ap.error(f"--expect-counter wants NAME=MIN, got {spec!r}")
-    if expect_counters and not args.metrics:
-        ap.error("--expect-counter needs --metrics")
+
+    def parse_expectations(specs: list, flag: str) -> dict:
+        out = {}
+        for spec in specs:
+            name, _, val = spec.partition("=")
+            try:
+                out[name] = float(val)
+            except ValueError:
+                ap.error(f"{flag} wants NAME=MIN, got {spec!r}")
+        if out and not args.metrics:
+            ap.error(f"{flag} needs --metrics")
+        return out
+
+    expect_counters = parse_expectations(args.expect_counter, "--expect-counter")
+    expect_histograms = parse_expectations(
+        args.expect_histogram, "--expect-histogram"
+    )
 
     chk = Checker()
     ev_ident = mt_ident = None
     if args.events:
         ev_ident = check_events(args.events, chk, args.expect_patients)
     if args.metrics:
-        mt_ident = check_metrics(args.metrics, chk, expect_counters)
+        mt_ident = check_metrics(
+            args.metrics, chk, expect_counters, expect_histograms
+        )
     if ev_ident and mt_ident:
         if mt_ident[0] != ev_ident[0]:
             chk.fail("cross", f"metrics run_id {mt_ident[0]!r} != "

@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""Thin shim: ``python scripts/loadgen.py`` == ``nm03-loadgen``.
+
+The implementation lives in :mod:`nm03_capstone_project_tpu.serving.loadgen`
+(so the ``nm03-loadgen`` console script can import it); this file exists so
+the scripts/ directory stays the one-stop home of runnable tooling
+(check_telemetry.py, check_bench_regression.py, ...).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from nm03_capstone_project_tpu.serving.loadgen import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,702 @@
+"""Online serving subsystem tests (ISSUE 4).
+
+Covers the contract end to end: admission-queue backpressure semantics,
+dynamic-batcher coalescing/padding (against a fake executor — no jax),
+loopback HTTP round trips on an ephemeral port (synthetic slice in, JPEG
+pair bytes out), shed-under-overload with ``Retry-After``, the degraded
+``/readyz`` contract, a fault-plan chaos run through the serving path
+(transient retry + hang -> one-way CPU degradation), SIGTERM graceful
+drain in a real subprocess, and the loadgen smoke whose metrics snapshot
+``check_telemetry.py`` gates with the new ``--expect-histogram`` hook.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from nm03_capstone_project_tpu.config import PipelineConfig
+from nm03_capstone_project_tpu.data.synthetic import phantom_slice
+from nm03_capstone_project_tpu.serving.batcher import DynamicBatcher
+from nm03_capstone_project_tpu.serving.queue import (
+    AdmissionQueue,
+    QueueClosed,
+    QueueFull,
+    ServeRequest,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECKER = os.path.join(REPO, "scripts", "check_telemetry.py")
+
+CANVAS = 128
+
+
+def _post(url: str, body: bytes, headers: dict, timeout=30.0):
+    """POST; returns (status, parsed json, headers) without raising on 4xx/5xx."""
+    req = urllib.request.Request(url, data=body, headers=headers, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def _get(url: str, timeout=10.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _raw_headers(h: int, w: int) -> dict:
+    return {
+        "Content-Type": "application/octet-stream",
+        "X-Nm03-Height": str(h),
+        "X-Nm03-Width": str(w),
+    }
+
+
+def _phantom_body(h: int = CANVAS, w: int = CANVAS, seed: int = 0) -> bytes:
+    return phantom_slice(h, w, seed=seed).astype("<f4").tobytes()
+
+
+def run_checker(*argv):
+    return subprocess.run(
+        [sys.executable, CHECKER, *map(str, argv)],
+        capture_output=True, text=True, timeout=60,
+    )
+
+
+# -- admission queue (pure stdlib, no jax) ---------------------------------
+
+
+def _req(i: int = 0) -> ServeRequest:
+    return ServeRequest(
+        request_id=f"r{i}", pixels=np.zeros((8, 8), np.float32), dims=(8, 8)
+    )
+
+
+class TestAdmissionQueue:
+    def test_capacity_bound_sheds(self):
+        q = AdmissionQueue(2)
+        q.put(_req(0))
+        q.put(_req(1))
+        with pytest.raises(QueueFull):
+            q.put(_req(2))
+        assert len(q) == 2
+
+    def test_close_refuses_but_drains_tail(self):
+        q = AdmissionQueue(4)
+        q.put(_req(0))
+        q.close()
+        with pytest.raises(QueueClosed):
+            q.put(_req(1))
+        # the admitted tail still comes out...
+        batch = q.get_batch(max_batch=4, max_wait_s=0.0)
+        assert [r.request_id for r in batch] == ["r0"]
+        # ...and an empty closed queue signals drain-complete
+        assert q.get_batch(max_batch=4, max_wait_s=0.0) == []
+
+    def test_get_batch_coalesces_backlog(self):
+        q = AdmissionQueue(8)
+        for i in range(3):
+            q.put(_req(i))
+        batch = q.get_batch(max_batch=8, max_wait_s=0.0)
+        assert [r.request_id for r in batch] == ["r0", "r1", "r2"]
+
+    def test_get_batch_respects_max_batch(self):
+        q = AdmissionQueue(8)
+        for i in range(5):
+            q.put(_req(i))
+        assert len(q.get_batch(max_batch=2, max_wait_s=0.0)) == 2
+        assert len(q) == 3
+
+    def test_get_batch_window_waits_for_riders(self):
+        q = AdmissionQueue(8)
+        q.put(_req(0))
+
+        def late_rider():
+            time.sleep(0.05)
+            q.put(_req(1))
+
+        t = threading.Thread(target=late_rider)
+        t.start()
+        batch = q.get_batch(max_batch=8, max_wait_s=0.5)
+        t.join()
+        assert len(batch) == 2
+
+    def test_zero_capacity_rejected(self):
+        with pytest.raises(ValueError):
+            AdmissionQueue(0)
+
+
+# -- dynamic batcher against a fake executor (no jax) ----------------------
+
+
+class FakeExecutor:
+    """Executor stand-in recording the padded batches it was handed."""
+
+    def __init__(self, buckets=(1, 2, 4), canvas=16, min_dim=4, fail=None):
+        self.cfg = SimpleNamespace(canvas=canvas, min_dim=min_dim)
+        self.buckets = tuple(buckets)
+        self.fail = fail
+        self.calls = []
+
+    @property
+    def max_batch(self):
+        return self.buckets[-1]
+
+    def bucket_for(self, n):
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(n)
+
+    def run_batch(self, pixels, dims):
+        self.calls.append((pixels.copy(), dims.copy()))
+        if self.fail is not None:
+            raise self.fail
+        # mask = 1 wherever the input was > 0 (so crops are checkable)
+        mask = (pixels > 0).astype(np.uint8)
+        return mask, np.ones(pixels.shape[0], bool)
+
+
+class TestDynamicBatcher:
+    def _reqs(self, sizes):
+        out = []
+        for i, (h, w) in enumerate(sizes):
+            out.append(
+                ServeRequest(
+                    request_id=f"r{i}",
+                    pixels=np.ones((h, w), np.float32),
+                    dims=(h, w),
+                )
+            )
+        return out
+
+    def test_pads_to_smallest_bucket(self):
+        ex = FakeExecutor()
+        b = DynamicBatcher(AdmissionQueue(8), ex, max_wait_s=0.0)
+        reqs = self._reqs([(8, 8), (6, 10), (16, 16)])
+        b.execute(reqs)
+        (pixels, dims), = ex.calls
+        assert pixels.shape == (4, 16, 16)  # 3 requests -> bucket 4
+        assert dims.tolist()[:3] == [[8, 8], [6, 10], [16, 16]]
+        # dead lane: zero pixels, min_dim dims
+        assert pixels[3].sum() == 0 and dims[3].tolist() == [4, 4]
+
+    def test_results_cropped_and_distributed(self):
+        ex = FakeExecutor()
+        b = DynamicBatcher(AdmissionQueue(8), ex, max_wait_s=0.0)
+        reqs = self._reqs([(8, 8), (6, 10)])
+        b.execute(reqs)
+        for r in reqs:
+            assert r.done.is_set() and r.error is None
+            assert r.mask.shape == r.dims
+            assert r.mask.all()  # input was all-ones -> mask all-ones
+            assert r.batch_size == 2
+
+    def test_executor_failure_fails_every_rider(self):
+        ex = FakeExecutor(fail=RuntimeError("boom"))
+        b = DynamicBatcher(AdmissionQueue(8), ex, max_wait_s=0.0)
+        reqs = self._reqs([(8, 8), (8, 8)])
+        b.execute(reqs)
+        for r in reqs:
+            assert r.done.is_set()
+            assert isinstance(r.error, RuntimeError)
+
+    def test_thread_coalesces_concurrent_submissions(self):
+        ex = FakeExecutor(buckets=(1, 2, 4, 8))
+        q = AdmissionQueue(16)
+        b = DynamicBatcher(q, ex, max_wait_s=0.1).start()
+        reqs = self._reqs([(8, 8)] * 6)
+        for r in reqs:
+            q.put(r)
+        for r in reqs:
+            assert r.wait(5.0)
+        q.close()
+        assert b.join(5.0)
+        assert max(r.batch_size for r in reqs) > 1
+
+    def test_max_batch_above_buckets_rejected(self):
+        ex = FakeExecutor(buckets=(1, 2))
+        with pytest.raises(ValueError, match="largest warm bucket"):
+            DynamicBatcher(AdmissionQueue(4), ex, max_batch=8)
+
+
+class TestExecutorBuckets:
+    def test_bucket_for_and_validation(self):
+        from nm03_capstone_project_tpu.serving.executor import WarmExecutor
+
+        ex = WarmExecutor(PipelineConfig(canvas=CANVAS), buckets=(1, 2, 4))
+        assert [ex.bucket_for(n) for n in (1, 2, 3, 4)] == [1, 2, 4, 4]
+        with pytest.raises(ValueError, match="exceeds the largest"):
+            ex.bucket_for(5)
+        with pytest.raises(ValueError, match="strictly increasing"):
+            WarmExecutor(PipelineConfig(), buckets=(4, 2))
+        with pytest.raises(ValueError, match=">= 1"):
+            WarmExecutor(PipelineConfig(), buckets=(0, 1))
+
+
+# -- loopback end-to-end ----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One warmed loopback server shared by the e2e tests (3 compiles)."""
+    from nm03_capstone_project_tpu.serving.server import ServingApp, serve_in_thread
+
+    app = ServingApp(
+        cfg=PipelineConfig(canvas=CANVAS),
+        queue_capacity=32,
+        buckets=(1, 2, 4),
+        max_wait_s=0.02,
+        request_timeout_s=30.0,
+    )
+    httpd, _, port = serve_in_thread(app)
+    yield app, f"http://127.0.0.1:{port}"
+    app.begin_drain(reason="test_teardown")
+    httpd.shutdown()
+    httpd.server_close()
+    app.close()
+
+
+class TestLoopbackE2E:
+    def test_health_and_ready(self, served):
+        app, base = served
+        status, body = _get(base + "/healthz")
+        assert status == 200 and json.loads(body)["status"] == "alive"
+        status, body = _get(base + "/readyz")
+        st = json.loads(body)
+        assert status == 200 and st["ready"] and st["warm"]
+
+    def test_synthetic_slice_to_jpeg_pair(self, served):
+        app, base = served
+        status, payload, headers = _post(
+            base + "/v1/segment", _phantom_body(), _raw_headers(CANVAS, CANVAS)
+        )
+        assert status == 200
+        orig = base64.b64decode(payload["original_jpeg_b64"])
+        proc = base64.b64decode(payload["processed_jpeg_b64"])
+        # JPEG SOI marker on both legs of the pair; EOI closes each stream
+        # (a torn/partial encode could never reach the wire)
+        for blob in (orig, proc):
+            assert blob[:2] == b"\xff\xd8" and blob[-2:] == b"\xff\xd9"
+        assert payload["mask_pixels"] > 0
+        assert payload["grow_converged"] is True
+        assert headers["X-Nm03-Batch-Size"] == str(payload["batch_size"])
+
+    def test_dicom_body_matches_raw(self, served, tmp_path):
+        """The full-parser ingress route produces the same mask as raw."""
+        app, base = served
+        img = phantom_slice(CANVAS, CANVAS, seed=3)
+        status, raw_payload, _ = _post(
+            base + "/v1/segment?output=mask",
+            img.astype("<f4").tobytes(),
+            _raw_headers(CANVAS, CANVAS),
+        )
+        assert status == 200
+        from nm03_capstone_project_tpu.data.dicomlite import write_dicom
+
+        path = tmp_path / "slice.dcm"
+        write_dicom(path, np.clip(img, 0, 65535).astype(np.uint16))
+        status, dcm_payload, _ = _post(
+            base + "/v1/segment?output=mask",
+            path.read_bytes(),
+            {"Content-Type": "application/dicom"},
+        )
+        assert status == 200
+        assert dcm_payload["mask_pixels"] == raw_payload["mask_pixels"]
+
+    def test_rejections(self, served):
+        app, base = served
+        # below min_dim
+        status, body, _ = _post(
+            base + "/v1/segment", b"\0" * (40 * 40 * 4), _raw_headers(40, 40)
+        )
+        assert status == 400 and "minimum dimension" in body["error"]
+        # above canvas: the declared dims alone must reject (413), before
+        # the body-size cap even matters
+        status, body, _ = _post(
+            base + "/v1/segment",
+            b"\0" * (200 * 200 * 4),
+            _raw_headers(200, 200),
+        )
+        assert status == 413
+        # wrong byte count for the declared dims
+        status, body, _ = _post(
+            base + "/v1/segment", b"\0" * 100, _raw_headers(CANVAS, CANVAS)
+        )
+        assert status == 400
+        # no recognizable content type and no dim headers
+        status, body, _ = _post(
+            base + "/v1/segment", b"\0" * 100, {"Content-Type": "text/plain"}
+        )
+        assert status == 415
+        # malformed DICOM through the real parser
+        status, body, _ = _post(
+            base + "/v1/segment", b"not a dicom file",
+            {"Content-Type": "application/dicom"},
+        )
+        assert status == 400 and "DICOM parse failed" in body["error"]
+
+    def test_concurrent_requests_coalesce(self, served):
+        app, base = served
+        results = []
+        lock = threading.Lock()
+
+        def one(i):
+            status, payload, _ = _post(
+                base + "/v1/segment?output=mask",
+                _phantom_body(seed=i % 3),
+                _raw_headers(CANVAS, CANVAS),
+            )
+            with lock:
+                results.append((status, payload.get("batch_size", 0)))
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert len(results) == 12
+        assert all(s == 200 for s, _ in results)
+        # the acceptance bar: coalescing actually happened
+        assert max(bs for _, bs in results) > 1
+
+    def test_metrics_endpoints(self, served, tmp_path):
+        app, base = served
+        status, prom = _get(base + "/metrics")
+        assert status == 200
+        text = prom.decode()
+        for name in (
+            "serving_requests_total",
+            "serving_batch_size_bucket",
+            "serving_queue_wait_seconds_bucket",
+            "serving_request_seconds_bucket",
+        ):
+            assert name in text, f"{name} missing from /metrics"
+        status, snap = _get(base + "/metrics.json")
+        assert status == 200
+        path = tmp_path / "serve_metrics.json"
+        path.write_bytes(snap)
+        res = run_checker(
+            "--metrics", path,
+            "--expect-counter", "serving_requests_total=10",
+            "--expect-counter", "serving_batches_total=1",
+            "--expect-histogram", "serving_queue_wait_seconds=10",
+            "--expect-histogram", "serving_batch_size=1",
+            "--expect-histogram", "serving_request_seconds=10",
+        )
+        assert res.returncode == 0, res.stderr
+
+
+# -- shed / drain on an unstarted app (no batcher -> deterministic) ---------
+
+
+@pytest.fixture()
+def stalled_server():
+    """A bound server whose batcher never starts: every admitted request
+    parks until its (short) timeout, so overload is deterministic."""
+    from nm03_capstone_project_tpu.serving.server import ServingApp, make_http_server
+
+    app = ServingApp(
+        cfg=PipelineConfig(canvas=CANVAS),
+        queue_capacity=1,
+        buckets=(1,),
+        max_wait_s=0.0,
+        request_timeout_s=0.6,
+    )
+    httpd = make_http_server(app)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield app, f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+    app.close()
+
+
+class TestBackpressure:
+    def test_readyz_not_warm(self, stalled_server):
+        app, base = stalled_server
+        status, body = _get(base + "/readyz")
+        st = json.loads(body)
+        assert status == 503 and not st["warm"] and not st["ready"]
+
+    def test_shed_past_queue_bound(self, stalled_server):
+        app, base = stalled_server
+        first_status = {}
+
+        def occupier():
+            s, body, _ = _post(
+                base + "/v1/segment?output=mask",
+                _phantom_body(),
+                _raw_headers(CANVAS, CANVAS),
+                timeout=10.0,
+            )
+            first_status["code"] = s
+
+        t = threading.Thread(target=occupier)
+        t.start()
+        deadline = time.monotonic() + 2.0
+        while len(app.queue) == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)  # wait until the occupier holds the only slot
+        status, body, headers = _post(
+            base + "/v1/segment?output=mask",
+            _phantom_body(seed=1),
+            _raw_headers(CANVAS, CANVAS),
+        )
+        t.join(timeout=10)
+        assert status == 503
+        assert headers.get("Retry-After") == "1"
+        assert first_status["code"] == 504  # the occupier timed out cleanly
+        reg = app.registry
+        assert reg.get("serving_shed_total").value >= 1
+        assert reg.get("serving_requests_total", status="shed").value >= 1
+        assert reg.get("serving_requests_total", status="timeout").value >= 1
+
+    def test_drain_refuses_with_retry_after(self, stalled_server):
+        app, base = stalled_server
+        assert app.begin_drain(reason="test") is True
+        status, body, headers = _post(
+            base + "/v1/segment?output=mask",
+            _phantom_body(),
+            _raw_headers(CANVAS, CANVAS),
+        )
+        assert status == 503 and body["draining"] is True
+        assert headers.get("Retry-After") == "1"
+        events = [r["event"] for r in app.obs.events.tail]
+        assert "serving_drain" in events
+        drain_rec = next(
+            r for r in app.obs.events.tail if r["event"] == "serving_drain"
+        )
+        assert drain_rec["level"] == "WARNING"
+        # idempotent
+        assert app.begin_drain(reason="again") is True
+
+
+class TestDegradedReadyz:
+    def test_degraded_flips_ready_off(self):
+        from nm03_capstone_project_tpu.serving.server import ServingApp
+
+        app = ServingApp(cfg=PipelineConfig(canvas=CANVAS), buckets=(1,))
+        app.executor.warm = True  # pretend warmup ran; no jax needed
+        assert app.ready
+        app.executor.supervisor.degraded = True
+        app.executor.supervisor.degraded_cause = "deadline"
+        assert not app.ready
+        st = app.status()
+        assert st["degraded"] and st["degraded_cause"] == "deadline"
+        app.close()
+
+
+# -- chaos through the serving path ----------------------------------------
+
+
+class TestServingChaos:
+    def test_transient_retry_then_hang_degrades_to_cpu(self):
+        """The PR-3 ladder under online traffic: request 1 eats a transient
+        fault and retries to success; request 2 eats an injected hang, the
+        dispatch deadline abandons it, the service degrades one-way to the
+        CPU fallback and KEEPS ANSWERING; /readyz reflects the degradation.
+        """
+        from nm03_capstone_project_tpu.resilience import FaultPlan, ResilienceConfig
+        from nm03_capstone_project_tpu.serving.server import ServingApp
+
+        plan = FaultPlan.from_spec(json.dumps({
+            "seed": 11,
+            "faults": [
+                {"site": "dispatch", "kind": "transient", "count": 1},
+                {"site": "dispatch", "kind": "hang", "hang_s": 30.0,
+                 "after": 2, "count": 1},
+            ],
+        }))
+        app = ServingApp(
+            cfg=PipelineConfig(canvas=CANVAS),
+            buckets=(1,),
+            max_wait_s=0.0,
+            resilience=ResilienceConfig(
+                retry_max=2, retry_backoff_s=0.01, dispatch_timeout_s=1.0
+            ),
+            fault_plan=plan,
+        )
+        app.start()
+        try:
+            img = phantom_slice(CANVAS, CANVAS, seed=0)
+            # request 1: transient -> retried inside the deadline -> ok
+            p1 = app.segment(img, render=False)
+            assert p1["mask_pixels"] > 0 and not p1["degraded"]
+            # request 2: hang -> deadline expiry -> one-way CPU degradation
+            p2 = app.segment(img, render=False)
+            assert p2["mask_pixels"] == p1["mask_pixels"]  # same math on CPU
+            assert p2["degraded"] is True
+            assert not app.ready  # /readyz contract
+            # request 3: straight to the (already-warm) fallback
+            p3 = app.segment(img, render=False)
+            assert p3["mask_pixels"] == p1["mask_pixels"]
+            reg = app.registry
+            assert reg.get("resilience_retries_total", cause="serve_dispatch").value >= 1
+            assert reg.get("pipeline_degraded_total", cause="deadline").value == 1
+            assert (
+                reg.get("resilience_faults_injected_total",
+                        site="dispatch", kind="transient").value == 1
+            )
+            assert (
+                reg.get("resilience_faults_injected_total",
+                        site="dispatch", kind="hang").value == 1
+            )
+        finally:
+            app.begin_drain(reason="test")
+            app.close()
+
+
+# -- SIGTERM graceful drain (real process) ----------------------------------
+
+
+class TestSigtermDrain:
+    def test_sigterm_drains_and_flushes(self, tmp_path):
+        port_file = tmp_path / "port"
+        metrics = tmp_path / "metrics.json"
+        events = tmp_path / "events.jsonl"
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "nm03_capstone_project_tpu.serving.server",
+                "--device", "cpu", "--port", "0",
+                "--port-file", str(port_file),
+                "--canvas", str(CANVAS), "--buckets", "1",
+                "--max-wait-ms", "5", "--heartbeat-s", "0",
+                "--metrics-out", str(metrics), "--log-json", str(events),
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO,
+        )
+        try:
+            deadline = time.monotonic() + 180
+            while not port_file.exists() and time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    pytest.fail(f"server died: {proc.stdout.read()}")
+                time.sleep(0.1)
+            assert port_file.exists(), "server never became ready"
+            port = int(port_file.read_text().strip())
+            base = f"http://127.0.0.1:{port}"
+            status, payload, _ = _post(
+                base + "/v1/segment?output=mask",
+                _phantom_body(),
+                _raw_headers(CANVAS, CANVAS),
+                timeout=60.0,
+            )
+            assert status == 200 and payload["mask_pixels"] > 0
+            proc.send_signal(signal.SIGTERM)
+            out, _ = proc.communicate(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate(timeout=30)
+        assert proc.returncode == 0, out
+        assert "drained and stopped" in out
+        # the flushed artifacts pass the schema gate, with the serving
+        # series asserted through the new --expect-* hooks
+        res = run_checker(
+            "--events", events, "--metrics", metrics,
+            "--expect-counter", "serving_requests_total=1",
+            "--expect-histogram", "serving_request_seconds=1",
+            "--expect-histogram", "serving_queue_wait_seconds=1",
+        )
+        assert res.returncode == 0, res.stderr
+
+
+# -- loadgen ---------------------------------------------------------------
+
+
+class TestLoadgen:
+    def test_percentiles(self):
+        from nm03_capstone_project_tpu.serving.loadgen import _percentile
+
+        vals = sorted(float(i) for i in range(1, 101))
+        assert _percentile(vals, 50) == 50.0
+        assert _percentile(vals, 99) == 99.0
+        assert _percentile([], 50) == 0.0
+
+    def test_loadgen_against_live_server(self, served, tmp_path):
+        """The acceptance loop: loadgen drives the loopback server, the
+        summary shows coalescing, and the results JSON lands on disk."""
+        from nm03_capstone_project_tpu.serving.loadgen import (
+            _make_payloads,
+            run_load,
+        )
+
+        app, base = served
+        payloads = _make_payloads(CANVAS, CANVAS, n_distinct=2, dicom=False)
+        summary = run_load(
+            base + "/v1/segment?output=mask",
+            payloads,
+            n_requests=16,
+            concurrency=8,
+            rate_rps=0.0,
+            timeout_s=30.0,
+        )
+        assert summary["requests_ok"] == 16
+        assert summary["max_observed_batch"] > 1
+        assert summary["latency_ms"]["p99"] >= summary["latency_ms"]["p50"] > 0
+        assert summary["throughput_rps"] > 0
+
+    def test_self_serve_smoke_cli(self, tmp_path):
+        """The tier-1-safe smoke the docs advertise: nm03-loadgen
+        --self-serve on CPU, small N, one warm bucket."""
+        results = tmp_path / "loadgen.json"
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        res = subprocess.run(
+            [
+                sys.executable, "-m",
+                "nm03_capstone_project_tpu.serving.loadgen",
+                "--self-serve",
+                "--self-serve-args",
+                f"--canvas {CANVAS} --buckets 2 --max-wait-ms 20",
+                "--requests", "8", "--concurrency", "4", "--warmup", "1",
+                "--height", str(CANVAS), "--width", str(CANVAS),
+                "--results-json", str(results),
+            ],
+            capture_output=True, text=True, timeout=300, env=env, cwd=REPO,
+        )
+        assert res.returncode == 0, res.stdout + res.stderr
+        summary = json.loads(results.read_text())
+        assert summary["requests_ok"] == 8
+        assert summary["server_status"]["draining"] is True
+
+
+# -- in-memory JPEG encoding ------------------------------------------------
+
+
+class TestEncodeJpegBytes:
+    def test_magic_and_roundtrip(self):
+        from nm03_capstone_project_tpu.render.export import encode_jpeg_bytes
+
+        img = (np.arange(64 * 64, dtype=np.uint32) % 256).astype(np.uint8)
+        img = img.reshape(64, 64)
+        blob = encode_jpeg_bytes(img)
+        assert blob[:2] == b"\xff\xd8" and blob[-2:] == b"\xff\xd9"
+        PIL = pytest.importorskip("PIL.Image")
+        import io
+
+        back = np.asarray(PIL.open(io.BytesIO(blob)))
+        assert back.shape == (64, 64)
+
+    def test_rejects_non_uint8(self):
+        from nm03_capstone_project_tpu.render.export import encode_jpeg_bytes
+
+        with pytest.raises(ValueError, match="uint8"):
+            encode_jpeg_bytes(np.zeros((8, 8), np.float32))
