@@ -319,9 +319,16 @@ def _bench_on(device, pixels, dims, reps, use_pallas=False):
     if checksum <= 0:
         _log("WARNING: pipeline segmented nothing — benchmark suspect")
 
+    from nm03_capstone_project_tpu.utils import sanitize
+
     t0 = time.perf_counter()
-    results = [fn(px, dm) for _ in range(reps)]  # enqueue, FIFO stream
-    int(results[-1])  # one sync: FIFO order implies all earlier reps finished
+    # --sanitize: the (upload-only) guard proves the steady-state loop
+    # performs zero implicit host->device transfers — inputs were
+    # committed above, so anything the guard catches is a hidden re-stage.
+    # The d2h scalar sync is deliberately sanctioned. No-op otherwise.
+    with sanitize.guard_dispatch():
+        results = [fn(px, dm) for _ in range(reps)]  # enqueue, FIFO stream
+        int(results[-1])  # one sync: FIFO implies all earlier reps finished
     elapsed = time.perf_counter() - t0
     return pixels.shape[0] * reps / elapsed, checksum
 
@@ -532,6 +539,7 @@ def _time_stage(fn, args, reps):
     def with_checksum(*a):
         out = fn(*a)
         leaves = jax.tree_util.tree_leaves(out)
+        # nm03-lint: disable=NM311 leaves are traced values already inside this trace; asarray is a dtype-view cast here, not per-trace construction
         return sum(jnp.asarray(leaf).astype(jnp.float32).sum() for leaf in leaves)
 
     jitted = jax.jit(with_checksum)
@@ -741,6 +749,7 @@ def worker(
     batches: tuple | None = None,
     want_volume: bool = False,
     want_scan: bool = False,
+    sanitize_on: bool = False,
 ):
     """Measure on this process's backend.
 
@@ -754,6 +763,14 @@ def worker(
     if batches is None:
         batches = (BATCH,)  # resolved at call time: tests monkeypatch BATCH
     _pin_platform(platform)
+    sanitize_state = None
+    if sanitize_on:
+        # the runtime twins (docs/STATIC_ANALYSIS.md): debug_nans +
+        # recompile watchdog here; the transfer guard arms the
+        # guard_dispatch() window inside _bench_on automatically
+        from nm03_capstone_project_tpu.utils import sanitize as _sanitize
+
+        sanitize_state = _sanitize.enable()
     import jax
 
     def emit(update: dict):
@@ -899,6 +916,10 @@ def worker(
             emit({"volume_error": f"{e!r:.500}"})
             _log(f"volume timing failed: {e!r:.500}")
 
+    if sanitize_state is not None:
+        # the jax-free orchestrator folds this into pipeline_recompiles_total
+        emit({"sanitize_recompiles": sanitize_state.recompiles})
+        _log(f"sanitize: {sanitize_state.recompiles} compilations observed")
     print(_SENTINEL + json.dumps(result), flush=True)
 
 
@@ -1240,9 +1261,13 @@ def _run_measurement(label, worker_args, env_overrides, timeout_s):
     fd, out_path = tempfile.mkstemp(prefix="bench_sections_", suffix=".jsonl")
     os.close(fd)
     _CURRENT_SECTIONS.append((label, out_path))
+    sanitize_args = ["--sanitize"] if _SANITIZE else []
     try:
         rc, stdout = _spawn(
-            label, ["--worker", *worker_args, "--out", out_path], env_overrides, timeout_s
+            label,
+            ["--worker", *worker_args, *sanitize_args, "--out", out_path],
+            env_overrides,
+            timeout_s,
         )[:2]
         full = _parse_sentinel(stdout) if rc == 0 else None
         if full is not None:
@@ -1472,6 +1497,10 @@ _FINAL_LINE_CAP = 4000
 # teardown noise included) can land after the final line. In-process test
 # callers keep their streams.
 _AS_SCRIPT = False
+# --sanitize: thread the runtime-twin flag to every measurement worker and
+# fold their reported compile counts into pipeline_recompiles_total
+# (docs/STATIC_ANALYSIS.md; the orchestrator itself never imports jax)
+_SANITIZE = False
 # fields the final line always keeps, whatever the shedding pressure
 # (backend_requested/actual are the honesty pair: the slim line must never
 # shed the evidence that a number was NOT measured on the chip)
@@ -1545,6 +1574,19 @@ def _emit_final(state) -> None:
         # slim stdout line sheds it under size pressure like any optional
         # section. close() also writes --metrics-out / run_finished.
         _record_path_metrics(state.get("accel") or state.get("cpu"))
+        if _SANITIZE:
+            # one coherent counter across the sanitized workers: created at
+            # 0 even when every worker was lost, so a --sanitize snapshot
+            # always carries the series
+            with contextlib.suppress(Exception):
+                from nm03_capstone_project_tpu.utils import sanitize as _san
+
+                total = sum(
+                    int(r.get("sanitize_recompiles", 0))
+                    for r in (state.get("accel"), state.get("cpu"))
+                    if r
+                )
+                _san.record_external_recompiles(_OBS_CTX.registry, total)
         with contextlib.suppress(Exception):
             state["meta"]["metrics"] = _OBS_CTX.metrics_snapshot()
             _OBS_CTX.close(
@@ -1737,6 +1779,21 @@ if __name__ == "__main__":
     parser.add_argument("--out", default=None)
     parser.add_argument("--batches", default=str(BATCH), help="comma list to sweep")
     parser.add_argument(
+        "--sanitize", action="store_true",
+        help="runtime twins of the nm03-lint static rules "
+        "(docs/STATIC_ANALYSIS.md): jax_debug_nans + transfer guard around "
+        "the dispatch loop + recompile watchdog in every worker; compile "
+        "counts land in pipeline_recompiles_total in the --metrics-out "
+        "snapshot. Debug/CI mode — numbers measured under it are not "
+        "comparable to unsanitized rounds",
+    )
+    parser.add_argument(
+        "--synthetic", action="store_true",
+        help="measure on synthetic phantom slices (always the case: bench "
+        "generates its inputs; the flag exists for driver-parity in CI "
+        "recipes)",
+    )
+    parser.add_argument(
         "--metrics-out", default=None,
         help="write the orchestrator's metrics snapshot here "
         "(schema nm03.metrics.v1, docs/OBSERVABILITY.md)",
@@ -1749,6 +1806,7 @@ if __name__ == "__main__":
     )
     ns = parser.parse_args()
     _AS_SCRIPT = True
+    _SANITIZE = ns.sanitize
     if ns.probe:
         probe(ns.platform)
     elif ns.zshard_scaling:
@@ -1763,6 +1821,7 @@ if __name__ == "__main__":
             tuple(int(b) for b in ns.batches.split(",")),
             want_volume=ns.volume,
             want_scan=ns.scan,
+            sanitize_on=ns.sanitize,
         )
     else:
         main(metrics_out=ns.metrics_out, log_json=ns.log_json)

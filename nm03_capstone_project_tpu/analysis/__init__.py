@@ -1,0 +1,21 @@
+"""Project-specific static analysis (``nm03-lint``) — docs/STATIC_ANALYSIS.md.
+
+jax-free and numpy-free at import by contract (and self-enforced: this
+package registers itself in its own import-contract registry).
+"""
+
+from nm03_capstone_project_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    SourceFile,
+    apply_baseline,
+    collect_files,
+    find_repo_root,
+    load_baseline,
+    run_rules,
+    write_baseline,
+)
+from nm03_capstone_project_tpu.analysis.cli import (  # noqa: F401
+    ALL_RULES,
+    RULE_CATALOG,
+    main,
+)

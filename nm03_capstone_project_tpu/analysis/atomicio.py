@@ -1,0 +1,135 @@
+"""NM35x — artifact writes must use the PR-3 tmp+rename idiom.
+
+A result JSON, manifest, journal snapshot, or exported JPEG is a *promise*:
+``--resume`` folds it into the manifest, ``check_telemetry.py`` validates
+it, a judge diffs it. PR 3 established the discipline — write to
+``<path>.tmp``, then ``os.replace`` — so a SIGTERM/ENOSPC mid-write leaves
+either the old artifact or a stray ``.tmp``, never a torn file that parses
+as truth. This rule catches the writes that bypass it.
+
+Heuristic: any truncating write (``open(..., "w"/"wb")``,
+``Path.write_text``, ``Path.write_bytes``) is a candidate; it is exempt
+when the enclosing function visibly completes the idiom (an ``os.replace``
+call in the same function) or the target expression names a tmp file.
+Append-mode opens are exempt by design — the journal's torn-tail-safe
+append IS the other sanctioned idiom. Long-lived streaming sinks (the
+JSONL event log) are real exceptions and carry inline suppressions with
+the reason, which doubles as their documentation.
+
+Rules:
+  NM351  truncating artifact write without the tmp+rename idiom
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence
+
+from nm03_capstone_project_tpu.analysis.core import Finding, SourceFile
+
+
+def _literal_mode(node: ast.Call) -> Optional[str]:
+    """The mode of an open() call when statically known ('r' default)."""
+    if len(node.args) >= 2:
+        m = node.args[1]
+        if isinstance(m, ast.Constant) and isinstance(m.value, str):
+            return m.value
+        return None  # dynamic mode: cannot judge
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            if isinstance(kw.value, ast.Constant) and isinstance(
+                kw.value.value, str
+            ):
+                return kw.value.value
+            return None
+    return "r"
+
+
+def _names_tmp(node: ast.expr) -> bool:
+    """True when the path expression visibly names a tmp target."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            if "tmp" in sub.value.lower():
+                return True
+        if isinstance(sub, ast.Name) and "tmp" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "tmp" in sub.attr.lower():
+            return True
+    return False
+
+
+def _enclosing_function(
+    tree: ast.AST, lineno: int
+) -> Optional[ast.AST]:
+    best = None
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            end = getattr(node, "end_lineno", None) or node.lineno
+            if node.lineno <= lineno <= end:
+                if best is None or node.lineno > best.lineno:
+                    best = node
+    return best
+
+
+def _has_replace(scope: ast.AST) -> bool:
+    """os.replace/os.rename, or <tmp-ish>.replace()/.rename() — NOT a bare
+    str.replace, which must not count as completing the idiom."""
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            base = node.func.value
+            if attr in ("replace", "rename"):
+                if isinstance(base, ast.Name) and base.id == "os":
+                    return True
+                if _names_tmp(base):
+                    return True
+    return False
+
+
+def check_atomic_io(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in files:
+        if src.tree is None:
+            continue
+        if src.relpath.startswith("tests/"):
+            continue  # test fixtures write scratch files on purpose
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            path_expr: Optional[ast.expr] = None
+            what = None
+            if isinstance(node.func, ast.Name) and node.func.id == "open":
+                mode = _literal_mode(node)
+                if mode is None or not mode.startswith("w"):
+                    continue
+                path_expr = node.args[0] if node.args else None
+                what = f'open(..., "{mode}")'
+            elif isinstance(node.func, ast.Attribute) and node.func.attr in (
+                "write_text",
+                "write_bytes",
+            ):
+                path_expr = node.func.value
+                what = f".{node.func.attr}()"
+            else:
+                continue
+            if path_expr is not None and _names_tmp(path_expr):
+                continue
+            scope = _enclosing_function(src.tree, node.lineno)
+            if scope is not None and _has_replace(scope):
+                continue
+            findings.append(
+                Finding(
+                    rule="NM351",
+                    path=src.relpath,
+                    line=node.lineno,
+                    message=(
+                        f"{what} truncates the target in place — a kill or "
+                        "full disk mid-write leaves a torn artifact; write "
+                        "to <path>.tmp and os.replace() it (docs/"
+                        "RESILIENCE.md), or suppress with why tearing is "
+                        "acceptable here"
+                    ),
+                    source_line=src.line_text(node.lineno),
+                )
+            )
+    return findings

@@ -1,0 +1,217 @@
+"""``nm03-lint`` — the project's own static-analysis gate.
+
+Runs every NM3xx rule family over the package (plus bench.py and scripts/)
+and reports findings *relative to the checked-in baseline*: exit 0 when
+nothing new, exit 1 per new finding class, exit 2 on usage errors. The
+baseline makes adoption monotonic — the gate is green the day it lands and
+every finding after that is a regression, never archaeology.
+
+Usage:
+    nm03-lint                      # default paths, text output
+    nm03-lint --format json        # machine-readable (scripts/check_static)
+    nm03-lint --select NM301,NM331 serving/   # narrow a run
+    nm03-lint --update-baseline    # absorb current findings (review the diff!)
+    nm03-lint --list-rules         # the catalog (docs/STATIC_ANALYSIS.md)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from nm03_capstone_project_tpu.analysis.atomicio import check_atomic_io
+from nm03_capstone_project_tpu.analysis.contracts import check_import_contracts
+from nm03_capstone_project_tpu.analysis.core import (
+    DEFAULT_BASELINE_NAME,
+    Finding,
+    apply_baseline,
+    collect_files,
+    find_repo_root,
+    load_baseline,
+    run_rules,
+    write_baseline,
+)
+from nm03_capstone_project_tpu.analysis.dtypes import check_dtype_discipline
+from nm03_capstone_project_tpu.analysis.hostsync import check_host_sync
+from nm03_capstone_project_tpu.analysis.retrace import check_retrace
+from nm03_capstone_project_tpu.analysis.threads import check_thread_shared_state
+
+ALL_RULES = (
+    check_import_contracts,
+    check_retrace,
+    check_host_sync,
+    check_thread_shared_state,
+    check_dtype_discipline,
+    check_atomic_io,
+)
+
+RULE_CATALOG = {
+    "NM301": "import-contract: jax/numpy imported at import time by a contract module",
+    "NM302": "import-contract: registry names a module missing from the tree",
+    "NM311": "retrace: array construction inside a jitted body",
+    "NM312": "retrace: jitted callable invoked with a non-static Python scalar",
+    "NM321": "host-sync: implicit device->host transfer inside an obs span",
+    "NM322": "host-sync: implicit transfer in a serving dispatch-path function",
+    "NM331": "threads: unguarded attribute write in a cross-thread class",
+    "NM341": "dtype: float64 introduction in the f32 ops pipeline",
+    "NM342": "dtype: uint8-cast comparison against an out-of-range literal",
+    "NM351": "atomic-io: truncating artifact write without tmp+rename",
+    "NM390": "meta: suppression without a reason",
+    "NM399": "meta: file does not parse",
+}
+
+
+def default_paths(root: Path) -> List[Path]:
+    paths = [root / "nm03_capstone_project_tpu"]
+    for extra in ("bench.py", "scripts"):
+        p = root / extra
+        if p.exists():
+            paths.append(p)
+    return paths
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nm03-lint", description=__doc__.strip().splitlines()[0]
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to scan (default: the package, bench.py, "
+        "scripts/)",
+    )
+    p.add_argument(
+        "--root",
+        default=None,
+        help="repo root for relative paths and the default baseline "
+        "(default: nearest ancestor of the first path with a "
+        "pyproject.toml)",
+    )
+    p.add_argument(
+        "--baseline",
+        default=None,
+        metavar="JSON",
+        help=f"baseline file (default: <root>/{DEFAULT_BASELINE_NAME}; "
+        "missing file = empty baseline)",
+    )
+    p.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="write the current findings as the new baseline and exit 0 "
+        "(the diff is the review artifact)",
+    )
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    p.add_argument(
+        "--select",
+        default=None,
+        metavar="RULES",
+        help="comma list of rule-id prefixes to run (e.g. NM30,NM331)",
+    )
+    p.add_argument(
+        "--format",
+        choices=["text", "json"],
+        default="text",
+        help="output format (json is the scripts/check_static.py interface)",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rid, desc in sorted(RULE_CATALOG.items()):
+            print(f"{rid}  {desc}")
+        return 0
+
+    if args.root:
+        root = Path(args.root).resolve()
+    else:
+        anchor = Path(args.paths[0]) if args.paths else Path.cwd()
+        anchor = anchor if anchor.is_dir() else anchor.parent
+        root = find_repo_root(anchor)
+    paths = [Path(p) for p in args.paths] or default_paths(root)
+    for p in paths:
+        if not p.exists():
+            print(f"nm03-lint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    try:
+        files = collect_files(paths, root)
+    except ValueError as e:
+        print(f"nm03-lint: {e} (is --root an ancestor of every path?)", file=sys.stderr)
+        return 2
+    select = (
+        [s.strip() for s in args.select.split(",") if s.strip()]
+        if args.select
+        else None
+    )
+    findings = run_rules(files, ALL_RULES, select=select)
+
+    baseline_path = (
+        Path(args.baseline) if args.baseline else root / DEFAULT_BASELINE_NAME
+    )
+    if args.update_baseline:
+        if (args.select or args.paths) and not args.baseline:
+            # the default baseline is whole-tree truth: rewriting it from a
+            # --select/path-narrowed run would silently DELETE every entry
+            # the narrowed run didn't reproduce, and the next full gate
+            # run would fail on previously-accepted findings. An explicit
+            # --baseline opts out (fixture trees, scratch files).
+            print(
+                "nm03-lint: refusing --update-baseline on a narrowed run "
+                "(--select/path arguments present); rerun with the default "
+                "scope, or pass an explicit --baseline",
+                file=sys.stderr,
+            )
+            return 2
+        write_baseline(baseline_path, findings)
+        print(
+            f"nm03-lint: baseline updated with {len(findings)} finding(s) "
+            f"at {baseline_path}"
+        )
+        return 0
+
+    if args.no_baseline:
+        new, matched = list(findings), 0
+    else:
+        try:
+            baseline = load_baseline(baseline_path)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"nm03-lint: bad baseline: {e}", file=sys.stderr)
+            return 2
+        new, matched = apply_baseline(findings, baseline)
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "files_scanned": len(files),
+                    "findings": [f.to_json() for f in new],
+                    "baselined": matched,
+                },
+                indent=1,
+            )
+        )
+    else:
+        for f in new:
+            print(f.render())
+        suffix = f" ({matched} baselined)" if matched else ""
+        print(
+            f"nm03-lint: {len(new)} new finding(s) across "
+            f"{len(files)} file(s){suffix}"
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,239 @@
+"""NM30x — import-contract enforcement (the jax-free/numpy-free registry).
+
+Several modules declare, in prose, that importing them must never import a
+backend: the resilience package (bench.py's orchestrator imports it while
+holding the never-imports-jax invariant, docs/OPERATIONS.md), the obs
+event/metric modules (stdlib-only by contract so telemetry is importable
+from any process), ``ops.selection_network`` (the median planner is a
+compile-time artifact consumed by jax-free processes), the serving queue
+(unit-testable without a backend), and bench.py itself. Until this rule,
+those contracts lived only in docstrings — one convenience import away from
+silently charging a multi-second jax init (or a chip claim) to a process
+that must never pay it.
+
+The rule walks *module-level* imports only: a lazy ``import jax`` inside a
+function is the sanctioned pattern (obs.spans, the CLI drivers) and is not
+an import-time cost. ``if TYPE_CHECKING:`` blocks are exempt for the same
+reason. Transitivity is enforced over project-internal edges: a contract
+module importing a sibling that imports jax is the same violation one hop
+later.
+
+Rules:
+  NM301  contract module (transitively) imports a banned package at
+         import time
+  NM302  registry drift: a registered module/package no longer exists in
+         the scanned tree (the contract would silently stop being checked)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Sequence, Set, Tuple
+
+from nm03_capstone_project_tpu.analysis.core import Finding, SourceFile
+
+# module-or-package-prefix -> banned top-level packages. A key matches
+# itself and (for packages) every submodule under it.
+CONTRACT_REGISTRY: Dict[str, Tuple[str, ...]] = {
+    "nm03_capstone_project_tpu.resilience": ("jax", "numpy"),
+    "nm03_capstone_project_tpu.obs": ("jax", "numpy"),
+    "nm03_capstone_project_tpu.ops.selection_network": ("jax", "numpy"),
+    "nm03_capstone_project_tpu.serving.queue": ("jax",),
+    "nm03_capstone_project_tpu.serving.metrics": ("jax",),
+    "nm03_capstone_project_tpu.utils.reporter": ("jax", "numpy"),
+    # the linter itself runs in pre-backend CI processes; the gate gates
+    # itself so a convenience import can never make the gate cost a backend
+    "nm03_capstone_project_tpu.analysis": ("jax", "numpy"),
+    # bench.py's orchestrator must never import jax (tunnel discipline:
+    # holding a chip claim in the parent wedges every child measurement)
+    "bench": ("jax",),
+}
+
+PROJECT_PREFIX = "nm03_capstone_project_tpu"
+
+
+class _ImportEdge:
+    __slots__ = ("target", "line", "source_line")
+
+    def __init__(self, target: str, line: int, source_line: str):
+        self.target = target
+        self.line = line
+        self.source_line = source_line
+
+
+def _module_level_imports(src: SourceFile) -> List[_ImportEdge]:
+    """Imports executed when the module is imported.
+
+    Walks the top level plus import-time bodies (if/try at module scope,
+    class bodies); skips function bodies and TYPE_CHECKING guards.
+    """
+    edges: List[_ImportEdge] = []
+    if src.tree is None:
+        return edges
+
+    def is_type_checking(test: ast.expr) -> bool:
+        return (isinstance(test, ast.Name) and test.id == "TYPE_CHECKING") or (
+            isinstance(test, ast.Attribute) and test.attr == "TYPE_CHECKING"
+        )
+
+    def relative_base(level: int) -> str:
+        """The package a level-N relative import resolves against.
+
+        For pkg/mod.py (module 'pkg.mod') level 1 is 'pkg' — strip one
+        component; for pkg/__init__.py the module name 'pkg' already IS
+        the package, so level 1 strips zero components (stripping one
+        would resolve 'from .events import X' against pkg's PARENT and
+        silently drop the edge from the contract graph).
+        """
+        strip = level - 1 if src.is_package else level
+        name = src.module_name
+        for _ in range(strip):
+            name = name.rsplit(".", 1)[0] if "." in name else ""
+        return name
+
+    def walk(body: Iterable[ast.stmt]) -> None:
+        for node in body:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    edges.append(
+                        _ImportEdge(alias.name, node.lineno, src.line_text(node.lineno))
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                if node.level and node.module is None:
+                    # `from . import x` — resolve against the package
+                    pkg = relative_base(node.level)
+                    for alias in node.names:
+                        edges.append(
+                            _ImportEdge(
+                                f"{pkg}.{alias.name}" if pkg else alias.name,
+                                node.lineno,
+                                src.line_text(node.lineno),
+                            )
+                        )
+                elif node.module:
+                    mod = node.module
+                    if node.level:
+                        base = relative_base(node.level)
+                        mod = f"{base}.{mod}" if base else mod
+                    edges.append(
+                        _ImportEdge(mod, node.lineno, src.line_text(node.lineno))
+                    )
+            elif isinstance(node, ast.If):
+                if not is_type_checking(node.test):
+                    walk(node.body)
+                walk(node.orelse)
+            elif isinstance(node, (ast.Try, ast.With)):
+                for sub in ast.iter_child_nodes(node):
+                    if isinstance(sub, ast.stmt):
+                        walk([sub])
+                if isinstance(node, ast.Try):
+                    for h in node.handlers:
+                        walk(h.body)
+            elif isinstance(node, ast.ClassDef):
+                walk(node.body)
+            # FunctionDef / AsyncFunctionDef bodies are lazy: not walked
+    walk(src.tree.body)
+    return edges
+
+
+def _registered_bans(module: str) -> Tuple[str, ...]:
+    bans: Set[str] = set()
+    for prefix, banned in CONTRACT_REGISTRY.items():
+        if module == prefix or module.startswith(prefix + "."):
+            bans.update(banned)
+    return tuple(sorted(bans))
+
+
+def check_import_contracts(files: Sequence[SourceFile]) -> List[Finding]:
+    by_module: Dict[str, SourceFile] = {f.module_name: f for f in files}
+    imports: Dict[str, List[_ImportEdge]] = {
+        name: _module_level_imports(f) for name, f in by_module.items()
+    }
+
+    def resolve_internal(target: str) -> List[str]:
+        """Project-internal modules a dotted import EXECUTES ([] if external).
+
+        ``from pkg.mod import name`` may name either pkg.mod.name (a module)
+        or an attribute of pkg.mod; importing either executes pkg.mod — and
+        Python also executes every ancestor package ``__init__`` on the way
+        down, so the whole chain joins the contract graph (a banned import
+        hidden in an ancestor ``__init__`` is the same import-time cost).
+        """
+        hits: List[str] = []
+        candidates = [target]
+        if "." in target:
+            candidates.append(target.rsplit(".", 1)[0])
+        for cand in candidates:
+            while cand:
+                if cand in by_module and cand not in hits:
+                    hits.append(cand)
+                cand = cand.rsplit(".", 1)[0] if "." in cand else ""
+        return hits
+
+    findings: List[Finding] = []
+    seen_keys: Set[Tuple[str, str, int]] = set()
+
+    for prefix in CONTRACT_REGISTRY:
+        if prefix not in by_module and not any(
+            m == prefix or m.startswith(prefix + ".") for m in by_module
+        ):
+            # only report drift when the scan plausibly covers the tree the
+            # registry describes (a fixture dir with its own modules should
+            # not fail for missing THIS repo's files)
+            if any(m.startswith(PROJECT_PREFIX) for m in by_module):
+                anchor = next(iter(files), None)
+                findings.append(
+                    Finding(
+                        rule="NM302",
+                        path=anchor.relpath if anchor else "<registry>",
+                        line=1,
+                        message=(
+                            f"import-contract registry names {prefix!r} but no "
+                            "such module is in the scanned tree — update "
+                            "analysis.contracts.CONTRACT_REGISTRY"
+                        ),
+                    )
+                )
+
+    for module, src in by_module.items():
+        bans = _registered_bans(module)
+        if not bans:
+            continue
+        # BFS over project-internal import-time edges from this module
+        stack: List[Tuple[str, List[str]]] = [(module, [])]
+        visited: Set[str] = set()
+        while stack:
+            cur, chain = stack.pop()
+            if cur in visited:
+                continue
+            visited.add(cur)
+            for edge in imports.get(cur, ()):
+                top = edge.target.split(".")[0]
+                if top in bans:
+                    # report at the root module's matching import when the
+                    # violation is direct; otherwise at the offending hop
+                    where = by_module[cur]
+                    via = " -> ".join(chain + [cur]) if chain else None
+                    msg = (
+                        f"{module} is declared {'/'.join(bans)}-free at import "
+                        f"time but imports {edge.target!r}"
+                    )
+                    if via:
+                        msg += f" (via {via})"
+                    key = (module, edge.target, edge.line)
+                    if key not in seen_keys:
+                        seen_keys.add(key)
+                        findings.append(
+                            Finding(
+                                rule="NM301",
+                                path=where.relpath,
+                                line=edge.line,
+                                message=msg,
+                                source_line=edge.source_line,
+                            )
+                        )
+                    continue
+                for internal in resolve_internal(edge.target):
+                    if internal not in visited:
+                        stack.append((internal, chain + [cur]))
+    return findings

@@ -1,0 +1,201 @@
+"""NM31x — retrace hazards in the jit hot paths.
+
+The serving executor exists because recompiles are the one latency cliff an
+always-warm service cannot absorb (a single retrace stalls every rider of
+the batch window). The two statically visible ways this codebase can
+reintroduce one:
+
+* calling a jitted function with a Python scalar positional argument that
+  was not declared static — every distinct value traces a new program
+  (weak-typed scalars specialize the jaxpr), which presents as "it got
+  slower after N requests", never as an error;
+* constructing ``jnp.array``/``jnp.asarray`` (or ``np.*`` equivalents) from
+  Python data *inside* a jitted body — at best a constant re-baked per
+  trace, at worst a host->device transfer on every call.
+
+Both have sanctioned idioms already in tree (``static_argnames`` on the
+growers, host-side construction + ``device_put`` in the drivers), so the
+rule points at the idiom, not just the hazard.
+
+Analysis is module-local by design: a jit wrapper and its callee defined in
+different files resolve through the import graph only at runtime, and a
+project linter that guesses cross-module bindings produces noise, not
+signal. The hot paths this rule exists for (runner, executor, bench worker)
+all jit module-local callables.
+
+Rules:
+  NM311  jnp.array/jnp.asarray/np.asarray/np.array construction inside a
+         jitted function body
+  NM312  jitted callable invoked with a Python numeric literal positional
+         argument and no static_argnames/static_argnums declaration
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from nm03_capstone_project_tpu.analysis.core import Finding, SourceFile
+
+_ARRAY_CTORS = {
+    ("jnp", "array"), ("jnp", "asarray"),
+    ("np", "array"), ("np", "asarray"), ("np", "frombuffer"),
+    ("numpy", "array"), ("numpy", "asarray"),
+}
+_WRAPPERS = {"vmap", "pmap", "grad", "value_and_grad", "checkify", "partial"}
+
+
+def _attr_pair(func: ast.expr) -> Optional[Tuple[str, str]]:
+    """('jax', 'jit') for ``jax.jit``; ('', 'jit') for bare ``jit``."""
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        return (func.value.id, func.attr)
+    if isinstance(func, ast.Name):
+        return ("", func.id)
+    return None
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    pair = _attr_pair(node.func)
+    return pair is not None and pair[1] in ("jit", "pjit") and pair[0] in (
+        "jax", "pjit", ""
+    )
+
+
+def _has_static(node: ast.Call) -> bool:
+    return any(
+        kw.arg in ("static_argnames", "static_argnums") for kw in node.keywords
+    )
+
+
+def _unwrap_to_callable(node: ast.expr) -> Optional[ast.expr]:
+    """Peel jax.vmap/functools.partial/... down to the jitted Name/Lambda."""
+    while isinstance(node, ast.Call):
+        pair = _attr_pair(node.func)
+        if pair is None or pair[1] not in _WRAPPERS:
+            return node  # a call producing the callable we cannot see into
+        if not node.args:
+            return None
+        node = node.args[0]
+    return node
+
+
+class _JitInventory(ast.NodeVisitor):
+    """Module-wide jit facts: jitted defs, jitted names, static-ness."""
+
+    def __init__(self):
+        self.defs: Dict[str, ast.AST] = {}  # every def/lambda by name
+        self.jitted_defs: List[Tuple[ast.AST, bool]] = []  # (def node, has_static)
+        self.jitted_names: Dict[str, bool] = {}  # name -> has_static
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.defs[node.name] = node
+        for dec in node.decorator_list:
+            if isinstance(dec, ast.Call) and _is_jit_call(dec):
+                self.jitted_defs.append((node, _has_static(dec)))
+                self.jitted_names[node.name] = _has_static(dec)
+            else:
+                pair = _attr_pair(dec)
+                if pair and pair[1] in ("jit", "pjit") and pair[0] in ("jax", ""):
+                    self.jitted_defs.append((node, False))
+                    self.jitted_names[node.name] = False
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if isinstance(node.value, ast.Call) and _is_jit_call(node.value):
+            has_static = _has_static(node.value)
+            target_names = [
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            ]
+            for name in target_names:
+                self.jitted_names[name] = has_static
+            inner = (
+                _unwrap_to_callable(node.value.args[0])
+                if node.value.args
+                else None
+            )
+            if isinstance(inner, ast.Lambda):
+                self.jitted_defs.append((inner, has_static))
+            elif isinstance(inner, ast.Name):
+                self._pending = getattr(self, "_pending", [])
+                self._pending.append((inner.id, has_static))
+        self.generic_visit(node)
+
+    def resolve_pending(self) -> None:
+        for name, has_static in getattr(self, "_pending", []):
+            node = self.defs.get(name)
+            if node is not None:
+                self.jitted_defs.append((node, has_static))
+
+
+def _is_number_literal(node: ast.expr) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)):
+        return not isinstance(node.value, bool)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, (ast.USub, ast.UAdd)):
+        return _is_number_literal(node.operand)
+    return False
+
+
+def check_retrace(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in files:
+        if src.tree is None:
+            continue
+        inv = _JitInventory()
+        inv.visit(src.tree)
+        inv.resolve_pending()
+
+        # NM311: array construction inside jitted bodies
+        seen_nodes: Set[int] = set()
+        for def_node, _static in inv.jitted_defs:
+            body = def_node.body if isinstance(def_node, ast.Lambda) else def_node
+            for sub in ast.walk(body):
+                if not isinstance(sub, ast.Call) or id(sub) in seen_nodes:
+                    continue
+                pair = _attr_pair(sub.func)
+                if pair in _ARRAY_CTORS:
+                    seen_nodes.add(id(sub))
+                    findings.append(
+                        Finding(
+                            rule="NM311",
+                            path=src.relpath,
+                            line=sub.lineno,
+                            message=(
+                                f"{pair[0]}.{pair[1]}() inside a jitted body: "
+                                "constructed per trace (and a host transfer "
+                                "when data is concrete) — build the array "
+                                "outside the jit and pass it in, or use "
+                                "jnp.full/zeros with traced shapes"
+                            ),
+                            source_line=src.line_text(sub.lineno),
+                        )
+                    )
+
+        # NM312: jitted name called with a Python numeric literal
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Name):
+                continue
+            has_static = inv.jitted_names.get(node.func.id)
+            if has_static is None or has_static:
+                continue
+            for arg in node.args:
+                if _is_number_literal(arg):
+                    findings.append(
+                        Finding(
+                            rule="NM312",
+                            path=src.relpath,
+                            line=node.lineno,
+                            message=(
+                                f"jitted {node.func.id}() called with a Python "
+                                "scalar literal and no static_argnames — every "
+                                "distinct value retraces; declare the argument "
+                                "static or pass a jnp array"
+                            ),
+                            source_line=src.line_text(node.lineno),
+                        )
+                    )
+                    break
+    return findings

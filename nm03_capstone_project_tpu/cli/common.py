@@ -104,6 +104,15 @@ def add_observability_args(parser: argparse.ArgumentParser) -> None:
         help="heartbeat event period for --log-json streams (uptime + live "
         "counter totals; 0 disables)",
     )
+    g.add_argument(
+        "--sanitize",
+        action="store_true",
+        help="enable the runtime twins of the nm03-lint static rules "
+        "(docs/STATIC_ANALYSIS.md): jax_debug_nans, a transfer guard "
+        "around staged-batch dispatch, and a recompile watchdog feeding "
+        "pipeline_recompiles_total. Debugging/CI mode: correctness "
+        "checks cost throughput",
+    )
 
 
 def add_resilience_args(parser: argparse.ArgumentParser) -> None:
@@ -203,6 +212,14 @@ def make_run_context(
         heartbeat_s=getattr(args, "heartbeat_s", 0.0) or 0.0,
         argv=argv,
     )
+    if getattr(args, "sanitize", False):
+        # the runtime twins of nm03-lint (docs/STATIC_ANALYSIS.md); must
+        # run after apply_device_env (jax config follows the pinned
+        # backend) — drivers call make_run_context inside run(), so that
+        # ordering holds by construction
+        from nm03_capstone_project_tpu.utils import sanitize
+
+        sanitize.enable(ctx.registry)
     if hasattr(args, "median_impl"):
         # snapshot which median/render paths this run will ACTUALLY use,
         # plus the comparator counts behind the median network (jax-free

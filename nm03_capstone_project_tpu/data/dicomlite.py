@@ -951,5 +951,9 @@ def write_dicom(
         ]
     )
 
-    with open(path, "wb") as f:
-        f.write(b"\x00" * 128 + b"DICM" + meta_group + ds)
+    # atomic (NM351): synthetic cohorts are cached on disk and reused by
+    # later runs (resolve_base_path skips regeneration for a non-empty
+    # tree) — a torn .dcm from a killed generator would poison every rerun
+    from nm03_capstone_project_tpu.utils.atomicio import atomic_write_bytes
+
+    atomic_write_bytes(path, b"\x00" * 128 + b"DICM" + meta_group + ds)

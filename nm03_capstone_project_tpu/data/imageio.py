@@ -112,8 +112,19 @@ def write_metaimage(
         f"ElementDataFile = {data_name}",
     ]
     mhd.parent.mkdir(parents=True, exist_ok=True)
-    mhd.write_text("\n".join(lines) + "\n")
-    (mhd.parent / data_name).write_bytes(payload)
+    # tmp+rename (NM351) with BOTH tmps staged before either rename, blob
+    # first: each file is individually complete-or-absent, and the only
+    # torn state is old-header/new-blob across two adjacent renames. On a
+    # re-export that changes dims/dtype that state fails the reader's
+    # blob-size-vs-header validation (ValueError, not garbage); the
+    # fixed ``<stem>.raw`` naming is the MetaIO convention external tools
+    # and the tests rely on, so a content-keyed blob name is not an option
+    data_tmp = mhd.parent / (data_name + ".tmp")
+    mhd_tmp = mhd.with_name(mhd.name + ".tmp")
+    data_tmp.write_bytes(payload)
+    mhd_tmp.write_text("\n".join(lines) + "\n")
+    os.replace(data_tmp, mhd.parent / data_name)
+    os.replace(mhd_tmp, mhd)
 
 
 def read_metaimage(path: str | os.PathLike) -> Tuple[np.ndarray, Tuple[float, ...]]:

@@ -16,6 +16,8 @@ import json
 from pathlib import Path
 from typing import Any, Dict, Optional, Tuple
 
+from nm03_capstone_project_tpu.utils.atomicio import atomic_write_text
+
 Params = Dict[str, Any]
 
 
@@ -34,7 +36,9 @@ def save_params(
     # force: a fine-tune run saves back into the checkpoint it restored from
     ocp.PyTreeCheckpointer().save(path, params, force=True)
     if meta is not None:
-        (path / "meta.json").write_text(json.dumps(meta, indent=1) + "\n")
+        # atomic (NM351): load_params treats meta.json as truth about the
+        # weights next to it; a torn sidecar must never deploy
+        atomic_write_text(path / "meta.json", json.dumps(meta, indent=1) + "\n")
 
 
 def load_params(

@@ -14,7 +14,7 @@ from __future__ import annotations
 import concurrent.futures as cf
 import os
 from pathlib import Path
-from typing import Iterable, List, Optional, Sequence, Tuple
+from typing import List, Sequence, Tuple
 
 import numpy as np
 

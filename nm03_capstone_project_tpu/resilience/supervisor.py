@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import threading
 import time
+from contextlib import nullcontext
 from typing import Callable, Optional
 
 from nm03_capstone_project_tpu.resilience.policy import (
@@ -41,6 +42,7 @@ from nm03_capstone_project_tpu.resilience.policy import (
     RetryPolicy,
     is_retryable,
 )
+from nm03_capstone_project_tpu.utils.sanitize import guard_dispatch
 
 
 class DispatchSupervisor:
@@ -71,6 +73,7 @@ class DispatchSupervisor:
         fallback: Optional[Callable[[], object]] = None,
         pre: Optional[Callable[[Optional[threading.Event]], None]] = None,
         label: str = "dispatch",
+        staged_inputs: bool = False,
     ):
         """Run ``primary()`` under supervision; degrade to ``fallback()``.
 
@@ -81,6 +84,14 @@ class DispatchSupervisor:
         arrays: fetching those could hang on the very wedge being escaped).
         ``pre`` is the fault-injection hook; it receives the attempt's
         cancel event so an injected hang dies with the abandoned thread.
+
+        ``staged_inputs`` declares that the primary's inputs were already
+        device_put — under ``--sanitize`` the supervised worker thread then
+        re-arms the (thread-local) upload guard around the primary, so a
+        hidden per-dispatch re-stage raises even in the supervised
+        configuration. Callers whose primaries upload host arrays by
+        design (the sequential per-slice path, the serving executor) leave
+        it False.
         """
         if self.degraded:
             if fallback is not None and self.cfg.fallback_cpu:
@@ -111,7 +122,9 @@ class DispatchSupervisor:
         deadline = Deadline.start(self.cfg.dispatch_timeout_s)
         attempt = 0
         while True:
-            status, value = self._attempt(primary, pre, deadline)
+            status, value = self._attempt(
+                primary, pre, deadline, staged_inputs=staged_inputs
+            )
             if status == "ok":
                 return value
             if status == "timeout":
@@ -146,15 +159,22 @@ class DispatchSupervisor:
 
     # -- internals ---------------------------------------------------------
 
-    def _attempt(self, primary, pre, deadline: Deadline):
+    def _attempt(self, primary, pre, deadline: Deadline, staged_inputs=False):
         box: dict = {}
         cancel = threading.Event()
 
         def work():
             try:
-                if pre is not None:
-                    pre(cancel)
-                box["out"] = primary()
+                # --sanitize: the transfer guard is thread-local, so a
+                # caller-side guard_dispatch() does not reach this worker
+                # thread — re-arm it here (only for staged-input callers)
+                # or the supervised configuration silently skips the
+                # check. No-op (and jax-free) when sanitize is off.
+                guard = guard_dispatch() if staged_inputs else nullcontext()
+                with guard:
+                    if pre is not None:
+                        pre(cancel)
+                    box["out"] = primary()
             except BaseException as e:  # noqa: BLE001 — crosses the thread
                 box["err"] = e
 

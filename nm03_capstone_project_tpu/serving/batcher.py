@@ -69,11 +69,17 @@ class DynamicBatcher:
         self._thread = threading.Thread(
             target=self._run, name="nm03-serve-batcher", daemon=True
         )
+        # written by the batcher thread, read by handler threads via
+        # stats() (the /readyz status payload) — lock-guarded (NM331)
+        self._lock = threading.Lock()
+        self._stats = {"batches": 0, "requests": 0, "max_coalesced": 0}
+        # nm03-lint: disable=NM331 written by the owner thread before _thread.start() and read only from that same thread in join(); the Thread.start() fence orders it for the batcher thread
         self._started = False
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "DynamicBatcher":
+        # nm03-lint: disable=NM331 owner-thread write, sequenced before _thread.start(); see __init__
         self._started = True
         self._thread.start()
         return self
@@ -88,6 +94,16 @@ class DynamicBatcher:
     @property
     def alive(self) -> bool:
         return self._thread.is_alive()
+
+    def stats(self) -> dict:
+        """Cumulative dispatch accounting (batches, riders, max coalesce).
+
+        Served in the ``/readyz`` status payload: the mean riders-per-batch
+        (requests/batches) is the one number that says whether the batching
+        window is actually coalescing under current traffic.
+        """
+        with self._lock:
+            return dict(self._stats)
 
     def _run(self) -> None:
         while True:
@@ -148,6 +164,12 @@ class DynamicBatcher:
                 SERVING_BATCHES_TOTAL,
                 help="device batches dispatched by the serving batcher",
             ).inc()
+        with self._lock:
+            self._stats["batches"] += 1
+            self._stats["requests"] += len(reqs)
+            self._stats["max_coalesced"] = max(
+                self._stats["max_coalesced"], len(reqs)
+            )
         pixels, dims = self.pad_batch(reqs)
         try:
             mask_b, conv_b = self.executor.run_batch(pixels, dims)
@@ -161,7 +183,10 @@ class DynamicBatcher:
             return
         for i, r in enumerate(reqs):
             h, w = r.dims
+            # run_batch already fetched host-side arrays inside the
+            # supervised primary; these asarray calls are zero-copy crops
+            # nm03-lint: disable=NM322 mask_b/conv_b are host ndarrays (fetched under supervision in WarmExecutor.run_batch); no device sync happens here
             r.mask = np.asarray(mask_b[i][:h, :w])
-            r.converged = bool(np.asarray(conv_b[i]))
+            r.converged = bool(np.asarray(conv_b[i]))  # nm03-lint: disable=NM322 host ndarray, see above
             r.batch_size = len(reqs)
             r.done.set()

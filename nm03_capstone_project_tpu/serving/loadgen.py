@@ -27,7 +27,6 @@ from __future__ import annotations
 
 import argparse
 import collections
-import io
 import json
 import threading
 import time

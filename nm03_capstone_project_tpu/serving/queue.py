@@ -55,6 +55,7 @@ class ServeRequest:
     done: threading.Event = field(default_factory=threading.Event)
 
     def fail(self, exc: BaseException) -> None:
+        # nm03-lint: disable=NM331 release ordering via the Event: the write is sequenced before done.set(), and the waiter reads error only after wait() returns
         self.error = exc
         self.done.set()
 

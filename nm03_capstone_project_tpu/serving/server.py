@@ -149,6 +149,7 @@ class ServingApp:
             "queue_depth": len(self.queue),
             "queue_capacity": self.queue.capacity,
             "buckets": list(self.executor.buckets),
+            "batcher": self.batcher.stats(),
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }
 

@@ -1,0 +1,52 @@
+"""Atomic artifact writes — THE tmp+rename idiom, in one place.
+
+Every artifact this codebase promises to readers (results JSON, manifests,
+checkpoint sidecars, cached synthetic inputs, baselines) must be written
+complete-or-not-at-all: a SIGTERM/SIGKILL/ENOSPC mid-write may leave a
+stray ``<path>.tmp``, never a torn file that parses as truth
+(docs/RESILIENCE.md; lint rule NM351 in docs/STATIC_ANALYSIS.md enforces
+the idiom statically). These helpers are that idiom's single point of
+correctness — hand-rolling it per call site is how the six slightly
+different copies this module replaced happened.
+
+stdlib-only by design: callers include jax-free contract modules.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import tempfile
+from pathlib import Path
+
+
+def atomic_write_bytes(path: str | os.PathLike, payload: bytes) -> None:
+    """Write ``payload`` to ``path`` atomically (private tmp + os.replace).
+
+    The tmp file comes from ``mkstemp`` in the target's directory, so two
+    concurrent writers of the same artifact each write a PRIVATE temp and
+    the outcome is last-complete-writer-wins — a fixed ``<path>.tmp``
+    sibling would let one writer rename the other's half-written bytes
+    into place (two racing synthetic-cohort generators, two runs updating
+    the same results JSON).
+    """
+    p = Path(path)
+    fd, tmp = tempfile.mkstemp(dir=p.parent, prefix=p.name + ".", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+        # mkstemp creates 0600; published artifacts should carry the same
+        # umask-derived mode a plain open() would have given them
+        umask = os.umask(0)
+        os.umask(umask)
+        os.chmod(tmp, 0o666 & ~umask)
+        os.replace(tmp, p)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+def atomic_write_text(path: str | os.PathLike, text: str) -> None:
+    """Write ``text`` to ``path`` atomically; see :func:`atomic_write_bytes`."""
+    atomic_write_bytes(path, text.encode("utf-8"))

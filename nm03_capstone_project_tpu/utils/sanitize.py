@@ -1,0 +1,190 @@
+"""``--sanitize``: the runtime twins of the nm03-lint static rules.
+
+Static analysis catches the hazards visible in source; these runtime
+checks catch the same hazard *classes* where only execution can see them
+(docs/STATIC_ANALYSIS.md pairs each rule with its twin):
+
+* ``jax_debug_nans`` — a NaN produced anywhere in a jitted program fails
+  the run at the producing op instead of surfacing as a garbage mask three
+  stages later (the dtype-discipline rules keep f64 out; this catches the
+  f32 overflow/0-division cases no static rule can);
+* a **recompile watchdog** — ``jax_log_compiles`` emits one WARNING per
+  XLA compilation; the watchdog counts them into
+  ``pipeline_recompiles_total`` (docs/OBSERVABILITY.md). A steady-state
+  run compiles a small fixed set up front; a *growing* counter is the
+  runtime face of the NM312 retrace hazard, attributable in the metrics
+  snapshot instead of invisible in lost throughput;
+* ``jax.transfer_guard_host_to_device("disallow")`` **around dispatch** —
+  the runtime face of NM321/NM322: inside a :func:`guard_transfers` block,
+  an implicit host->device upload (a numpy array handed to a compiled
+  call) raises instead of silently re-staging per dispatch. The guard is
+  deliberately upload-only: device->host fetches are *sanctioned* inside
+  the supervised primary (the deadline must cover them, PR 3), and on
+  accelerator backends a full ``transfer_guard("disallow")`` would reject
+  exactly those fetches — CPU's zero-copy d2h masks that, so the
+  direction matters.
+
+jax is imported lazily: constructing the objects costs nothing in jax-free
+processes (bench.py's orchestrator wires the counter from worker-reported
+counts without ever enabling the config flags itself).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+from typing import Optional
+
+RECOMPILES_TOTAL = "pipeline_recompiles_total"
+
+_COMPILE_PREFIXES = ("Compiling ",)
+
+# process-wide sanitize state: set by enable(), consulted by the zero-
+# plumbing guard_dispatch() the drivers wrap their dispatch sites in
+_ACTIVE: Optional["SanitizeState"] = None
+
+
+def active() -> bool:
+    """True when enable() ran in this process."""
+    return _ACTIVE is not None
+
+
+def state() -> Optional["SanitizeState"]:
+    return _ACTIVE
+
+
+class RecompileWatchdog(logging.Handler):
+    """Counts XLA compilations from the ``jax_log_compiles`` WARNING stream.
+
+    Attach to the root ``jax`` logger: the compile records propagate up
+    from ``jax._src.interpreters.*``/``jax._src.dispatch`` regardless of
+    which internal module emits them in a given jax version.
+    """
+
+    def __init__(self, registry=None):
+        super().__init__(level=logging.WARNING)
+        self.registry = registry
+        self.count = 0
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:  # noqa: BLE001 — a logging handler must never raise
+            return
+        if not msg.startswith(_COMPILE_PREFIXES):
+            return
+        self.count += 1
+        if self.registry is not None:
+            try:
+                self.registry.counter(
+                    RECOMPILES_TOTAL,
+                    help="XLA compilations observed by the --sanitize "
+                    "recompile watchdog (growth past warmup = retrace "
+                    "hazard, see docs/STATIC_ANALYSIS.md NM312)",
+                ).inc()
+            except Exception:  # noqa: BLE001 — telemetry never costs the run
+                pass
+
+
+class SanitizeState:
+    """Handle for one enabled sanitize session (keeps the handler removable)."""
+
+    def __init__(self, watchdog: RecompileWatchdog, enabled: bool):
+        self.watchdog = watchdog
+        self.enabled = enabled
+
+    @property
+    def recompiles(self) -> int:
+        return self.watchdog.count
+
+    def close(self) -> None:
+        logging.getLogger("jax").removeHandler(self.watchdog)
+
+
+def enable(registry=None) -> SanitizeState:
+    """Turn on the runtime twins in this process (imports jax).
+
+    Sanitize is deliberately ONE-WAY for the process, like PR 3's CPU
+    degradation: ``jax_debug_nans``/``jax_log_compiles`` stay on until the
+    process exits, and no caller un-sets them (a mode that half-restores
+    global config mid-process is worse than one that honestly doesn't).
+    Idempotent: a repeat call detaches the previous watchdog (its stale
+    registry stops receiving counts) and installs a fresh one for the new
+    ``registry`` — in-process callers running several drivers get one
+    watchdog, not a stack. ``registry`` may be None (bench workers report
+    ``state.recompiles`` to the jax-free orchestrator instead). The
+    counter is created at 0 immediately so a sanitized run's snapshot
+    always carries it, even when nothing ever compiles.
+    """
+    import jax
+
+    jax.config.update("jax_debug_nans", True)
+    jax.config.update("jax_log_compiles", True)
+    global _ACTIVE
+    if _ACTIVE is not None:
+        _ACTIVE.close()  # detach the previous watchdog: no stacking,
+        # and the prior run's registry stops accumulating
+    watchdog = RecompileWatchdog(registry)
+    jax_logger = logging.getLogger("jax")
+    jax_logger.addHandler(watchdog)
+    if jax_logger.level > logging.WARNING or jax_logger.level == logging.NOTSET:
+        jax_logger.setLevel(logging.WARNING)
+    if registry is not None:
+        registry.counter(
+            RECOMPILES_TOTAL,
+            help="XLA compilations observed by the --sanitize recompile "
+            "watchdog (growth past warmup = retrace hazard, see "
+            "docs/STATIC_ANALYSIS.md NM312)",
+        ).inc(0)
+    _ACTIVE = SanitizeState(watchdog, enabled=True)
+    return _ACTIVE
+
+
+def record_external_recompiles(registry, count: int) -> None:
+    """Fold a worker process's watchdog count into this process's registry.
+
+    bench.py's orchestrator never imports jax; its workers run sanitized
+    and report their compile counts in the result record, which lands here
+    so ``--metrics-out`` carries one coherent ``pipeline_recompiles_total``.
+    """
+    registry.counter(
+        RECOMPILES_TOTAL,
+        help="XLA compilations observed by the --sanitize recompile "
+        "watchdog (growth past warmup = retrace hazard, see "
+        "docs/STATIC_ANALYSIS.md NM312)",
+    ).inc(max(int(count), 0))
+
+
+@contextlib.contextmanager
+def guard_transfers(enabled: bool = True):
+    """Upload-only transfer guard scoped to a dispatch window.
+
+    ``jax.transfer_guard_host_to_device("disallow")``: an implicit numpy
+    argument to a compiled call raises; explicit ``device_put`` staging
+    and all device->host fetches (the supervised primary's job) pass on
+    EVERY backend — a bidirectional ``disallow`` only looks workable on
+    CPU, where d2h is zero-copy and unguarded. A no-op (and jax-free)
+    when ``enabled`` is false so call sites can thread the flag
+    unconditionally.
+    """
+    if not enabled:
+        yield
+        return
+    import jax
+
+    with jax.transfer_guard_host_to_device("disallow"):
+        yield
+
+
+@contextlib.contextmanager
+def guard_dispatch():
+    """Zero-plumbing dispatch guard for the drivers.
+
+    Equivalent to ``guard_transfers(active())``: call sites wrap their
+    staged-input dispatch unconditionally; the guard only exists when the
+    process ran :func:`enable` (``--sanitize``). Explicit ``device_put``
+    staging and result fetches pass on every backend; an *implicit*
+    host-array argument upload — the NM322 hazard at runtime — raises.
+    """
+    with guard_transfers(active()):
+        yield

@@ -16,6 +16,8 @@ import time
 from pathlib import Path
 from typing import Dict, List
 
+from nm03_capstone_project_tpu.utils.atomicio import atomic_write_text
+
 
 def sync(tree) -> None:
     """Block until every array in the pytree is computed (honest timing).
@@ -98,4 +100,6 @@ def write_results_json(path: str, payload: dict) -> None:
     p = Path(path)
     p.parent.mkdir(parents=True, exist_ok=True)
     payload = {**payload, "git_sha": payload.get("git_sha", git_sha())}
-    p.write_text(json.dumps(payload, indent=1, sort_keys=True) + "\n")
+    # atomic (NM351): a results JSON is a gate input (check_bench_
+    # regression, judges) — a kill mid-write must never leave half a record
+    atomic_write_text(p, json.dumps(payload, indent=1, sort_keys=True) + "\n")

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -108,9 +109,13 @@ def main(argv=None) -> int:
             "source": args.results,
             "ms_per_batch": current,
         }
-        with open(args.baseline, "w") as f:
+        # tmp+rename (NM351): BASELINE.json is the regression gate's truth;
+        # updating it must be all-or-nothing
+        tmp = f"{args.baseline}.tmp"
+        with open(tmp, "w") as f:
             json.dump(base_doc, f, indent=2)
             f.write("\n")
+        os.replace(tmp, args.baseline)
         print(
             f"check_bench_regression: baseline updated from {args.results} "
             f"({len(current)} stages, backend {backend})"

@@ -9,7 +9,6 @@ import json
 import pathlib
 
 import jax
-import numpy as np
 import pytest
 
 from nm03_capstone_project_tpu.cli import volume as volume_cli
