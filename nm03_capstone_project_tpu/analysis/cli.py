@@ -23,6 +23,7 @@ from pathlib import Path
 from typing import List, Optional
 
 from nm03_capstone_project_tpu.analysis.atomicio import check_atomic_io
+from nm03_capstone_project_tpu.analysis.compilehome import check_compile_home
 from nm03_capstone_project_tpu.analysis.contracts import check_import_contracts
 from nm03_capstone_project_tpu.analysis.core import (
     DEFAULT_BASELINE_NAME,
@@ -46,6 +47,7 @@ ALL_RULES = (
     check_thread_shared_state,
     check_dtype_discipline,
     check_atomic_io,
+    check_compile_home,
 )
 
 RULE_CATALOG = {
@@ -59,6 +61,7 @@ RULE_CATALOG = {
     "NM341": "dtype: float64 introduction in the f32 ops pipeline",
     "NM342": "dtype: uint8-cast comparison against an out-of-range literal",
     "NM351": "atomic-io: truncating artifact write without tmp+rename",
+    "NM361": "compile-home: jit/pjit/shard_map referenced outside compilehub/",
     "NM390": "meta: suppression without a reason",
     "NM399": "meta: file does not parse",
 }

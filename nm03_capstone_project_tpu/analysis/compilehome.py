@@ -1,0 +1,127 @@
+"""NM361 — compile-home discipline: jit/pjit/shard_map live in compilehub.
+
+The compile hub exists because the scattered alternative already failed in
+this repo's history: ``parallel/`` referenced the promoted
+``jax.shard_map`` while the installed jaxlib only shipped
+``jax.experimental.shard_map``, and 8 tier-1 tests failed from the seed
+until PR 6 hoisted the reference into one compat shim. A second scattered
+call site is one upgrade away from the same AttributeError — and, more
+quietly, from a compile cache the hub cannot see (warmup, AOT policy and
+the ``/readyz`` executable accounting only cover what the hub builds).
+
+The rule therefore flags any *reference* to jax's compilation entry
+points outside ``nm03_capstone_project_tpu/compilehub/``:
+
+* ``from jax... import jit/pjit/shard_map`` (any jax module) — the
+  binding itself is the violation; suppressing it sanctions the uses;
+* dotted references — ``jax.jit``, ``jax.experimental.pjit.pjit``, an
+  aliased ``sm.shard_map`` where ``sm`` was imported from jax;
+
+in decorators, ``functools.partial`` arguments and plain calls alike
+(AST attribute/name references, so strings and docstrings never trip it).
+
+Sanctioned escapes: the hub's own ``hub_jit``/``compat.shard_map``
+(different names — no finding), and the Pallas kernel wrappers in
+``ops/pallas_*.py``, which carry reasoned suppressions: their ``jax.jit``
+is the kernel's dispatch envelope whose static_argnames pin the
+pallas_call grid, not a pipeline compile the hub should own.
+
+Rule:
+  NM361  jit/pjit/shard_map referenced outside compilehub/
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from nm03_capstone_project_tpu.analysis.core import Finding, SourceFile
+
+_FORBIDDEN = {"jit", "pjit", "shard_map"}
+_HOME_PREFIX = "nm03_capstone_project_tpu/compilehub/"
+
+
+def _dotted(node: ast.expr) -> Optional[str]:
+    """'jax.experimental.pjit' for a Name/Attribute chain; None otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _jax_module_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Local names bound to jax modules: {alias: real dotted module}.
+
+    ``import jax`` -> {'jax': 'jax'}; ``import jax.experimental.shard_map
+    as sm`` -> {'sm': ...}; ``from jax.experimental import shard_map`` ->
+    {'shard_map': 'jax.experimental.shard_map'} (that one ALSO trips the
+    import check itself — the alias map just catches attribute uses if
+    the import line was suppressed but a dotted use appears elsewhere).
+    """
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax" or a.name.startswith("jax."):
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.module == "jax" or node.module.startswith("jax."):
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def check_compile_home(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in files:
+        if src.tree is None or src.relpath.startswith(_HOME_PREFIX):
+            continue
+        aliases = _jax_module_aliases(src.tree)
+        seen: Set[Tuple[int, str]] = set()
+
+        def emit(line: int, what: str) -> None:
+            if (line, what) in seen:
+                return
+            seen.add((line, what))
+            findings.append(
+                Finding(
+                    rule="NM361",
+                    path=src.relpath,
+                    line=line,
+                    message=(
+                        f"{what} referenced outside compilehub/ — lowering "
+                        "and compilation belong to the compile hub (use "
+                        "compilehub.hub_jit / compilehub.shard_map, or a "
+                        "hub program); Pallas kernel wrappers suppress "
+                        "with a reason (docs/STATIC_ANALYSIS.md)"
+                    ),
+                    source_line=src.line_text(line),
+                )
+            )
+
+        for node in ast.walk(src.tree):
+            # the binding: from jax[...] import jit/pjit/shard_map
+            if isinstance(node, ast.ImportFrom) and node.module and (
+                node.module == "jax" or node.module.startswith("jax.")
+            ):
+                for a in node.names:
+                    if a.name in _FORBIDDEN:
+                        emit(node.lineno, f"{node.module}.{a.name}")
+            # the reference: <jax-ish>.jit / .pjit / .shard_map
+            elif isinstance(node, ast.Attribute) and node.attr in _FORBIDDEN:
+                base = _dotted(node.value)
+                if base is None:
+                    continue
+                head = base.split(".")[0]
+                resolved = aliases.get(head)
+                if resolved is not None:
+                    base = base.replace(head, resolved, 1)
+                if base == "jax" or base.startswith("jax."):
+                    emit(node.lineno, f"{base}.{node.attr}")
+    return findings

@@ -38,6 +38,9 @@ DISPATCH_PATHS: Dict[str, Tuple[str, ...]] = {
     "nm03_capstone_project_tpu/serving/batcher.py": (
         "DynamicBatcher._run",
         "DynamicBatcher.execute",
+        # the per-lane chunk path (PR 6): runs on the lane worker pool,
+        # where a stray sync stalls that lane's whole chunk
+        "DynamicBatcher._execute_chunk",
     ),
     "nm03_capstone_project_tpu/serving/executor.py": (
         "WarmExecutor.run_batch",

@@ -43,6 +43,11 @@ _ARRAY_CTORS = {
     ("numpy", "array"), ("numpy", "asarray"),
 }
 _WRAPPERS = {"vmap", "pmap", "grad", "value_and_grad", "checkify", "partial"}
+# the compile hub's tracked wrappers ARE jit for this rule's purposes —
+# without them the NM311/312 coverage would silently vanish the day a call
+# site migrates to the hub (PR 6 migrated all of them)
+_JIT_NAMES = ("jit", "pjit", "hub_jit", "_hub_jit")
+_JIT_BASES = ("jax", "pjit", "", "hub", "compilehub")
 
 
 def _attr_pair(func: ast.expr) -> Optional[Tuple[str, str]]:
@@ -56,8 +61,10 @@ def _attr_pair(func: ast.expr) -> Optional[Tuple[str, str]]:
 
 def _is_jit_call(node: ast.Call) -> bool:
     pair = _attr_pair(node.func)
-    return pair is not None and pair[1] in ("jit", "pjit") and pair[0] in (
-        "jax", "pjit", ""
+    return (
+        pair is not None
+        and pair[1] in _JIT_NAMES
+        and pair[0] in _JIT_BASES
     )
 
 
@@ -95,7 +102,7 @@ class _JitInventory(ast.NodeVisitor):
                 self.jitted_names[node.name] = _has_static(dec)
             else:
                 pair = _attr_pair(dec)
-                if pair and pair[1] in ("jit", "pjit") and pair[0] in ("jax", ""):
+                if pair and pair[1] in _JIT_NAMES and pair[0] in _JIT_BASES:
                     self.jitted_defs.append((node, False))
                     self.jitted_names[node.name] = False
         self.generic_visit(node)

@@ -25,7 +25,6 @@ Fault tolerance mirrors the reference at both granularities
 from __future__ import annotations
 
 import concurrent.futures as cf
-import functools
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
@@ -111,50 +110,30 @@ def _native_available() -> bool:
     return native.available()
 
 
-@functools.lru_cache(maxsize=8)
 def _compiled_slice_fn(cfg: PipelineConfig):
-    """jit of pipeline + on-device render for one slice."""
-    import jax
+    """Pipeline + on-device render for one slice (compile-hub program)."""
+    from nm03_capstone_project_tpu.compilehub import programs
 
-    from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
-    from nm03_capstone_project_tpu.render.render import render_pair
-
-    def f(pixels, dims):
-        out = process_slice(pixels, dims, cfg)
-        gray, seg = render_pair(out["original"], out["mask"], dims, cfg)
-        return gray, seg, out["grow_converged"]
-
-    return jax.jit(f)
+    return programs.slice_pipeline(cfg, render=True)
 
 
-@functools.lru_cache(maxsize=8)
 def _compiled_slice_mask_fn(cfg: PipelineConfig):
-    """jit of the pipeline alone: only the mask crosses back to the host."""
-    import jax
+    """The pipeline alone: only the mask crosses back to the host."""
+    from nm03_capstone_project_tpu.compilehub import programs
 
-    from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
-
-    def f(pixels, dims):
-        out = process_slice(pixels, dims, cfg)
-        return out["mask"], out["grow_converged"]
-
-    return jax.jit(f)
+    return programs.slice_pipeline(cfg, render=False)
 
 
-@functools.lru_cache(maxsize=8)
 def _compiled_batch_mask_fn(cfg: PipelineConfig):
-    """Vmapped mask-only pipeline (host-render export path)."""
-    import jax
+    """Vmapped mask-only pipeline (host-render export path).
 
-    from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
+    The device copy of the pixel stack is dead after the pipeline reads it
+    (the host keeps its own copy for rendering) — the hub program donates
+    its HBM.
+    """
+    from nm03_capstone_project_tpu.compilehub import programs
 
-    def one(pixels, dims):
-        out = process_slice(pixels, dims, cfg)
-        return out["mask"], out["grow_converged"]
-
-    # the device copy of the pixel stack is dead after the pipeline reads it
-    # (the host keeps its own copy for rendering) — donate its HBM
-    return jax.jit(jax.vmap(one), donate_argnums=(0,))
+    return programs.batch_pipeline(cfg, render=False)
 
 
 def _student_batch_mask(params, pixels, dims, cfg):
@@ -176,23 +155,17 @@ def _student_batch_mask(params, pixels, dims, cfg):
     return mask * valid_mask(dims, pixels.shape[-2:]).astype(mask.dtype)
 
 
-@functools.lru_cache(maxsize=8)
 def _compiled_batch_fn(cfg: PipelineConfig):
-    """jit of vmapped pipeline + render over a fixed-size slice stack."""
-    import jax
+    """Vmapped pipeline + render over a fixed-size slice stack.
 
-    from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
-    from nm03_capstone_project_tpu.render.render import render_pair
+    The hub program donates the pixel stack: the raw canvas batch is dead
+    after the pipeline reads it, so XLA may reuse its HBM for
+    intermediates (the render output is a different shape, but fusion
+    scratch benefits).
+    """
+    from nm03_capstone_project_tpu.compilehub import programs
 
-    def one(pixels, dims):
-        out = process_slice(pixels, dims, cfg)
-        gray, seg = render_pair(out["original"], out["mask"], dims, cfg)
-        return gray, seg, out["grow_converged"]
-
-    # donate the pixel stack: the raw canvas batch is dead after the pipeline
-    # reads it, so XLA may reuse its HBM for intermediates (the render output
-    # is a different shape, but fusion scratch benefits)
-    return jax.jit(jax.vmap(one), donate_argnums=(0,))
+    return programs.batch_pipeline(cfg, render=True)
 
 
 @dataclass
@@ -495,14 +468,16 @@ class CohortProcessor:
                 )
                 return gray, seg, jnp.ones(mask.shape[:1], jnp.bool_)
 
+        from nm03_capstone_project_tpu.compilehub import hub_jit
+
         if batched:
             # host-render keeps its own pixel copy on the host, so the
             # device stack is dead after the student reads it — donate,
             # matching the classical batched fns (the render path still
             # reads px after the mask, so it cannot donate)
-            fn = jax.jit(core, donate_argnums=(0,) if host_render else ())
+            fn = hub_jit(core, donate_argnums=(0,) if host_render else ())
         else:
-            fn = jax.jit(lambda px, dm: jax.tree.map(
+            fn = hub_jit(lambda px, dm: jax.tree.map(
                 lambda a: a[0], core(px[None], dm[None])
             ))
         self._student_fns[key] = fn

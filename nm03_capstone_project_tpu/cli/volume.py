@@ -17,7 +17,6 @@ pairs, success counters, catch-and-continue per patient) plus optional
 from __future__ import annotations
 
 import argparse
-import functools
 import sys
 from pathlib import Path
 
@@ -147,26 +146,15 @@ def _load_volume(base, patient_id, cfg):
     return np.stack(planes), np.asarray(hw, np.int32), stems, skipped
 
 
-@functools.lru_cache(maxsize=4)
 def _compiled_volume_fn(cfg):
-    """jit-compiled volume pipeline + vmapped renders, cached per config.
+    """Volume pipeline + vmapped renders (compile-hub program).
 
     One program per (cfg, depth) shape: (vol, dims) -> (mask, gray stack,
     segmentation stack) — compute and render fused, one dispatch per patient.
     """
-    import jax
+    from nm03_capstone_project_tpu.compilehub import programs
 
-    from nm03_capstone_project_tpu.pipeline.volume_pipeline import process_volume
-    from nm03_capstone_project_tpu.render.render import render_pair
-
-    def f(vol, dims):
-        out = process_volume(vol, dims, cfg)
-        gray, seg = jax.vmap(lambda p, m: render_pair(p, m, dims, cfg))(
-            vol, out["mask"]
-        )
-        return out["mask"], gray, seg, out["grow_converged"]
-
-    return jax.jit(f)
+    return programs.volume_pipeline(cfg, "render")
 
 
 def _make_student_volume_fn(model_params, cfg):
@@ -178,6 +166,7 @@ def _make_student_volume_fn(model_params, cfg):
     import jax
     import jax.numpy as jnp
 
+    from nm03_capstone_project_tpu.compilehub import hub_jit
     from nm03_capstone_project_tpu.core.backend import is_tpu_backend
     from nm03_capstone_project_tpu.core.image import valid_mask
     from nm03_capstone_project_tpu.models import predict_mask3d, prepare_student_inputs
@@ -186,7 +175,7 @@ def _make_student_volume_fn(model_params, cfg):
     dtype = jnp.bfloat16 if is_tpu_backend() else jnp.float32
     pool_multiple = 2 ** len(model_params["enc"])  # one halving per level
 
-    @jax.jit
+    @hub_jit
     def f(vol, dims):
         depth = vol.shape[0]
         pad = (-depth) % pool_multiple
@@ -198,33 +187,20 @@ def _make_student_volume_fn(model_params, cfg):
     return f
 
 
-@functools.lru_cache(maxsize=4)
 def _compiled_volume_mask_fn(cfg):
     """Mask-only volume pipeline: the host-render path fetches 65 KB/plane
     instead of two rendered canvases (~1.5 MB/plane) through the link."""
-    import jax
+    from nm03_capstone_project_tpu.compilehub import programs
 
-    from nm03_capstone_project_tpu.pipeline.volume_pipeline import process_volume
-
-    def f(vol, dims):
-        out = process_volume(vol, dims, cfg)
-        return out["mask"], out["grow_converged"]
-
-    return jax.jit(f)
+    return programs.volume_pipeline(cfg, "mask")
 
 
-@functools.lru_cache(maxsize=4)
 def _compiled_render_fn(cfg):
-    """Cached vmapped render program for the z-sharded path (whose compute
-    runs through parallel.process_volume_zsharded separately)."""
-    import jax
+    """The deferred vmapped render program for the z-sharded path (whose
+    compute runs through parallel.process_volume_zsharded separately)."""
+    from nm03_capstone_project_tpu.compilehub import programs
 
-    from nm03_capstone_project_tpu.render.render import render_pair
-
-    def f(vol, mask, dims):
-        return jax.vmap(lambda p, m: render_pair(p, m, dims, cfg))(vol, mask)
-
-    return jax.jit(f)
+    return programs.volume_pipeline(cfg, "render_only")
 
 
 def run(args: argparse.Namespace) -> int:

@@ -1,0 +1,46 @@
+"""compilehub — the single mesh-aware compile home for every chip.
+
+Public surface:
+
+* :func:`~.compat.shard_map` / :func:`~.compat.pjit` /
+  :func:`~.compat.distributed_is_initialized` — the version-compat shim
+  (the ONLY place those jax entry points may be named; nm03-lint NM361);
+* :func:`~.hub.hub_jit` — the tracked ``jax.jit`` every call site uses;
+* :class:`~.hub.CompileSpec` / :class:`~.hub.CompileHub` /
+  :func:`~.hub.get_hub` — the registry of compile specs returning warm
+  executables;
+* :mod:`~.programs` — the named pipeline programs (slice/batch/volume/
+  serve-lane), including :func:`~.programs.lane_devices` for the serving
+  fleet's per-chip replica lanes.
+
+Importing this package never initializes a backend; jax is paid for when
+a program is built, not when the hub is named.
+"""
+
+from nm03_capstone_project_tpu.compilehub import programs
+from nm03_capstone_project_tpu.compilehub.compat import (
+    distributed_is_initialized,
+    ensure_cpu_multiprocess_collectives,
+    pjit,
+    shard_map,
+)
+from nm03_capstone_project_tpu.compilehub.hub import (
+    CompileHub,
+    CompileSpec,
+    aot_compile,
+    get_hub,
+    hub_jit,
+)
+
+__all__ = [
+    "CompileHub",
+    "CompileSpec",
+    "aot_compile",
+    "distributed_is_initialized",
+    "ensure_cpu_multiprocess_collectives",
+    "get_hub",
+    "hub_jit",
+    "pjit",
+    "programs",
+    "shard_map",
+]

@@ -1,0 +1,134 @@
+"""Version-compat shim over jax's partitioned-compilation entry points.
+
+THE ONLY module in this codebase allowed to name ``shard_map`` / ``pjit``
+(nm03-lint NM361 enforces it). The reason is recorded in the repo's own
+history: the z-shard and distributed paths were written against the
+promoted ``jax.shard_map`` API and 8 tier-1 tests failed from the seed
+onward on a jaxlib that only ships ``jax.experimental.shard_map`` — an
+AttributeError that sat unnoticed precisely because the call sites were
+scattered. One shim, resolved once, means an API migration is a one-file
+change and a version drift is a loud import-time error here, not a
+mid-cohort crash three layers down.
+
+Resolution order (cached after first use):
+
+* ``shard_map`` — the promoted ``jax.shard_map`` (keyword ``check_vma``)
+  when present, else ``jax.experimental.shard_map.shard_map`` (the same
+  knob spelled ``check_rep``). Callers always write ``check_vma=``; the
+  shim translates.
+* ``pjit`` — ``jax.experimental.pjit.pjit`` when present, else ``jax.jit``
+  (on modern jax they are the same function; the alias keeps old call
+  sites compiling).
+* ``distributed_is_initialized`` — ``jax.distributed.is_initialized`` when
+  present, else a fenced probe of the runtime's global distributed state
+  (absent on older jax, where only ``initialize``/``shutdown`` exist).
+
+Everything resolves lazily inside the functions so importing this module
+never initializes a backend.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+# (kind, callable) caches — resolved on first use, stable for the process
+_SHARD_MAP: Optional[Tuple[str, Callable]] = None
+_PJIT: Optional[Callable] = None
+
+
+def _resolve_shard_map() -> Tuple[str, Callable]:
+    global _SHARD_MAP
+    if _SHARD_MAP is None:
+        import jax
+
+        impl = getattr(jax, "shard_map", None)
+        if impl is not None:
+            _SHARD_MAP = ("check_vma", impl)
+        else:
+            from jax.experimental.shard_map import shard_map as impl
+
+            _SHARD_MAP = ("check_rep", impl)
+    return _SHARD_MAP
+
+
+def shard_map(
+    fn: Callable,
+    *,
+    mesh,
+    in_specs,
+    out_specs,
+    check_vma: bool = True,
+) -> Callable:
+    """``shard_map`` under either spelling of the replication-check knob.
+
+    ``check_vma`` follows the promoted API's name; on a jax that only has
+    the experimental entry point it is passed through as ``check_rep``
+    (same semantics: verify per-output replication claims).
+    """
+    knob, impl = _resolve_shard_map()
+    kwargs = {knob: check_vma}
+    return impl(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
+
+
+def pjit(fn: Callable, **kwargs: Any) -> Callable:
+    """``pjit`` where it exists, ``jax.jit`` where they have merged."""
+    global _PJIT
+    if _PJIT is None:
+        import jax
+
+        try:
+            from jax.experimental.pjit import pjit as impl
+        except ImportError:  # modern jax: pjit IS jit
+            impl = jax.jit
+        _PJIT = impl
+    return _PJIT(fn, **kwargs)
+
+
+def ensure_cpu_multiprocess_collectives() -> bool:
+    """Best-effort cross-process collectives for the CPU backend (gloo).
+
+    On jaxlibs of this vintage a multi-process job on the CPU backend
+    fails at dispatch with "Multiprocess computations aren't implemented
+    on the CPU backend" unless the gloo collectives implementation is
+    selected BEFORE the backend initializes. Newer jax selects it
+    automatically (and may drop the knob entirely), and an operator may
+    have chosen mpi explicitly — so this sets gloo only when the knob
+    exists and still holds its empty default, and reports False (never
+    raises) otherwise. Called by ``parallel.distributed.initialize`` on
+    the join path; harmless on accelerator backends (the knob only
+    affects CPU backend creation).
+    """
+    import jax
+
+    try:
+        current = getattr(jax.config, "jax_cpu_collectives_implementation", None)
+        if current:  # operator already chose (gloo/mpi) — respect it
+            return True
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        return True
+    except Exception:  # noqa: BLE001 — knob moved/removed; newer jax auto-selects
+        return False
+
+
+def distributed_is_initialized() -> bool:
+    """True once this process has joined a ``jax.distributed`` job.
+
+    ``jax.distributed.is_initialized`` only exists on newer jax; older
+    releases expose the same fact through the private global state. The
+    probe is fenced: if the private layout moved too, report False and let
+    the caller's own idempotence flag (``parallel.distributed``) carry the
+    second-call no-op.
+    """
+    import jax
+
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None) is not None
+    except Exception:  # noqa: BLE001 — private layout moved; undetermined
+        return False

@@ -1,0 +1,220 @@
+"""The compile hub: one home for lowering, compiling, and caching.
+
+Every pipeline callable this framework dispatches — the 2D slice programs,
+the vmapped batch programs, the volume pipeline, the mesh-sharded z-shard
+and data-parallel programs, the serving executor's per-bucket executables —
+is compiled *here*, through one registry keyed by :class:`CompileSpec`.
+Before this module, ``jax.jit`` call sites were scattered across ``ops/``,
+``cli/runner.py``, ``cli/volume.py``, ``serving/executor.py`` and
+``parallel/``, each with its own ``lru_cache`` and its own idea of
+donation and warmup; OpenCLIPER's thesis (PAPERS.md) applies directly:
+hoist device/compile management out of the request path into one
+overhead-reduced home, so compilation policy (AOT vs deferred, donation,
+device pinning, mesh placement) is decided once and observable in one
+place.
+
+Layers:
+
+* :func:`hub_jit` — the tracked ``jax.jit`` wrapper every call site uses
+  (nm03-lint NM361 bans naming ``jax.jit`` anywhere else, Pallas kernel
+  wrappers excepted). Thin by design: it adds accounting, not semantics.
+* :class:`CompileSpec` / :class:`CompileHub` — the registry of compile
+  specs (program name, config, bucket shape, mesh, donation, backend,
+  lane) returning cached warm executables. Builders run outside the lock;
+  first completed build wins (the racing loser's executable is dropped,
+  mirroring the serving executor's historical contract).
+* :func:`aot_compile` — ``lower().compile()`` with the documented
+  fallback: AOT is an optimization, not a contract, on backends where
+  lowering at abstract shapes is unavailable.
+
+The concrete pipeline programs live in :mod:`.programs`; mesh/sharding
+version compatibility lives in :mod:`.compat`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+__all__ = [
+    "CompileHub",
+    "CompileSpec",
+    "aot_compile",
+    "get_hub",
+    "hub_jit",
+]
+
+# The registry deliberately never evicts: dropping a warm serving
+# executable mid-traffic is a recompile stall — the exact cliff the hub
+# exists to prevent — and the lru_cache(maxsize=4..8) caches it replaced
+# could do exactly that under a config sweep. Spec diversity is small and
+# fixed in every production process (one cfg, a handful of buckets x
+# lanes); a process that keeps minting NEW specs (unbounded cfg sweep in
+# one process) is leaking executables, so the hub warns once past this
+# soft cap instead of silently growing.
+REGISTRY_SOFT_CAP = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class CompileSpec:
+    """Identity of one compiled executable in the hub's registry.
+
+    ``name`` is the program family (``serve_mask``, ``batch_render``,
+    ``zshard_volume`` ...); ``cfg`` the :class:`PipelineConfig` (hashable
+    frozen dataclass) the program was specialized for; ``shape`` the
+    static input shape the executable was AOT-compiled at (``None`` for
+    deferred-trace callables that compile per call shape); ``mesh`` the
+    device mesh for sharded programs; ``device`` the concrete device a
+    pinned (replica-lane) executable is committed to — the DEVICE OBJECT,
+    not its id: ids are only unique per backend, and two distinct devices
+    colliding on one key would silently defeat the lane fan-out; ``lane``
+    the human-facing lane index for display; ``backend`` a backend
+    override (the CPU degradation target); ``donate`` whether the leading
+    input's buffer is donated; ``variant`` a free-form discriminator.
+    """
+
+    name: str
+    cfg: Any = None
+    shape: Optional[Tuple[int, ...]] = None
+    mesh: Any = None
+    device: Any = None
+    lane: Optional[int] = None
+    backend: Optional[str] = None
+    donate: bool = False
+    variant: str = ""
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name,
+            "shape": list(self.shape) if self.shape else None,
+            "mesh": dict(self.mesh.shape) if self.mesh is not None else None,
+            "device": str(self.device) if self.device is not None else None,
+            "lane": self.lane,
+            "backend": self.backend,
+            "donate": self.donate,
+            "variant": self.variant or None,
+        }
+
+
+def aot_compile(jitted: Callable, *arg_structs) -> Tuple[Callable, bool]:
+    """``jitted.lower(*arg_structs).compile()`` with deferred fallback.
+
+    Returns ``(executable, aot_ok)``. AOT means the executable exists the
+    moment this returns — serve-time calls never trace; on backends where
+    abstract lowering is unavailable the jitted callable itself is
+    returned and the first call pays the compile (the historical serving
+    behavior, kept as the documented fallback).
+    """
+    try:
+        return jitted.lower(*arg_structs).compile(), True
+    except Exception:  # noqa: BLE001 — AOT is an optimization, not a contract
+        return jitted, False
+
+
+class CompileHub:
+    """Registry of compile specs returning warm executables.
+
+    Thread-safe: handler/warmup threads race through :meth:`get` during
+    serving startup, and the batch drivers' IO pools may trigger fallback
+    builds concurrently. Builds run outside the lock (a compile can take
+    seconds and must not serialize unrelated lookups); the first build to
+    publish wins.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache: Dict[CompileSpec, Callable] = {}
+        self._aot: Dict[CompileSpec, bool] = {}
+        self._builds = 0
+        self._jit_wraps = 0
+        self._cap_warned = False
+
+    # -- the registry ------------------------------------------------------
+
+    def get(
+        self, spec: CompileSpec, build: Callable[[CompileSpec], Callable]
+    ) -> Callable:
+        """The spec's executable, building (and caching) it on first use."""
+        with self._lock:
+            fn = self._cache.get(spec)
+        if fn is not None:
+            return fn
+        built = build(spec)
+        if isinstance(built, tuple):  # (executable, aot_ok) from aot_compile
+            built, aot_ok = built
+        else:
+            aot_ok = False
+        with self._lock:
+            if spec not in self._cache:
+                self._cache[spec] = built
+                self._aot[spec] = aot_ok
+                self._builds += 1
+            over_cap = (
+                len(self._cache) > REGISTRY_SOFT_CAP and not self._cap_warned
+            )
+            if over_cap:
+                self._cap_warned = True
+            out = self._cache[spec]
+        if over_cap:
+            from nm03_capstone_project_tpu.utils.reporter import get_logger
+
+            get_logger("compilehub").warning(
+                "compile hub holds %d executables (> soft cap %d): specs "
+                "keep differing — an unbounded cfg/mesh sweep in one "
+                "process leaks executables; use hub.drop() for hot-swaps",
+                len(self._cache), REGISTRY_SOFT_CAP,
+            )
+        return out
+
+    def peek(self, spec: CompileSpec) -> Optional[Callable]:
+        """The cached executable, or None — never builds (readiness probes)."""
+        with self._lock:
+            return self._cache.get(spec)
+
+    def drop(self, spec: CompileSpec) -> None:
+        """Evict one executable (tests; a config hot-swap would use this)."""
+        with self._lock:
+            self._cache.pop(spec, None)
+            self._aot.pop(spec, None)
+
+    def jit(self, fn: Callable, **kwargs: Any) -> Callable:
+        """The hub's ``jax.jit``: semantics untouched, creation counted."""
+        import jax
+
+        with self._lock:
+            self._jit_wraps += 1
+        return jax.jit(fn, **kwargs)
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """Registry state for ``/readyz`` payloads and tests."""
+        with self._lock:
+            return {
+                "executables": len(self._cache),
+                "aot": sum(1 for ok in self._aot.values() if ok),
+                "builds": self._builds,
+                "jit_wraps": self._jit_wraps,
+            }
+
+    def specs(self) -> list:
+        with self._lock:
+            keys = list(self._cache)
+        return [k.describe() for k in keys]
+
+
+_HUB = CompileHub()
+
+
+def get_hub() -> CompileHub:
+    """The process-wide hub. One registry per process: executables are
+    shared wherever the spec matches (two serving apps with one config
+    warm once), and the spec's fields are exactly what may differ."""
+    return _HUB
+
+
+def hub_jit(fn: Callable, **kwargs: Any) -> Callable:
+    """Module-level alias of :meth:`CompileHub.jit` on the process hub —
+    the one-line migration target for the historical ``jax.jit`` sites."""
+    return _HUB.jit(fn, **kwargs)

@@ -1,0 +1,253 @@
+"""Named pipeline programs, built and cached through the hub.
+
+The drivers' historical per-file ``functools.lru_cache`` compile caches
+(``cli/runner.py`` held four, ``cli/volume.py`` three, the serving
+executor one per bucket) collapse into these builders: each public getter
+makes a :class:`~.hub.CompileSpec` and asks the process hub, so every
+layer that dispatches compute shares one registry, one cache policy, and
+one accounting surface.
+
+Program families:
+
+* ``slice_*`` — one slice through the 2D pipeline (sequential driver);
+* ``batch_*`` — the vmapped fixed-shape batch programs (parallel driver),
+  leading input donated where the host keeps its own copy;
+* ``volume_*`` — the 3D pipeline with fused/deferred render variants;
+* ``serve_mask`` — the serving executor's mask-only bucket program, AOT
+  lowered+compiled at the bucket shape and (for the sharded fleet) pinned
+  to one replica-lane device, so one ``nm03-serve`` process drives every
+  chip with per-chip executables instead of one single-device program.
+
+Everything imports jax lazily: building a program is the moment a backend
+is paid for, never importing this module.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from nm03_capstone_project_tpu.compilehub.hub import (
+    CompileSpec,
+    aot_compile,
+    get_hub,
+    hub_jit,
+)
+
+__all__ = [
+    "batch_pipeline",
+    "lane_devices",
+    "serve_mask",
+    "slice_pipeline",
+    "volume_pipeline",
+]
+
+
+# -- replica-lane planning ---------------------------------------------------
+
+
+def lane_devices(lanes: Optional[int] = None, backend: Optional[str] = None) -> List:
+    """The serving fleet's replica-lane devices (one lane = one chip).
+
+    Local devices only: in a multi-process job each serving replica owns
+    its own chips (the admission tier spreads traffic across replicas).
+    ``lanes`` caps the count (``nm03-serve --lanes``); None or 0 takes
+    every local device.
+    """
+    import jax
+
+    devs = jax.local_devices() if backend is None else jax.local_devices(
+        backend=backend
+    )
+    if lanes is not None and lanes > 0:
+        if lanes > len(devs):
+            raise ValueError(
+                f"requested {lanes} lanes, only {len(devs)} local devices"
+            )
+        devs = devs[:lanes]
+    return list(devs)
+
+
+# -- 2D slice programs -------------------------------------------------------
+
+
+def slice_pipeline(cfg, render: bool = True):
+    """One-slice program: pipeline (+ on-device render pair when ``render``)."""
+
+    def build(spec: CompileSpec):
+        from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
+
+        if spec.variant == "render":
+            from nm03_capstone_project_tpu.render.render import render_pair
+
+            def f(pixels, dims):
+                out = process_slice(pixels, dims, spec.cfg)
+                gray, seg = render_pair(out["original"], out["mask"], dims, spec.cfg)
+                return gray, seg, out["grow_converged"]
+
+        else:
+
+            def f(pixels, dims):
+                out = process_slice(pixels, dims, spec.cfg)
+                return out["mask"], out["grow_converged"]
+
+        return hub_jit(f)
+
+    spec = CompileSpec(
+        name="slice_pipeline",
+        cfg=cfg,
+        variant="render" if render else "mask",
+    )
+    return get_hub().get(spec, build)
+
+
+def batch_pipeline(cfg, render: bool = False):
+    """Vmapped fixed-shape batch program (the parallel driver's dispatch).
+
+    The mask-only variant donates the pixel stack (the host keeps its own
+    copy for rendering); the render variant cannot donate nothing less —
+    the pixels die after the pipeline reads them either way, so both
+    donate the leading input.
+    """
+
+    def build(spec: CompileSpec):
+        import jax
+
+        from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
+
+        if spec.variant == "render":
+            from nm03_capstone_project_tpu.render.render import render_pair
+
+            def one(pixels, dims):
+                out = process_slice(pixels, dims, spec.cfg)
+                gray, seg = render_pair(out["original"], out["mask"], dims, spec.cfg)
+                return gray, seg, out["grow_converged"]
+
+        else:
+
+            def one(pixels, dims):
+                out = process_slice(pixels, dims, spec.cfg)
+                return out["mask"], out["grow_converged"]
+
+        return hub_jit(jax.vmap(one), donate_argnums=(0,))
+
+    spec = CompileSpec(
+        name="batch_pipeline",
+        cfg=cfg,
+        donate=True,
+        variant="render" if render else "mask",
+    )
+    return get_hub().get(spec, build)
+
+
+# -- 3D volume programs ------------------------------------------------------
+
+
+def volume_pipeline(cfg, variant: str = "render"):
+    """The volume driver's programs, one per export layout.
+
+    ``render`` — mask + vmapped render pair in one program (one dispatch
+    per patient); ``mask`` — mask-only (host-render export fetches 65
+    KB/plane, not two rendered canvases); ``render_only`` — the deferred
+    (vol, mask, dims) -> (gray, seg) render used by the z-shard/student
+    paths whose compute ran elsewhere.
+    """
+    if variant not in ("render", "mask", "render_only"):
+        raise ValueError(f"unknown volume program variant {variant!r}")
+
+    def build(spec: CompileSpec):
+        import jax
+
+        if spec.variant == "render":
+            from nm03_capstone_project_tpu.pipeline.volume_pipeline import (
+                process_volume,
+            )
+            from nm03_capstone_project_tpu.render.render import render_pair
+
+            def f(vol, dims):
+                out = process_volume(vol, dims, spec.cfg)
+                gray, seg = jax.vmap(
+                    lambda p, m: render_pair(p, m, dims, spec.cfg)
+                )(vol, out["mask"])
+                return out["mask"], gray, seg, out["grow_converged"]
+
+        elif spec.variant == "mask":
+            from nm03_capstone_project_tpu.pipeline.volume_pipeline import (
+                process_volume,
+            )
+
+            def f(vol, dims):
+                out = process_volume(vol, dims, spec.cfg)
+                return out["mask"], out["grow_converged"]
+
+        else:  # render_only
+            from nm03_capstone_project_tpu.render.render import render_pair
+
+            def f(vol, mask, dims):
+                return jax.vmap(lambda p, m: render_pair(p, m, dims, spec.cfg))(
+                    vol, mask
+                )
+
+        return hub_jit(f)
+
+    spec = CompileSpec(name="volume_pipeline", cfg=cfg, variant=variant)
+    return get_hub().get(spec, build)
+
+
+# -- serving: per-lane bucket executables ------------------------------------
+
+
+def serve_mask(cfg, bucket: Optional[int] = None, device=None):
+    """The serving executor's mask-only batch program.
+
+    With ``bucket`` the program is AOT lowered+compiled at the bucket
+    shape (the executable exists the moment this returns — serve-time
+    calls never trace), and with ``device`` it is pinned to that replica
+    lane via ``SingleDeviceSharding``: inputs commit to the lane's chip
+    and outputs stay there until the supervised fetch, so N lanes dispatch
+    N batches genuinely concurrently instead of queueing on device 0's
+    stream. Without ``bucket`` (the CPU degradation target) the deferred
+    jitted callable is returned: XLA retraces per shape, acceptable on the
+    degraded path where correct-but-slower is the contract.
+    """
+
+    def build(spec: CompileSpec):
+        import jax
+        import jax.numpy as jnp
+
+        from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
+
+        def one(px, dm):
+            out = process_slice(px, dm, spec.cfg)
+            return out["mask"], out["grow_converged"]
+
+        kwargs = {}
+        if device is not None:
+            from jax.sharding import SingleDeviceSharding
+
+            sh = SingleDeviceSharding(device)
+            kwargs = {"in_shardings": sh, "out_shardings": sh}
+        # no donation: a supervised retry re-runs the primary with the SAME
+        # host arrays, and serving's per-batch HBM footprint is tiny
+        fn = hub_jit(jax.vmap(one), **kwargs)
+        if spec.shape is None:
+            return fn
+        c = spec.cfg.canvas
+        b = spec.shape[0]
+        return aot_compile(
+            fn,
+            jax.ShapeDtypeStruct((b, c, c), jnp.float32),
+            jax.ShapeDtypeStruct((b, 2), jnp.int32),
+        )
+
+    spec = CompileSpec(
+        name="serve_mask",
+        cfg=cfg,
+        shape=(int(bucket), cfg.canvas, cfg.canvas) if bucket else None,
+        # keyed on the DEVICE OBJECT (hashable): device ids are only
+        # unique per backend, and a collision would silently hand lane N
+        # an executable pinned to another chip
+        device=device,
+        lane=getattr(device, "id", None) if device is not None else None,
+        variant="pinned" if device is not None else "",
+    )
+    return get_hub().get(spec, build)

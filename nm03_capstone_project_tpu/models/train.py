@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from nm03_capstone_project_tpu.compilehub import hub_jit
 from nm03_capstone_project_tpu.config import PipelineConfig
 from nm03_capstone_project_tpu.core.image import valid_mask
 from nm03_capstone_project_tpu.models.unet import apply_unet, param_shardings
@@ -89,7 +90,7 @@ def segmentation_loss(
     return bce + dice.mean()
 
 
-@functools.partial(jax.jit, static_argnames=("tx", "compute_dtype", "apply_fn"))
+@functools.partial(hub_jit, static_argnames=("tx", "compute_dtype", "apply_fn"))
 def train_step(
     params: Params,
     opt_state,
@@ -147,7 +148,7 @@ def make_sharded_train_step(mesh, params: Params, tx, compute_dtype=jnp.bfloat16
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    step_fn = jax.jit(
+    step_fn = hub_jit(
         step,
         in_shardings=(p_shard, o_shard, batch_shard, batch_shard, batch_shard),
         out_shardings=(p_shard, o_shard, NamedSharding(mesh, P())),

@@ -92,7 +92,9 @@ def initialize(
         return True
     import jax
 
-    if jax.distributed.is_initialized():  # someone else already joined us
+    from nm03_capstone_project_tpu.compilehub import distributed_is_initialized
+
+    if distributed_is_initialized():  # someone else already joined us
         _initialized = True
         return True
     explicit = (
@@ -108,6 +110,14 @@ def initialize(
         return False
 
     try:
+        # joining a real multi-process job: make sure the CPU backend can
+        # actually run cross-process collectives on this jaxlib (gloo; a
+        # no-op where jax auto-selects or an operator already chose)
+        from nm03_capstone_project_tpu.compilehub import (
+            ensure_cpu_multiprocess_collectives,
+        )
+
+        ensure_cpu_multiprocess_collectives()
         # jax runs its cluster autodetection (TPU-pod metadata, SLURM, GKE,
         # JAX_COORDINATOR_ADDRESS env...) for any argument left as None.
         jax.distributed.initialize(

@@ -15,77 +15,91 @@ convergence test, which reduces over the *slice*, not the mesh.
 
 from __future__ import annotations
 
-import functools
 from typing import Dict, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from nm03_capstone_project_tpu.compilehub import CompileSpec, get_hub, hub_jit
 from nm03_capstone_project_tpu.config import DEFAULT_CONFIG, PipelineConfig
 from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
 
 
-@functools.lru_cache(maxsize=8)
 def _compiled_sharded_batch(
     mesh: Mesh, cfg: PipelineConfig, with_render: bool, mask_only: bool = False
 ):
-    """jit of the vmapped pipeline with batch-axis in/out shardings."""
-    shard3 = NamedSharding(mesh, P("data", None, None))
-    shard2 = NamedSharding(mesh, P("data", None))
-    shard1 = NamedSharding(mesh, P("data"))
+    """The vmapped pipeline with batch-axis in/out shardings, via the hub."""
 
-    if mask_only:
-        # the host-render drivers fetch nothing but the mask (plus the
-        # per-slice convergence flag, 1 byte/slice): don't emit the
-        # original-canvas passthrough as a program output, and donate the
-        # input stack's HBM (the host keeps its own copy for rendering)
-        def mask_fn(pixels, dims):
-            out = process_slice(pixels, dims, cfg)
-            return {"mask": out["mask"], "grow_converged": out["grow_converged"]}
+    def build(spec: CompileSpec):
+        mesh, cfg = spec.mesh, spec.cfg
+        shard3 = NamedSharding(mesh, P("data", None, None))
+        shard2 = NamedSharding(mesh, P("data", None))
+        shard1 = NamedSharding(mesh, P("data"))
 
-        return jax.jit(
-            jax.vmap(mask_fn),
-            in_shardings=(shard3, shard2),
-            out_shardings={"mask": shard3, "grow_converged": shard1},
-            donate_argnums=(0,),
-        )
+        if spec.variant == "mask_only":
+            # the host-render drivers fetch nothing but the mask (plus the
+            # per-slice convergence flag, 1 byte/slice): don't emit the
+            # original-canvas passthrough as a program output, and donate the
+            # input stack's HBM (the host keeps its own copy for rendering)
+            def mask_fn(pixels, dims):
+                out = process_slice(pixels, dims, cfg)
+                return {"mask": out["mask"], "grow_converged": out["grow_converged"]}
 
-    if with_render:
-        from nm03_capstone_project_tpu.render.render import (
-            render_gray,
-            render_segmentation,
-        )
-
-        def one(pixels, dims):
-            out = process_slice(pixels, dims, cfg)
-            orig = render_gray(out["original"], dims, cfg.render_size)
-            proc = render_segmentation(
-                out["mask"],
-                dims,
-                cfg.render_size,
-                cfg.overlay_opacity,
-                cfg.overlay_border_opacity,
-                cfg.overlay_border_radius,
+            return hub_jit(
+                jax.vmap(mask_fn),
+                in_shardings=(shard3, shard2),
+                out_shardings={"mask": shard3, "grow_converged": shard1},
+                donate_argnums=(0,),
             )
-            return {
-                "original": orig,
-                "mask": proc,
-                "grow_converged": out["grow_converged"],
-            }
 
-    else:
+        if spec.variant == "render":
+            from nm03_capstone_project_tpu.render.render import (
+                render_gray,
+                render_segmentation,
+            )
 
-        def one(pixels, dims):
-            return process_slice(pixels, dims, cfg)
+            def one(pixels, dims):
+                out = process_slice(pixels, dims, cfg)
+                orig = render_gray(out["original"], dims, cfg.render_size)
+                proc = render_segmentation(
+                    out["mask"],
+                    dims,
+                    cfg.render_size,
+                    cfg.overlay_opacity,
+                    cfg.overlay_border_opacity,
+                    cfg.overlay_border_radius,
+                )
+                return {
+                    "original": orig,
+                    "mask": proc,
+                    "grow_converged": out["grow_converged"],
+                }
 
-    return jax.jit(
-        jax.vmap(one),
-        in_shardings=(shard3, shard2),
-        out_shardings={
-            "original": shard3,
-            "mask": shard3,
-            "grow_converged": shard1,
-        },
+        else:
+
+            def one(pixels, dims):
+                return process_slice(pixels, dims, cfg)
+
+        return hub_jit(
+            jax.vmap(one),
+            in_shardings=(shard3, shard2),
+            out_shardings={
+                "original": shard3,
+                "mask": shard3,
+                "grow_converged": shard1,
+            },
+        )
+
+    variant = "mask_only" if mask_only else ("render" if with_render else "")
+    return get_hub().get(
+        CompileSpec(
+            name="dp_batch",
+            cfg=cfg,
+            mesh=mesh,
+            donate=mask_only,
+            variant=variant,
+        ),
+        build,
     )
 
 

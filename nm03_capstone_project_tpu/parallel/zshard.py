@@ -25,13 +25,18 @@ directories (SURVEY.md section 4).
 
 from __future__ import annotations
 
-import functools
 from typing import Dict
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from nm03_capstone_project_tpu.compilehub import (
+    CompileSpec,
+    get_hub,
+    hub_jit,
+    shard_map,
+)
 from nm03_capstone_project_tpu.config import DEFAULT_CONFIG, PipelineConfig
 from nm03_capstone_project_tpu.core.image import valid_mask
 from nm03_capstone_project_tpu.ops.elementwise import cast_uint8
@@ -155,30 +160,47 @@ def _post_mask(
     return mask * valid.astype(mask.dtype)
 
 
-@functools.lru_cache(maxsize=8)
 def _compiled_zsharded(mesh: Mesh, cfg: PipelineConfig):
-    n_shards = mesh.shape[AXIS]
-    spec_v = P(AXIS, None, None)
+    """The z-sharded volume program, compiled and cached by the hub.
 
-    def run(vol_local: jax.Array, dims: jax.Array) -> Dict[str, jax.Array]:
-        pre, seeds, valid, band = _pre_and_band(vol_local, dims, cfg)
-        region, converged = _region_grow_local(
-            pre, seeds, band, n_shards, cfg.grow_block_iters, cfg.grow_max_iters
+    ``shard_map`` comes from the compilehub compat shim — the seed's
+    direct ``jax.shard_map`` reference is exactly the version drift that
+    failed these paths on jaxlibs shipping only the experimental entry
+    point (ISSUE 6 satellite; pinned by tests/test_parallel.py).
+    """
+
+    def build(spec: CompileSpec):
+        n_shards = spec.mesh.shape[AXIS]
+        spec_v = P(AXIS, None, None)
+
+        def run(vol_local: jax.Array, dims: jax.Array) -> Dict[str, jax.Array]:
+            pre, seeds, valid, band = _pre_and_band(vol_local, dims, spec.cfg)
+            region, converged = _region_grow_local(
+                pre, seeds, band, n_shards,
+                spec.cfg.grow_block_iters, spec.cfg.grow_max_iters,
+            )
+            return {
+                "original": vol_local,
+                "mask": _post_mask(region, valid, spec.cfg, n_shards),
+                "grow_converged": converged,
+            }
+
+        sharded = shard_map(
+            run,
+            mesh=spec.mesh,
+            in_specs=(spec_v, P()),
+            out_specs={
+                "original": spec_v,
+                "mask": spec_v,
+                "grow_converged": P(),
+            },
+            check_vma=False,
         )
-        return {
-            "original": vol_local,
-            "mask": _post_mask(region, valid, cfg, n_shards),
-            "grow_converged": converged,
-        }
+        return hub_jit(sharded)
 
-    sharded = jax.shard_map(
-        run,
-        mesh=mesh,
-        in_specs=(spec_v, P()),
-        out_specs={"original": spec_v, "mask": spec_v, "grow_converged": P()},
-        check_vma=False,
+    return get_hub().get(
+        CompileSpec(name="zshard_volume", cfg=cfg, mesh=mesh), build
     )
-    return jax.jit(sharded)
 
 
 def _region_grow_local_batch(
@@ -243,49 +265,57 @@ def _region_grow_local_batch(
     return region, cur == prev
 
 
-@functools.lru_cache(maxsize=8)
 def _compiled_batch_zsharded(mesh: Mesh, cfg: PipelineConfig):
     """Batched twin over a ('data', 'z') 2D mesh: a COHORT of long series at
     once — volumes sharded over 'data', each volume's planes over 'z'. The
     halo ppermutes ride the 'z' rings only; the 'data' axis communicates
     exactly one scalar per convergence check (the loop-uniformity bit, see
     :func:`_region_grow_local_batch`), which is exactly the layout a 2D
-    torus wants."""
-    n_shards = mesh.shape[AXIS]
-    spec_v = P("data", AXIS, None, None)
+    torus wants. Compiled and cached through the hub like every other
+    mesh program."""
 
-    def run(vol_local: jax.Array, dims_local: jax.Array) -> Dict[str, jax.Array]:
-        # vol_local: (b_local, d_local, H, W). The pure front/back halves
-        # are the single-volume helpers under vmap; only the growing loop
-        # is batch-aware (see _region_grow_local_batch for why it cannot
-        # simply be vmapped).
-        pre, seeds, valid, band = jax.vmap(
-            lambda v, d: _pre_and_band(v, d, cfg)
-        )(vol_local, dims_local)
-        region, converged = _region_grow_local_batch(
-            pre, seeds, band, n_shards, cfg.grow_block_iters, cfg.grow_max_iters
-        )
-        mask = jax.vmap(lambda r, v: _post_mask(r, v, cfg, n_shards))(
-            region, valid
-        )
-        return {
-            "original": vol_local,
-            "mask": mask,
-            "grow_converged": converged,
-        }
+    def build(spec: CompileSpec):
+        n_shards = spec.mesh.shape[AXIS]
+        spec_v = P("data", AXIS, None, None)
+        cfg = spec.cfg
 
-    sharded = jax.shard_map(
-        run,
-        mesh=mesh,
-        in_specs=(spec_v, P("data", None)),
-        out_specs={
-            "original": spec_v,
-            "mask": spec_v,
-            "grow_converged": P("data"),
-        },
-        check_vma=False,
+        def run(vol_local: jax.Array, dims_local: jax.Array) -> Dict[str, jax.Array]:
+            # vol_local: (b_local, d_local, H, W). The pure front/back halves
+            # are the single-volume helpers under vmap; only the growing loop
+            # is batch-aware (see _region_grow_local_batch for why it cannot
+            # simply be vmapped).
+            pre, seeds, valid, band = jax.vmap(
+                lambda v, d: _pre_and_band(v, d, cfg)
+            )(vol_local, dims_local)
+            region, converged = _region_grow_local_batch(
+                pre, seeds, band, n_shards,
+                cfg.grow_block_iters, cfg.grow_max_iters,
+            )
+            mask = jax.vmap(lambda r, v: _post_mask(r, v, cfg, n_shards))(
+                region, valid
+            )
+            return {
+                "original": vol_local,
+                "mask": mask,
+                "grow_converged": converged,
+            }
+
+        sharded = shard_map(
+            run,
+            mesh=spec.mesh,
+            in_specs=(spec_v, P("data", None)),
+            out_specs={
+                "original": spec_v,
+                "mask": spec_v,
+                "grow_converged": P("data"),
+            },
+            check_vma=False,
+        )
+        return hub_jit(sharded)
+
+    return get_hub().get(
+        CompileSpec(name="zshard_volume_batch", cfg=cfg, mesh=mesh), build
     )
-    return jax.jit(sharded)
 
 
 def process_volume_zsharded(

@@ -1,23 +1,28 @@
-"""Dynamic batcher: coalesce in-flight requests into warm-bucket batches.
+"""Dynamic batcher: coalesce in-flight requests, fan out across lanes.
 
 The TPU pipeline is vmapped and compiled per batch shape; a single-slice
-request uses a sliver of the chip. The batcher closes that gap the way
+request uses a sliver of one chip. The batcher closes that gap the way
 continuous-batching servers do (PAPERS.md — Orca/vLLM insight, applied to
 a fixed-shape vision pipeline): requests that arrive within one short wait
-window ride the SAME executable call, padded up to the smallest warm
-bucket. Under load, batches fill to the cap and the window never waits;
-at low load, a request waits at most ``max_wait_s`` before running alone —
-the standard latency/throughput knob.
+window coalesce, then split into per-lane chunks that ride the compile
+hub's per-chip executables CONCURRENTLY — the sharded serving fleet.
+Under load, the window fills to ``lanes x largest bucket`` and every chip
+computes a full bucket at once; at low load, a request waits at most
+``max_wait_s`` before running alone on one lane — the standard
+latency/throughput knob, now multiplied by chips.
 
-One batcher thread owns all device dispatch. That is a design choice, not
-a limitation: the pipeline saturates a single accelerator per batch, so a
-second in-flight batch would only queue behind the first on the device
-stream — keeping dispatch single-threaded makes supervision (PR 3) and
-accounting trivially race-free while costing nothing.
+One batcher thread still owns the admission queue (coalescing needs one
+consumer); device dispatch is no longer single-threaded — each coalesced
+batch's chunks run on a lane-sized worker pool, one supervised dispatch
+per lane, and the batcher waits for the slowest chunk before popping the
+next window. With one lane this degenerates to exactly the PR-4 behavior:
+no pool, inline dispatch, identical accounting.
 """
 
 from __future__ import annotations
 
+import concurrent.futures as cf
+import math
 import threading
 import time
 from typing import List, Optional
@@ -59,26 +64,65 @@ class DynamicBatcher:
         self.queue = queue
         self.executor = executor
         self.max_wait_s = float(max_wait_s)
-        self.max_batch = int(max_batch or executor.max_batch)
-        if self.max_batch > executor.max_batch:
-            raise ValueError(
-                f"max_batch {self.max_batch} exceeds the largest warm "
-                f"bucket {executor.max_batch}"
-            )
+        # None = lane-unaware executor (tests' fakes): single-lane semantics
+        self._lane_aware = hasattr(executor, "lane_count")
+        self.max_batch = int(max_batch) if max_batch else None
+        self._validate_max_batch()
         self.obs = obs
         self._thread = threading.Thread(
             target=self._run, name="nm03-serve-batcher", daemon=True
         )
+        # lane worker pool, created on first multi-chunk batch (a 1-lane
+        # process never pays the threads)
+        self._pool: Optional[cf.ThreadPoolExecutor] = None
         # written by the batcher thread, read by handler threads via
         # stats() (the /readyz status payload) — lock-guarded (NM331)
         self._lock = threading.Lock()
-        self._stats = {"batches": 0, "requests": 0, "max_coalesced": 0}
+        self._stats = {
+            "batches": 0,
+            "requests": 0,
+            "max_coalesced": 0,
+            "lane_batches": {},
+        }
         # nm03-lint: disable=NM331 written by the owner thread before _thread.start() and read only from that same thread in join(); the Thread.start() fence orders it for the batcher thread
         self._started = False
 
     # -- lifecycle ---------------------------------------------------------
 
+    def _validate_max_batch(self) -> None:
+        """Reject an explicit ``max_batch`` above the fleet's capacity.
+
+        Runs at construction AND again at :meth:`start`: on the normal
+        server path the lane count is still unresolved when the batcher is
+        built (resolving it would initialize a backend in ``__init__``),
+        but by ``start()`` warmup has resolved it — so an operator typo
+        like ``--max-batch 64`` on a 1-chip host fails fast at startup
+        (the PR-4 contract), never silently clamps.
+        """
+        if self.max_batch is None:
+            return
+        lanes_known = (
+            getattr(self.executor, "lane_count", None)
+            if self._lane_aware
+            else 1
+        )
+        if not lanes_known:
+            return  # lanes unresolved: start() re-validates
+        fleet = self.executor.max_batch * lanes_known
+        if self.max_batch > fleet:
+            if lanes_known == 1 and not self._lane_aware:
+                raise ValueError(
+                    f"max_batch {self.max_batch} exceeds the largest warm "
+                    f"bucket {self.executor.max_batch}"
+                )
+            raise ValueError(
+                f"max_batch {self.max_batch} exceeds the fleet capacity "
+                f"{fleet} ({lanes_known} lane(s) x largest warm bucket "
+                f"{self.executor.max_batch})"
+            )
+
     def start(self) -> "DynamicBatcher":
+        self._validate_max_batch()  # lanes are resolved by now (warmup ran)
         # nm03-lint: disable=NM331 owner-thread write, sequenced before _thread.start(); see __init__
         self._started = True
         self._thread.start()
@@ -95,19 +139,40 @@ class DynamicBatcher:
     def alive(self) -> bool:
         return self._thread.is_alive()
 
+    def lanes(self) -> int:
+        """The lane count dispatch fans out over (1 until lanes resolve)."""
+        if not self._lane_aware:
+            return 1
+        return self.executor.lane_count or 1
+
+    def effective_max_batch(self) -> int:
+        """The coalescing window's cap: fleet capacity, or the explicit
+        ``max_batch`` when smaller. Computed per window because the lane
+        count resolves at warmup, after this object is constructed."""
+        fleet = self.executor.max_batch * self.lanes()
+        if self.max_batch is not None:
+            return min(self.max_batch, fleet)
+        return fleet
+
     def stats(self) -> dict:
-        """Cumulative dispatch accounting (batches, riders, max coalesce).
+        """Cumulative dispatch accounting (batches, riders, max coalesce,
+        per-lane device batches).
 
         Served in the ``/readyz`` status payload: the mean riders-per-batch
-        (requests/batches) is the one number that says whether the batching
-        window is actually coalescing under current traffic.
+        (requests/batches) says whether the batching window is coalescing,
+        and ``lane_batches`` growing on every lane (not just "0") is the
+        fan-out evidence under current traffic.
         """
         with self._lock:
-            return dict(self._stats)
+            out = dict(self._stats)
+            out["lane_batches"] = dict(self._stats["lane_batches"])
+            return out
 
     def _run(self) -> None:
         while True:
-            batch = self.queue.get_batch(self.max_batch, self.max_wait_s)
+            batch = self.queue.get_batch(
+                self.effective_max_batch(), self.max_wait_s
+            )
             if not batch:  # closed and empty: drain complete
                 return
             try:
@@ -141,12 +206,56 @@ class DynamicBatcher:
             dims[i] = (h, w)
         return pixels, dims
 
+    def _chunk(self, reqs: List[ServeRequest]) -> List[List[ServeRequest]]:
+        """Split one coalesced window into per-lane device chunks.
+
+        Chunk size is the smallest warm bucket holding an even share
+        (``ceil(len/lanes)``): 12 requests over 8 lanes ride 6 chunks of
+        bucket 2 — wide fan-out, minimal padding waste — while 128 over 8
+        fill every lane's largest bucket.
+        """
+        lanes = self.lanes()
+        per = max(1, math.ceil(len(reqs) / lanes))
+        per = self.executor.bucket_for(min(per, self.executor.max_batch))
+        return [reqs[i : i + per] for i in range(0, len(reqs), per)]
+
+    def _execute_chunk(self, reqs: List[ServeRequest], lane: int) -> None:
+        """Run one chunk on one lane and answer its riders."""
+        pixels, dims = self.pad_batch(reqs)
+        try:
+            if self._lane_aware:
+                mask_b, conv_b = self.executor.run_batch(pixels, dims, lane=lane)
+            else:
+                mask_b, conv_b = self.executor.run_batch(pixels, dims)
+        except BaseException as e:  # noqa: BLE001 — per-chunk containment
+            # the PR-3 ladder is exhausted (deterministic failure, or
+            # degraded with --no-fallback-cpu): every rider of THIS chunk
+            # fails with the same cause; the HTTP layer maps it to a 500.
+            # Sibling chunks on other lanes are unaffected.
+            log.warning(
+                "serve dispatch failed for %d request(s) on lane %d: %s",
+                len(reqs), lane, e,
+            )
+            for r in reqs:
+                r.fail(e)
+            return
+        for i, r in enumerate(reqs):
+            h, w = r.dims
+            # run_batch already fetched host-side arrays inside the
+            # supervised primary; these asarray calls are zero-copy crops
+            # nm03-lint: disable=NM322 mask_b/conv_b are host ndarrays (fetched under supervision in WarmExecutor.run_batch); no device sync happens here
+            r.mask = np.asarray(mask_b[i][:h, :w])
+            r.converged = bool(np.asarray(conv_b[i]))  # nm03-lint: disable=NM322 host ndarray, see above
+            r.batch_size = len(reqs)
+            r.done.set()
+
     def execute(self, reqs: List[ServeRequest]) -> None:
-        """Run one coalesced batch and answer every request in it."""
+        """Run one coalesced window — fanned across lanes — and answer it."""
         now = time.monotonic()
         reg = self.obs.registry if self.obs is not None else None
         for r in reqs:
             r.queue_wait_s = max(now - r.t_admitted, 0.0)
+        chunks = self._chunk(reqs)
         if reg is not None:
             wait_h = reg.histogram(
                 SERVING_QUEUE_WAIT_SECONDS,
@@ -163,30 +272,31 @@ class DynamicBatcher:
             reg.counter(
                 SERVING_BATCHES_TOTAL,
                 help="device batches dispatched by the serving batcher",
-            ).inc()
+            ).inc(len(chunks))
+        lanes = self.lanes()
         with self._lock:
-            self._stats["batches"] += 1
+            self._stats["batches"] += len(chunks)
             self._stats["requests"] += len(reqs)
             self._stats["max_coalesced"] = max(
                 self._stats["max_coalesced"], len(reqs)
             )
-        pixels, dims = self.pad_batch(reqs)
-        try:
-            mask_b, conv_b = self.executor.run_batch(pixels, dims)
-        except BaseException as e:  # noqa: BLE001 — per-batch containment
-            # the PR-3 ladder is exhausted (deterministic failure, or
-            # degraded with --no-fallback-cpu): every rider fails with the
-            # same cause; the HTTP layer maps it to a 500
-            log.warning("serve dispatch failed for %d request(s): %s", len(reqs), e)
-            for r in reqs:
-                r.fail(e)
+            for ci in range(len(chunks)):
+                lane_key = str(ci % lanes)
+                self._stats["lane_batches"][lane_key] = (
+                    self._stats["lane_batches"].get(lane_key, 0) + 1
+                )
+        if len(chunks) == 1:
+            self._execute_chunk(chunks[0], 0)
             return
-        for i, r in enumerate(reqs):
-            h, w = r.dims
-            # run_batch already fetched host-side arrays inside the
-            # supervised primary; these asarray calls are zero-copy crops
-            # nm03-lint: disable=NM322 mask_b/conv_b are host ndarrays (fetched under supervision in WarmExecutor.run_batch); no device sync happens here
-            r.mask = np.asarray(mask_b[i][:h, :w])
-            r.converged = bool(np.asarray(conv_b[i]))  # nm03-lint: disable=NM322 host ndarray, see above
-            r.batch_size = len(reqs)
-            r.done.set()
+        with self._lock:
+            if self._pool is None:
+                self._pool = cf.ThreadPoolExecutor(
+                    max_workers=lanes, thread_name_prefix="nm03-serve-lane"
+                )
+            pool = self._pool
+        futures = [
+            pool.submit(self._execute_chunk, chunk, ci % lanes)
+            for ci, chunk in enumerate(chunks)
+        ]
+        for f in futures:
+            f.result()  # _execute_chunk never raises; this is the barrier

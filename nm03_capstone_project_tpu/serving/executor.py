@@ -1,20 +1,30 @@
-"""Warm per-bucket executables behind the PR-3 dispatch supervision.
+"""Warm per-bucket, per-lane executables behind the PR-3 dispatch supervision.
 
 The r05 bench showed per-batch dispatch overhead — not device FLOPs — is
 what a cold path pays on every call: tracing, compilation, and executable
 lookup all sit between an arriving request and the chip. An online service
-cannot amortize that over a cohort, so this executor compiles ONE
-executable per batch-size bucket at startup (``warmup``) and serve-time
-dispatch is a dictionary lookup plus an XLA execute — the always-warm
-model that makes dynamic batching worth doing at all.
+cannot amortize that over a cohort, so this executor warms ONE executable
+per (replica lane, batch-size bucket) at startup and serve-time dispatch
+is a registry lookup plus an XLA execute — the always-warm model that
+makes dynamic batching worth doing at all.
 
-Supervision is inherited, not reimplemented: every batch dispatch runs
+**Replica lanes** are the sharded-serving unlock (ROADMAP item 1): every
+local device becomes a lane, each lane holds its own compile-hub
+executables pinned to its chip (``SingleDeviceSharding``), and the
+batcher fans coalesced batches out across lanes so capacity scales with
+chips, not processes. One device degenerates to exactly the PR-4
+single-executable behavior. Compilation itself lives in
+:mod:`nm03_capstone_project_tpu.compilehub` — this class holds no compile
+cache of its own, only lane state.
+
+Supervision is inherited, not reimplemented: every lane dispatch runs
 through the PR-3 :class:`DispatchSupervisor`, so online traffic gets the
 same deadline guard, transient-error retry, and one-way CPU degradation
-as the batch drivers — a wedged accelerator turns into slower responses
-and a not-ready ``/readyz``, never a hung service. The CPU fallback
-recomputes from the host arrays the batcher already holds (fetching from
-a wedged device would BE the wedge).
+as the batch drivers. Degradation is process-wide by design: the CPU
+fallback serves every lane's traffic (correct-but-slower), ``/readyz``
+flips not-ready, and the load balancer drains the whole replica — a
+single sick chip is not worth per-lane triage inside one process (see
+docs/OPERATIONS.md, "Multi-chip serving").
 """
 
 from __future__ import annotations
@@ -22,10 +32,11 @@ from __future__ import annotations
 import itertools
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from nm03_capstone_project_tpu.compilehub import programs
 from nm03_capstone_project_tpu.config import PipelineConfig
 from nm03_capstone_project_tpu.resilience import (
     DispatchSupervisor,
@@ -34,17 +45,24 @@ from nm03_capstone_project_tpu.resilience import (
     ResilienceConfig,
     execute_hang,
 )
+from nm03_capstone_project_tpu.serving.metrics import (
+    SERVING_LANE_BATCHES_TOTAL,
+    SERVING_LANE_INFLIGHT,
+    SERVING_LANES_READY,
+)
 
 DEFAULT_BUCKETS: Tuple[int, ...] = (1, 2, 4, 8, 16)
 
 
 class WarmExecutor:
-    """One compiled ``slice_pipeline`` executable per (batch-bucket, config).
+    """Per-lane, per-bucket warm ``slice_pipeline`` executables.
 
     ``buckets`` is the ascending list of batch sizes an executable exists
-    for; a coalesced batch is padded up to the smallest bucket that fits
+    for; a coalesced chunk is padded up to the smallest bucket that fits
     (:meth:`bucket_for`), so the compile-shape set is fixed at startup and
-    serve-time traffic can never trigger a recompile stall.
+    serve-time traffic can never trigger a recompile stall. ``lanes``
+    caps the replica-lane count (None = every local device, resolved
+    lazily so constructing the executor never initializes a backend).
     """
 
     def __init__(
@@ -54,6 +72,7 @@ class WarmExecutor:
         resilience: Optional[ResilienceConfig] = None,
         obs=None,
         fault_plan: Optional[FaultPlan] = None,
+        lanes: Optional[int] = None,
     ):
         if not buckets or list(buckets) != sorted(set(int(b) for b in buckets)):
             raise ValueError(
@@ -61,6 +80,8 @@ class WarmExecutor:
             )
         if any(b < 1 for b in buckets):
             raise ValueError(f"buckets must be >= 1, got {buckets}")
+        if lanes is not None and lanes < 1:
+            raise ValueError(f"lanes must be >= 1 (or None = all), got {lanes}")
         self.cfg = cfg
         self.buckets: Tuple[int, ...] = tuple(int(b) for b in buckets)
         self.obs = obs
@@ -71,22 +92,83 @@ class WarmExecutor:
         )
         retry.obs = obs
         self.supervisor = DispatchSupervisor(self.res, retry=retry, obs=obs)
-        self._compiled: Dict[int, object] = {}
         self._fallback_fn = None
         self._lock = threading.Lock()
         self._dispatch_seq = itertools.count()
         self._warm = False
+        self._requested_lanes = lanes
+        self._lane_devices: Optional[List] = None
+        self._lane_warm: List[bool] = []
+        self._lane_inflight: List[int] = []
+        self._lane_batches: List[int] = []
+
+    # -- lanes -------------------------------------------------------------
+
+    def _resolve_lanes(self) -> List:
+        """The lane device list, resolving (and initializing jax) once."""
+        with self._lock:
+            if self._lane_devices is not None:
+                return self._lane_devices
+        devs = programs.lane_devices(self._requested_lanes)
+        with self._lock:
+            if self._lane_devices is None:
+                self._lane_devices = devs
+                self._lane_warm = [self._warm] * len(devs)
+                self._lane_inflight = [0] * len(devs)
+                self._lane_batches = [0] * len(devs)
+            return self._lane_devices
+
+    @property
+    def lane_count(self) -> Optional[int]:
+        """Resolved lane count; the requested cap before resolution (None
+        = unknown until a backend exists)."""
+        with self._lock:
+            if self._lane_devices is not None:
+                return len(self._lane_devices)
+        return self._requested_lanes
+
+    @property
+    def lanes_ready(self) -> int:
+        """Warm lanes — the ``serving_lanes_ready`` gauge's value."""
+        with self._lock:
+            if self._lane_devices is not None:
+                return sum(1 for w in self._lane_warm if w)
+            return (self._requested_lanes or 1) if self._warm else 0
+
+    def lane_state(self) -> List[dict]:
+        """Per-lane readiness/inflight/dispatch state (the ``/readyz``
+        ``lanes.per_lane`` payload); [] before lane resolution."""
+        with self._lock:
+            if self._lane_devices is None:
+                return []
+            return [
+                {
+                    "lane": i,
+                    "device": str(d),
+                    "warm": self._lane_warm[i],
+                    "inflight": self._lane_inflight[i],
+                    "batches": self._lane_batches[i],
+                }
+                for i, d in enumerate(self._lane_devices)
+            ]
+
+    def _set_lanes_ready_gauge(self) -> None:
+        if self.obs is not None:
+            self.obs.registry.gauge(
+                SERVING_LANES_READY,
+                help="warm replica lanes (chips) in this serving process",
+            ).set(self.lanes_ready)
 
     # -- state -------------------------------------------------------------
 
     @property
     def warm(self) -> bool:
-        """True once every bucket's executable is built and executed.
+        """True once every lane's every bucket is built and executed.
 
         Read by handler threads (via ``/readyz``) while ``warmup`` runs on
         the startup thread; the write is lock-guarded (nm03-lint NM331) so
-        a reader observing True also observes the fully-populated
-        ``_compiled`` dict, not just the flag.
+        a reader observing True also observes the fully-populated lane
+        registry, not just the flag.
         """
         with self._lock:
             return self._warm
@@ -95,6 +177,9 @@ class WarmExecutor:
     def warm(self, value: bool) -> None:
         with self._lock:
             self._warm = bool(value)
+            if self._lane_devices is not None:
+                for i in range(len(self._lane_warm)):
+                    self._lane_warm[i] = bool(value)
 
     @property
     def degraded(self) -> bool:
@@ -118,75 +203,59 @@ class WarmExecutor:
             f"batch of {n} exceeds the largest bucket {self.buckets[-1]}"
         )
 
-    # -- compilation -------------------------------------------------------
+    # -- compilation (delegated to the compile hub) ------------------------
 
-    def _build(self, bucket: int):
-        """Compile the mask-only vmapped pipeline for one bucket shape.
+    def _get_compiled(self, bucket: int, lane: int = 0):
+        """The (lane, bucket) executable from the hub's registry.
 
-        AOT (``jit(...).lower(...).compile()``) so the executable exists
-        the moment warmup returns — serve-time calls never trace. Falls
-        back to a plain jitted callable (first call compiles) on backends
-        where AOT lowering is unavailable.
+        AOT lowered+compiled at the bucket shape and pinned to the lane's
+        device; the hub caches, so two executors with one config share
+        warm executables and a post-warmup call here is a dict lookup.
         """
-        import jax
-        import jax.numpy as jnp
+        devs = self._resolve_lanes()
+        if not 0 <= lane < len(devs):
+            raise ValueError(f"lane {lane} outside [0, {len(devs)})")
+        return programs.serve_mask(self.cfg, bucket=bucket, device=devs[lane])
 
-        from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
+    def warmup(self) -> Dict[str, Dict[int, float]]:
+        """Compile + execute every (lane, bucket) once; nested timings.
 
-        cfg = self.cfg
-
-        def one(px, dm):
-            out = process_slice(px, dm, cfg)
-            return out["mask"], out["grow_converged"]
-
-        # no donation: a supervised retry re-runs the primary with the SAME
-        # host arrays, and serving's per-batch HBM footprint is tiny
-        fn = jax.jit(jax.vmap(one))
-        c = cfg.canvas
-        try:
-            return fn.lower(
-                jax.ShapeDtypeStruct((bucket, c, c), jnp.float32),
-                jax.ShapeDtypeStruct((bucket, 2), jnp.int32),
-            ).compile()
-        except Exception:  # noqa: BLE001 — AOT is an optimization, not a contract
-            return fn
-
-    def _get_compiled(self, bucket: int):
-        with self._lock:
-            fn = self._compiled.get(bucket)
-        if fn is not None:
-            return fn
-        fn = self._build(bucket)
-        with self._lock:
-            self._compiled.setdefault(bucket, fn)
-            return self._compiled[bucket]
-
-    def warmup(self) -> Dict[int, float]:
-        """Compile + execute every bucket once; {bucket: seconds}.
-
-        The execute (on zeros) is part of warmup on purpose: first-run
-        allocator/executable setup must be paid here, behind ``/readyz``,
-        not by the first unlucky request.
+        Returns ``{"lane0": {bucket: seconds}, ...}``. The execute (on
+        zeros) is part of warmup on purpose: first-run allocator and
+        executable setup must be paid here, behind ``/readyz``, not by the
+        first unlucky request. Lanes warm in order and the
+        ``serving_lanes_ready`` gauge rises as each completes, so a probe
+        mid-warmup sees honest partial readiness.
         """
         c = self.cfg.canvas
-        timings: Dict[int, float] = {}
-        for b in self.buckets:
-            t0 = time.perf_counter()
-            fn = self._get_compiled(b)
-            px = np.zeros((b, c, c), np.float32)
-            dm = np.full((b, 2), self.cfg.min_dim, np.int32)
-            mask, conv = fn(px, dm)
-            np.asarray(mask), np.asarray(conv)  # block until executed
-            timings[b] = round(time.perf_counter() - t0, 3)
+        devs = self._resolve_lanes()
+        timings: Dict[str, Dict[int, float]] = {}
+        for lane in range(len(devs)):
+            lane_t: Dict[int, float] = {}
+            for b in self.buckets:
+                t0 = time.perf_counter()
+                fn = self._get_compiled(b, lane)
+                px = np.zeros((b, c, c), np.float32)
+                dm = np.full((b, 2), self.cfg.min_dim, np.int32)
+                mask, conv = fn(px, dm)
+                np.asarray(mask), np.asarray(conv)  # block until executed
+                lane_t[b] = round(time.perf_counter() - t0, 3)
+            timings[f"lane{lane}"] = lane_t
+            with self._lock:
+                self._lane_warm[lane] = True
+            self._set_lanes_ready_gauge()
         if self.obs is not None:
-            for b, s in timings.items():
-                self.obs.registry.gauge(
-                    "serving_warmup_seconds",
-                    help="startup compile+first-execute time per batch bucket",
-                    bucket=str(b),
-                ).set(s)
+            for lane_key, lane_t in timings.items():
+                for b, s in lane_t.items():
+                    self.obs.registry.gauge(
+                        "serving_warmup_seconds",
+                        help="startup compile+first-execute time per lane and batch bucket",
+                        bucket=str(b),
+                        lane=lane_key[len("lane"):],
+                    ).set(s)
         # nm03-lint: disable=NM331 goes through the lock-guarded property setter above; the linter cannot see through the descriptor
         self.warm = True
+        self._set_lanes_ready_gauge()
         return timings
 
     # -- degradation target ------------------------------------------------
@@ -194,9 +263,11 @@ class WarmExecutor:
     def _fallback_call(self):
         """CPU recompute of the same batch from host arrays (PR-3 ladder).
 
-        One jitted callable shared across buckets — XLA retraces per bucket
-        shape, which is acceptable on the degraded path (correct-but-slower
-        is the contract; the service flips not-ready either way).
+        One deferred-trace hub program shared across buckets and lanes —
+        XLA retraces per bucket shape, which is acceptable on the degraded
+        path (correct-but-slower is the contract; the service flips
+        not-ready either way, and every lane funnels here: a wedged chip
+        drains the replica, it does not get per-lane triage).
         """
         with self._lock:
             if self._fallback_fn is not None:
@@ -205,20 +276,13 @@ class WarmExecutor:
 
         import jax
 
-        from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
-
         cpu = jax.local_devices(backend="cpu")[0]
         cfg = (
             dataclasses.replace(self.cfg, use_pallas=False)
             if self.cfg.use_pallas
             else self.cfg
         )
-
-        def one(px, dm):
-            out = process_slice(px, dm, cfg)
-            return out["mask"], out["grow_converged"]
-
-        inner = jax.jit(jax.vmap(one))
+        inner = programs.serve_mask(cfg)  # deferred-trace, default device
 
         def call(px, dm):
             with jax.default_device(cpu):
@@ -258,18 +322,30 @@ class WarmExecutor:
 
     # -- the serve-time entry point ----------------------------------------
 
-    def run_batch(self, pixels: np.ndarray, dims: np.ndarray):
-        """Execute one bucket-padded batch under supervision.
+    def run_batch(self, pixels: np.ndarray, dims: np.ndarray, lane: int = 0):
+        """Execute one bucket-padded batch on one lane, under supervision.
 
         ``pixels`` is (bucket, canvas, canvas) float32, ``dims`` (bucket, 2)
-        int32 — already padded by the batcher. Returns host-side
+        int32 — already padded by the batcher; ``lane`` picks the replica
+        lane whose pinned executable (and chip) runs it. Returns host-side
         ``(mask, converged)`` arrays. Raises only when the PR-3 ladder is
         exhausted (deterministic error, or degraded with fallback disabled);
         the batcher fails the batch's requests with it.
         """
         bucket = int(pixels.shape[0])
-        fn = self._get_compiled(bucket)
+        fn = self._get_compiled(bucket, lane)
         index = next(self._dispatch_seq)
+        reg = self.obs.registry if self.obs is not None else None
+        if reg is not None:
+            inflight_g = reg.gauge(
+                SERVING_LANE_INFLIGHT,
+                help="device batches in flight per replica lane",
+                lane=str(lane),
+            )
+            inflight_g.inc()
+        with self._lock:
+            if lane < len(self._lane_inflight):
+                self._lane_inflight[lane] += 1
 
         def primary():
             # fetch INSIDE the supervised call: a wedged fetch is the same
@@ -280,9 +356,26 @@ class WarmExecutor:
         def fallback():
             return self._fallback_call()(pixels, dims)
 
-        return self.supervisor.run(
-            primary,
-            fallback=fallback,
-            pre=self._pre(index),
-            label="serve_dispatch",
-        )
+        try:
+            out = self.supervisor.run(
+                primary,
+                fallback=fallback,
+                pre=self._pre(index),
+                label="serve_dispatch",
+            )
+        finally:
+            if reg is not None:
+                inflight_g.dec()
+            with self._lock:
+                if lane < len(self._lane_inflight):
+                    self._lane_inflight[lane] -= 1
+        with self._lock:
+            if lane < len(self._lane_batches):
+                self._lane_batches[lane] += 1
+        if reg is not None:
+            reg.counter(
+                SERVING_LANE_BATCHES_TOTAL,
+                help="device batches dispatched per replica lane",
+                lane=str(lane),
+            ).inc()
+        return out

@@ -208,6 +208,36 @@ def run_load(
     return result.summary(time.monotonic() - t_start, mode)
 
 
+def probe_server_topology(url: str, timeout_s: float = 5.0) -> dict:
+    """Best-effort ``/readyz`` probe for the serving topology fields.
+
+    Returns ``{lanes, mesh_shape, buckets, degraded}`` (values None when
+    the server is unreachable or predates the fleet fields). The body is
+    parsed whatever the status code — a draining or degraded server still
+    reports its shape, and the loadgen record must carry the topology the
+    measurement actually ran against (the bench-evidence honesty contract,
+    extended to serving: a p99 from one lane must not masquerade as an
+    8-chip number).
+    """
+    out = {"lanes": None, "mesh_shape": None, "buckets": None, "degraded": None}
+    req = urllib.request.Request(f"{url}/readyz", method="GET")
+    try:
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                body = resp.read()
+        except urllib.error.HTTPError as e:  # 503 still carries the payload
+            body = e.read()
+        st = json.loads(body or b"{}")
+    except Exception:  # noqa: BLE001 — a probe failure must not fail the run
+        return out
+    lanes = (st.get("lanes") or {}).get("count")
+    out["lanes"] = lanes
+    out["mesh_shape"] = st.get("mesh_shape")
+    out["buckets"] = st.get("buckets")
+    out["degraded"] = st.get("degraded")
+    return out
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="nm03-loadgen", description=__doc__.strip().splitlines()[0]
@@ -291,6 +321,12 @@ def main(argv=None) -> int:
         args.timeout_s,
     )
     summary["endpoint"] = endpoint
+    # serving topology alongside the numbers (mesh_shape/lanes ride next to
+    # the drivers' backend_requested/backend_actual honesty pair): probed
+    # from the live server so the record describes what actually served
+    topo = probe_server_topology(url, timeout_s=args.timeout_s)
+    summary["lanes"] = topo["lanes"]
+    summary["mesh_shape"] = topo["mesh_shape"]
     if args.self_serve and app is not None:
         app.begin_drain(reason="loadgen_done")
         httpd.shutdown()

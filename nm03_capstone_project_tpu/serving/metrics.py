@@ -19,11 +19,18 @@ SERVING_REQUESTS_TOTAL = "serving_requests_total"
 SERVING_SHED_TOTAL = "serving_shed_total"
 # dispatched device batches (post-coalescing; requests/batches = mean batch)
 SERVING_BATCHES_TOTAL = "serving_batches_total"
+# device batches per replica lane ({lane}): the fan-out evidence — under
+# load every lane's series grows, not just lane 0's
+SERVING_LANE_BATCHES_TOTAL = "serving_lane_batches_total"
 
 # -- gauges -----------------------------------------------------------------
 SERVING_INFLIGHT = "serving_inflight"  # admitted, not yet responded
 SERVING_READY = "serving_ready"  # 1 = warmed + admitting, 0 otherwise
 SERVING_DEGRADED = "serving_degraded"  # 1 = one-way CPU degradation tripped
+# warm replica lanes (chips): rises lane-by-lane through warmup; the
+# multi-chip readiness signal check_telemetry's --expect-gauge asserts
+SERVING_LANES_READY = "serving_lanes_ready"
+SERVING_LANE_INFLIGHT = "serving_lane_inflight"  # {lane}: batches in flight
 
 # -- histograms -------------------------------------------------------------
 SERVING_QUEUE_WAIT_SECONDS = "serving_queue_wait_seconds"

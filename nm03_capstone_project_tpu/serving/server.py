@@ -89,6 +89,7 @@ class ServingApp:
         resilience=None,
         fault_plan=None,
         obs=None,
+        lanes: Optional[int] = None,
     ):
         from nm03_capstone_project_tpu.obs import RunContext
 
@@ -101,6 +102,7 @@ class ServingApp:
             resilience=resilience,
             obs=self.obs,
             fault_plan=fault_plan,
+            lanes=lanes,
         )
         self.batcher = DynamicBatcher(
             self.queue,
@@ -129,6 +131,7 @@ class ServingApp:
         self.obs.events.emit(
             "serving_ready",
             buckets=list(self.executor.buckets),
+            lanes=self.executor.lane_count,
             warmup_s=timings,
         )
         return timings
@@ -140,6 +143,9 @@ class ServingApp:
         )
 
     def status(self) -> dict:
+        from nm03_capstone_project_tpu.compilehub import get_hub
+
+        lane_count = self.executor.lane_count
         return {
             "ready": self.ready,
             "warm": self.executor.warm,
@@ -150,6 +156,15 @@ class ServingApp:
             "queue_capacity": self.queue.capacity,
             "buckets": list(self.executor.buckets),
             "batcher": self.batcher.stats(),
+            # the sharded fleet: per-lane warm/inflight state, the replica
+            # mesh shape, and the compile hub's registry accounting
+            "lanes": {
+                "count": lane_count,
+                "ready": self.executor.lanes_ready,
+                "per_lane": self.executor.lane_state(),
+            },
+            "mesh_shape": [lane_count] if lane_count else None,
+            "compile_hub": get_hub().stats(),
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }
 
@@ -515,6 +530,16 @@ def build_parser() -> argparse.ArgumentParser:
         "executable; a coalesced batch pads to the smallest that fits)",
     )
     g.add_argument(
+        "--lanes",
+        type=int,
+        default=0,
+        metavar="N",
+        help="replica lanes (chips) this process serves across; each lane "
+        "holds its own warm per-bucket executables pinned to one device "
+        "and the batcher fans coalesced batches out over them "
+        "(0 = every local device; docs/OPERATIONS.md multi-chip runbook)",
+    )
+    g.add_argument(
         "--request-timeout-s",
         type=float,
         default=60.0,
@@ -554,6 +579,7 @@ def app_from_args(args: argparse.Namespace, obs=None) -> ServingApp:
         resilience=res,
         fault_plan=plan,
         obs=obs,
+        lanes=args.lanes or None,
     )
 
 
@@ -590,7 +616,8 @@ def main(argv=None) -> int:
         _write_port_file(args.port_file, port)
     print(
         f"nm03-serve: listening on {args.host}:{port} "
-        f"(buckets {list(app.executor.buckets)}, warmup {timings})",
+        f"(lanes {app.executor.lane_count}, buckets "
+        f"{list(app.executor.buckets)}, warmup {timings})",
         flush=True,
     )
 

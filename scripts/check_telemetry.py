@@ -45,7 +45,12 @@ metrics
   * ``--expect-histogram NAME=MINCOUNT`` (repeatable) requires the summed
     observation count across NAME's histogram series to be at least
     MINCOUNT — the serving load/chaos smoke's assertion hook (e.g.
-    ``--expect-histogram serving_queue_wait_seconds=10``).
+    ``--expect-histogram serving_queue_wait_seconds=10``);
+  * ``--expect-gauge NAME=VALUE`` (repeatable) requires the summed value
+    of NAME's gauge series to EQUAL VALUE — exact, not a floor, because
+    the gauges this asserts are topology facts (e.g.
+    ``--expect-gauge serving_lanes_ready=8``: a 7-lane fleet is a
+    degraded replica, not a lesser success).
 
 cross
   * when both artifacts are given, their run_id and git_sha must match.
@@ -223,7 +228,7 @@ def _check_histogram(where: str, rec: dict, chk: Checker) -> None:
 
 
 def check_metrics(path: str, chk: Checker, expect_counters=None,
-                  expect_histograms=None):
+                  expect_histograms=None, expect_gauges=None):
     """Validate one metrics snapshot; returns (run_id, git_sha) or None.
 
     ``expect_counters``: {name: min_total} — the summed value across NAME's
@@ -231,6 +236,8 @@ def check_metrics(path: str, chk: Checker, expect_counters=None,
     ``expect_histograms``: {name: min_count} — the summed observation count
     across NAME's histogram series must be >= min_count (and NAME must
     actually be a histogram).
+    ``expect_gauges``: {name: value} — the summed value across NAME's gauge
+    series must EQUAL value (serving-topology assertions).
     """
     try:
         with open(path) as f:
@@ -253,6 +260,7 @@ def check_metrics(path: str, chk: Checker, expect_counters=None,
     kind_by_name: dict[str, str] = {}
     seen: set[tuple] = set()
     counter_sums: dict[str, float] = {}
+    gauge_sums: dict[str, float] = {}
     histogram_counts: dict[str, int] = {}
     for j, rec in enumerate(metrics):
         where = f"{path}: metrics[{j}]"
@@ -298,10 +306,23 @@ def check_metrics(path: str, chk: Checker, expect_counters=None,
                 chk.fail(where, f"{name}: counter value {v} is negative")
             if kind == "counter" and _is_num(v):
                 counter_sums[name] = counter_sums.get(name, 0.0) + v
+            if kind == "gauge" and _is_num(v):
+                gauge_sums[name] = gauge_sums.get(name, 0.0) + v
     for name, want in sorted((expect_counters or {}).items()):
         got = counter_sums.get(name, 0.0)
         if got < want:
             chk.fail(path, f"counter {name} totals {got}, expected >= {want}")
+    for name, want in sorted((expect_gauges or {}).items()):
+        if name not in gauge_sums:
+            kind = kind_by_name.get(name)
+            if kind is not None and kind != "gauge":
+                chk.fail(path, f"{name} is a {kind}, not a gauge")
+            else:
+                chk.fail(path, f"gauge {name} absent, expected == {want}")
+            continue
+        got = gauge_sums[name]
+        if got != want:
+            chk.fail(path, f"gauge {name} totals {got}, expected == {want}")
     for name, want in sorted((expect_histograms or {}).items()):
         if name not in histogram_counts and kind_by_name.get(name) is not None:
             chk.fail(path, f"{name} is a {kind_by_name[name]}, not a histogram")
@@ -336,6 +357,12 @@ def main(argv=None) -> int:
         ">= MINCOUNT (repeatable; serving load/chaos assertions, e.g. "
         "serving_queue_wait_seconds=10)",
     )
+    ap.add_argument(
+        "--expect-gauge", action="append", default=[], metavar="NAME=VALUE",
+        help="require the summed value of gauge NAME to EQUAL VALUE "
+        "(repeatable; serving-topology assertions, e.g. "
+        "serving_lanes_ready=8)",
+    )
     args = ap.parse_args(argv)
     if not args.events and not args.metrics:
         ap.error("nothing to check: pass --events and/or --metrics")
@@ -356,6 +383,7 @@ def main(argv=None) -> int:
     expect_histograms = parse_expectations(
         args.expect_histogram, "--expect-histogram"
     )
+    expect_gauges = parse_expectations(args.expect_gauge, "--expect-gauge")
 
     chk = Checker()
     ev_ident = mt_ident = None
@@ -363,7 +391,8 @@ def main(argv=None) -> int:
         ev_ident = check_events(args.events, chk, args.expect_patients)
     if args.metrics:
         mt_ident = check_metrics(
-            args.metrics, chk, expect_counters, expect_histograms
+            args.metrics, chk, expect_counters, expect_histograms,
+            expect_gauges,
         )
     if ev_ident and mt_ident:
         if mt_ident[0] != ev_ident[0]:

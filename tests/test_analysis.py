@@ -20,6 +20,7 @@ import pytest
 
 from nm03_capstone_project_tpu.analysis import ALL_RULES, collect_files, run_rules
 from nm03_capstone_project_tpu.analysis.atomicio import check_atomic_io
+from nm03_capstone_project_tpu.analysis.compilehome import check_compile_home
 from nm03_capstone_project_tpu.analysis.contracts import check_import_contracts
 from nm03_capstone_project_tpu.analysis.core import (
     apply_baseline,
@@ -391,10 +392,16 @@ class TestThreadSharedState:
         """The acceptance drill: the REAL batcher minus its stats lock must
         fail NM331."""
         src = (REPO / PKG / "serving" / "batcher.py").read_text()
-        guarded = '        with self._lock:\n            self._stats["batches"] += 1'
+        guarded = (
+            '        with self._lock:\n'
+            '            self._stats["batches"] += len(chunks)'
+        )
         assert guarded in src
         broken = src.replace(
-            guarded, '        if True:\n            self._stats["batches"] += 1', 1
+            guarded,
+            '        if True:\n'
+            '            self._stats["batches"] += len(chunks)',
+            1,
         )
         fs = lint_tree(
             tmp_path,
@@ -547,6 +554,115 @@ class TestAtomicIo:
     def test_real_tree_atomic_clean(self):
         parsed = collect_files([REPO / PKG, REPO / "scripts"], REPO)
         fs = run_rules(parsed, (check_atomic_io,))
+        assert rules_of(fs) == [], [f.render() for f in fs]
+
+
+class TestCompileHome:
+    def test_direct_jit_reference_flagged(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/ops/thing.py": """
+                import jax
+                f = jax.jit(lambda x: x)
+                """
+            },
+            rules=(check_compile_home,),
+        )
+        assert "NM361" in rules_of(fs)
+
+    def test_import_binding_flagged(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/parallel/z.py": """
+                from jax.experimental.shard_map import shard_map
+                """
+            },
+            rules=(check_compile_home,),
+        )
+        assert "NM361" in rules_of(fs)
+
+    def test_aliased_module_attribute_flagged(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/parallel/z.py": """
+                import jax.experimental.shard_map as sm
+                g = sm.shard_map
+                """
+            },
+            rules=(check_compile_home,),
+        )
+        assert "NM361" in rules_of(fs)
+
+    def test_partial_decorator_arg_flagged(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/ops/k.py": """
+                import functools
+                import jax
+                @functools.partial(jax.jit, static_argnames=("n",))
+                def f(x, n):
+                    return x * n
+                """
+            },
+            rules=(check_compile_home,),
+        )
+        assert "NM361" in rules_of(fs)
+
+    def test_compilehub_is_the_sanctioned_home(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/compilehub/compat.py": """
+                import jax
+                from jax.experimental.shard_map import shard_map
+                p = jax.jit
+                """
+            },
+            rules=(check_compile_home,),
+        )
+        assert rules_of(fs) == []
+
+    def test_hub_consumers_clean(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/parallel/z.py": f"""
+                import jax
+                from {PKG}.compilehub import hub_jit, shard_map
+                f = hub_jit(jax.vmap(lambda x: x))
+                g = shard_map
+                """
+            },
+            rules=(check_compile_home,),
+        )
+        assert rules_of(fs) == []
+
+    def test_suppression_with_reason_honored(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/ops/k.py": """
+                import jax
+                # nm03-lint: disable=NM361 Pallas kernel wrapper: the jit is the kernel's dispatch envelope
+                f = jax.jit(lambda x: x)
+                """
+            },
+            rules=(check_compile_home,),
+        )
+        assert rules_of(fs) == []
+
+    def test_real_tree_compile_home_clean(self):
+        """The acceptance bar: zero NM361 findings outside compilehub/ on
+        the real tree (the Pallas wrappers' reasoned suppressions are the
+        only sanctioned escapes)."""
+        parsed = collect_files(
+            [REPO / PKG, REPO / "bench.py", REPO / "scripts"], REPO
+        )
+        fs = run_rules(parsed, (check_compile_home,))
         assert rules_of(fs) == [], [f.render() for f in fs]
 
 

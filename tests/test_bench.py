@@ -177,6 +177,12 @@ class TestOrchestrator:
         # touch the real results/bench_partial.json a chip run left behind
         self.partial_path = tmp_path / "bench_partial.json"
         monkeypatch.setattr(bench, "_PARTIAL_PATH", str(self.partial_path))
+        # the REAL zshard worker is a multi-minute 8-virtual-device
+        # subprocess (and actually runs now that the compile hub fixed the
+        # seed's jax.shard_map AttributeError — it used to die instantly,
+        # which is the only reason these tests ever looked fast); stub it
+        # unless a test opts back in
+        monkeypatch.setattr(bench, "_measure_zshard", lambda deadline: None)
 
     def _run_main(self, monkeypatch, capsys, accel, cpu, probe_ok=True,
                   vigil_ok=False):

@@ -256,7 +256,12 @@ class TestExecutorBuckets:
 
 @pytest.fixture(scope="module")
 def served():
-    """One warmed loopback server shared by the e2e tests (3 compiles)."""
+    """One warmed loopback server shared by the e2e tests (3 compiles).
+
+    lanes=1 on purpose: these are the single-lane regression tests (the
+    PR-4 contract must survive the fleet); the multi-lane fan-out path has
+    its own suite in tests/test_serving_lanes.py.
+    """
     from nm03_capstone_project_tpu.serving.server import ServingApp, serve_in_thread
 
     app = ServingApp(
@@ -265,6 +270,7 @@ def served():
         buckets=(1, 2, 4),
         max_wait_s=0.02,
         request_timeout_s=30.0,
+        lanes=1,
     )
     httpd, _, port = serve_in_thread(app)
     yield app, f"http://127.0.0.1:{port}"
@@ -532,6 +538,7 @@ class TestServingChaos:
                 retry_max=2, retry_backoff_s=0.01, dispatch_timeout_s=1.0
             ),
             fault_plan=plan,
+            lanes=1,  # deterministic dispatch indices for the fault plan
         )
         app.start()
         try:
@@ -577,7 +584,7 @@ class TestSigtermDrain:
                 sys.executable, "-m", "nm03_capstone_project_tpu.serving.server",
                 "--device", "cpu", "--port", "0",
                 "--port-file", str(port_file),
-                "--canvas", str(CANVAS), "--buckets", "1",
+                "--canvas", str(CANVAS), "--buckets", "1", "--lanes", "1",
                 "--max-wait-ms", "5", "--heartbeat-s", "0",
                 "--metrics-out", str(metrics), "--log-json", str(events),
             ],
@@ -665,7 +672,7 @@ class TestLoadgen:
                 "nm03_capstone_project_tpu.serving.loadgen",
                 "--self-serve",
                 "--self-serve-args",
-                f"--canvas {CANVAS} --buckets 2 --max-wait-ms 20",
+                f"--canvas {CANVAS} --buckets 2 --lanes 1 --max-wait-ms 20",
                 "--requests", "8", "--concurrency", "4", "--warmup", "1",
                 "--height", str(CANVAS), "--width", str(CANVAS),
                 "--results-json", str(results),

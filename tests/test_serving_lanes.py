@@ -1,0 +1,348 @@
+"""Sharded serving fleet tests (ISSUE 6): lanes, fan-out, per-lane state.
+
+Three layers, mirroring tests/test_serving.py's structure:
+
+* batcher fan-out against a lane-aware fake executor (no jax): chunking
+  policy, lane assignment, per-lane accounting;
+* the real ``WarmExecutor`` on the conftest's 8 virtual CPU devices:
+  per-lane warm executables, lane state, cross-lane mask equality;
+* end-to-end: an in-process multi-lane server under concurrent traffic,
+  and the acceptance subprocess — ``nm03-serve`` on a forced 8-device
+  host (mirroring tests/test_multihost.py's env discipline) serving
+  batches across all lanes, masks bit-identical to single-device, gated
+  by ``check_telemetry.py --expect-gauge serving_lanes_ready=8``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from nm03_capstone_project_tpu.config import PipelineConfig
+from nm03_capstone_project_tpu.data.synthetic import phantom_slice
+from nm03_capstone_project_tpu.serving.batcher import DynamicBatcher
+from nm03_capstone_project_tpu.serving.queue import AdmissionQueue, ServeRequest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECKER = os.path.join(REPO, "scripts", "check_telemetry.py")
+CANVAS = 128
+
+
+def _reqs(n, hw=16):
+    return [
+        ServeRequest(
+            request_id=f"r{i}",
+            pixels=np.ones((hw, hw), np.float32),
+            dims=(hw, hw),
+        )
+        for i in range(n)
+    ]
+
+
+class FakeLaneExecutor:
+    """Lane-aware executor stand-in recording (batch shape, lane) pairs."""
+
+    def __init__(self, buckets=(1, 2, 4), lanes=4, canvas=16, min_dim=4):
+        self.cfg = SimpleNamespace(canvas=canvas, min_dim=min_dim)
+        self.buckets = tuple(buckets)
+        self.lane_count = lanes
+        self.calls = []
+        self._lock = threading.Lock()
+
+    @property
+    def max_batch(self):
+        return self.buckets[-1]
+
+    def bucket_for(self, n):
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(n)
+
+    def run_batch(self, pixels, dims, lane=0):
+        with self._lock:
+            self.calls.append((pixels.shape[0], lane))
+        mask = (pixels > 0).astype(np.uint8)
+        return mask, np.ones(pixels.shape[0], bool)
+
+
+class TestBatcherFanOut:
+    def test_window_splits_across_lanes(self):
+        ex = FakeLaneExecutor(buckets=(1, 2, 4), lanes=4)
+        b = DynamicBatcher(AdmissionQueue(32), ex, max_wait_s=0.0)
+        reqs = _reqs(12)
+        b.execute(reqs)
+        # 12 over 4 lanes -> chunk target 3 -> bucket 4 -> 3 chunks
+        assert sorted(c[0] for c in ex.calls) == [4, 4, 4]
+        assert sorted(c[1] for c in ex.calls) == [0, 1, 2]
+        for r in reqs:
+            assert r.done.is_set() and r.error is None
+            assert r.mask.shape == r.dims and r.batch_size == 4
+
+    def test_effective_max_batch_is_fleet_capacity(self):
+        ex = FakeLaneExecutor(buckets=(1, 2, 4), lanes=4)
+        b = DynamicBatcher(AdmissionQueue(32), ex, max_wait_s=0.0)
+        assert b.effective_max_batch() == 16
+        b2 = DynamicBatcher(AdmissionQueue(32), ex, max_wait_s=0.0, max_batch=6)
+        assert b2.effective_max_batch() == 6
+
+    def test_explicit_max_batch_validated_against_fleet(self):
+        ex = FakeLaneExecutor(buckets=(1, 2), lanes=4)
+        DynamicBatcher(AdmissionQueue(8), ex, max_batch=8)  # 4 x 2: fits
+        with pytest.raises(ValueError, match="fleet capacity"):
+            DynamicBatcher(AdmissionQueue(8), ex, max_batch=9)
+
+    def test_unresolved_lanes_validate_at_start(self):
+        # the normal server path: lanes resolve during warmup, AFTER the
+        # batcher is constructed — an over-capacity max_batch must still
+        # fail fast at start(), not silently clamp (PR-4 contract)
+        ex = FakeLaneExecutor(buckets=(1, 2), lanes=None)
+        b = DynamicBatcher(AdmissionQueue(8), ex, max_batch=9)  # unknown yet
+        ex.lane_count = 2  # "warmup" resolved 2 lanes: capacity 4
+        with pytest.raises(ValueError, match="fleet capacity"):
+            b.start()
+
+    def test_single_request_stays_on_one_lane(self):
+        ex = FakeLaneExecutor(lanes=4)
+        b = DynamicBatcher(AdmissionQueue(8), ex, max_wait_s=0.0)
+        b.execute(_reqs(1))
+        assert ex.calls == [(1, 0)]
+
+    def test_per_lane_stats(self):
+        ex = FakeLaneExecutor(buckets=(1, 2), lanes=2)
+        b = DynamicBatcher(AdmissionQueue(32), ex, max_wait_s=0.0)
+        b.execute(_reqs(4))  # 2 chunks of bucket 2 on lanes 0 and 1
+        st = b.stats()
+        assert st["batches"] == 2 and st["requests"] == 4
+        assert st["lane_batches"] == {"0": 1, "1": 1}
+
+    def test_chunk_failure_contained_to_its_riders(self):
+        class FailLane1(FakeLaneExecutor):
+            def run_batch(self, pixels, dims, lane=0):
+                if lane == 1:
+                    raise RuntimeError("lane 1 boom")
+                return super().run_batch(pixels, dims, lane)
+
+        ex = FailLane1(buckets=(1, 2), lanes=2)
+        b = DynamicBatcher(AdmissionQueue(32), ex, max_wait_s=0.0)
+        reqs = _reqs(4)
+        b.execute(reqs)
+        ok = [r for r in reqs if r.error is None]
+        failed = [r for r in reqs if r.error is not None]
+        assert len(ok) == 2 and len(failed) == 2
+        assert all(isinstance(r.error, RuntimeError) for r in failed)
+        assert all(r.done.is_set() for r in reqs)
+
+
+CFG = PipelineConfig(canvas=CANVAS)
+
+
+class TestWarmExecutorLanes:
+    def test_warmup_per_lane_and_cross_lane_equality(self):
+        from nm03_capstone_project_tpu.serving.executor import WarmExecutor
+
+        ex = WarmExecutor(CFG, buckets=(1,), lanes=2)
+        assert ex.lane_count == 2  # requested, pre-resolution
+        timings = ex.warmup()
+        assert set(timings) == {"lane0", "lane1"}
+        assert ex.warm and ex.lanes_ready == 2
+        state = ex.lane_state()
+        assert [s["lane"] for s in state] == [0, 1]
+        assert all(s["warm"] for s in state)
+        img = phantom_slice(CANVAS, CANVAS, seed=2).astype(np.float32)
+        px = img[None]
+        dm = np.asarray([[CANVAS, CANVAS]], np.int32)
+        m0, c0 = ex.run_batch(px, dm, lane=0)
+        m1, c1 = ex.run_batch(px, dm, lane=1)
+        np.testing.assert_array_equal(m0, m1)
+        assert [s["batches"] for s in ex.lane_state()] == [1, 1]
+        with pytest.raises(ValueError, match="lane"):
+            ex.run_batch(px, dm, lane=7)
+
+    def test_lane_overflow_rejected(self):
+        from nm03_capstone_project_tpu.serving.executor import WarmExecutor
+
+        with pytest.raises(ValueError, match="lanes"):
+            WarmExecutor(CFG, buckets=(1,), lanes=0)
+
+
+def _post(url, body, headers, timeout=60.0):
+    req = urllib.request.Request(url, data=body, headers=headers, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _raw_headers(h, w):
+    return {
+        "Content-Type": "application/octet-stream",
+        "X-Nm03-Height": str(h),
+        "X-Nm03-Width": str(w),
+    }
+
+
+def _expected_mask_pixels(img: np.ndarray) -> int:
+    """Single-device reference through the same hub program the fleet runs."""
+    import jax.numpy as jnp
+
+    from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
+
+    out = process_slice(
+        jnp.asarray(img.astype(np.float32)),
+        jnp.asarray([img.shape[0], img.shape[1]], jnp.int32),
+        CFG,
+    )
+    return int(np.count_nonzero(np.asarray(out["mask"])))
+
+
+class TestMultiLaneServingE2E:
+    def test_concurrent_traffic_fans_across_lanes_mask_identical(self):
+        from nm03_capstone_project_tpu.serving.server import ServingApp
+
+        app = ServingApp(
+            cfg=CFG,
+            queue_capacity=64,
+            buckets=(1, 2),
+            max_wait_s=0.05,
+            request_timeout_s=60.0,
+            lanes=4,
+        )
+        app.start()
+        try:
+            imgs = {s: phantom_slice(CANVAS, CANVAS, seed=s) for s in (0, 1, 2)}
+            want = {s: _expected_mask_pixels(imgs[s]) for s in imgs}
+            results = []
+            lock = threading.Lock()
+
+            def one(i):
+                p = app.segment(imgs[i % 3], render=False)
+                with lock:
+                    results.append((i % 3, p))
+
+            threads = [
+                threading.Thread(target=one, args=(i,)) for i in range(16)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert len(results) == 16
+            # masks bit-identical to the single-device pipeline, whatever
+            # lane served them
+            for seed, payload in results:
+                assert payload["mask_pixels"] == want[seed], seed
+            st = app.status()
+            assert st["lanes"]["count"] == 4 and st["lanes"]["ready"] == 4
+            assert st["mesh_shape"] == [4]
+            lanes_used = {
+                s["lane"] for s in st["lanes"]["per_lane"] if s["batches"] > 0
+            }
+            assert len(lanes_used) >= 2, st["lanes"]
+            assert app.registry.get("serving_lanes_ready").value == 4
+            hub = st["compile_hub"]
+            assert hub["executables"] >= 8  # 4 lanes x 2 buckets
+        finally:
+            app.begin_drain(reason="test")
+            app.close()
+
+
+class TestServeCliAcceptance:
+    def test_eight_lane_subprocess_serves_all_lanes(self, tmp_path):
+        """The ISSUE 6 acceptance bar, end to end in a real process:
+        ``nm03-serve`` on 8 forced virtual CPU devices serves concurrent
+        batches across all lanes (observed via serving_lane_* metrics and
+        gated by --expect-gauge serving_lanes_ready=8) with masks
+        bit-identical to the single-device pipeline.
+        """
+        port_file = tmp_path / "port"
+        metrics = tmp_path / "metrics.json"
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        )
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m",
+                "nm03_capstone_project_tpu.serving.server",
+                "--device", "cpu", "--port", "0",
+                "--port-file", str(port_file),
+                "--canvas", str(CANVAS), "--buckets", "1", "--lanes", "0",
+                "--max-wait-ms", "30", "--heartbeat-s", "0",
+                "--metrics-out", str(metrics),
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO,
+        )
+        try:
+            deadline = time.monotonic() + 300
+            while not port_file.exists() and time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    pytest.fail(f"server died: {proc.stdout.read()}")
+                time.sleep(0.2)
+            assert port_file.exists(), "server never became ready"
+            base = f"http://127.0.0.1:{int(port_file.read_text())}"
+            img = phantom_slice(CANVAS, CANVAS, seed=1)
+            want = _expected_mask_pixels(img)
+            body = img.astype("<f4").tobytes()
+            results = []
+            lock = threading.Lock()
+
+            def one():
+                s, p = _post(
+                    base + "/v1/segment?output=mask",
+                    body,
+                    _raw_headers(CANVAS, CANVAS),
+                )
+                with lock:
+                    results.append((s, p))
+
+            threads = [threading.Thread(target=one) for _ in range(16)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert len(results) == 16
+            assert all(s == 200 for s, _ in results), results
+            assert all(p["mask_pixels"] == want for _, p in results)
+            with urllib.request.urlopen(base + "/readyz", timeout=10) as r:
+                st = json.loads(r.read())
+            assert st["lanes"]["count"] == 8 and st["lanes"]["ready"] == 8
+            lanes_used = {
+                s["lane"] for s in st["lanes"]["per_lane"] if s["batches"] > 0
+            }
+            # 16 one-slice requests, bucket 1: the window splits 16 ways,
+            # wrapping all 8 lanes
+            assert len(lanes_used) >= 4, st["lanes"]
+            proc.send_signal(signal.SIGTERM)
+            out, _ = proc.communicate(timeout=120)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate(timeout=30)
+        assert proc.returncode == 0, out
+        res = subprocess.run(
+            [
+                sys.executable, CHECKER,
+                "--metrics", str(metrics),
+                "--expect-gauge", "serving_lanes_ready=8",
+                "--expect-counter", "serving_lane_batches_total=8",
+                "--expect-counter", "serving_requests_total=16",
+            ],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert res.returncode == 0, res.stderr
