@@ -583,6 +583,17 @@ def zshard_scaling() -> None:
         _log(f"serve lanes {lanes}: {tput:.1f} slices/s (checksums {checks})")
     all_checks = set().union(*lane_checks.values()) if lane_checks else set()
     out["serve_lane_checksum_ok"] = len(all_checks) == 1
+    # the fleet's compile-cost columns (ISSUE 7): what warming every
+    # per-lane serve_mask executable cost, with the XLA cost/memory
+    # analysis where exposed — the denominators the serve_lane_tput
+    # numbers were missing
+    from nm03_capstone_project_tpu.compilehub import get_hub
+
+    hub = get_hub()
+    out["compile_cost"] = {
+        "total_compile_seconds": hub.stats()["total_compile_seconds"],
+        "specs": [e for e in hub.cost_report() if e["name"] == "serve_mask"],
+    }
     print(_SENTINEL + json.dumps(out), flush=True)
 
 
@@ -782,6 +793,41 @@ def _pin_platform(platform: str | None):
         jax.config.update("jax_platforms", platform)
 
 
+def _compile_cost_record(batch: int) -> dict:
+    """AOT compile cost + XLA cost analysis of the mask program at ``batch``.
+
+    The roofline denominators ISSUE 7 adds to the perf trajectory: what the
+    executable costs to BUILD (compile wall) and to RUN (flops, bytes
+    accessed, HBM residency) next to the measured slices/s — the numbers
+    the AOT-serialization plan (ROADMAP item 2) needs a baseline for.
+    Fields beyond ``compile_s`` exist only where jaxlib exposes
+    ``cost_analysis()``/``memory_analysis()`` on this backend.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from nm03_capstone_project_tpu.compilehub import executable_cost
+    from nm03_capstone_project_tpu.config import PipelineConfig
+    from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_batch
+
+    cfg = PipelineConfig()
+    fn = _hub_jit(lambda px, dm: process_batch(px, dm, cfg)["mask"])
+    t0 = time.perf_counter()
+    compiled = fn.lower(
+        jax.ShapeDtypeStruct((batch, CANVAS, CANVAS), jnp.float32),
+        jax.ShapeDtypeStruct((batch, 2), jnp.int32),
+    ).compile()
+    out = {"batch": batch, "compile_s": round(time.perf_counter() - t0, 3)}
+    cost = executable_cost(compiled)
+    out.update({k: cost[k] for k in sorted(cost)})
+    if cost.get("flops") and cost.get("bytes_accessed"):
+        out["intensity_flops_per_byte"] = round(
+            cost["flops"] / cost["bytes_accessed"], 4
+        )
+        out["flops_per_slice"] = round(cost["flops"] / batch, 1)
+    return out
+
+
 def probe(platform: str | None) -> None:
     """Tunnel health check: devices + a tiny jit round trip, nothing more."""
     _pin_platform(platform)
@@ -894,6 +940,14 @@ def worker(
             )
         }
     )
+    try:
+        # compile-cost / roofline columns (ISSUE 7): AOT-compiled mask
+        # program at the winning batch — compile wall + flops/bytes/HBM
+        cost = _compile_cost_record(batch)
+        emit({"compile_cost": cost})
+        _log(f"compile cost @batch={batch}: {cost}")
+    except Exception as e:  # noqa: BLE001 — never lose the headline
+        _log(f"compile-cost leg skipped: {e}")
 
     if want_scan:
         try:

@@ -18,6 +18,7 @@ the reason, which doubles as their documentation.
 
 Rules:
   NM351  truncating artifact write without the tmp+rename idiom
+  NM371  flight-recorder/trace module writes a file without atomic_write_*
 """
 
 from __future__ import annotations
@@ -84,6 +85,163 @@ def _has_replace(scope: ast.AST) -> bool:
                 if _names_tmp(base):
                     return True
     return False
+
+
+# NM371 — the post-mortem modules' write discipline is stricter than
+# NM351: a flight-recorder dump races the very crash it documents, and a
+# trace export may be cut by the next SIGTERM, so BOTH must route every
+# write through utils.atomicio.atomic_write_* — no hand-rolled tmp+rename
+# (which NM351 would accept) and no direct write primitives at all.
+OBS_DUMP_MODULES: tuple = (
+    "nm03_capstone_project_tpu/obs/flightrec.py",
+    "nm03_capstone_project_tpu/obs/trace.py",
+)
+
+_DIRECT_WRITE_ATTRS = ("write_text", "write_bytes")
+_HAND_ROLLED = ("replace", "rename", "mkstemp", "NamedTemporaryFile")
+
+
+_MODE_CHARS = set("rwaxbtU+")
+
+
+def _attr_open_mode(node: ast.Call) -> Optional[str]:
+    """Best-effort mode of an attribute-style open call.
+
+    Covers BOTH calling conventions: ``Path(p).open(mode, ...)`` (mode
+    first) and ``io.open(path, mode, ...)`` (path first) — any string
+    literal among the first two positionals that *looks like* a mode
+    string counts, so a literal path (``io.open("debug.json", "w")``)
+    can never masquerade as a read mode. None = statically unjudgeable,
+    which the caller flags — strictness is this rule's contract.
+    """
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            if isinstance(kw.value, ast.Constant) and isinstance(
+                kw.value.value, str
+            ):
+                return kw.value.value
+            return None
+    candidates = []
+    saw_non_literal = False
+    for a in node.args[:2]:
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            v = a.value
+            if v and set(v) <= _MODE_CHARS and len(v) <= 4:
+                candidates.append(v)
+        else:
+            saw_non_literal = True
+    if candidates:
+        for v in candidates:  # the most write-looking candidate decides
+            if any(c in v for c in "wax+"):
+                return v
+        return candidates[0]
+    if saw_non_literal:
+        return None
+    return "r"
+
+
+def _hand_rolled_bindings(tree: ast.AST):
+    """Names that reach the hand-rolled write primitives in this module.
+
+    NM371's contract is ANY spelling: ``import os as _os`` and
+    ``from os import replace as rp`` must not slip past a matcher pinned
+    to the literal attribute form ``os.replace``. Returns
+    (module_aliases, bare_names): local names bound to the os/tempfile
+    modules, and local names bound directly to a hand-rolled primitive
+    (mapped back to its canonical ``module.attr`` for the message).
+    """
+    module_aliases = set()
+    bare_names = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname is None:
+                    # `import os.path` binds the TOP-LEVEL name `os`
+                    if a.name.split(".")[0] in ("os", "tempfile"):
+                        module_aliases.add(a.name.split(".")[0])
+                elif a.name in ("os", "tempfile"):
+                    # `import os.path as p` binds p to os.path, whose
+                    # attrs are not the hand-rolled primitives — only a
+                    # whole-module alias counts
+                    module_aliases.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module in ("os", "tempfile"):
+                for a in node.names:
+                    if a.name in _HAND_ROLLED:
+                        bare_names[a.asname or a.name] = (
+                            f"{node.module}.{a.name}"
+                        )
+    return module_aliases, bare_names
+
+
+def check_obs_dump_io(files: Sequence[SourceFile]) -> List[Finding]:
+    """NM371: obs.trace / obs.flightrec must write via atomic_write_*."""
+    findings: List[Finding] = []
+    for src in files:
+        if src.relpath not in OBS_DUMP_MODULES or src.tree is None:
+            continue
+        mod_aliases, bare_hand_rolled = _hand_rolled_bindings(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            what = None
+            if isinstance(node.func, ast.Name) and node.func.id == "open":
+                mode = _literal_mode(node)
+                if mode is None or any(c in (mode or "") for c in "wax+"):
+                    what = f'open(..., "{mode}")' if mode else "open(...) with a non-read mode"
+            elif (
+                isinstance(node.func, ast.Name)
+                and node.func.id in bare_hand_rolled
+            ):
+                what = f"{bare_hand_rolled[node.func.id]}() (from-import)"
+            elif isinstance(node.func, ast.Attribute):
+                if node.func.attr in _DIRECT_WRITE_ATTRS:
+                    what = f".{node.func.attr}()"
+                elif node.func.attr == "open":
+                    # Path.open / io.open are the same primitive wearing an
+                    # attribute: flag any non-read (or statically unknown)
+                    # mode. NOTE Path.open takes mode as its FIRST
+                    # positional, unlike builtin open(path, mode).
+                    mode = _attr_open_mode(node)
+                    if mode is None or any(c in (mode or "") for c in "wax+"):
+                        what = (
+                            f'.open(..., "{mode}")' if mode
+                            else ".open(...) with a non-read mode"
+                        )
+                elif node.func.attr in _HAND_ROLLED and isinstance(
+                    node.func.value, ast.Name
+                ) and node.func.value.id in mod_aliases:
+                    what = f"{node.func.value.id}.{node.func.attr}()"
+                elif node.func.attr == "rename":
+                    # Path(...).rename(target) — receiver-agnostic: these
+                    # modules have no legitimate rename of any kind
+                    what = ".rename()"
+                elif (
+                    node.func.attr == "replace"
+                    and len(node.args) == 1
+                    and not node.keywords
+                ):
+                    # Path(...).replace(target) takes ONE positional;
+                    # str.replace(old, new) takes two, so stays clean
+                    what = ".replace(target) (pathlib-style rename)"
+            if what is None:
+                continue
+            findings.append(
+                Finding(
+                    rule="NM371",
+                    path=src.relpath,
+                    line=node.lineno,
+                    message=(
+                        f"{what} in a flight-recorder/trace module — dumps "
+                        "and exports race the crash/drain they document and "
+                        "must route through utils.atomicio.atomic_write_* "
+                        "(the idiom's single point of correctness), never a "
+                        "direct or hand-rolled write"
+                    ),
+                    source_line=src.line_text(node.lineno),
+                )
+            )
+    return findings
 
 
 def check_atomic_io(files: Sequence[SourceFile]) -> List[Finding]:

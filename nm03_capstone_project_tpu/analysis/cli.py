@@ -22,7 +22,10 @@ import sys
 from pathlib import Path
 from typing import List, Optional
 
-from nm03_capstone_project_tpu.analysis.atomicio import check_atomic_io
+from nm03_capstone_project_tpu.analysis.atomicio import (
+    check_atomic_io,
+    check_obs_dump_io,
+)
 from nm03_capstone_project_tpu.analysis.compilehome import check_compile_home
 from nm03_capstone_project_tpu.analysis.contracts import check_import_contracts
 from nm03_capstone_project_tpu.analysis.core import (
@@ -47,6 +50,7 @@ ALL_RULES = (
     check_thread_shared_state,
     check_dtype_discipline,
     check_atomic_io,
+    check_obs_dump_io,
     check_compile_home,
 )
 
@@ -62,6 +66,7 @@ RULE_CATALOG = {
     "NM342": "dtype: uint8-cast comparison against an out-of-range literal",
     "NM351": "atomic-io: truncating artifact write without tmp+rename",
     "NM361": "compile-home: jit/pjit/shard_map referenced outside compilehub/",
+    "NM371": "obs-io: flight-recorder/trace module writes without atomic_write_*",
     "NM390": "meta: suppression without a reason",
     "NM399": "meta: file does not parse",
 }

@@ -37,6 +37,13 @@ from nm03_capstone_project_tpu.analysis.core import Finding, SourceFile
 CONTRACT_REGISTRY: Dict[str, Tuple[str, ...]] = {
     "nm03_capstone_project_tpu.resilience": ("jax", "numpy"),
     "nm03_capstone_project_tpu.obs": ("jax", "numpy"),
+    # the trace/flight-recorder pair is pinned EXPLICITLY on top of the
+    # obs package entry (ISSUE 7 / NM371 contract): a rename or move out
+    # of obs/ must trip NM302 rather than silently shedding the contract —
+    # these two must stay importable (and dump-capable) from wedged or
+    # crashing processes that never paid a backend import
+    "nm03_capstone_project_tpu.obs.trace": ("jax", "numpy"),
+    "nm03_capstone_project_tpu.obs.flightrec": ("jax", "numpy"),
     "nm03_capstone_project_tpu.ops.selection_network": ("jax", "numpy"),
     "nm03_capstone_project_tpu.serving.queue": ("jax",),
     "nm03_capstone_project_tpu.serving.metrics": ("jax",),

@@ -28,6 +28,7 @@ from nm03_capstone_project_tpu.compilehub.hub import (
     CompileHub,
     CompileSpec,
     aot_compile,
+    executable_cost,
     get_hub,
     hub_jit,
 )
@@ -36,6 +37,7 @@ __all__ = [
     "CompileHub",
     "CompileSpec",
     "aot_compile",
+    "executable_cost",
     "distributed_is_initialized",
     "ensure_cpu_multiprocess_collectives",
     "get_hub",

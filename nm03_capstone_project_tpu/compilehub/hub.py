@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 __all__ = [
@@ -96,6 +97,23 @@ class CompileSpec:
             "variant": self.variant or None,
         }
 
+    def label(self) -> str:
+        """Compact stable series label for this spec — the ``spec`` label
+        of the ``compile_seconds`` / ``executable_flops`` /
+        ``executable_hbm_bytes`` gauges and the ``/readyz``
+        ``compile_seconds`` map (bounded cardinality: the spec set is
+        fixed per process by design, see REGISTRY_SOFT_CAP)."""
+        parts = [self.name]
+        if self.shape:
+            parts.append("x".join(str(d) for d in self.shape))
+        if self.lane is not None:
+            parts.append(f"lane{self.lane}")
+        if self.backend:
+            parts.append(self.backend)
+        if self.variant:
+            parts.append(self.variant)
+        return "/".join(parts)
+
 
 def aot_compile(jitted: Callable, *arg_structs) -> Tuple[Callable, bool]:
     """``jitted.lower(*arg_structs).compile()`` with deferred fallback.
@@ -112,6 +130,55 @@ def aot_compile(jitted: Callable, *arg_structs) -> Tuple[Callable, bool]:
         return jitted, False
 
 
+def executable_cost(built: Any) -> Dict[str, float]:
+    """Best-effort ``cost_analysis()``/``memory_analysis()`` of a compiled
+    executable, normalized to flat numeric fields.
+
+    Only AOT executables (``jitted.lower().compile()`` results) expose
+    these; deferred-trace callables return ``{}``. Every field is optional
+    — jaxlib's analysis surface varies by version and backend — so callers
+    treat presence as evidence, absence as "not exposed here", never as
+    zero. ``peak_hbm_bytes`` is the arguments+outputs+temps resident set
+    (aliased/donated bytes subtracted): the roofline denominator the bench
+    records carry (ISSUE 7).
+    """
+    out: Dict[str, float] = {}
+    try:
+        ca = built.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # older jaxlib: one dict per device
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            for src_key, key in (("flops", "flops"),
+                                 ("bytes accessed", "bytes_accessed")):
+                v = ca.get(src_key)
+                if v is not None:
+                    out[key] = float(v)
+    except Exception:  # noqa: BLE001 — analysis is evidence, not a contract
+        pass
+    try:
+        ma = built.memory_analysis()
+        parts = {}
+        for attr, key in (
+            ("argument_size_in_bytes", "argument_bytes"),
+            ("output_size_in_bytes", "output_bytes"),
+            ("temp_size_in_bytes", "temp_bytes"),
+            ("alias_size_in_bytes", "alias_bytes"),
+            ("generated_code_size_in_bytes", "code_bytes"),
+        ):
+            v = getattr(ma, attr, None)
+            if v is not None:
+                parts[key] = float(v)
+        out.update(parts)
+        if {"argument_bytes", "output_bytes", "temp_bytes"} <= parts.keys():
+            out["peak_hbm_bytes"] = (
+                parts["argument_bytes"] + parts["output_bytes"]
+                + parts["temp_bytes"] - parts.get("alias_bytes", 0.0)
+            )
+    except Exception:  # noqa: BLE001 — see above
+        pass
+    return out
+
+
 class CompileHub:
     """Registry of compile specs returning warm executables.
 
@@ -126,6 +193,9 @@ class CompileHub:
         self._lock = threading.Lock()
         self._cache: Dict[CompileSpec, Callable] = {}
         self._aot: Dict[CompileSpec, bool] = {}
+        # per-spec cost accounting (ISSUE 7): build wall-time always; the
+        # XLA cost/memory analysis where the executable exposes it
+        self._cost: Dict[CompileSpec, Dict[str, float]] = {}
         self._builds = 0
         self._jit_wraps = 0
         self._cap_warned = False
@@ -140,15 +210,25 @@ class CompileHub:
             fn = self._cache.get(spec)
         if fn is not None:
             return fn
+        t0 = time.perf_counter()
         built = build(spec)
+        build_s = time.perf_counter() - t0
         if isinstance(built, tuple):  # (executable, aot_ok) from aot_compile
             built, aot_ok = built
         else:
             aot_ok = False
+        # compile-cost accounting: the build wall covers lowering+compile
+        # for AOT specs (deferred specs pay their compile at first call —
+        # serving warmup times that separately); the XLA analyses only
+        # exist on AOT executables
+        cost: Dict[str, float] = {"compile_s": round(build_s, 4)}
+        if aot_ok:
+            cost.update(executable_cost(built))
         with self._lock:
             if spec not in self._cache:
                 self._cache[spec] = built
                 self._aot[spec] = aot_ok
+                self._cost[spec] = cost
                 self._builds += 1
             over_cap = (
                 len(self._cache) > REGISTRY_SOFT_CAP and not self._cap_warned
@@ -177,6 +257,7 @@ class CompileHub:
         with self._lock:
             self._cache.pop(spec, None)
             self._aot.pop(spec, None)
+            self._cost.pop(spec, None)
 
     def jit(self, fn: Callable, **kwargs: Any) -> Callable:
         """The hub's ``jax.jit``: semantics untouched, creation counted."""
@@ -189,14 +270,55 @@ class CompileHub:
     # -- observability -----------------------------------------------------
 
     def stats(self) -> dict:
-        """Registry state for ``/readyz`` payloads and tests."""
+        """Registry state for ``/readyz`` payloads and tests.
+
+        ``total_compile_seconds`` is the warmup-cost rollup ISSUE 7's
+        ``/readyz`` fix demands: what this process paid the compiler,
+        visible without grepping logs.
+        """
         with self._lock:
             return {
                 "executables": len(self._cache),
                 "aot": sum(1 for ok in self._aot.values() if ok),
                 "builds": self._builds,
                 "jit_wraps": self._jit_wraps,
+                "total_compile_seconds": round(
+                    sum(c.get("compile_s", 0.0) for c in self._cost.values()), 4
+                ),
             }
+
+    def compile_seconds(self) -> Dict[str, float]:
+        """Per-spec compile wall-time, keyed by :meth:`CompileSpec.label`.
+
+        Labels that collide (two cfg variants of one program family) sum —
+        the map answers "what did warming THIS family/bucket/lane cost",
+        not "enumerate every cfg hash".
+        """
+        with self._lock:
+            items = [(k.label(), c.get("compile_s", 0.0)) for k, c in self._cost.items()]
+        out: Dict[str, float] = {}
+        for label, s in items:
+            out[label] = round(out.get(label, 0.0) + s, 4)
+        return out
+
+    def cost_report(self) -> list:
+        """Every spec's identity + compile cost + XLA cost/memory analysis
+        (the ``/readyz`` detail, the serving cost gauges' source, and the
+        bench records' roofline columns)."""
+        with self._lock:
+            items = [(k, dict(c)) for k, c in self._cost.items()]
+        out = []
+        for spec, cost in items:
+            entry = spec.describe()
+            entry["label"] = spec.label()
+            entry.update(cost)
+            if cost.get("flops") and cost.get("bytes_accessed"):
+                entry["intensity_flops_per_byte"] = round(
+                    cost["flops"] / cost["bytes_accessed"], 4
+                )
+            out.append(entry)
+        out.sort(key=lambda e: e["label"])
+        return out
 
     def specs(self) -> list:
         with self._lock:

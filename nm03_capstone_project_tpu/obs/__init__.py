@@ -13,12 +13,19 @@ Unifies (and supersedes) the scattered timing/profiling/logging fragments:
   wall + monotonic timestamps, plus the heartbeat thread and the bridge
   that mirrors package-logger warnings into the stream;
 * :mod:`~nm03_capstone_project_tpu.obs.run` — :class:`RunContext`, the
-  driver-facing facade that owns the per-patient outcome protocol.
+  driver-facing facade that owns the per-patient outcome protocol;
+* :mod:`~nm03_capstone_project_tpu.obs.trace` — request-scoped serving
+  traces (span trees per trace id, Chrome/Perfetto export via
+  ``nm03-trace``);
+* :mod:`~nm03_capstone_project_tpu.obs.flightrec` — the crash flight
+  recorder (per-thread rings, atomic dumps on SIGUSR2 / degradation /
+  unhandled crash).
 
 Schemas and metric names are documented in docs/OBSERVABILITY.md and
 validated by scripts/check_telemetry.py.
 """
 
+from nm03_capstone_project_tpu.obs import flightrec  # noqa: F401
 from nm03_capstone_project_tpu.obs.events import (  # noqa: F401
     LEVELS,
     SCHEMA_EVENTS,
@@ -47,4 +54,12 @@ from nm03_capstone_project_tpu.obs.run import (  # noqa: F401
 from nm03_capstone_project_tpu.obs.spans import (  # noqa: F401
     STAGE_LATENCY_METRIC,
     SpanRecorder,
+)
+from nm03_capstone_project_tpu.obs.trace import (  # noqa: F401
+    NULL_TRACE,
+    SERVE_TRACE_EVENT,
+    ChunkTrace,
+    TraceContext,
+    new_trace_id,
+    sanitize_trace_id,
 )

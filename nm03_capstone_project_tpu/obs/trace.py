@@ -1,0 +1,433 @@
+"""Request-scoped tracing for the serving path + the Perfetto exporter.
+
+PR 1's spans are per-stage *aggregates* and PR 6's ``serving_lane_*``
+series say how many batches each lane ran; neither can answer "where did
+request X's 400 ms go". This module adds the missing attribution layer:
+
+* every ``POST /v1/segment`` gets a **trace id** (an inbound
+  ``X-Nm03-Request-Id`` header is honored after sanitization, else one is
+  minted) that travels on the :class:`~..serving.queue.ServeRequest`
+  through admission → coalescing → per-lane chunk dispatch → the
+  supervised executor → response, and is echoed back as the
+  ``X-Nm03-Request-Id`` response header so ``nm03-loadgen`` can correlate;
+* each hop records a **span** (``queue_wait``, ``coalesce``, ``pad_stack``,
+  ``device_dispatch`` per supervised attempt, ``fetch``, ``cpu_fallback``,
+  ``encode``). Chunk-level spans are *shared*: one record carries every
+  rider's trace id, which is exactly how a coalesced batch shows up as one
+  dispatch block with N requests on the timeline;
+* completed requests emit one ``serve_trace`` event (the span tree) into
+  the ordinary JSONL event log, and every span begin/end also feeds the
+  :mod:`~nm03_capstone_project_tpu.obs.flightrec` ring — an in-flight
+  request's trace id is in the flight recorder *before* the dispatch that
+  may wedge;
+* ``nm03-trace`` (this module's :func:`main`) converts an event stream's
+  ``serve_trace`` records into Chrome/Perfetto ``trace_event`` JSON (B/E
+  pairs; request tracks + lane tracks), validated by
+  ``scripts/check_telemetry.py --expect-trace``.
+
+jax-free AND numpy-free at import by contract (NM301 registry pins
+``obs.trace``); the exporter writes through ``atomic_write_text`` (NM371).
+Schema (``nm03.trace.v1``) is documented in docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import itertools
+import json
+import os
+import re
+import sys
+import threading
+import time
+from typing import Dict, Iterable, List, Optional
+
+from nm03_capstone_project_tpu.obs import flightrec
+
+SCHEMA_TRACE = "nm03.trace.v1"
+# the JSONL event (one per completed request) carrying the span tree
+SERVE_TRACE_EVENT = "serve_trace"
+
+# the serving span vocabulary (docs/OBSERVABILITY.md trace schema). The
+# exporter and validator are deliberately name-agnostic (every B event
+# must carry a trace id, whatever it is called); this tuple is the
+# authoritative schema list, pinned by the serving e2e test — a new span
+# name on the request path must be added here AND to the docs table
+SERVE_SPAN_NAMES = (
+    "queue_wait",       # admission -> popped by the batcher
+    "coalesce",         # popped -> the batching window closed
+    "pad_stack",        # chunk padded into its bucket canvas stack
+    "device_dispatch",  # one supervised execute attempt on one lane
+    "fetch",            # device -> host result fetch (inside the deadline)
+    "cpu_fallback",     # degraded-path recompute
+    "encode",           # host render + JPEG encode on the handler thread
+)
+
+# client-supplied trace ids: bounded charset/length so a hostile header
+# cannot smuggle log-breaking bytes into the event stream or a filename
+_TRACE_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._:\-]{0,63}$")
+
+_SPAN_SEQ = itertools.count(1)
+
+
+def new_trace_id() -> str:
+    import uuid
+
+    return uuid.uuid4().hex[:16]
+
+
+def sanitize_trace_id(raw: Optional[str]) -> Optional[str]:
+    """A usable client-supplied trace id, or None (caller mints one)."""
+    if not isinstance(raw, str):
+        return None
+    raw = raw.strip()
+    return raw if _TRACE_ID_RE.match(raw) else None
+
+
+def _new_span_id() -> str:
+    # pid-salted: the exporter dedupes shared chunk spans by id, and a
+    # concatenated event stream (two replicas' logs, or a restarted
+    # server appending with ">>") must not let a second process's s1
+    # collide with the first's and be silently dropped from the export
+    return f"s{os.getpid():x}.{next(_SPAN_SEQ):x}"
+
+
+def make_span(
+    name: str,
+    t0_s: float,
+    t1_s: float,
+    trace_ids: List[str],
+    lane: Optional[int] = None,
+    **fields,
+) -> dict:
+    """One span record (the unit both the event log and the exporter use).
+
+    Times are ``time.monotonic()`` seconds — one process-wide timebase so
+    spans from different threads line up on one timeline. ``riders`` > 1
+    marks a shared (chunk-level) span: one dispatch, many requests.
+    """
+    rec = {
+        "id": _new_span_id(),
+        "name": str(name),
+        "t0_s": round(t0_s, 6),
+        "dur_s": round(max(t1_s - t0_s, 0.0), 6),
+        "thread": threading.current_thread().name,
+        "lane": lane,
+        "riders": len(trace_ids),
+        "trace_ids": list(trace_ids),
+    }
+    for k, v in fields.items():
+        if k not in rec:
+            rec[k] = v
+    return rec
+
+
+class TraceContext:
+    """One request's span collection, carried on the ServeRequest.
+
+    Appends happen from the handler, batcher, and lane-pool threads, but
+    always sequenced by the request's own lifecycle handoffs (queue put,
+    chunk dispatch, done-Event); the lock makes the container safe against
+    a concurrent flight-recorder snapshot mid-append anyway.
+    """
+
+    __slots__ = ("trace_id", "spans", "_lock")
+
+    def __init__(self, trace_id: Optional[str] = None):
+        self.trace_id = trace_id or new_trace_id()
+        self.spans: List[dict] = []
+        self._lock = threading.Lock()
+
+    def add(self, rec: dict) -> None:
+        with self._lock:
+            self.spans.append(rec)
+
+    def add_span(
+        self, name: str, t0_s: float, t1_s: float, lane: Optional[int] = None,
+        **fields,
+    ) -> dict:
+        """Record a retrospective span (both endpoints already measured)."""
+        rec = make_span(name, t0_s, t1_s, [self.trace_id], lane=lane, **fields)
+        self.add(rec)
+        flightrec.note(
+            "span", name, trace_id=self.trace_id,
+            dur_s=rec["dur_s"], lane=lane,
+        )
+        return rec
+
+    @contextlib.contextmanager
+    def span(self, name: str, lane: Optional[int] = None, **fields):
+        """Time a section on this request's trace (e.g. ``encode``)."""
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.add_span(name, t0, time.monotonic(), lane=lane, **fields)
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return list(self.spans)
+
+
+class ChunkTrace:
+    """Shared spans for one dispatched chunk: many riders, one lane.
+
+    The batcher builds one per chunk; ``span()`` records ONE span carrying
+    every rider's trace id and appends it to every rider's context — the
+    exporter then shows a coalesced batch as a single dispatch block with
+    ``riders`` requests on the lane's track.
+    """
+
+    __slots__ = ("contexts", "lane", "trace_ids")
+
+    def __init__(self, contexts: Iterable, lane: Optional[int] = None):
+        self.contexts = [c for c in contexts if c is not None]
+        self.lane = lane
+        self.trace_ids = [c.trace_id for c in self.contexts]
+
+    def mark(self, name: str, **fields) -> None:
+        """Flight-recorder-only marker (no span): the in-flight evidence a
+        wedged dispatch leaves behind even when its span never closes."""
+        flightrec.note(
+            "mark", name, trace_ids=self.trace_ids, lane=self.lane, **fields
+        )
+
+    @contextlib.contextmanager
+    def span(self, name: str, **fields):
+        if not self.contexts:
+            yield
+            return
+        t0 = time.monotonic()
+        flightrec.note(
+            "span_begin", name, trace_ids=self.trace_ids, lane=self.lane,
+            **fields,
+        )
+        try:
+            yield
+        finally:
+            rec = make_span(
+                name, t0, time.monotonic(), self.trace_ids, lane=self.lane,
+                **fields,
+            )
+            for c in self.contexts:
+                c.add(rec)
+            flightrec.note(
+                "span", name, trace_ids=self.trace_ids,
+                dur_s=rec["dur_s"], lane=self.lane,
+            )
+
+
+class _NullTrace:
+    """No-op stand-in so un-traced call paths cost nothing."""
+
+    lane = None
+    trace_ids: List[str] = []
+
+    def mark(self, name: str, **fields) -> None:
+        pass
+
+    def span(self, name: str, **fields):
+        return contextlib.nullcontext()
+
+
+NULL_TRACE = _NullTrace()
+
+
+# -- Chrome/Perfetto trace_event export --------------------------------------
+
+
+def chrome_trace_events(serve_traces: Iterable[dict]) -> List[dict]:
+    """``serve_trace`` records -> Chrome ``trace_event`` B/E pairs.
+
+    Track layout: request-scoped spans (lane is null) ride a per-request
+    track named by trace id; chunk-scoped spans ride ``lane N`` tracks —
+    the view where ≥2 requests sharing one dispatch span on distinct lanes
+    is visible at a glance. Shared spans are deduplicated by span id (they
+    appear in every rider's record). Metadata (``ph: "M"``) events name
+    the process and tracks; B/E events are globally ts-sorted.
+    """
+    recs = [r for r in serve_traces]
+    # trace ids are client-controlled and nothing enforces uniqueness: a
+    # client retrying with the same X-Nm03-Request-Id while the original
+    # is in flight yields two span trees under one id. Disambiguate those
+    # request tracks by the server-side request_id so the serializing
+    # cursor below never rewrites one request's times to fit another's.
+    id_counts: Dict[str, int] = {}
+    for rec in recs:
+        tid_ = rec.get("trace_id")
+        if tid_:
+            id_counts[tid_] = id_counts.get(tid_, 0) + 1
+
+    spans: List[tuple] = []  # (span, request-track override)
+    seen: set = set()
+    for rec in recs:
+        tid_ = rec.get("trace_id")
+        req_track = f"req {tid_}" if tid_ else None
+        if tid_ and id_counts.get(tid_, 0) > 1 and rec.get("request_id"):
+            req_track = f"req {tid_} ({rec['request_id']})"
+        for sp in rec.get("spans") or []:
+            sid = sp.get("id")
+            if sid is None or sid in seen:
+                continue
+            seen.add(sid)
+            spans.append((sp, req_track))
+
+    tids: Dict[str, int] = {}
+    events: List[dict] = [
+        {
+            "ph": "M", "pid": 1, "tid": 0, "name": "process_name",
+            "args": {"name": "nm03-serve"},
+        }
+    ]
+
+    def tid_for(track: str) -> int:
+        if track not in tids:
+            tids[track] = len(tids) + 1
+            events.append(
+                {
+                    "ph": "M", "pid": 1, "tid": tids[track],
+                    "name": "thread_name", "args": {"name": track},
+                }
+            )
+        return tids[track]
+
+    # group by track: within one track (one request's lifecycle spans, or
+    # one lane's sequential chunk work) spans never truly overlap, but the
+    # independent 0.1 µs roundings of t0 and dur can make an adjacent
+    # pair's E land a hair after the next B — a serializing cursor per
+    # track clamps that away so B/E stacks balance at every prefix
+    by_track: Dict[str, List[dict]] = {}
+    for sp, req_track in spans:
+        lane = sp.get("lane")
+        if lane is not None:
+            track = f"lane {lane}"
+        else:
+            # `or`, not a .get default: a present-but-empty trace_ids list
+            # (schema drift, hand-edited stream) must not crash the export
+            track = req_track or f"req {(sp.get('trace_ids') or ['?'])[0]}"
+        by_track.setdefault(track, []).append(sp)
+
+    be: List[dict] = []
+    # rounding tears are <= 0.2 µs (two independent 0.1 µs roundings);
+    # anything past this is a genuine overlap, not an artifact
+    _TEAR_EPS_US = 1.0
+    for track, track_spans in by_track.items():
+        # greedy interval partitioning: spans that GENUINELY overlap on one
+        # track — a PR-3 retry ladder's abandoned device_dispatch attempt
+        # returning late while attempt 2 runs on the same lane — keep their
+        # true times on an "(overlap)" sibling track instead of being
+        # cursor-clamped into a wrong start and a zero width; the cursor
+        # only ever absorbs sub-µs rounding tears
+        subtracks: List[list] = []  # [tid, cursor_ts] per sibling track
+        for sp in sorted(track_spans, key=lambda s: float(s.get("t0_s", 0.0))):
+            lane = sp.get("lane")
+            b_ts = round(float(sp.get("t0_s", 0.0)) * 1e6, 1)
+            e_ts = round(
+                (float(sp.get("t0_s", 0.0)) + float(sp.get("dur_s", 0.0)))
+                * 1e6,
+                1,
+            )
+            slot = next(
+                (s for s in subtracks if b_ts >= s[1] - _TEAR_EPS_US), None
+            )
+            if slot is None:
+                n = len(subtracks)
+                name = track if n == 0 else (
+                    f"{track} (overlap)" if n == 1 else f"{track} (overlap {n})"
+                )
+                slot = [tid_for(name), b_ts]
+                subtracks.append(slot)
+            if b_ts < slot[1]:
+                b_ts = slot[1]  # sub-µs tear
+            if e_ts <= b_ts:
+                e_ts = round(b_ts + 0.1, 1)  # strictly-positive width
+            slot[1] = e_ts
+            args = {
+                "trace_ids": sp.get("trace_ids", []),
+                "riders": sp.get("riders", len(sp.get("trace_ids", []))),
+            }
+            if lane is not None:
+                args["lane"] = lane
+            if "attempt" in sp:
+                args["attempt"] = sp["attempt"]
+            common = {"name": sp.get("name", "?"), "pid": 1, "tid": slot[0],
+                      "cat": "serving"}
+            be.append({**common, "ph": "B", "ts": b_ts, "args": args})
+            be.append({**common, "ph": "E", "ts": e_ts})
+    # stable global ts order; an E at the same ts as its track's next B
+    # must come first so the per-track stack stays balanced at every prefix
+    be.sort(key=lambda e: (e["ts"], 0 if e["ph"] == "E" else 1))
+    events.extend(be)
+    return events
+
+
+def load_serve_traces(events_path: str) -> List[dict]:
+    """The ``serve_trace`` records of one JSONL event stream (in order)."""
+    out: List[dict] = []
+    with open(events_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail: a crash mid-write is exactly our use case
+            if isinstance(rec, dict) and rec.get("event") == SERVE_TRACE_EVENT:
+                out.append(rec)
+    return out
+
+
+def export_chrome_trace(events_path: str, out_path: str) -> int:
+    """Write the Perfetto-loadable export; returns the request count."""
+    from nm03_capstone_project_tpu.utils.atomicio import atomic_write_text
+
+    traces = load_serve_traces(events_path)
+    payload = {
+        "schema": SCHEMA_TRACE,
+        "displayTimeUnit": "ms",
+        "traceEvents": chrome_trace_events(traces),
+        "metadata": {
+            "source": events_path,
+            "requests": len(traces),
+        },
+    }
+    atomic_write_text(out_path, json.dumps(payload, indent=1) + "\n")
+    return len(traces)
+
+
+def main(argv=None) -> int:
+    """``nm03-trace``: events JSONL -> Chrome/Perfetto trace_event JSON.
+
+    Load the output at https://ui.perfetto.dev (or chrome://tracing). The
+    triage loop is documented in docs/OPERATIONS.md ("post-mortem triage").
+    """
+    p = argparse.ArgumentParser(
+        prog="nm03-trace", description=main.__doc__.strip().splitlines()[0]
+    )
+    p.add_argument("events", help="JSONL event stream (--log-json output)")
+    p.add_argument(
+        "-o", "--out", default=None,
+        help="trace JSON output path (default: <events>.trace.json)",
+    )
+    args = p.parse_args(argv)
+    out = args.out or f"{args.events}.trace.json"
+    try:
+        n = export_chrome_trace(args.events, out)
+    except OSError as e:
+        print(f"nm03-trace: {e}", file=sys.stderr)
+        return 2
+    print(f"nm03-trace: {n} request trace(s) -> {out}")
+    if n == 0:
+        print(
+            "nm03-trace: no serve_trace records found — was the stream "
+            "written by nm03-serve --log-json with traffic served?",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -208,6 +208,19 @@ class DispatchSupervisor:
                 )
             except Exception:  # noqa: BLE001 — telemetry never costs the run
                 pass
+        if first:
+            # the degradation transition IS the post-mortem moment: dump
+            # the flight-recorder rings (every thread's recent events and
+            # spans, in-flight trace ids included) while the evidence is
+            # still in memory. Inert unless a dump dir is configured
+            # (nm03-serve --flight-dir / NM03_FLIGHTREC_DIR); obs.flightrec
+            # is stdlib-only, so this import keeps resilience jax-free.
+            try:
+                from nm03_capstone_project_tpu.obs import flightrec
+
+                flightrec.auto_dump(reason=f"degraded_{cause}")
+            except Exception:  # noqa: BLE001 — capture is best-effort
+                pass
         if fallback is not None and self.cfg.fallback_cpu:
             return fallback()
         if error is not None:

@@ -29,6 +29,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from nm03_capstone_project_tpu.obs.trace import ChunkTrace
 from nm03_capstone_project_tpu.serving.executor import WarmExecutor
 from nm03_capstone_project_tpu.serving.metrics import (
     BATCH_SIZE_BUCKETS,
@@ -221,12 +222,28 @@ class DynamicBatcher:
 
     def _execute_chunk(self, reqs: List[ServeRequest], lane: int) -> None:
         """Run one chunk on one lane and answer its riders."""
-        pixels, dims = self.pad_batch(reqs)
+        # one shared trace for the chunk: every span it records carries all
+        # riders' trace ids — a coalesced batch IS one dispatch on one lane
+        trace = ChunkTrace([r.trace for r in reqs], lane=lane)
+        with trace.span("pad_stack"):
+            pixels, dims = self.pad_batch(reqs)
+        # flight-recorder marker BEFORE the dispatch that may wedge: a
+        # post-mortem dump must carry the in-flight trace ids even when
+        # the dispatch span never closes
+        trace.mark("chunk_dispatch", batch=len(reqs), bucket=pixels.shape[0])
         try:
-            if self._lane_aware:
-                mask_b, conv_b = self.executor.run_batch(pixels, dims, lane=lane)
+            if self._lane_aware and getattr(self.executor, "supports_trace", False):
+                mask_b, conv_b = self.executor.run_batch(
+                    pixels, dims, lane=lane, trace=trace
+                )
+            elif self._lane_aware:
+                with trace.span("device_dispatch"):
+                    mask_b, conv_b = self.executor.run_batch(
+                        pixels, dims, lane=lane
+                    )
             else:
-                mask_b, conv_b = self.executor.run_batch(pixels, dims)
+                with trace.span("device_dispatch"):
+                    mask_b, conv_b = self.executor.run_batch(pixels, dims)
         except BaseException as e:  # noqa: BLE001 — per-chunk containment
             # the PR-3 ladder is exhausted (deterministic failure, or
             # degraded with --no-fallback-cpu): every rider of THIS chunk
@@ -247,6 +264,7 @@ class DynamicBatcher:
             r.mask = np.asarray(mask_b[i][:h, :w])
             r.converged = bool(np.asarray(conv_b[i]))  # nm03-lint: disable=NM322 host ndarray, see above
             r.batch_size = len(reqs)
+            r.lane = lane
             r.done.set()
 
     def execute(self, reqs: List[ServeRequest]) -> None:
@@ -255,6 +273,13 @@ class DynamicBatcher:
         reg = self.obs.registry if self.obs is not None else None
         for r in reqs:
             r.queue_wait_s = max(now - r.t_admitted, 0.0)
+            if r.trace is not None:
+                # retrospective spans from the stamps the queue left:
+                # admission -> pop (queue_wait), pop -> window close
+                # (coalesce) — together they are the reported queue_wait_s
+                popped = r.t_popped or now
+                r.trace.add_span("queue_wait", r.t_admitted, popped)
+                r.trace.add_span("coalesce", popped, now)
         chunks = self._chunk(reqs)
         if reg is not None:
             wait_h = reg.histogram(

@@ -38,6 +38,7 @@ import numpy as np
 
 from nm03_capstone_project_tpu.compilehub import programs
 from nm03_capstone_project_tpu.config import PipelineConfig
+from nm03_capstone_project_tpu.obs.trace import NULL_TRACE
 from nm03_capstone_project_tpu.resilience import (
     DispatchSupervisor,
     FaultPlan,
@@ -57,6 +58,10 @@ DEFAULT_BUCKETS: Tuple[int, ...] = (1, 2, 4, 8, 16)
 class WarmExecutor:
     """Per-lane, per-bucket warm ``slice_pipeline`` executables.
 
+    ``supports_trace`` tells the batcher this executor accepts the
+    ``trace=`` chunk-trace argument on :meth:`run_batch` (test fakes
+    without it get a coarse batcher-side dispatch span instead).
+
     ``buckets`` is the ascending list of batch sizes an executable exists
     for; a coalesced chunk is padded up to the smallest bucket that fits
     (:meth:`bucket_for`), so the compile-shape set is fixed at startup and
@@ -64,6 +69,8 @@ class WarmExecutor:
     caps the replica-lane count (None = every local device, resolved
     lazily so constructing the executor never initializes a backend).
     """
+
+    supports_trace = True
 
     def __init__(
         self,
@@ -322,16 +329,24 @@ class WarmExecutor:
 
     # -- the serve-time entry point ----------------------------------------
 
-    def run_batch(self, pixels: np.ndarray, dims: np.ndarray, lane: int = 0):
+    def run_batch(
+        self, pixels: np.ndarray, dims: np.ndarray, lane: int = 0, trace=None
+    ):
         """Execute one bucket-padded batch on one lane, under supervision.
 
         ``pixels`` is (bucket, canvas, canvas) float32, ``dims`` (bucket, 2)
         int32 — already padded by the batcher; ``lane`` picks the replica
-        lane whose pinned executable (and chip) runs it. Returns host-side
-        ``(mask, converged)`` arrays. Raises only when the PR-3 ladder is
-        exhausted (deterministic error, or degraded with fallback disabled);
-        the batcher fails the batch's requests with it.
+        lane whose pinned executable (and chip) runs it. ``trace`` is the
+        chunk's :class:`~nm03_capstone_project_tpu.obs.trace.ChunkTrace`:
+        each supervised attempt records a ``device_dispatch`` + ``fetch``
+        span pair (and the degraded path a ``cpu_fallback`` span) shared
+        by every rider — retries show up as repeated attempts on the
+        timeline. Returns host-side ``(mask, converged)`` arrays. Raises
+        only when the PR-3 ladder is exhausted (deterministic error, or
+        degraded with fallback disabled); the batcher fails the batch's
+        requests with it.
         """
+        trace = trace if trace is not None else NULL_TRACE
         bucket = int(pixels.shape[0])
         fn = self._get_compiled(bucket, lane)
         index = next(self._dispatch_seq)
@@ -347,14 +362,21 @@ class WarmExecutor:
             if lane < len(self._lane_inflight):
                 self._lane_inflight[lane] += 1
 
+        attempts = {"n": 0}  # shared so retried primaries number their spans
+
         def primary():
             # fetch INSIDE the supervised call: a wedged fetch is the same
             # wedge as a wedged dispatch (supervisor contract)
-            mask, conv = fn(pixels, dims)
-            return np.asarray(mask), np.asarray(conv)
+            attempts["n"] += 1
+            with trace.span("device_dispatch", attempt=attempts["n"]):
+                mask, conv = fn(pixels, dims)
+            with trace.span("fetch", attempt=attempts["n"]):
+                # nm03-lint: disable=NM321 the fetch span MEASURES this device sync — that is its entire purpose (trace schema, docs/OBSERVABILITY.md)
+                return np.asarray(mask), np.asarray(conv)
 
         def fallback():
-            return self._fallback_call()(pixels, dims)
+            with trace.span("cpu_fallback"):
+                return self._fallback_call()(pixels, dims)
 
         try:
             out = self.supervisor.run(

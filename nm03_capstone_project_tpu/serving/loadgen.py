@@ -5,7 +5,11 @@ pipeline; this tool measures the SERVING path — queue wait, coalescing,
 shed behavior — with the numbers capacity planning needs: p50/p95/p99
 end-to-end latency, sustained throughput, status mix, and the observed
 batch-size distribution (from the server's ``X-Nm03-Batch-Size`` header,
-the direct evidence that dynamic batching coalesced anything).
+the direct evidence that dynamic batching coalesced anything). Every
+request carries a unique ``X-Nm03-Request-Id`` the server honors as its
+trace id and echoes back; the per-request records in ``--results-json``
+(sent/echoed id, server-reported queue-wait and lane) join client-side
+latencies to the server-side span trees ``nm03-trace`` exports (ISSUE 7).
 
 Two traffic models:
 
@@ -32,6 +36,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
+import uuid
 from typing import List, Optional
 
 import numpy as np
@@ -48,23 +53,59 @@ def _percentile(sorted_vals: List[float], p: float) -> float:
 class LoadResult:
     """Thread-safe accumulator for per-request observations."""
 
+    # per-request records kept for --results-json; bounded so a very long
+    # soak cannot balloon the artifact
+    MAX_REQUEST_RECORDS = 10000
+
     def __init__(self):
         self._lock = threading.Lock()
         self.latencies_s: List[float] = []
         self.statuses: collections.Counter = collections.Counter()
         self.batch_sizes: collections.Counter = collections.Counter()
+        self.queue_waits_s: List[float] = []
+        self.lanes: collections.Counter = collections.Counter()
+        self.requests_dropped = 0
+        self.requests: List[dict] = []
+        self.echo_mismatches = 0
         self.errors: List[str] = []
 
     def record(self, status: str, latency_s: float, batch_size: int = 0,
-               error: str = "") -> None:
+               error: str = "", sent_id: str = "", echoed_id: str = "",
+               queue_wait_s: Optional[float] = None,
+               lane: Optional[int] = None) -> None:
         with self._lock:
             self.statuses[status] += 1
             if status == "ok":
                 self.latencies_s.append(latency_s)
                 if batch_size:
                     self.batch_sizes[batch_size] += 1
+                if queue_wait_s is not None:
+                    self.queue_waits_s.append(queue_wait_s)
+                if lane is not None:
+                    self.lanes[lane] += 1
             elif error and len(self.errors) < 20:
                 self.errors.append(error)
+            if sent_id and echoed_id and sent_id != echoed_id:
+                self.echo_mismatches += 1
+            if len(self.requests) < self.MAX_REQUEST_RECORDS:
+                rec = {
+                    "id": sent_id,
+                    "echoed_id": echoed_id,
+                    "status": status,
+                    "latency_ms": round(latency_s * 1e3, 3),
+                }
+                if queue_wait_s is not None:
+                    rec["queue_wait_ms"] = round(queue_wait_s * 1e3, 3)
+                if lane is not None:
+                    rec["lane"] = lane
+                if batch_size:
+                    rec["batch_size"] = batch_size
+                self.requests.append(rec)
+            else:
+                # counted, not silent: a soak past the cap must say so in
+                # the artifact, or a server-side join reads the missing
+                # tail as requests with no client record
+                self.requests_dropped += 1
 
     def summary(self, wall_s: float, mode: str) -> dict:
         lat = sorted(self.latencies_s)
@@ -89,6 +130,22 @@ class LoadResult:
             "batch_sizes": {str(k): v for k, v in sorted(self.batch_sizes.items())},
             "max_observed_batch": max(self.batch_sizes) if self.batch_sizes else 0,
         }
+        # server-reported attribution (X-Nm03-Queue-Wait-Ms / X-Nm03-Lane):
+        # the queue-wait distribution separates "the server was slow" from
+        # "the request waited", and lanes_observed is the client-side view
+        # of the fleet fan-out
+        qw = sorted(self.queue_waits_s)
+        out["queue_wait_ms"] = {
+            "p50": round(_percentile(qw, 50) * 1e3, 2),
+            "p95": round(_percentile(qw, 95) * 1e3, 2),
+            "p99": round(_percentile(qw, 99) * 1e3, 2),
+            "mean": round(sum(qw) / len(qw) * 1e3, 2) if qw else 0.0,
+        }
+        out["lanes_observed"] = {str(k): v for k, v in sorted(self.lanes.items())}
+        out["trace_echo_mismatches"] = self.echo_mismatches
+        if self.requests_dropped:
+            out["requests_record_cap"] = self.MAX_REQUEST_RECORDS
+            out["requests_records_dropped"] = self.requests_dropped
         if self.errors:
             out["error_sample"] = self.errors[:5]
         return out
@@ -134,20 +191,39 @@ def _make_payloads(height: int, width: int, n_distinct: int, dicom: bool):
 
 
 def _one_request(url: str, body: bytes, headers: dict, timeout_s: float,
-                 result: LoadResult) -> None:
+                 result: LoadResult, req_id: str = "") -> None:
     t0 = time.monotonic()
+    if req_id:
+        headers = {**headers, "X-Nm03-Request-Id": req_id}
     req = urllib.request.Request(url, data=body, headers=headers, method="POST")
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
             resp.read()
             bs = int(resp.headers.get("X-Nm03-Batch-Size", 0))
-            result.record("ok", time.monotonic() - t0, batch_size=bs)
+            echoed = resp.headers.get("X-Nm03-Request-Id", "")
+            qw_hdr = resp.headers.get("X-Nm03-Queue-Wait-Ms")
+            lane_hdr = resp.headers.get("X-Nm03-Lane")
+            try:
+                qw = float(qw_hdr) / 1e3 if qw_hdr is not None else None
+            except ValueError:
+                qw = None
+            try:
+                lane = int(lane_hdr) if lane_hdr not in (None, "None") else None
+            except ValueError:
+                lane = None
+            result.record(
+                "ok", time.monotonic() - t0, batch_size=bs, sent_id=req_id,
+                echoed_id=echoed, queue_wait_s=qw, lane=lane,
+            )
     except urllib.error.HTTPError as e:
+        echoed = e.headers.get("X-Nm03-Request-Id", "") if e.headers else ""
         e.read()
         status = {503: "shed", 504: "timeout"}.get(e.code, f"http_{e.code}")
-        result.record(status, time.monotonic() - t0, error=f"HTTP {e.code}")
+        result.record(status, time.monotonic() - t0, error=f"HTTP {e.code}",
+                      sent_id=req_id, echoed_id=echoed)
     except Exception as e:  # noqa: BLE001 — a load test records, never dies
-        result.record("error", time.monotonic() - t0, error=str(e))
+        result.record("error", time.monotonic() - t0, error=str(e),
+                      sent_id=req_id)
 
 
 def run_load(
@@ -159,8 +235,19 @@ def run_load(
     timeout_s: float,
     result: Optional[LoadResult] = None,
 ) -> dict:
-    """Drive the load; returns the summary dict."""
+    """Drive the load; returns the summary dict.
+
+    Every request carries a unique ``X-Nm03-Request-Id`` (``lg-<run>-<n>``)
+    that the server honors as the trace id and echoes back — the handle
+    that joins a loadgen record to its server-side span tree
+    (``nm03-trace``) and flight-recorder entries.
+    """
     result = result if result is not None else LoadResult()
+    run_tag = uuid.uuid4().hex[:6]
+
+    def req_id(i: int) -> str:
+        return f"lg-{run_tag}-{i:06d}"
+
     t_start = time.monotonic()
     if rate_rps and rate_rps > 0:
         # open loop: fixed schedule, one thread per in-flight request —
@@ -174,7 +261,8 @@ def run_load(
                 time.sleep(delay)
             body, headers = payloads[i % len(payloads)]
             t = threading.Thread(
-                target=_one_request, args=(url, body, headers, timeout_s, result),
+                target=_one_request,
+                args=(url, body, headers, timeout_s, result, req_id(i)),
                 daemon=True,
             )
             t.start()
@@ -194,7 +282,7 @@ def run_load(
                 if i is None:
                     return
                 body, headers = payloads[i % len(payloads)]
-                _one_request(url, body, headers, timeout_s, result)
+                _one_request(url, body, headers, timeout_s, result, req_id(i))
 
         workers = [
             threading.Thread(target=worker, daemon=True)
@@ -316,9 +404,10 @@ def main(argv=None) -> int:
         warm = LoadResult()  # discarded: compile/cache effects stay out
         run_load(endpoint, payloads, args.warmup, min(args.warmup, 4), 0.0,
                  args.timeout_s, warm)
+    result = LoadResult()
     summary = run_load(
         endpoint, payloads, args.requests, args.concurrency, args.rate,
-        args.timeout_s,
+        args.timeout_s, result,
     )
     summary["endpoint"] = endpoint
     # serving topology alongside the numbers (mesh_shape/lanes ride next to
@@ -336,8 +425,21 @@ def main(argv=None) -> int:
     if args.results_json:
         from nm03_capstone_project_tpu.utils.timing import write_results_json
 
-        write_results_json(args.results_json, summary)
+        # per-request records (sent/echoed trace id, server-reported
+        # queue-wait and lane) ride the artifact, not stdout
+        write_results_json(
+            args.results_json, {**summary, "requests": result.requests}
+        )
     print(json.dumps(summary, indent=2))
+    lat, qw = summary["latency_ms"], summary["queue_wait_ms"]
+    print(
+        f"loadgen: ok={summary['requests_ok']}/{summary['requests_total']} "
+        f"p50={lat['p50']}ms p95={lat['p95']}ms "
+        f"queue_wait_p95={qw['p95']}ms "
+        f"lanes={summary['lanes_observed'] or '{}'} "
+        f"echo_mismatch={summary['trace_echo_mismatches']}",
+        flush=True,
+    )
     # exit non-zero when nothing succeeded: a load test that measured no
     # requests is a failed measurement, whatever the server said
     return 0 if summary["requests_ok"] > 0 else 1

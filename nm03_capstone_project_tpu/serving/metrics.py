@@ -24,6 +24,14 @@ SERVING_BATCHES_TOTAL = "serving_batches_total"
 SERVING_LANE_BATCHES_TOTAL = "serving_lane_batches_total"
 
 # -- gauges -----------------------------------------------------------------
+# compile-cost accounting (ISSUE 7; labels: spec = CompileSpec.label()):
+# what each warm executable cost to build and what it costs to run — the
+# denominators the perf trajectory was missing. Published from the hub's
+# cost report after serving warmup; flops/hbm series exist only where the
+# jaxlib version exposes cost_analysis()/memory_analysis().
+COMPILE_SECONDS = "compile_seconds"
+EXECUTABLE_FLOPS = "executable_flops"
+EXECUTABLE_HBM_BYTES = "executable_hbm_bytes"
 SERVING_INFLIGHT = "serving_inflight"  # admitted, not yet responded
 SERVING_READY = "serving_ready"  # 1 = warmed + admitting, 0 otherwise
 SERVING_DEGRADED = "serving_degraded"  # 1 = one-way CPU degradation tripped

@@ -46,13 +46,25 @@ class ServeRequest:
     pixels: object  # np.ndarray (h, w) float32, raw intensities
     dims: tuple  # (h, w)
     t_admitted: float = field(default_factory=time.monotonic)
+    # request-scoped tracing (ISSUE 7): the obs.trace.TraceContext whose
+    # trace id rode in on X-Nm03-Request-Id (or was minted at admission);
+    # every hop appends its span here. None for trace-less callers (tests).
+    trace: object = None
+    # stamped by AdmissionQueue.get_batch when the batcher pops this
+    # request — splits the queue_wait span from the coalesce span
+    t_popped: float = 0.0
     # filled by the batcher
     mask: object = None  # np.ndarray (h, w) uint8, cropped to dims
     converged: bool = True
     batch_size: int = 0
     queue_wait_s: float = 0.0
+    lane: Optional[int] = None  # the replica lane that served it
     error: Optional[BaseException] = None
     done: threading.Event = field(default_factory=threading.Event)
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        return self.trace.trace_id if self.trace is not None else None
 
     def fail(self, exc: BaseException) -> None:
         # nm03-lint: disable=NM331 release ordering via the Event: the write is sequenced before done.set(), and the waiter reads error only after wait() returns
@@ -122,17 +134,23 @@ class AdmissionQueue:
         Returns [] when the queue is closed AND empty — the batcher's exit
         signal.
         """
+        def pop() -> ServeRequest:
+            req = self._items.popleft()
+            # the queue_wait/coalesce trace boundary: waited until HERE
+            req.t_popped = time.monotonic()
+            return req
+
         batch: list = []
         with self._not_empty:
             while not self._items:
                 if self._closed:
                     return []
                 self._not_empty.wait(timeout=poll_s)
-            batch.append(self._items.popleft())
+            batch.append(pop())
             window_end = time.monotonic() + max_wait_s
             while len(batch) < max_batch:
                 if self._items:
-                    batch.append(self._items.popleft())
+                    batch.append(pop())
                     continue
                 remaining = window_end - time.monotonic()
                 if remaining <= 0 or self._closed:

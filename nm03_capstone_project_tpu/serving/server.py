@@ -41,9 +41,18 @@ from urllib.parse import parse_qs, urlsplit
 import numpy as np
 
 from nm03_capstone_project_tpu.config import PipelineConfig
+from nm03_capstone_project_tpu.obs.trace import (
+    SERVE_TRACE_EVENT,
+    TraceContext,
+    new_trace_id,
+    sanitize_trace_id,
+)
 from nm03_capstone_project_tpu.serving.batcher import DynamicBatcher
 from nm03_capstone_project_tpu.serving.executor import DEFAULT_BUCKETS, WarmExecutor
 from nm03_capstone_project_tpu.serving.metrics import (
+    COMPILE_SECONDS,
+    EXECUTABLE_FLOPS,
+    EXECUTABLE_HBM_BYTES,
     LATENCY_BUCKETS,
     SERVING_DEGRADED,
     SERVING_INFLIGHT,
@@ -128,6 +137,7 @@ class ServingApp:
         self.registry.gauge(
             SERVING_READY, help="1 = warmed and admitting, 0 otherwise"
         ).set(1)
+        self._publish_compile_cost()
         self.obs.events.emit(
             "serving_ready",
             buckets=list(self.executor.buckets),
@@ -135,6 +145,54 @@ class ServingApp:
             warmup_s=timings,
         )
         return timings
+
+    def _publish_compile_cost(self) -> None:
+        """Surface the hub's per-spec compile/cost accounting as gauges.
+
+        Runs after warmup (the spec set is complete then, and fixed for
+        the process's lifetime — no unbounded label cardinality). The
+        flops/HBM series only exist where the jaxlib exposes
+        ``cost_analysis()``/``memory_analysis()`` on AOT executables.
+        """
+        from nm03_capstone_project_tpu.compilehub import get_hub
+
+        hub = get_hub()
+        # compile_seconds comes from the hub's own per-label map (labels
+        # that collide — two cfg variants of one family — SUM there), so
+        # the gauge and the /readyz compile_hub.compile_seconds map can
+        # never disagree for the same label
+        for spec, seconds in hub.compile_seconds().items():
+            self.registry.gauge(
+                COMPILE_SECONDS,
+                help="compile wall-time per hub spec (AOT lower+compile; "
+                "deferred specs pay at first call, see "
+                "serving_warmup_seconds)",
+                spec=spec,
+            ).set(seconds)
+        # flops/HBM are per-executable alternatives, not additive: on a
+        # label collision keep the max (the conservative roofline
+        # denominator), never last-sorted-wins
+        flops: dict = {}
+        hbm: dict = {}
+        for entry in hub.cost_report():
+            spec = entry["label"]
+            if entry.get("flops") is not None:
+                flops[spec] = max(flops.get(spec, 0.0), entry["flops"])
+            if entry.get("peak_hbm_bytes") is not None:
+                hbm[spec] = max(hbm.get(spec, 0.0), entry["peak_hbm_bytes"])
+        for spec, v in flops.items():
+            self.registry.gauge(
+                EXECUTABLE_FLOPS,
+                help="XLA cost_analysis flops per executable",
+                spec=spec,
+            ).set(v)
+        for spec, v in hbm.items():
+            self.registry.gauge(
+                EXECUTABLE_HBM_BYTES,
+                help="XLA memory_analysis resident bytes "
+                "(arguments+outputs+temps-aliased) per executable",
+                spec=spec,
+            ).set(v)
 
     @property
     def ready(self) -> bool:
@@ -164,7 +222,12 @@ class ServingApp:
                 "per_lane": self.executor.lane_state(),
             },
             "mesh_shape": [lane_count] if lane_count else None,
-            "compile_hub": get_hub().stats(),
+            # stats() carries the total_compile_seconds rollup; the per-spec
+            # map makes warmup cost visible without grepping logs (ISSUE 7)
+            "compile_hub": {
+                **get_hub().stats(),
+                "compile_seconds": get_hub().compile_seconds(),
+            },
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }
 
@@ -265,11 +328,22 @@ class ServingApp:
             )
         return h, w
 
-    def submit(self, pixels: np.ndarray) -> ServeRequest:
-        """Admit one decoded slice; QueueFull/QueueClosed shed at the door."""
+    def submit(
+        self, pixels: np.ndarray, trace_id: Optional[str] = None
+    ) -> ServeRequest:
+        """Admit one decoded slice; QueueFull/QueueClosed shed at the door.
+
+        ``trace_id`` is the request-scoped trace identity (an honored
+        inbound ``X-Nm03-Request-Id``, or minted here): the request's
+        :class:`TraceContext` carries it through every hop and it is
+        echoed back on the response.
+        """
         h, w = self.guard_pixels(pixels)
         req = ServeRequest(
-            request_id=uuid.uuid4().hex[:12], pixels=pixels, dims=(h, w)
+            request_id=uuid.uuid4().hex[:12],
+            pixels=pixels,
+            dims=(h, w),
+            trace=TraceContext(trace_id or new_trace_id()),
         )
         self.queue.put(req)  # raises QueueFull / QueueClosed
         self.registry.gauge(
@@ -277,7 +351,12 @@ class ServingApp:
         ).inc()
         return req
 
-    def segment(self, pixels: np.ndarray, render: bool = True) -> dict:
+    def segment(
+        self,
+        pixels: np.ndarray,
+        render: bool = True,
+        trace_id: Optional[str] = None,
+    ) -> dict:
         """The full request path minus HTTP: admit, wait, build the payload.
 
         Raises RequestRejected (guards), QueueFull/QueueClosed (shed), or
@@ -286,7 +365,7 @@ class ServingApp:
         """
         t_start = time.monotonic()
         try:
-            req = self.submit(pixels)
+            req = self.submit(pixels, trace_id=trace_id)
         except (QueueFull, QueueClosed):
             self.registry.counter(
                 SERVING_SHED_TOTAL,
@@ -313,10 +392,12 @@ class ServingApp:
             ).dec()
         payload = {
             "request_id": req.request_id,
+            "trace_id": req.trace_id,
             "shape": [req.dims[0], req.dims[1]],
             "grow_converged": req.converged,
             "batch_size": req.batch_size,
             "queue_wait_s": round(req.queue_wait_s, 6),
+            "lane": req.lane,
             "degraded": self.executor.degraded,
             "mask_pixels": int(np.count_nonzero(req.mask)),
         }
@@ -325,13 +406,25 @@ class ServingApp:
             from nm03_capstone_project_tpu.render.host_render import host_render_pair
 
             dims = np.asarray(req.dims, np.int32)
-            gray, seg = host_render_pair(pixels, req.mask, dims, self.cfg)
-            payload["original_jpeg_b64"] = base64.b64encode(
-                encode_jpeg_bytes(gray, self.jpeg_quality)
-            ).decode("ascii")
-            payload["processed_jpeg_b64"] = base64.b64encode(
-                encode_jpeg_bytes(seg, self.jpeg_quality)
-            ).decode("ascii")
+            with req.trace.span("encode"):
+                gray, seg = host_render_pair(pixels, req.mask, dims, self.cfg)
+                payload["original_jpeg_b64"] = base64.b64encode(
+                    encode_jpeg_bytes(gray, self.jpeg_quality)
+                ).decode("ascii")
+                payload["processed_jpeg_b64"] = base64.b64encode(
+                    encode_jpeg_bytes(seg, self.jpeg_quality)
+                ).decode("ascii")
+        # one serve_trace event per completed request: the span tree the
+        # nm03-trace exporter turns into a Perfetto timeline
+        self.obs.events.emit(
+            SERVE_TRACE_EVENT,
+            trace_id=req.trace_id,
+            request_id=req.request_id,
+            lane=req.lane,
+            batch_size=req.batch_size,
+            queue_wait_s=round(req.queue_wait_s, 6),
+            spans=req.trace.snapshot(),
+        )
         self.registry.histogram(
             SERVING_REQUEST_SECONDS,
             help="end-to-end request latency (admission to payload built)",
@@ -406,6 +499,13 @@ def make_handler(app: ServingApp):
                 return
             query = parse_qs(split.query)
             render = query.get("output", ["jpeg"])[0] != "mask"
+            # request-scoped trace identity: honor a sane inbound
+            # X-Nm03-Request-Id, mint one otherwise; echoed on EVERY
+            # response (errors included) so clients can correlate
+            trace_id = sanitize_trace_id(
+                self.headers.get("X-Nm03-Request-Id")
+            ) or new_trace_id()
+            echo = [("X-Nm03-Request-Id", trace_id)]
             # decode phase: every rejection here is counted "invalid" ONCE
             # (segment() owns counting from admission onward)
             try:
@@ -428,36 +528,45 @@ def make_handler(app: ServingApp):
                     )
             except RequestRejected as e:
                 app._count_request("invalid")
-                self._reply(e.http_status, {"error": str(e)})
+                self._reply(e.http_status, {"error": str(e)}, headers=echo)
                 return
             except (ValueError, OverflowError) as e:  # bad int headers etc.
                 app._count_request("invalid")
-                self._reply(400, {"error": str(e)})
+                self._reply(400, {"error": str(e)}, headers=echo)
                 return
             try:
-                payload = app.segment(pixels, render=render)
+                payload = app.segment(pixels, render=render, trace_id=trace_id)
             except RequestRejected as e:  # guard failures (counted inside)
-                self._reply(e.http_status, {"error": str(e)})
+                self._reply(e.http_status, {"error": str(e)}, headers=echo)
             except (QueueFull, QueueClosed) as e:
                 self._reply(
                     503,
                     {"error": str(e), "draining": app.draining},
-                    headers=[("Retry-After", str(RETRY_AFTER_S))],
+                    headers=[("Retry-After", str(RETRY_AFTER_S)), *echo],
                 )
             except TimeoutError as e:
-                self._reply(504, {"error": str(e)})
+                self._reply(504, {"error": str(e)}, headers=echo)
             except Exception as e:  # noqa: BLE001 — per-request containment
                 log.warning("request failed: %s", e)
                 self._reply(
-                    500, {"error": str(e), "error_class": type(e).__name__}
+                    500,
+                    {"error": str(e), "error_class": type(e).__name__},
+                    headers=echo,
                 )
             else:
+                # the echoed trace id plus the per-request attribution
+                # headers nm03-loadgen records (queue wait / serving lane)
                 self._reply(
                     200,
                     payload,
                     headers=[
                         ("X-Nm03-Batch-Size", str(payload["batch_size"])),
-                        ("X-Nm03-Request-Id", payload["request_id"]),
+                        ("X-Nm03-Request-Id", payload["trace_id"]),
+                        ("X-Nm03-Lane", str(payload["lane"])),
+                        (
+                            "X-Nm03-Queue-Wait-Ms",
+                            f"{payload['queue_wait_s'] * 1e3:.3f}",
+                        ),
                     ],
                 )
 
@@ -549,6 +658,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--jpeg-quality", type=int, default=90, help="JPEG encoder quality"
     )
     g.add_argument(
+        "--flight-dir",
+        default=None,
+        metavar="DIR",
+        help="flight-recorder dump directory (default: $NM03_FLIGHTREC_DIR "
+        "or the cwd); dumps fire on SIGUSR2, on one-way CPU degradation, "
+        "and on an unhandled crash — docs/OPERATIONS.md post-mortem triage",
+    )
+    g.add_argument(
         "--device",
         choices=["auto", "tpu", "cpu"],
         default="auto",
@@ -599,6 +716,11 @@ def main(argv=None) -> int:
 
     common.apply_device_env(args.device)
     configure_reporting(verbose=args.verbose)
+    # arm the flight recorder before any backend work: SIGUSR2 dumps,
+    # degradation auto-dumps, and crash dumps all come through here
+    from nm03_capstone_project_tpu.obs import flightrec
+
+    flightrec.install(dump_dir=args.flight_dir)
     from nm03_capstone_project_tpu.obs import RunContext
 
     run_ctx = RunContext.create(

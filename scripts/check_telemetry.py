@@ -52,6 +52,18 @@ metrics
     ``--expect-gauge serving_lanes_ready=8``: a 7-lane fleet is a
     degraded replica, not a lesser success).
 
+trace (``--expect-trace FILE``)
+  * FILE is a Chrome/Perfetto ``trace_event`` export (``nm03-trace``
+    output): a JSON object whose ``traceEvents`` list is non-empty;
+  * duration events come in matching B/E pairs per (pid, tid) with proper
+    stack nesting (every E closes the most recent open B of that track,
+    names agree, nothing left open at EOF);
+  * timestamps are monotonic non-decreasing across the B/E stream
+    (metadata ``M`` events are exempt);
+  * every serving span (every B event) carries a trace id in its args
+    (``trace_ids`` non-empty or ``trace_id``) — the request attribution
+    the export exists for.
+
 cross
   * when both artifacts are given, their run_id and git_sha must match.
 """
@@ -336,6 +348,74 @@ def check_metrics(path: str, chk: Checker, expect_counters=None,
     return (snap.get("run_id"), snap.get("git_sha"))
 
 
+def check_trace(path: str, chk: Checker) -> None:
+    """Validate one Chrome/Perfetto trace_event export (nm03-trace output)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        chk.fail(path, f"unreadable or not JSON: {e}")
+        return
+    events = data.get("traceEvents") if isinstance(data, dict) else None
+    if not isinstance(events, list) or not events:
+        chk.fail(path, "traceEvents missing or empty")
+        return
+
+    stacks: dict[tuple, list] = {}
+    prev_ts = None
+    b_count = 0
+    for i, ev in enumerate(events):
+        where = f"{path}: traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            chk.fail(where, "event is not a JSON object")
+            continue
+        ph = ev.get("ph")
+        if ph == "M":
+            continue  # metadata names tracks; no ts contract
+        if ph not in ("B", "E"):
+            chk.fail(where, f"unexpected phase {ph!r} (want B/E/M)")
+            continue
+        ts = ev.get("ts")
+        if not _is_num(ts):
+            chk.fail(where, f"ts {ts!r} not numeric")
+            continue
+        if prev_ts is not None and ts < prev_ts:
+            chk.fail(where, f"ts {ts} went backwards (prev {prev_ts})")
+        prev_ts = ts
+        key = (ev.get("pid"), ev.get("tid"))
+        stack = stacks.setdefault(key, [])
+        if ph == "B":
+            b_count += 1
+            args = ev.get("args")
+            has_id = isinstance(args, dict) and (
+                (isinstance(args.get("trace_ids"), list) and args["trace_ids"])
+                or args.get("trace_id")
+            )
+            if not has_id:
+                chk.fail(
+                    where,
+                    f"serving span {ev.get('name')!r} carries no trace id "
+                    "(args.trace_ids/trace_id)",
+                )
+            stack.append((ev.get("name"), i))
+        else:  # E
+            if not stack:
+                chk.fail(where, f"E {ev.get('name')!r} with no open B on "
+                                f"track {key}")
+                continue
+            b_name, _ = stack.pop()
+            e_name = ev.get("name")
+            if e_name is not None and e_name != b_name:
+                chk.fail(where, f"E {e_name!r} closes B {b_name!r} "
+                                f"(mismatched pair on track {key})")
+    for key, stack in sorted(stacks.items(), key=lambda kv: str(kv[0])):
+        if stack:
+            names = [n for n, _ in stack]
+            chk.fail(path, f"track {key} ends with unclosed B events: {names}")
+    if b_count == 0:
+        chk.fail(path, "no duration (B/E) events — an empty timeline")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--events", default=None, help="JSONL event stream to validate")
@@ -363,9 +443,17 @@ def main(argv=None) -> int:
         "(repeatable; serving-topology assertions, e.g. "
         "serving_lanes_ready=8)",
     )
+    ap.add_argument(
+        "--expect-trace", action="append", default=[], metavar="FILE",
+        help="validate a Perfetto/Chrome trace_event export (nm03-trace "
+        "output): non-empty, monotonic ts, matched B/E pairs, every "
+        "serving span carrying a trace id (repeatable)",
+    )
     args = ap.parse_args(argv)
-    if not args.events and not args.metrics:
-        ap.error("nothing to check: pass --events and/or --metrics")
+    if not args.events and not args.metrics and not args.expect_trace:
+        ap.error(
+            "nothing to check: pass --events, --metrics and/or --expect-trace"
+        )
 
     def parse_expectations(specs: list, flag: str) -> dict:
         out = {}
@@ -394,6 +482,8 @@ def main(argv=None) -> int:
             args.metrics, chk, expect_counters, expect_histograms,
             expect_gauges,
         )
+    for trace_path in args.expect_trace:
+        check_trace(trace_path, chk)
     if ev_ident and mt_ident:
         if mt_ident[0] != ev_ident[0]:
             chk.fail("cross", f"metrics run_id {mt_ident[0]!r} != "
@@ -407,7 +497,9 @@ def main(argv=None) -> int:
     if chk.problems:
         print(f"check_telemetry: {len(chk.problems)} violation(s)", file=sys.stderr)
         return 1
-    checked = " and ".join(p for p in (args.events, args.metrics) if p)
+    checked = " and ".join(
+        p for p in (args.events, args.metrics, *args.expect_trace) if p
+    )
     print(f"check_telemetry: OK ({checked})")
     return 0
 

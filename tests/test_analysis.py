@@ -19,7 +19,10 @@ import textwrap
 import pytest
 
 from nm03_capstone_project_tpu.analysis import ALL_RULES, collect_files, run_rules
-from nm03_capstone_project_tpu.analysis.atomicio import check_atomic_io
+from nm03_capstone_project_tpu.analysis.atomicio import (
+    check_atomic_io,
+    check_obs_dump_io,
+)
 from nm03_capstone_project_tpu.analysis.compilehome import check_compile_home
 from nm03_capstone_project_tpu.analysis.contracts import check_import_contracts
 from nm03_capstone_project_tpu.analysis.core import (
@@ -554,6 +557,219 @@ class TestAtomicIo:
     def test_real_tree_atomic_clean(self):
         parsed = collect_files([REPO / PKG, REPO / "scripts"], REPO)
         fs = run_rules(parsed, (check_atomic_io,))
+        assert rules_of(fs) == [], [f.render() for f in fs]
+
+
+class TestObsDumpIo:
+    """NM371 (ISSUE 7): the flight-recorder/trace modules' write discipline
+    is stricter than NM351 — every write routes through atomic_write_*."""
+
+    def test_direct_write_in_flightrec_flagged(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/obs/flightrec.py": """
+                import json
+                def dump(path, snap):
+                    with open(path, "w") as f:
+                        json.dump(snap, f)
+                """
+            },
+            rules=(check_obs_dump_io,),
+        )
+        assert "NM371" in rules_of(fs)
+
+    def test_path_open_write_flagged(self, tmp_path):
+        # Path.open("w")/io.open are the same primitive wearing an
+        # attribute; mode is the FIRST positional there
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/obs/flightrec.py": """
+                import json, pathlib
+                def dump(path, snap):
+                    with pathlib.Path(path).open("w") as f:
+                        json.dump(snap, f)
+                """
+            },
+            rules=(check_obs_dump_io,),
+        )
+        assert "NM371" in rules_of(fs)
+
+    def test_io_open_literal_path_write_flagged(self, tmp_path):
+        # io.open takes (path, mode): a literal path must not masquerade
+        # as a read mode and let a write through
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/obs/flightrec.py": """
+                import io, json
+                def dump(snap):
+                    with io.open("debug.json", "w") as f:
+                        json.dump(snap, f)
+                """
+            },
+            rules=(check_obs_dump_io,),
+        )
+        assert "NM371" in rules_of(fs)
+
+    def test_io_open_literal_path_read_clean(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/obs/trace.py": """
+                import io, json
+                def load():
+                    with io.open("events.jsonl") as f:
+                        return json.load(f)
+                """
+            },
+            rules=(check_obs_dump_io,),
+        )
+        assert rules_of(fs) == [], [f.render() for f in fs]
+
+    def test_path_open_read_clean(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/obs/trace.py": """
+                import json, pathlib
+                def load(path):
+                    with pathlib.Path(path).open() as f:
+                        return json.load(f)
+                """
+            },
+            rules=(check_obs_dump_io,),
+        )
+        assert rules_of(fs) == [], [f.render() for f in fs]
+
+    def test_hand_rolled_tmp_rename_flagged_too(self, tmp_path):
+        # NM351 would ACCEPT this; NM371 must not — the idiom's single
+        # point of correctness is utils.atomicio, not a local copy
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/obs/trace.py": """
+                import json, os
+                def export(path, payload):
+                    tmp = f"{path}.tmp"
+                    with open(tmp, "w") as f:
+                        json.dump(payload, f)
+                    os.replace(tmp, path)
+                """
+            },
+            rules=(check_obs_dump_io,),
+        )
+        assert "NM371" in rules_of(fs)
+
+    def test_from_import_replace_flagged(self, tmp_path):
+        # ANY spelling: `from os import replace` must not slip past a
+        # matcher pinned to the literal `os.replace` attribute form
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/obs/flightrec.py": """
+                import json
+                from os import replace as publish
+                def export(path, payload):
+                    tmp = f"{path}.tmp"
+                    with open(tmp, "x") as f:
+                        json.dump(payload, f)
+                    publish(tmp, path)
+                """
+            },
+            rules=(check_obs_dump_io,),
+        )
+        assert rules_of(fs).count("NM371") >= 2  # the open AND the rename
+
+    def test_aliased_module_rename_flagged(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/obs/trace.py": """
+                import os as _os
+                def export(tmp, path):
+                    _os.rename(tmp, path)
+                """
+            },
+            rules=(check_obs_dump_io,),
+        )
+        assert "NM371" in rules_of(fs)
+
+    def test_pathlib_replace_and_rename_flagged(self, tmp_path):
+        # the modern spelling of the banned tmp+rename two-step
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/obs/flightrec.py": """
+                import pathlib
+                def publish(tmp, path):
+                    pathlib.Path(tmp).replace(path)
+                def publish2(tmp, path):
+                    tmp.rename(path)
+                """
+            },
+            rules=(check_obs_dump_io,),
+        )
+        assert rules_of(fs).count("NM371") == 2
+
+    def test_str_replace_clean(self, tmp_path):
+        # str.replace takes two positionals — must not trip the
+        # one-positional pathlib-replace matcher
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/obs/trace.py": """
+                def safe(reason):
+                    return reason.replace(" ", "_")
+                """
+            },
+            rules=(check_obs_dump_io,),
+        )
+        assert rules_of(fs) == [], [f.render() for f in fs]
+
+    def test_atomic_write_and_reads_clean(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/obs/flightrec.py": f"""
+                import json
+                from {PKG}.utils.atomicio import atomic_write_text
+                def load(path):
+                    with open(path) as f:
+                        return json.load(f)
+                def dump(path, snap):
+                    atomic_write_text(path, json.dumps(snap))
+                """
+            },
+            rules=(check_obs_dump_io,),
+        )
+        assert rules_of(fs) == [], [f.render() for f in fs]
+
+    def test_other_modules_unaffected(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/obs/events.py": """
+                def sink(path):
+                    return open(path, "w", buffering=1)
+                """
+            },
+            rules=(check_obs_dump_io,),
+        )
+        assert rules_of(fs) == []
+
+    def test_trace_flightrec_pinned_in_contract_registry(self):
+        from nm03_capstone_project_tpu.analysis.contracts import (
+            CONTRACT_REGISTRY,
+        )
+
+        for mod in (f"{PKG}.obs.trace", f"{PKG}.obs.flightrec"):
+            assert CONTRACT_REGISTRY[mod] == ("jax", "numpy")
+
+    def test_real_tree_obs_dump_clean(self):
+        parsed = collect_files([REPO / PKG], REPO)
+        fs = run_rules(parsed, (check_obs_dump_io,))
         assert rules_of(fs) == [], [f.render() for f in fs]
 
 

@@ -1,0 +1,938 @@
+"""ISSUE 7 tests: request-scoped tracing, compile-cost accounting, and the
+crash flight recorder.
+
+Four layers, mirroring the serving suites' structure:
+
+* jax-free units: trace ids, span records, shared chunk spans, the Chrome
+  ``trace_event`` exporter, the flight recorder's rings and atomic dumps;
+* the validator loop: ``nm03-trace`` CLI -> ``check_telemetry.py
+  --expect-trace`` (green on a real export, red on torn B/E pairs and on
+  spans missing trace ids);
+* in-process serving: trace ids honored/echoed, span trees in the event
+  stream, compile-cost in ``/readyz`` and the metrics snapshot, the
+  hang->degradation auto-dump drill;
+* subprocess acceptance: ``nm03-serve --lanes 4`` under loadgen traffic
+  produces a Perfetto-loadable trace where a coalesced batch shows >=2
+  requests sharing a dispatch span and dispatches land on >=2 distinct
+  lanes; and the SIGUSR2 drill — a live server with an in-flight (hung)
+  request dumps a flight record carrying that request's trace id.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from nm03_capstone_project_tpu.obs import flightrec, trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECKER = os.path.join(REPO, "scripts", "check_telemetry.py")
+CANVAS = 128
+
+
+# -- jax-free units ----------------------------------------------------------
+
+
+class TestTraceIds:
+    def test_sanitize_accepts_sane_ids(self):
+        for ok in ("abc", "lg-1a2b3c-000001", "A.b:c_d-9"):
+            assert trace.sanitize_trace_id(ok) == ok
+
+    def test_sanitize_rejects_garbage(self):
+        for bad in (None, "", "  ", "a" * 65, "sp ace", "new\nline",
+                    "-leading", 'q"uote', b"bytes"):
+            assert trace.sanitize_trace_id(bad) is None
+
+    def test_new_ids_unique(self):
+        ids = {trace.new_trace_id() for _ in range(64)}
+        assert len(ids) == 64
+
+    def test_span_ids_pid_salted(self):
+        # the exporter dedupes shared spans by id: ids from two processes
+        # (concatenated replica logs, ">>"-appended restarts) must not
+        # collide, so the per-process counter is salted with the pid
+        import os
+
+        sid = trace._new_span_id()
+        assert sid.startswith(f"s{os.getpid():x}.")
+
+
+class TestSpans:
+    def test_add_span_and_context_manager(self):
+        ctx = trace.TraceContext("t1")
+        ctx.add_span("queue_wait", 1.0, 1.25, extra="x")
+        with ctx.span("encode"):
+            pass
+        spans = ctx.snapshot()
+        assert [s["name"] for s in spans] == ["queue_wait", "encode"]
+        assert spans[0]["dur_s"] == 0.25 and spans[0]["trace_ids"] == ["t1"]
+        assert spans[0]["extra"] == "x"
+        assert spans[1]["dur_s"] >= 0
+
+    def test_fields_cannot_shadow_the_span_envelope(self):
+        rec = trace.make_span("x", 0.0, 1.0, ["t"], **{"riders": 99, "ok": 1})
+        assert rec["riders"] == 1  # reserved keys win over caller fields
+        assert rec["ok"] == 1
+
+    def test_chunk_span_shared_across_riders(self):
+        a, b = trace.TraceContext("a"), trace.TraceContext("b")
+        chunk = trace.ChunkTrace([a, b], lane=2)
+        with chunk.span("device_dispatch", attempt=1):
+            pass
+        sa, sb = a.snapshot()[0], b.snapshot()[0]
+        assert sa is sb  # literally one record, many riders
+        assert sa["riders"] == 2 and sa["lane"] == 2
+        assert sorted(sa["trace_ids"]) == ["a", "b"]
+
+    def test_null_trace_is_inert(self):
+        with trace.NULL_TRACE.span("anything"):
+            pass
+        trace.NULL_TRACE.mark("anything")
+
+
+class TestChromeExport:
+    def _records(self):
+        a = trace.TraceContext("ra")
+        a.add_span("queue_wait", 5.0, 5.1)
+        b = trace.TraceContext("rb")
+        b.add_span("queue_wait", 5.05, 5.1)
+        chunk = trace.ChunkTrace([a, b], lane=0)
+        with chunk.span("device_dispatch", attempt=1):
+            time.sleep(0.002)
+        return [
+            {"event": "serve_trace", "trace_id": "ra", "spans": a.snapshot()},
+            {"event": "serve_trace", "trace_id": "rb", "spans": b.snapshot()},
+        ]
+
+    def test_be_pairs_dedupe_and_order(self):
+        events = trace.chrome_trace_events(self._records())
+        bs = [e for e in events if e.get("ph") == "B"]
+        es = [e for e in events if e.get("ph") == "E"]
+        # 2 queue_waits + ONE shared dispatch (deduped by span id)
+        assert len(bs) == len(es) == 3
+        ts = [e["ts"] for e in events if e.get("ph") in ("B", "E")]
+        assert ts == sorted(ts)
+        disp = [e for e in bs if e["name"] == "device_dispatch"]
+        assert disp[0]["args"]["riders"] == 2
+        assert sorted(disp[0]["args"]["trace_ids"]) == ["ra", "rb"]
+
+    def test_track_layout(self):
+        events = trace.chrome_trace_events(self._records())
+        names = {
+            e["args"]["name"] for e in events
+            if e.get("ph") == "M" and e.get("name") == "thread_name"
+        }
+        assert "lane 0" in names
+        assert any(n.startswith("req ") for n in names)
+
+    def test_every_b_event_carries_trace_ids(self):
+        events = trace.chrome_trace_events(self._records())
+        for e in events:
+            if e.get("ph") == "B":
+                assert e["args"]["trace_ids"], e
+
+    def test_reused_client_trace_id_gets_distinct_tracks(self):
+        # trace ids are client-controlled; a retry reusing one mid-flight
+        # must not let the serializing cursor rewrite either request's
+        # times — the two span trees get distinct request tracks
+        recs = []
+        for req_id in ("srv-1", "srv-2"):
+            ctx = trace.TraceContext("dup-id")
+            ctx.add_span("queue_wait", 1.0, 1.2)
+            recs.append({
+                "event": "serve_trace", "trace_id": "dup-id",
+                "request_id": req_id, "spans": ctx.snapshot(),
+            })
+        events = trace.chrome_trace_events(recs)
+        tracks = {
+            e["args"]["name"] for e in events
+            if e.get("ph") == "M" and e.get("name") == "thread_name"
+        }
+        assert "req dup-id (srv-1)" in tracks and "req dup-id (srv-2)" in tracks
+        # and the overlapping spans keep their true (untouched) start ts
+        bs = [e for e in events if e.get("ph") == "B"]
+        assert len(bs) == 2 and len({e["tid"] for e in bs}) == 2
+        assert all(e["ts"] == 1.0 * 1e6 for e in bs)
+
+    def test_genuine_lane_overlap_spills_to_sibling_track(self):
+        # a PR-3 retry ladder: attempt 1 abandoned at the deadline but
+        # still running while attempt 2 serves the batch — BOTH spans land
+        # on "lane 0". The serializing cursor must not rewrite attempt 2's
+        # start or zero-width it; real overlap spills to a sibling track
+        # with true times, and the export still validates
+        ctx = trace.TraceContext("rc")
+        a1 = trace.make_span(
+            "device_dispatch", 1.0, 3.6, ["rc"], lane=0, attempt=1
+        )
+        a2 = trace.make_span(
+            "device_dispatch", 2.0, 2.5, ["rc"], lane=0, attempt=2
+        )
+        ctx.add(a1)
+        ctx.add(a2)
+        events = trace.chrome_trace_events(
+            [{"event": "serve_trace", "trace_id": "rc",
+              "spans": ctx.snapshot()}]
+        )
+        bs = {e["args"]["attempt"]: e for e in events if e.get("ph") == "B"}
+        assert bs[1]["ts"] == 1.0 * 1e6 and bs[2]["ts"] == 2.0 * 1e6
+        assert bs[1]["tid"] != bs[2]["tid"]
+        tracks = {
+            e["args"]["name"] for e in events
+            if e.get("ph") == "M" and e.get("name") == "thread_name"
+        }
+        assert "lane 0" in tracks and "lane 0 (overlap)" in tracks
+        # per-track stacks still balance: E never precedes its B
+        for tid in {e["tid"] for e in events if e.get("ph") in "BE"}:
+            depth = 0
+            for e in events:
+                if e.get("tid") != tid or e.get("ph") not in "BE":
+                    continue
+                depth += 1 if e["ph"] == "B" else -1
+                assert depth >= 0
+            assert depth == 0
+
+    def test_schema_drifted_record_does_not_crash_export(self):
+        # a null trace_id + present-but-EMPTY trace_ids list (hand-edited
+        # or foreign-producer stream) must export, not IndexError
+        events = trace.chrome_trace_events([
+            {"event": "serve_trace", "trace_id": None, "spans": [
+                {"id": "s1", "name": "x", "t0_s": 1.0, "dur_s": 0.1,
+                 "lane": None, "trace_ids": []},
+            ]},
+        ])
+        assert any(e.get("ph") == "B" for e in events)
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        rec = flightrec.FlightRecorder(ring=8)
+        for i in range(50):
+            rec.note("span", f"n{i}")
+        snap = rec.snapshot()
+        (records,) = snap["threads"].values()
+        assert len(records) == 8 and records[-1]["name"] == "n49"
+
+    def test_thread_table_lru_capped(self):
+        rec = flightrec.FlightRecorder(max_threads=2)
+
+        def noter(i):
+            rec.note("span", f"from{i}")
+
+        for i in range(4):
+            t = threading.Thread(target=noter, args=(i,), name=f"ring-t{i}")
+            t.start()
+            t.join()
+        snap = rec.snapshot()
+        assert len(snap["threads"]) == 2
+        names = {k.split("#")[0] for k in snap["threads"]}
+        assert names == {"ring-t2", "ring-t3"}
+
+    def test_rings_are_per_thread_even_with_shared_names(self):
+        # every supervisor worker is named "nm03-dispatch": one shared
+        # ring would let healthy lanes flush a wedged lane's evidence
+        rec = flightrec.FlightRecorder(ring=4)
+        barrier = threading.Barrier(2)
+
+        def noter(tag):
+            barrier.wait(timeout=10)
+            for i in range(4):
+                rec.note("span", f"{tag}-{i}")
+
+        threads = [
+            threading.Thread(target=noter, args=(t,), name="nm03-dispatch")
+            for t in ("a", "b")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = rec.snapshot()
+        assert len(snap["threads"]) == 2  # distinct idents, distinct rings
+        assert snap["records_total"] == 8  # nothing overwrote anything
+
+    def test_eviction_spares_live_silent_threads(self):
+        # a wedged thread stops noting (so stops being LRU-refreshed);
+        # eviction must drop dead threads' rings before a live one's
+        rec = flightrec.FlightRecorder(max_threads=2)
+        hold = threading.Event()
+        parked = threading.Event()
+
+        def wedged():
+            rec.note("span_begin", "device_dispatch", trace_ids=["stuck-1"])
+            parked.set()
+            hold.wait(timeout=30)
+
+        w = threading.Thread(target=wedged, name="wedged-lane")
+        w.start()
+        assert parked.wait(timeout=10)
+        try:
+            for i in range(5):  # transient handler-thread churn
+                t = threading.Thread(
+                    target=lambda: rec.note("span", "encode"),
+                    name=f"handler-{i}",
+                )
+                t.start()
+                t.join()
+            snap = rec.snapshot()
+            wedged_rings = [k for k in snap["threads"] if "wedged-lane" in k]
+            assert wedged_rings, snap["threads"].keys()
+            assert "stuck-1" in json.dumps(snap["threads"][wedged_rings[0]])
+        finally:
+            hold.set()
+            w.join(timeout=10)
+
+    def test_dump_is_atomic_and_schema_stable(self, tmp_path):
+        rec = flightrec.FlightRecorder()
+        rec.note("span", "queue_wait", trace_id="abc", lane=0)
+        path = rec.dump(path=str(tmp_path / "d.json"), reason="unit")
+        assert not list(tmp_path.glob("*.tmp"))  # tmp renamed away
+        data = json.loads((tmp_path / "d.json").read_text())
+        assert data["schema"] == flightrec.SCHEMA_FLIGHT
+        assert data["reason"] == "unit" and data["records_total"] == 1
+        assert "abc" in json.dumps(data["threads"])
+        assert path == str(tmp_path / "d.json")
+
+    def test_auto_dump_inert_until_configured(self, tmp_path):
+        rec = flightrec.FlightRecorder()
+        rec.note("span", "x")
+        assert rec.auto_dump("nope") is None
+        rec.configure(str(tmp_path))
+        path = rec.auto_dump("armed")
+        assert path is not None and os.path.exists(path)
+        assert "armed" in os.path.basename(path)
+        rec.configure(None)
+        assert rec.auto_dump("again") is None
+
+    def test_note_never_raises(self):
+        rec = flightrec.FlightRecorder()
+        rec.note("span", "x", unserializable=object())  # stored as-is, fine
+        # dump stringifies via default=str rather than dying
+        snap = rec.snapshot()
+        assert snap["records_total"] == 1
+
+
+# -- the exporter -> validator loop ------------------------------------------
+
+
+def run_checker(*args):
+    return subprocess.run(
+        [sys.executable, CHECKER, *[str(a) for a in args]],
+        capture_output=True, text=True, timeout=60,
+    )
+
+
+class TestExpectTraceGate:
+    def _export(self, tmp_path):
+        ctx = trace.TraceContext("ok-1")
+        ctx.add_span("queue_wait", 1.0, 1.1)
+        chunk = trace.ChunkTrace([ctx], lane=0)
+        with chunk.span("device_dispatch", attempt=1):
+            pass
+        events = tmp_path / "e.jsonl"
+        with open(events, "w") as f:
+            f.write(json.dumps({"event": "run_started"}) + "\n")
+            f.write(json.dumps({
+                "event": "serve_trace", "trace_id": "ok-1",
+                "spans": ctx.snapshot(),
+            }) + "\n")
+        out = tmp_path / "t.json"
+        n = trace.export_chrome_trace(str(events), str(out))
+        assert n == 1
+        return out
+
+    def test_valid_export_passes(self, tmp_path):
+        out = self._export(tmp_path)
+        res = run_checker("--expect-trace", out)
+        assert res.returncode == 0, res.stderr
+
+    def test_unbalanced_pairs_fail(self, tmp_path):
+        out = self._export(tmp_path)
+        data = json.loads(out.read_text())
+        data["traceEvents"] = [
+            e for e in data["traceEvents"] if e.get("ph") != "E"
+        ]
+        out.write_text(json.dumps(data))
+        res = run_checker("--expect-trace", out)
+        assert res.returncode == 1
+        assert "unclosed" in res.stderr
+
+    def test_missing_trace_id_fails(self, tmp_path):
+        out = self._export(tmp_path)
+        data = json.loads(out.read_text())
+        for e in data["traceEvents"]:
+            if e.get("ph") == "B":
+                e["args"] = {}
+        out.write_text(json.dumps(data))
+        res = run_checker("--expect-trace", out)
+        assert res.returncode == 1
+        assert "no trace id" in res.stderr
+
+    def test_backwards_ts_fails(self, tmp_path):
+        out = self._export(tmp_path)
+        data = json.loads(out.read_text())
+        be = [e for e in data["traceEvents"] if e.get("ph") in ("B", "E")]
+        be[-1]["ts"] = -1.0
+        out.write_text(json.dumps(data))
+        res = run_checker("--expect-trace", out)
+        assert res.returncode == 1
+        assert "backwards" in res.stderr
+
+    def test_empty_export_fails(self, tmp_path):
+        out = tmp_path / "empty.json"
+        out.write_text(json.dumps({"traceEvents": []}))
+        res = run_checker("--expect-trace", out)
+        assert res.returncode == 1
+
+    def test_nm03_trace_cli_exit_codes(self, tmp_path):
+        events = tmp_path / "no_traces.jsonl"
+        events.write_text(json.dumps({"event": "run_started"}) + "\n")
+        res = subprocess.run(
+            [sys.executable, "-m", "nm03_capstone_project_tpu.obs.trace",
+             str(events)],
+            capture_output=True, text=True, timeout=60, cwd=REPO,
+        )
+        assert res.returncode == 1  # empty export is a failed export
+        # diagnostics belong on stderr (runbook pipes stdout to artifacts)
+        assert "no serve_trace records" in res.stderr
+
+
+# -- batcher/executor span plumbing (fake executor, no jax) ------------------
+
+
+class TracingFakeExecutor:
+    """Lane-aware, trace-aware executor stand-in (mirrors WarmExecutor)."""
+
+    supports_trace = True
+
+    def __init__(self, buckets=(1, 2, 4), lanes=2, canvas=16, min_dim=4):
+        self.cfg = SimpleNamespace(canvas=canvas, min_dim=min_dim)
+        self.buckets = tuple(buckets)
+        self.lane_count = lanes
+
+    @property
+    def max_batch(self):
+        return self.buckets[-1]
+
+    def bucket_for(self, n):
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(n)
+
+    def run_batch(self, pixels, dims, lane=0, trace=None):
+        from nm03_capstone_project_tpu.obs.trace import NULL_TRACE
+
+        trace = trace if trace is not None else NULL_TRACE
+        with trace.span("device_dispatch", attempt=1):
+            mask = (pixels > 0).astype(np.uint8)
+        with trace.span("fetch", attempt=1):
+            pass
+        return mask, np.ones(pixels.shape[0], bool)
+
+
+class TestBatcherTracePlumbing:
+    def _reqs(self, n, hw=8):
+        from nm03_capstone_project_tpu.serving.queue import ServeRequest
+
+        return [
+            ServeRequest(
+                request_id=f"r{i}",
+                pixels=np.ones((hw, hw), np.float32),
+                dims=(hw, hw),
+                trace=trace.TraceContext(f"tr-{i}"),
+            )
+            for i in range(n)
+        ]
+
+    def test_span_tree_and_lane_recorded(self):
+        from nm03_capstone_project_tpu.serving.batcher import DynamicBatcher
+        from nm03_capstone_project_tpu.serving.queue import AdmissionQueue
+
+        ex = TracingFakeExecutor(buckets=(1, 2), lanes=2)
+        b = DynamicBatcher(AdmissionQueue(16), ex, max_wait_s=0.0)
+        reqs = self._reqs(4)  # 2 chunks of bucket 2 on lanes 0/1
+        b.execute(reqs)
+        for r in reqs:
+            names = [s["name"] for s in r.trace.snapshot()]
+            assert names == [
+                "queue_wait", "coalesce", "pad_stack", "device_dispatch",
+                "fetch",
+            ], names
+            assert r.lane in (0, 1)
+        # chunk spans are SHARED between a chunk's riders, not across chunks
+        d0 = [s for s in reqs[0].trace.snapshot()
+              if s["name"] == "device_dispatch"][0]
+        assert d0["riders"] == 2 and len(d0["trace_ids"]) == 2
+        lanes_used = {r.lane for r in reqs}
+        assert lanes_used == {0, 1}
+
+    def test_trace_less_requests_still_served(self):
+        from nm03_capstone_project_tpu.serving.batcher import DynamicBatcher
+        from nm03_capstone_project_tpu.serving.queue import (
+            AdmissionQueue,
+            ServeRequest,
+        )
+
+        ex = TracingFakeExecutor(buckets=(1, 2), lanes=2)
+        b = DynamicBatcher(AdmissionQueue(16), ex, max_wait_s=0.0)
+        reqs = [
+            ServeRequest(
+                request_id=f"r{i}", pixels=np.ones((8, 8), np.float32),
+                dims=(8, 8),
+            )
+            for i in range(3)
+        ]
+        b.execute(reqs)
+        assert all(r.done.is_set() and r.error is None for r in reqs)
+
+    def test_queue_stamps_pop_time(self):
+        from nm03_capstone_project_tpu.serving.queue import AdmissionQueue
+
+        q = AdmissionQueue(4)
+        (req,) = self._reqs(1)
+        q.put(req)
+        batch = q.get_batch(max_batch=1, max_wait_s=0.0)
+        assert batch == [req]
+        assert req.t_popped >= req.t_admitted > 0
+
+
+# -- compile-cost accounting -------------------------------------------------
+
+
+class TestCompileCost:
+    def test_hub_times_builds_and_reports_cost(self):
+        from nm03_capstone_project_tpu.compilehub import get_hub, programs
+        from nm03_capstone_project_tpu.config import PipelineConfig
+
+        # a canvas no other suite uses: guarantees a FRESH spec this test
+        # owns, whatever ran before in the process
+        cfg = PipelineConfig(canvas=96)
+        import jax
+
+        dev = jax.local_devices()[0]
+        programs.serve_mask(cfg, bucket=1, device=dev)
+        hub = get_hub()
+        stats = hub.stats()
+        assert stats["total_compile_seconds"] > 0
+        per_spec = hub.compile_seconds()
+        label = f"serve_mask/1x96x96/lane{dev.id}/pinned"
+        assert label in per_spec and per_spec[label] > 0
+        (entry,) = [e for e in hub.cost_report() if e["label"] == label]
+        assert entry["compile_s"] > 0
+        # the XLA analyses are version/backend-dependent: when present
+        # they must be positive and coherent, absence is not a failure
+        if "flops" in entry:
+            assert entry["flops"] > 0
+        if "bytes_accessed" in entry and "flops" in entry:
+            assert entry["intensity_flops_per_byte"] > 0
+
+    def test_executable_cost_on_aot_compile(self):
+        import jax
+        import jax.numpy as jnp
+
+        from nm03_capstone_project_tpu.compilehub import (
+            aot_compile,
+            executable_cost,
+            hub_jit,
+        )
+
+        fn = hub_jit(lambda x: (x * 2.0).sum())
+        compiled, aot_ok = aot_compile(
+            fn, jax.ShapeDtypeStruct((8, 8), jnp.float32)
+        )
+        assert aot_ok
+        cost = executable_cost(compiled)
+        assert isinstance(cost, dict)
+        for v in cost.values():
+            assert isinstance(v, float)
+
+    def test_deferred_callable_reports_empty_cost(self):
+        from nm03_capstone_project_tpu.compilehub import executable_cost
+
+        assert executable_cost(lambda x: x) == {}
+
+
+# -- in-process serving ------------------------------------------------------
+
+
+CFG_CANVAS = CANVAS
+
+
+@pytest.fixture(scope="module")
+def traced_app():
+    from nm03_capstone_project_tpu.config import PipelineConfig
+    from nm03_capstone_project_tpu.serving.server import ServingApp
+
+    app = ServingApp(
+        cfg=PipelineConfig(canvas=CFG_CANVAS),
+        queue_capacity=32,
+        buckets=(1, 2),
+        max_wait_s=0.05,
+        request_timeout_s=60.0,
+        lanes=1,
+    )
+    app.start()
+    yield app
+    app.begin_drain(reason="test")
+    app.close()
+
+
+class TestServingTraceE2E:
+    def test_trace_id_honored_and_span_tree_emitted(self, traced_app):
+        from nm03_capstone_project_tpu.data.synthetic import phantom_slice
+
+        app = traced_app
+        img = phantom_slice(CFG_CANVAS, CFG_CANVAS, seed=0)
+        results = []
+        lock = threading.Lock()
+
+        def one(i):
+            p = app.segment(img, render=False, trace_id=f"e2e-{i}")
+            with lock:
+                results.append(p)
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert len(results) == 6
+        for p in results:
+            assert p["trace_id"].startswith("e2e-")
+            assert p["lane"] == 0
+            assert p["queue_wait_s"] >= 0
+        traces = [
+            r for r in app.obs.events.tail if r["event"] == "serve_trace"
+        ]
+        by_id = {t["trace_id"]: t for t in traces}
+        assert {f"e2e-{i}" for i in range(6)} <= set(by_id)
+        names = {s["name"] for t in traces for s in t["spans"]}
+        assert {"queue_wait", "coalesce", "pad_stack", "device_dispatch",
+                "fetch"} <= names
+        # SERVE_SPAN_NAMES is the authoritative vocabulary: a new span
+        # name on the request path must be added there (and to the
+        # docs/OBSERVABILITY.md schema table) or this trips
+        assert names <= set(trace.SERVE_SPAN_NAMES), names
+
+    def test_readyz_carries_compile_cost(self, traced_app):
+        st = traced_app.status()
+        hub = st["compile_hub"]
+        assert hub["total_compile_seconds"] > 0
+        assert hub["compile_seconds"], hub
+        assert any("serve_mask" in k for k in hub["compile_seconds"])
+
+    def test_cost_gauges_in_snapshot(self, traced_app):
+        snap = traced_app.obs.metrics_snapshot()
+        by_name = {}
+        for m in snap["metrics"]:
+            by_name.setdefault(m["name"], []).append(m)
+        assert "compile_seconds" in by_name
+        for m in by_name["compile_seconds"]:
+            assert "spec" in m["labels"] and m["value"] >= 0
+        # the gauge must agree with the hub's own per-label map (the
+        # /readyz source) — including its sum-on-label-collision rule
+        from nm03_capstone_project_tpu.compilehub import get_hub
+
+        hub_map = get_hub().compile_seconds()
+        for m in by_name["compile_seconds"]:
+            spec = m["labels"]["spec"]
+            assert spec in hub_map
+            assert m["value"] == pytest.approx(hub_map[spec])
+
+    def test_export_from_event_tail_validates(self, traced_app, tmp_path):
+        traces = [
+            r for r in traced_app.obs.events.tail
+            if r["event"] == "serve_trace"
+        ]
+        assert traces
+        events = trace.chrome_trace_events(traces)
+        out = tmp_path / "inproc.trace.json"
+        out.write_text(json.dumps({"traceEvents": events}))
+        res = run_checker("--expect-trace", out)
+        assert res.returncode == 0, res.stderr
+
+
+class TestDegradationAutoDump:
+    def test_hang_degradation_dumps_flight_record(self, tmp_path):
+        """The chaos drill: an injected hang trips the dispatch deadline,
+        the one-way CPU degradation fires, and the supervisor auto-dumps
+        the flight recorder — with the wedged request's trace id inside."""
+        from nm03_capstone_project_tpu.config import PipelineConfig
+        from nm03_capstone_project_tpu.data.synthetic import phantom_slice
+        from nm03_capstone_project_tpu.resilience import (
+            FaultPlan,
+            ResilienceConfig,
+        )
+        from nm03_capstone_project_tpu.serving.server import ServingApp
+
+        plan = FaultPlan.from_spec(json.dumps({
+            "seed": 7,
+            "faults": [
+                {"site": "dispatch", "kind": "hang", "hang_s": 30.0,
+                 "count": 1},
+            ],
+        }))
+        flightrec.configure(str(tmp_path))
+        app = ServingApp(
+            cfg=PipelineConfig(canvas=CFG_CANVAS),
+            buckets=(1,),
+            max_wait_s=0.0,
+            resilience=ResilienceConfig(
+                retry_max=1, retry_backoff_s=0.01, dispatch_timeout_s=1.0
+            ),
+            fault_plan=plan,
+            lanes=1,
+        )
+        app.start()
+        try:
+            img = phantom_slice(CFG_CANVAS, CFG_CANVAS, seed=1)
+            p = app.segment(img, render=False, trace_id="chaos-hang-1")
+            assert p["degraded"] is True
+            dumps = sorted(tmp_path.glob("nm03_flight_*degraded_deadline*.json"))
+            assert dumps, list(tmp_path.iterdir())
+            data = json.loads(dumps[0].read_text())
+            assert data["schema"] == flightrec.SCHEMA_FLIGHT
+            assert "chaos-hang-1" in dumps[0].read_text()
+        finally:
+            flightrec.configure(None)
+            app.begin_drain(reason="test")
+            app.close()
+
+
+# -- loadgen attribution -----------------------------------------------------
+
+
+class TestLoadgenTrace:
+    def test_ids_echoed_and_queue_wait_recorded(self, traced_app):
+        from nm03_capstone_project_tpu.serving.loadgen import (
+            LoadResult,
+            _make_payloads,
+            run_load,
+        )
+        from nm03_capstone_project_tpu.serving.server import make_http_server
+
+        httpd = make_http_server(traced_app, "127.0.0.1", 0)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            port = httpd.server_address[1]
+            payloads = _make_payloads(
+                CFG_CANVAS, CFG_CANVAS, n_distinct=2, dicom=False
+            )
+            result = LoadResult()
+            summary = run_load(
+                f"http://127.0.0.1:{port}/v1/segment?output=mask",
+                payloads, n_requests=8, concurrency=4, rate_rps=0.0,
+                timeout_s=60.0, result=result,
+            )
+            assert summary["requests_ok"] == 8
+            assert summary["trace_echo_mismatches"] == 0
+            assert summary["queue_wait_ms"]["p95"] >= 0
+            assert summary["lanes_observed"].get("0", 0) > 0
+            assert len(result.requests) == 8
+            for rec in result.requests:
+                assert rec["id"].startswith("lg-")
+                assert rec["echoed_id"] == rec["id"]
+                assert rec["queue_wait_ms"] >= 0 and rec["lane"] == 0
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+# -- subprocess acceptance ---------------------------------------------------
+
+
+def _wait_port_file(proc, port_file, budget_s=300):
+    deadline = time.monotonic() + budget_s
+    while not os.path.exists(port_file) and time.monotonic() < deadline:
+        if proc.poll() is not None:
+            pytest.fail(f"server died: {proc.stdout.read()}")
+        time.sleep(0.2)
+    assert os.path.exists(port_file), "server never became ready"
+    with open(port_file) as f:
+        return int(f.read().strip())
+
+
+class TestAcceptanceMultiLaneTrace:
+    def test_four_lane_loadgen_trace_perfetto_loadable(self, tmp_path):
+        """The ISSUE 7 acceptance bar: loadgen against ``nm03-serve
+        --lanes 4`` yields a Perfetto-loadable export where >=1 coalesced
+        batch shows >=2 requests sharing one dispatch span, dispatches
+        land on >=2 distinct lanes, every request carries queue-wait/
+        coalesce/dispatch/fetch segments, and ``/readyz`` + the metrics
+        snapshot carry the compile-cost fields."""
+        from nm03_capstone_project_tpu.serving.loadgen import (
+            LoadResult,
+            _make_payloads,
+            run_load,
+        )
+
+        port_file = tmp_path / "port"
+        events = tmp_path / "events.jsonl"
+        metrics = tmp_path / "metrics.json"
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        )
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m",
+                "nm03_capstone_project_tpu.serving.server",
+                "--device", "cpu", "--port", "0",
+                "--port-file", str(port_file),
+                "--canvas", str(CANVAS), "--buckets", "1,2", "--lanes", "4",
+                "--max-wait-ms", "60", "--heartbeat-s", "0",
+                "--log-json", str(events), "--metrics-out", str(metrics),
+                "--flight-dir", str(tmp_path),
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO,
+        )
+        try:
+            port = _wait_port_file(proc, str(port_file))
+            base = f"http://127.0.0.1:{port}"
+            payloads = _make_payloads(CANVAS, CANVAS, n_distinct=2, dicom=False)
+            result = LoadResult()
+            summary = run_load(
+                base + "/v1/segment?output=mask", payloads,
+                n_requests=16, concurrency=16, rate_rps=0.0,
+                timeout_s=120.0, result=result,
+            )
+            assert summary["requests_ok"] == 16, summary
+            assert summary["trace_echo_mismatches"] == 0
+            assert len(summary["lanes_observed"]) >= 2, summary
+            with urllib.request.urlopen(base + "/readyz", timeout=10) as r:
+                st = json.loads(r.read())
+            assert st["compile_hub"]["total_compile_seconds"] > 0
+            assert st["compile_hub"]["compile_seconds"]
+            proc.send_signal(signal.SIGTERM)
+            out, _ = proc.communicate(timeout=120)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate(timeout=30)
+        assert proc.returncode == 0, out
+
+        # the flushed stream passes the events gate WITH serve_trace
+        # records inside, and the export passes --expect-trace
+        trace_out = tmp_path / "serve.trace.json"
+        res = subprocess.run(
+            [sys.executable, "-m", "nm03_capstone_project_tpu.obs.trace",
+             str(events), "-o", str(trace_out)],
+            capture_output=True, text=True, timeout=60, cwd=REPO,
+        )
+        assert res.returncode == 0, res.stdout + res.stderr
+        res = run_checker(
+            "--events", events, "--metrics", metrics,
+            "--expect-trace", trace_out,
+            "--expect-histogram", "serving_queue_wait_seconds=16",
+        )
+        assert res.returncode == 0, res.stderr
+
+        data = json.loads(trace_out.read_text())
+        bs = [e for e in data["traceEvents"] if e.get("ph") == "B"]
+        dispatches = [e for e in bs if e["name"] == "device_dispatch"]
+        assert dispatches
+        # >=2 requests share one dispatch span (a coalesced batch)...
+        assert any(len(e["args"]["trace_ids"]) >= 2 for e in dispatches), (
+            [e["args"] for e in dispatches]
+        )
+        # ...and dispatches land on >=2 distinct lanes
+        lanes = {e["args"].get("lane") for e in dispatches}
+        assert len(lanes) >= 2, lanes
+        # per-request segment coverage: every loadgen id has the full tree
+        spans_by_id: dict = {}
+        for e in bs:
+            for tid in e["args"]["trace_ids"]:
+                spans_by_id.setdefault(tid, set()).add(e["name"])
+        lg_ids = [r["id"] for r in result.requests]
+        for tid in lg_ids:
+            assert {"queue_wait", "coalesce", "device_dispatch",
+                    "fetch"} <= spans_by_id.get(tid, set()), tid
+
+
+class TestSigusr2Drill:
+    def test_sigusr2_dumps_inflight_trace_id(self, tmp_path):
+        """SIGUSR2 against a live server with a WEDGED in-flight request
+        produces an atomic flight-recorder dump naming that request's
+        trace id — the wedge post-mortem ISSUE 7 promises."""
+        port_file = tmp_path / "port"
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            NM03_FAULT_PLAN=json.dumps({
+                "seed": 3,
+                "faults": [{"site": "dispatch", "kind": "hang",
+                            "hang_s": 120.0, "count": 1}],
+            }),
+        )
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m",
+                "nm03_capstone_project_tpu.serving.server",
+                "--device", "cpu", "--port", "0",
+                "--port-file", str(port_file),
+                "--canvas", str(CANVAS), "--buckets", "1", "--lanes", "1",
+                "--max-wait-ms", "5", "--heartbeat-s", "0",
+                "--flight-dir", str(tmp_path),
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO,
+        )
+        try:
+            port = _wait_port_file(proc, str(port_file))
+            base = f"http://127.0.0.1:{port}"
+            from nm03_capstone_project_tpu.data.synthetic import phantom_slice
+
+            body = phantom_slice(CANVAS, CANVAS, seed=0).astype("<f4").tobytes()
+
+            def fire():
+                req = urllib.request.Request(
+                    base + "/v1/segment?output=mask", data=body,
+                    headers={
+                        "Content-Type": "application/octet-stream",
+                        "X-Nm03-Height": str(CANVAS),
+                        "X-Nm03-Width": str(CANVAS),
+                        "X-Nm03-Request-Id": "wedge-drill-1",
+                    },
+                    method="POST",
+                )
+                try:
+                    urllib.request.urlopen(req, timeout=10).read()
+                except Exception:  # noqa: BLE001 — it is SUPPOSED to wedge
+                    pass
+
+            threading.Thread(target=fire, daemon=True).start()
+            # wait until the request is admitted and the batcher recorded
+            # its queue_wait span into the flight ring, then trigger
+            time.sleep(2.0)
+            proc.send_signal(signal.SIGUSR2)
+            deadline = time.monotonic() + 30
+            dump = None
+            while time.monotonic() < deadline:
+                dumps = sorted(tmp_path.glob("nm03_flight_*sigusr2*.json"))
+                if dumps:
+                    dump = dumps[0]
+                    break
+                time.sleep(0.2)
+            assert dump is not None, list(tmp_path.iterdir())
+            text = dump.read_text()
+            data = json.loads(text)  # atomic: parses whole, or not present
+            assert data["schema"] == flightrec.SCHEMA_FLIGHT
+            assert "wedge-drill-1" in text, text[:2000]
+        finally:
+            proc.kill()
+            proc.communicate(timeout=30)
