@@ -47,6 +47,12 @@ CONTRACT_REGISTRY: Dict[str, Tuple[str, ...]] = {
     "nm03_capstone_project_tpu.ops.selection_network": ("jax", "numpy"),
     "nm03_capstone_project_tpu.serving.queue": ("jax",),
     "nm03_capstone_project_tpu.serving.metrics": ("jax",),
+    # the lane fault-domain state machine (ISSUE 8): unit-testable — and
+    # its quarantine transitions flight-dumpable — without a backend.
+    # jax-only like its queue/metrics siblings: the serving package
+    # __init__ (an ancestor on every import path) legitimately imports
+    # numpy for the batcher/server exports
+    "nm03_capstone_project_tpu.serving.lanes": ("jax",),
     "nm03_capstone_project_tpu.utils.reporter": ("jax", "numpy"),
     # the linter itself runs in pre-backend CI processes; the gate gates
     # itself so a convenience import can never make the gate cost a backend

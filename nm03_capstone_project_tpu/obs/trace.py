@@ -60,6 +60,8 @@ SERVE_SPAN_NAMES = (
     "pad_stack",        # chunk padded into its bucket canvas stack
     "device_dispatch",  # one supervised execute attempt on one lane
     "fetch",            # device -> host result fetch (inside the deadline)
+    "requeue",          # chunk re-dispatched off a quarantined lane
+    "probe",            # probation canary on a quarantined lane (off-path)
     "cpu_fallback",     # degraded-path recompute
     "encode",           # host render + JPEG encode on the handler thread
 )
@@ -179,12 +181,16 @@ class ChunkTrace:
     ``riders`` requests on the lane's track.
     """
 
-    __slots__ = ("contexts", "lane", "trace_ids")
+    __slots__ = ("contexts", "lane", "trace_ids", "served_by_fallback")
 
     def __init__(self, contexts: Iterable, lane: Optional[int] = None):
         self.contexts = [c for c in contexts if c is not None]
         self.lane = lane
         self.trace_ids = [c.trace_id for c in self.contexts]
+        # set True by WarmExecutor._run_degraded: the chunk was answered
+        # by the process-wide CPU fallback, on no lane — the batcher's
+        # per-lane accounting must skip it
+        self.served_by_fallback = False
 
     def mark(self, name: str, **fields) -> None:
         """Flight-recorder-only marker (no span): the in-flight evidence a

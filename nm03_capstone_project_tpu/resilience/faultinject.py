@@ -25,7 +25,9 @@ Activation (either):
       {"site": "export",   "kind": "sigterm",  "after": 4}
     ]}
 
-Selectors (``patient``, ``stem``, ``index``) restrict where a rule fires;
+Selectors (``patient``, ``stem``, ``index``, ``lane`` — the last for the
+serving fleet's dispatch site, so a chaos drill can deterministically wedge
+one chosen replica lane) restrict where a rule fires;
 ``after`` skips the first N-1 matching checks (1-based ordinal), ``count``
 caps total fires (default unlimited), and ``rate`` fires probabilistically —
 with the draw derived from (plan seed, rule, site, selector values), so the
@@ -95,6 +97,11 @@ class FaultRule:
     patient: Optional[str] = None
     stem: Optional[str] = None
     index: Optional[int] = None
+    # replica-lane selector (dispatch site, serving fleet): a chaos drill
+    # can deterministically wedge ONE chosen lane of a multi-chip replica
+    # ({"site": "dispatch", "kind": "hang", "lane": 2}); checks that carry
+    # no lane (the batch drivers) never match a lane-selected rule
+    lane: Optional[int] = None
     after: Optional[int] = None  # fire from the Nth matching check (1-based)
     count: Optional[int] = None  # max fires; None = unlimited
     rate: Optional[float] = None  # per-check probability (seeded draw)
@@ -117,13 +124,15 @@ class FaultRule:
             raise ValueError(f"hang_s must be >= 0, got {self.hang_s}")
         return self
 
-    def selectors_match(self, patient=None, stem=None, index=None) -> bool:
+    def selectors_match(self, patient=None, stem=None, index=None, lane=None) -> bool:
         """Selector-only match (no ordinal/count/rate state consulted)."""
         if self.patient is not None and self.patient != patient:
             return False
         if self.stem is not None and self.stem != stem:
             return False
         if self.index is not None and self.index != index:
+            return False
+        if self.lane is not None and self.lane != lane:
             return False
         return True
 
@@ -157,7 +166,7 @@ class FaultPlan:
                 raise ValueError(f"fault plan is not valid JSON: {e}") from e
         if not isinstance(spec, dict):
             raise ValueError(f"fault plan must be a JSON object, got {type(spec)}")
-        known = {"site", "kind", "patient", "stem", "index", "after",
+        known = {"site", "kind", "patient", "stem", "index", "lane", "after",
                  "count", "rate", "hang_s"}
         rules = []
         for i, entry in enumerate(spec.get("faults", [])):
@@ -190,17 +199,31 @@ class FaultPlan:
             for r in self.rules
         )
 
-    def _draw(self, rule_idx: int, rule: FaultRule, patient, stem, index) -> bool:
+    def _draw(
+        self, rule_idx: int, rule: FaultRule, patient, stem, index, lane
+    ) -> bool:
         # keyed, not sequential: the draw depends only on the plan seed and
-        # the check's identity, so IO-pool thread interleaving cannot change
-        # which slices a rate rule hits
+        # the check's identity (lane included — a serving fleet's lane
+        # thread scheduling must not change which dispatches a rate rule
+        # hits), so thread interleaving cannot change the injection set
         rng = random.Random(
-            f"{self.seed}:{rule_idx}:{rule.site}:{patient}:{stem}:{index}"
+            f"{self.seed}:{rule_idx}:{rule.site}:{patient}:{stem}:{index}:{lane}"
         )
         return rng.random() < rule.rate
 
-    def fire(self, site: str, obs=None, patient=None, stem=None, index=None):
+    def fire(
+        self, site: str, obs=None, patient=None, stem=None, index=None,
+        lane=None, lane_only=False,
+    ):
         """Return the first rule firing at this check site, else None.
+
+        ``lane_only`` restricts the check to rules that EXPLICITLY select a
+        lane — rules without a ``lane`` selector are skipped entirely
+        (their ordinal/budget state untouched). The serving probation
+        probes use it: an off-request-path canary must keep failing on a
+        deliberately-wedged chip, but must never consume a generic
+        dispatch rule's ``count``/``after`` budget meant for request
+        traffic.
 
         Consumes ordinal (``after``) and budget (``count``) state; emits the
         ``resilience_faults_injected_total`` counter + ``fault_injected``
@@ -212,14 +235,20 @@ class FaultPlan:
         hit = None
         with self._lock:
             for i, r in enumerate(self.rules):
-                if r.site != site or not r.selectors_match(patient, stem, index):
+                if lane_only and r.lane is None:
+                    continue
+                if r.site != site or not r.selectors_match(
+                    patient, stem, index, lane
+                ):
                     continue
                 r._seen += 1
                 if r.after is not None and r._seen < r.after:
                     continue
                 if r.count is not None and r._fired >= r.count:
                     continue
-                if r.rate is not None and not self._draw(i, r, patient, stem, index):
+                if r.rate is not None and not self._draw(
+                    i, r, patient, stem, index, lane
+                ):
                     continue
                 r._fired += 1
                 hit = r
@@ -228,7 +257,7 @@ class FaultPlan:
             try:
                 obs.fault_injected(
                     site=site, kind=hit.kind,
-                    patient=patient, stem=stem, index=index,
+                    patient=patient, stem=stem, index=index, lane=lane,
                 )
             except Exception:  # noqa: BLE001 — telemetry never blocks a fault
                 pass

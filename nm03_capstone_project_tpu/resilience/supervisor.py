@@ -53,10 +53,21 @@ class DispatchSupervisor:
         cfg: ResilienceConfig,
         retry: Optional[RetryPolicy] = None,
         obs=None,
+        emit_degraded: bool = True,
     ):
+        """``emit_degraded=False`` makes the degradation transition QUIET:
+        the one-way flag still flips (and ``run`` still raises / falls
+        back identically), but no ``degraded`` event, no
+        ``pipeline_degraded_total`` increment, and no flight-recorder
+        auto-dump fire. The serving executor runs one supervisor per
+        replica lane in this mode — a single lane expiring its deadline
+        is a lane *quarantine* (serving/lanes.py owns that telemetry),
+        not a process-wide degradation; the process-level event fires
+        only when the last healthy lane goes."""
         self.cfg = cfg
         self.retry = retry or cfg.make_retry_policy()
         self.obs = obs
+        self.emit_degraded = bool(emit_degraded)
         self._lock = threading.Lock()
         self.degraded = False
         self.degraded_cause: Optional[str] = None
@@ -198,6 +209,8 @@ class DispatchSupervisor:
                 self.degraded = True
                 self.degraded_cause = cause
                 first = True
+        if first and not self.emit_degraded:
+            first = False  # quiet mode: the caller owns transition telemetry
         if first and self.obs is not None:
             try:
                 self.obs.degraded(

@@ -10,9 +10,14 @@ Four pieces, each alone testable:
   coalescing into padded, bucket-shaped batches
   (:class:`DynamicBatcher`);
 * :mod:`~nm03_capstone_project_tpu.serving.executor` — one warm compiled
-  executable per batch bucket, dispatched through the PR-3
+  executable per batch bucket and replica lane, each lane's dispatches
+  supervised by its own PR-3
   :class:`~nm03_capstone_project_tpu.resilience.DispatchSupervisor`
   (:class:`WarmExecutor`);
+* :mod:`~nm03_capstone_project_tpu.serving.lanes` — the per-lane fault
+  domains (ISSUE 8): HEALTHY → QUARANTINED → PROBATION → HEALTHY, so one
+  sick chip costs 1/N capacity, not the replica
+  (:class:`LaneFaultDomains`);
 * :mod:`~nm03_capstone_project_tpu.serving.server` — the stdlib HTTP
   front end (``nm03-serve``): ``POST /v1/segment``, ``/healthz``,
   ``/readyz``, ``/metrics``, SIGTERM graceful drain.
@@ -26,6 +31,10 @@ from nm03_capstone_project_tpu.serving.batcher import DynamicBatcher  # noqa: F4
 from nm03_capstone_project_tpu.serving.executor import (  # noqa: F401
     DEFAULT_BUCKETS,
     WarmExecutor,
+)
+from nm03_capstone_project_tpu.serving.lanes import (  # noqa: F401
+    LaneFaultDomains,
+    LaneQuarantined,
 )
 from nm03_capstone_project_tpu.serving.metrics import (  # noqa: F401
     SERVING_BATCHES_TOTAL,

@@ -17,11 +17,22 @@ batch's chunks run on a lane-sized worker pool, one supervised dispatch
 per lane, and the batcher waits for the slowest chunk before popping the
 next window. With one lane this degenerates to exactly the PR-4 behavior:
 no pool, inline dispatch, identical accounting.
+
+Per-lane fault domains (ISSUE 8): the fan-out targets are the *currently
+healthy* lanes, not all lanes — the coalescing window's capacity shrinks
+and grows with the healthy-lane count, and a chunk whose lane quarantines
+mid-dispatch (:class:`~nm03_capstone_project_tpu.serving.lanes.LaneQuarantined`)
+is re-dispatched to a remaining healthy lane (span ``requeue``) instead
+of failing its riders — the request-level analog of the source paper's
+per-image error recovery. Only when no healthy lane remains does the
+chunk ride the executor's process-wide degraded path (CPU fallback, or a
+hard failure with ``--no-fallback-cpu``).
 """
 
 from __future__ import annotations
 
 import concurrent.futures as cf
+import itertools
 import math
 import threading
 import time
@@ -31,6 +42,7 @@ import numpy as np
 
 from nm03_capstone_project_tpu.obs.trace import ChunkTrace
 from nm03_capstone_project_tpu.serving.executor import WarmExecutor
+from nm03_capstone_project_tpu.serving.lanes import LaneQuarantined
 from nm03_capstone_project_tpu.serving.metrics import (
     BATCH_SIZE_BUCKETS,
     LATENCY_BUCKETS,
@@ -76,6 +88,8 @@ class DynamicBatcher:
         # lane worker pool, created on first multi-chunk batch (a 1-lane
         # process never pays the threads)
         self._pool: Optional[cf.ThreadPoolExecutor] = None
+        # round-robin cursor spreading requeued chunks over healthy lanes
+        self._requeue_seq = itertools.count()
         # written by the batcher thread, read by handler threads via
         # stats() (the /readyz status payload) — lock-guarded (NM331)
         self._lock = threading.Lock()
@@ -146,11 +160,29 @@ class DynamicBatcher:
             return 1
         return self.executor.lane_count or 1
 
+    def healthy_lanes(self) -> List[int]:
+        """Lane ids currently taking traffic (the fan-out targets).
+
+        Falls back to every lane when the executor predates fault domains
+        (tests' fakes) or when nothing is healthy — in the latter case the
+        executor is degraded and any lane id reaches the CPU fallback.
+        """
+        if self._lane_aware:
+            healthy = getattr(self.executor, "healthy_lanes", None)
+            if callable(healthy):
+                ids = healthy()
+                if ids:
+                    return ids
+        return list(range(self.lanes()))
+
     def effective_max_batch(self) -> int:
-        """The coalescing window's cap: fleet capacity, or the explicit
-        ``max_batch`` when smaller. Computed per window because the lane
-        count resolves at warmup, after this object is constructed."""
-        fleet = self.executor.max_batch * self.lanes()
+        """The coalescing window's cap: *healthy* fleet capacity, or the
+        explicit ``max_batch`` when smaller. Computed per window because
+        the lane count resolves at warmup (after construction) and the
+        healthy set shrinks/grows with quarantine and reinstatement — a
+        3-of-4-lane replica must not coalesce 4 lanes' worth of riders
+        onto 3 chips' executables."""
+        fleet = self.executor.max_batch * len(self.healthy_lanes())
         if self.max_batch is not None:
             return min(self.max_batch, fleet)
         return fleet
@@ -207,21 +239,43 @@ class DynamicBatcher:
             dims[i] = (h, w)
         return pixels, dims
 
-    def _chunk(self, reqs: List[ServeRequest]) -> List[List[ServeRequest]]:
+    def _chunk(
+        self, reqs: List[ServeRequest], n_lanes: int
+    ) -> List[List[ServeRequest]]:
         """Split one coalesced window into per-lane device chunks.
 
         Chunk size is the smallest warm bucket holding an even share
-        (``ceil(len/lanes)``): 12 requests over 8 lanes ride 6 chunks of
+        (``ceil(len/n_lanes)``): 12 requests over 8 lanes ride 6 chunks of
         bucket 2 — wide fan-out, minimal padding waste — while 128 over 8
-        fill every lane's largest bucket.
+        fill every lane's largest bucket. ``n_lanes`` is the HEALTHY lane
+        count: a shrunken fleet packs bigger chunks onto fewer chips
+        rather than queueing chunks behind a quarantined lane.
         """
-        lanes = self.lanes()
-        per = max(1, math.ceil(len(reqs) / lanes))
+        per = max(1, math.ceil(len(reqs) / max(n_lanes, 1)))
         per = self.executor.bucket_for(min(per, self.executor.max_batch))
         return [reqs[i : i + per] for i in range(0, len(reqs), per)]
 
+    def _dispatch(self, reqs, pixels, dims, lane: int, trace):
+        """One dispatch attempt on one lane (trace-aware when supported)."""
+        if self._lane_aware and getattr(self.executor, "supports_trace", False):
+            return self.executor.run_batch(pixels, dims, lane=lane, trace=trace)
+        if self._lane_aware:
+            with trace.span("device_dispatch"):
+                return self.executor.run_batch(pixels, dims, lane=lane)
+        with trace.span("device_dispatch"):
+            return self.executor.run_batch(pixels, dims)
+
     def _execute_chunk(self, reqs: List[ServeRequest], lane: int) -> None:
-        """Run one chunk on one lane and answer its riders."""
+        """Run one chunk on one lane and answer its riders.
+
+        When the lane quarantines mid-dispatch (``LaneQuarantined``), the
+        chunk is re-dispatched to a remaining healthy lane under a
+        ``requeue`` span — the riders never see the sick chip, they just
+        wait one more dispatch inside their existing request deadline.
+        Each requeue hop burns one lane from the healthy set, so the loop
+        is bounded by the fleet size; when no healthy lane remains the
+        executor's process-wide degraded path (CPU fallback) answers.
+        """
         # one shared trace for the chunk: every span it records carries all
         # riders' trace ids — a coalesced batch IS one dispatch on one lane
         trace = ChunkTrace([r.trace for r in reqs], lane=lane)
@@ -231,31 +285,87 @@ class DynamicBatcher:
         # post-mortem dump must carry the in-flight trace ids even when
         # the dispatch span never closes
         trace.mark("chunk_dispatch", batch=len(reqs), bucket=pixels.shape[0])
-        try:
-            if self._lane_aware and getattr(self.executor, "supports_trace", False):
-                mask_b, conv_b = self.executor.run_batch(
-                    pixels, dims, lane=lane, trace=trace
-                )
-            elif self._lane_aware:
-                with trace.span("device_dispatch"):
-                    mask_b, conv_b = self.executor.run_batch(
-                        pixels, dims, lane=lane
+        # requeue budget: one hop per lane the fleet started with, plus one
+        # final hop for the degraded path — a racing reinstatement cannot
+        # make the chunk ping-pong forever
+        hops_left = self.lanes() + 1
+        while True:
+            try:
+                mask_b, conv_b = self._dispatch(reqs, pixels, dims, lane, trace)
+                break
+            except LaneQuarantined as q:
+                hops_left -= 1
+                if hops_left <= 0:
+                    log.warning(
+                        "serve chunk exhausted its requeue budget "
+                        "(%d riders, last lane %d)", len(reqs), q.lane,
                     )
-            else:
-                with trace.span("device_dispatch"):
-                    mask_b, conv_b = self.executor.run_batch(pixels, dims)
-        except BaseException as e:  # noqa: BLE001 — per-chunk containment
-            # the PR-3 ladder is exhausted (deterministic failure, or
-            # degraded with --no-fallback-cpu): every rider of THIS chunk
-            # fails with the same cause; the HTTP layer maps it to a 500.
-            # Sibling chunks on other lanes are unaffected.
-            log.warning(
-                "serve dispatch failed for %d request(s) on lane %d: %s",
-                len(reqs), lane, e,
-            )
-            for r in reqs:
-                r.fail(e)
-            return
+                    # LaneQuarantined is batcher-internal by contract
+                    # (serving/lanes.py): riders get an operator-readable
+                    # wrapper, not the routing signal — this only happens
+                    # when lanes FLAP (quarantine/reinstate churn faster
+                    # than the hop budget) without the fleet ever settling
+                    # into the degraded CPU path
+                    err = RuntimeError(
+                        f"request dispatched {self.lanes() + 1} times "
+                        f"({self.lanes()} re-dispatches) across "
+                        "quarantining lanes without completing; the "
+                        "replica's lanes are flapping (see "
+                        "serving_lane_quarantines_total and the "
+                        "quarantine-triage runbook)"
+                    )
+                    err.__cause__ = q
+                    for r in reqs:
+                        r.fail(err)
+                    return
+                healthy = [
+                    ln for ln in self.healthy_lanes() if ln != q.lane
+                ] or [0]  # no healthy lane: the executor is (going) degraded
+                # and any lane id reaches the CPU fallback
+                # shared round-robin, NOT a function of chunk size: several
+                # same-size chunks fleeing one quarantined lane must spread
+                # over the survivors, not herd onto one chip
+                next_lane = healthy[next(self._requeue_seq) % len(healthy)]
+                with trace.span(
+                    "requeue", from_lane=q.lane, to_lane=next_lane,
+                    cause=q.cause,
+                ):
+                    for r in reqs:
+                        r.requeues += 1
+                trace.lane = next_lane
+                lane = next_lane
+            except BaseException as e:  # noqa: BLE001 — per-chunk containment
+                # the PR-3 ladder is exhausted (deterministic failure, or
+                # degraded with --no-fallback-cpu): every rider of THIS
+                # chunk fails with the same cause; the HTTP layer maps it
+                # to a 500. Sibling chunks on other lanes are unaffected.
+                log.warning(
+                    "serve dispatch failed for %d request(s) on lane %d: %s",
+                    len(reqs), lane, e,
+                )
+                for r in reqs:
+                    r.fail(e)
+                return
+        # credit the lane that ACTUALLY ran the chunk (after any requeue
+        # hops) — /readyz's lane_batches must agree with the executor's
+        # serving_lane_batches_total for the same traffic. A chunk the
+        # process-wide CPU fallback served ran on NO lane: neither series
+        # counts it. The executor flags that case on the chunk's OWN trace
+        # — re-reading `degraded` here would race a concurrent last-lane
+        # quarantine and miscount a chunk that DID run on its lane.
+        served_on_lane = not getattr(trace, "served_by_fallback", False)
+        if self._lane_aware and not getattr(
+            self.executor, "supports_trace", False
+        ):
+            # the trace never reached the executor (lane-aware test fake):
+            # the degraded re-read is the only signal available
+            served_on_lane = not getattr(self.executor, "degraded", False)
+        if served_on_lane:
+            with self._lock:
+                lane_key = str(lane)
+                self._stats["lane_batches"][lane_key] = (
+                    self._stats["lane_batches"].get(lane_key, 0) + 1
+                )
         for i, r in enumerate(reqs):
             h, w = r.dims
             # run_batch already fetched host-side arrays inside the
@@ -264,7 +374,9 @@ class DynamicBatcher:
             r.mask = np.asarray(mask_b[i][:h, :w])
             r.converged = bool(np.asarray(conv_b[i]))  # nm03-lint: disable=NM322 host ndarray, see above
             r.batch_size = len(reqs)
-            r.lane = lane
+            # a fallback-served chunk ran on NO lane: the payload/header
+            # report null, matching the lane accounting both series skip
+            r.lane = lane if served_on_lane else None
             r.done.set()
 
     def execute(self, reqs: List[ServeRequest]) -> None:
@@ -280,7 +392,11 @@ class DynamicBatcher:
                 popped = r.t_popped or now
                 r.trace.add_span("queue_wait", r.t_admitted, popped)
                 r.trace.add_span("coalesce", popped, now)
-        chunks = self._chunk(reqs)
+        # fan over the lanes that are actually taking traffic: a window
+        # coalesced while lane 2 sat in quarantine splits across the other
+        # three and never waits on the sick chip
+        targets = self.healthy_lanes()
+        chunks = self._chunk(reqs, len(targets))
         if reg is not None:
             wait_h = reg.histogram(
                 SERVING_QUEUE_WAIT_SECONDS,
@@ -298,29 +414,29 @@ class DynamicBatcher:
                 SERVING_BATCHES_TOTAL,
                 help="device batches dispatched by the serving batcher",
             ).inc(len(chunks))
-        lanes = self.lanes()
+        # chunk ci rides HEALTHY lane targets[ci % len(targets)] — never a
+        # quarantined one (the executor would only bounce it back)
+        assign = [targets[ci % len(targets)] for ci in range(len(chunks))]
         with self._lock:
             self._stats["batches"] += len(chunks)
             self._stats["requests"] += len(reqs)
             self._stats["max_coalesced"] = max(
                 self._stats["max_coalesced"], len(reqs)
             )
-            for ci in range(len(chunks)):
-                lane_key = str(ci % lanes)
-                self._stats["lane_batches"][lane_key] = (
-                    self._stats["lane_batches"].get(lane_key, 0) + 1
-                )
         if len(chunks) == 1:
-            self._execute_chunk(chunks[0], 0)
+            self._execute_chunk(chunks[0], assign[0])
             return
         with self._lock:
             if self._pool is None:
+                # sized to the FULL fleet: reinstated lanes must not queue
+                # behind a pool sized during a quarantine dip
                 self._pool = cf.ThreadPoolExecutor(
-                    max_workers=lanes, thread_name_prefix="nm03-serve-lane"
+                    max_workers=self.lanes(),
+                    thread_name_prefix="nm03-serve-lane",
                 )
             pool = self._pool
         futures = [
-            pool.submit(self._execute_chunk, chunk, ci % lanes)
+            pool.submit(self._execute_chunk, chunk, assign[ci])
             for ci, chunk in enumerate(chunks)
         ]
         for f in futures:

@@ -1,4 +1,4 @@
-"""Warm per-bucket, per-lane executables behind the PR-3 dispatch supervision.
+"""Warm per-bucket, per-lane executables behind per-lane fault domains.
 
 The r05 bench showed per-batch dispatch overhead — not device FLOPs — is
 what a cold path pays on every call: tracing, compilation, and executable
@@ -17,14 +17,18 @@ single-executable behavior. Compilation itself lives in
 :mod:`nm03_capstone_project_tpu.compilehub` — this class holds no compile
 cache of its own, only lane state.
 
-Supervision is inherited, not reimplemented: every lane dispatch runs
-through the PR-3 :class:`DispatchSupervisor`, so online traffic gets the
-same deadline guard, transient-error retry, and one-way CPU degradation
-as the batch drivers. Degradation is process-wide by design: the CPU
-fallback serves every lane's traffic (correct-but-slower), ``/readyz``
-flips not-ready, and the load balancer drains the whole replica — a
-single sick chip is not worth per-lane triage inside one process (see
-docs/OPERATIONS.md, "Multi-chip serving").
+Supervision is inherited, not reimplemented — but the fault domain is now
+the **lane**, not the process (ISSUE 8): each lane runs its dispatches
+through its own PR-3 :class:`DispatchSupervisor`, and a deadline expiry
+or exhausted retry budget *quarantines that lane*
+(:mod:`~nm03_capstone_project_tpu.serving.lanes`) instead of draining
+the replica. A background probation probe re-executes the quarantined
+lane's warm executable on a canary batch, supervised, off the request
+path, and reinstates the lane when it passes. The one-way process-wide
+CPU degradation remains the last resort: it fires only when EVERY lane
+is quarantined — a replica keeps serving at (N−1)/N capacity through a
+single-chip failure instead of degrading to CPU
+(docs/OPERATIONS.md, "Multi-chip serving").
 """
 
 from __future__ import annotations
@@ -38,7 +42,8 @@ import numpy as np
 
 from nm03_capstone_project_tpu.compilehub import programs
 from nm03_capstone_project_tpu.config import PipelineConfig
-from nm03_capstone_project_tpu.obs.trace import NULL_TRACE
+from nm03_capstone_project_tpu.obs import flightrec
+from nm03_capstone_project_tpu.obs.trace import NULL_TRACE, TraceContext
 from nm03_capstone_project_tpu.resilience import (
     DispatchSupervisor,
     FaultPlan,
@@ -46,13 +51,31 @@ from nm03_capstone_project_tpu.resilience import (
     ResilienceConfig,
     execute_hang,
 )
+from nm03_capstone_project_tpu.resilience.policy import (
+    DeadlineExceeded,
+    is_retryable,
+)
+from nm03_capstone_project_tpu.serving.lanes import (
+    PROBATION,
+    QUARANTINED,
+    LaneFaultDomains,
+    LaneQuarantined,
+)
 from nm03_capstone_project_tpu.serving.metrics import (
     SERVING_LANE_BATCHES_TOTAL,
     SERVING_LANE_INFLIGHT,
     SERVING_LANES_READY,
 )
+from nm03_capstone_project_tpu.utils.reporter import get_logger
+
+log = get_logger("serving")
 
 DEFAULT_BUCKETS: Tuple[int, ...] = (1, 2, 4, 8, 16)
+
+# how long the probation prober sleeps between passes over the
+# quarantined set; a quarantined chip gets its first canary after one
+# interval, so the knob trades reinstatement latency against probe load
+DEFAULT_LANE_PROBE_INTERVAL_S = 5.0
 
 
 class WarmExecutor:
@@ -68,6 +91,16 @@ class WarmExecutor:
     serve-time traffic can never trigger a recompile stall. ``lanes``
     caps the replica-lane count (None = every local device, resolved
     lazily so constructing the executor never initializes a backend).
+
+    Fault domains: each lane owns a supervisor and a state in the
+    :class:`LaneFaultDomains` machine. :meth:`run_batch` on a lane whose
+    supervised dispatch times out (or exhausts its transient-retry
+    budget) raises :class:`LaneQuarantined` toward the batcher — which
+    re-dispatches the chunk to a healthy lane — and the probation prober
+    (one daemon thread, spawned at first quarantine) re-warms the lane
+    off the request path. ``degraded`` flips one-way only when the LAST
+    healthy lane quarantines; from then on every dispatch runs the CPU
+    fallback (or fails fast with ``--no-fallback-cpu``).
     """
 
     supports_trace = True
@@ -80,6 +113,7 @@ class WarmExecutor:
         obs=None,
         fault_plan: Optional[FaultPlan] = None,
         lanes: Optional[int] = None,
+        lane_probe_interval_s: float = DEFAULT_LANE_PROBE_INTERVAL_S,
     ):
         if not buckets or list(buckets) != sorted(set(int(b) for b in buckets)):
             raise ValueError(
@@ -89,25 +123,44 @@ class WarmExecutor:
             raise ValueError(f"buckets must be >= 1, got {buckets}")
         if lanes is not None and lanes < 1:
             raise ValueError(f"lanes must be >= 1 (or None = all), got {lanes}")
+        if lane_probe_interval_s <= 0:
+            raise ValueError(
+                f"lane_probe_interval_s must be > 0, got {lane_probe_interval_s}"
+            )
         self.cfg = cfg
         self.buckets: Tuple[int, ...] = tuple(int(b) for b in buckets)
         self.obs = obs
         self.res = resilience if resilience is not None else ResilienceConfig()
         self.fault_plan = fault_plan
-        retry = self.res.make_retry_policy(
-            seed=fault_plan.seed if fault_plan is not None else 0
-        )
-        retry.obs = obs
-        self.supervisor = DispatchSupervisor(self.res, retry=retry, obs=obs)
+        self.lane_probe_interval_s = float(lane_probe_interval_s)
         self._fallback_fn = None
         self._lock = threading.Lock()
         self._dispatch_seq = itertools.count()
+        self._probe_seq = itertools.count()
         self._warm = False
         self._requested_lanes = lanes
         self._lane_devices: Optional[List] = None
         self._lane_warm: List[bool] = []
         self._lane_inflight: List[int] = []
         self._lane_batches: List[int] = []
+        self._lane_supervisors: List[DispatchSupervisor] = []
+        self.fleet: Optional[LaneFaultDomains] = None
+        self._prober: Optional[threading.Thread] = None
+        self._degraded = False
+        self._degraded_cause: Optional[str] = None
+
+    def _new_supervisor(self) -> DispatchSupervisor:
+        """One quiet-mode supervisor (a lane's, or a probe's): deadline +
+        retry semantics identical to PR 3, but its one-way degradation is
+        a LANE outcome — the process-level event/dump fires here, in
+        :meth:`_process_degrade`, only when the last lane goes."""
+        retry = self.res.make_retry_policy(
+            seed=self.fault_plan.seed if self.fault_plan is not None else 0
+        )
+        retry.obs = self.obs
+        return DispatchSupervisor(
+            self.res, retry=retry, obs=self.obs, emit_degraded=False
+        )
 
     # -- lanes -------------------------------------------------------------
 
@@ -119,10 +172,18 @@ class WarmExecutor:
         devs = programs.lane_devices(self._requested_lanes)
         with self._lock:
             if self._lane_devices is None:
+                # fleet construction INSIDE the winner check:
+                # LaneFaultDomains.__init__ publishes every lane's state
+                # gauge, so a losing racer's throwaway fleet would reset
+                # a live quarantine's gauge back to healthy
                 self._lane_devices = devs
                 self._lane_warm = [self._warm] * len(devs)
                 self._lane_inflight = [0] * len(devs)
                 self._lane_batches = [0] * len(devs)
+                self._lane_supervisors = [
+                    self._new_supervisor() for _ in devs
+                ]
+                self.fleet = LaneFaultDomains(len(devs), obs=self.obs)
             return self._lane_devices
 
     @property
@@ -136,19 +197,55 @@ class WarmExecutor:
 
     @property
     def lanes_ready(self) -> int:
-        """Warm lanes — the ``serving_lanes_ready`` gauge's value."""
+        """Warm AND healthy lanes — the ``serving_lanes_ready`` gauge.
+
+        A quarantined lane's executables stay warm, but it takes no
+        traffic, so it is not *ready*; probation reinstatement returns
+        the gauge to the full lane count.
+        """
         with self._lock:
+            fleet = self.fleet
             if self._lane_devices is not None:
-                return sum(1 for w in self._lane_warm if w)
+                return sum(
+                    1
+                    for i, w in enumerate(self._lane_warm)
+                    if w and (fleet is None or fleet.is_healthy(i))
+                )
             return (self._requested_lanes or 1) if self._warm else 0
 
+    def healthy_lanes(self) -> Optional[List[int]]:
+        """Lane ids currently accepting traffic; None before resolution."""
+        with self._lock:
+            fleet = self.fleet
+        if fleet is None:
+            return None
+        return fleet.healthy_lanes()
+
+    @property
+    def quarantined_count(self) -> int:
+        with self._lock:
+            fleet = self.fleet
+        return fleet.quarantined_count() if fleet is not None else 0
+
+    @property
+    def capacity(self) -> Optional[float]:
+        """Healthy-lane fraction of the fleet (the ``/readyz`` field);
+        None before lane resolution."""
+        with self._lock:
+            fleet = self.fleet
+            n = len(self._lane_devices) if self._lane_devices else 0
+        if fleet is None or n == 0:
+            return None
+        return round(fleet.healthy_count() / n, 4)
+
     def lane_state(self) -> List[dict]:
-        """Per-lane readiness/inflight/dispatch state (the ``/readyz``
-        ``lanes.per_lane`` payload); [] before lane resolution."""
+        """Per-lane readiness/inflight/dispatch/fault-domain state (the
+        ``/readyz`` ``lanes.per_lane`` payload); [] before resolution."""
         with self._lock:
             if self._lane_devices is None:
                 return []
-            return [
+            fleet = self.fleet
+            rows = [
                 {
                     "lane": i,
                     "device": str(d),
@@ -158,12 +255,19 @@ class WarmExecutor:
                 }
                 for i, d in enumerate(self._lane_devices)
             ]
+        if fleet is not None:
+            for row, st in zip(rows, fleet.snapshot()):
+                row["state"] = st["state"]
+                row["quarantine_cause"] = st["cause"]
+                row["quarantines"] = st["quarantines"]
+        return rows
 
     def _set_lanes_ready_gauge(self) -> None:
         if self.obs is not None:
             self.obs.registry.gauge(
                 SERVING_LANES_READY,
-                help="warm replica lanes (chips) in this serving process",
+                help="warm, healthy replica lanes (chips) taking traffic "
+                "in this serving process",
             ).set(self.lanes_ready)
 
     # -- state -------------------------------------------------------------
@@ -190,12 +294,15 @@ class WarmExecutor:
 
     @property
     def degraded(self) -> bool:
-        """True once the one-way CPU degradation has tripped (PR 3)."""
-        return self.supervisor.degraded
+        """True once the LAST healthy lane quarantined and the one-way
+        process-wide CPU degradation tripped (the PR-3 last resort)."""
+        with self._lock:
+            return self._degraded
 
     @property
     def degraded_cause(self) -> Optional[str]:
-        return self.supervisor.degraded_cause
+        with self._lock:
+            return self._degraded_cause
 
     @property
     def max_batch(self) -> int:
@@ -272,9 +379,9 @@ class WarmExecutor:
 
         One deferred-trace hub program shared across buckets and lanes —
         XLA retraces per bucket shape, which is acceptable on the degraded
-        path (correct-but-slower is the contract; the service flips
-        not-ready either way, and every lane funnels here: a wedged chip
-        drains the replica, it does not get per-lane triage).
+        path (correct-but-slower is the contract; every-lane-quarantined
+        means the service flips not-ready and the balancer drains the
+        replica while this keeps answering).
         """
         with self._lock:
             if self._fallback_fn is not None:
@@ -308,24 +415,206 @@ class WarmExecutor:
 
     # -- chaos hook --------------------------------------------------------
 
-    def _pre(self, index: int):
-        """Dispatch-site fault hook (resilience.FaultPlan); None when off."""
+    def _pre(
+        self,
+        index: Optional[int],
+        lane: Optional[int] = None,
+        lane_only: bool = False,
+    ):
+        """Dispatch-site fault hook (resilience.FaultPlan); None when off.
+
+        ``lane`` reaches the plan's selectors, so a rule like
+        ``{"site": "dispatch", "kind": "hang", "lane": 2}`` wedges one
+        chosen lane deterministically. Probation probes pass
+        ``lane_only=True``: only rules that explicitly select their lane
+        are consulted — a still-sick chip keeps failing its canary — and
+        generic dispatch rules keep their ordinal/``count`` budgets for
+        the request traffic they were written against.
+        """
         plan = self.fault_plan
         if plan is None or not plan.has_site("dispatch"):
             return None
 
         def pre(cancel):
-            rule = plan.fire("dispatch", obs=self.obs, index=index)
+            rule = plan.fire(
+                "dispatch", obs=self.obs, index=index, lane=lane,
+                lane_only=lane_only,
+            )
             if rule is None:
                 return
             if rule.kind == "hang":
                 execute_hang(rule, cancel)
             else:  # transient
                 raise InjectedTransientError(
-                    f"injected transient device error (serve dispatch {index})"
+                    f"injected transient device error (serve dispatch "
+                    f"{index} lane {lane})"
                 )
 
         return pre
+
+    # -- quarantine / probation -------------------------------------------
+
+    @staticmethod
+    def _quarantine_cause(exc: BaseException) -> Optional[str]:
+        """Map a supervised-dispatch failure to a lane-quarantine cause.
+
+        Deadline expiry and an exhausted transient-retry budget are LANE
+        faults (the chip, or its tunnel, is sick); anything else is a
+        deterministic error that must propagate to the riders unchanged.
+        """
+        if isinstance(exc, DeadlineExceeded):
+            return "deadline"
+        if is_retryable(exc):
+            return "device_lost"
+        return None
+
+    def _quarantine_lane(self, lane: int, cause: str, trace) -> None:
+        fleet = self.fleet
+        if fleet is None:
+            return
+        changed, healthy_left = fleet.quarantine(
+            lane, cause, trace_ids=getattr(trace, "trace_ids", [])
+        )
+        if not changed:
+            return
+        self._set_lanes_ready_gauge()
+        if healthy_left == 0:
+            self._process_degrade(cause)
+        else:
+            self._ensure_prober()
+
+    def _process_degrade(self, cause: str) -> None:
+        """Every lane is quarantined: trip the one-way PR-3 last resort.
+
+        This is the ONLY site that emits the process-level ``degraded``
+        event / ``pipeline_degraded_total`` / ``degraded_<cause>`` flight
+        dump — single-lane quarantines carry their own telemetry
+        (serving/lanes.py) and must not masquerade as a dead replica.
+        """
+        with self._lock:
+            if self._degraded:
+                return
+            self._degraded = True
+            self._degraded_cause = str(cause)
+        log.warning(
+            "all %s lanes quarantined: one-way CPU degradation (%s)",
+            self.lane_count, cause,
+        )
+        if self.obs is not None:
+            try:
+                self.obs.degraded(
+                    cause=cause,
+                    site="serve_fleet",
+                    timeout_s=self.res.dispatch_timeout_s,
+                    lanes=self.lane_count,
+                )
+            except Exception:  # noqa: BLE001 — telemetry never costs the run
+                pass
+        flightrec.auto_dump(reason=f"degraded_{cause}")
+
+    def _ensure_prober(self) -> None:
+        # start() INSIDE the lock: a created-but-unstarted Thread reports
+        # is_alive() False, so releasing the lock before start() would let
+        # a racing quarantine spawn a duplicate probe loop
+        with self._lock:
+            if self._prober is not None and self._prober.is_alive():
+                return
+            self._prober = threading.Thread(
+                target=self._probe_loop, name="nm03-lane-probe", daemon=True
+            )
+            self._prober.start()
+
+    def _probe_loop(self) -> None:
+        """The probation loop: canary every quarantined lane, reinstate on
+        success. Exits when nothing is quarantined (re-spawned by the next
+        quarantine) or when the process-wide degradation tripped
+        (degradation is one-way — a dead replica gets replaced, not
+        resurrected lane by lane)."""
+        try:
+            while True:
+                time.sleep(self.lane_probe_interval_s)
+                if self.degraded:
+                    return
+                fleet = self.fleet
+                if fleet is None:
+                    return
+                quarantined = fleet.lanes_in(QUARANTINED)
+                if not quarantined and not fleet.lanes_in(PROBATION):
+                    return
+                for lane in quarantined:
+                    if self.degraded:
+                        return
+                    if not fleet.begin_probation(lane):
+                        continue
+                    if self._probe_lane(lane) and not self.degraded:
+                        # the degraded re-read is only a fast path; the
+                        # authoritative guard is reinstate() itself, which
+                        # refuses once the fleet retired — atomic with the
+                        # quarantine that drained the last healthy lane, so
+                        # a canary racing that quarantine can never
+                        # resurrect a lane into a drained replica (the lane
+                        # stays in PROBATION and the loop exits above)
+                        with self._lock:
+                            self._lane_supervisors[lane] = (
+                                self._new_supervisor()
+                            )
+                        if fleet.reinstate(lane):
+                            self._set_lanes_ready_gauge()
+                    elif not self.degraded:
+                        fleet.fail_probation(lane)
+        finally:
+            # single unregister for EVERY exit path (including an
+            # unexpected exception), BEFORE the liveness gap closes: a
+            # quarantine landing between the exit decision and thread
+            # death saw a live prober in _ensure_prober and skipped
+            # spawning — re-checking after the unregister reclaims
+            # exactly that window (the respawn sees self._prober is None;
+            # degraded / no-fleet exits never respawn)
+            with self._lock:
+                self._prober = None
+            fleet = self.fleet
+            if (
+                fleet is not None
+                and fleet.lanes_in(QUARANTINED)
+                and not self.degraded
+            ):
+                self._ensure_prober()
+
+    def _probe_lane(self, lane: int) -> bool:
+        """One supervised canary on the lane's smallest warm bucket.
+
+        Runs the SAME hub executable the request path uses (re-warming is
+        free — the hub still holds it), under a fresh supervisor so the
+        probe gets the full deadline/retry budget, with the fault plan
+        consulted (a chaos drill's still-wedged lane keeps failing its
+        canary). The ``probe`` span lands in the flight-recorder ring
+        under a synthetic ``probe-l<lane>-<n>`` trace id.
+        """
+        c = self.cfg.canvas
+        b = self.buckets[0]
+        ctx = TraceContext(f"probe-l{lane}-{next(self._probe_seq)}")
+        try:
+            fn = self._get_compiled(b, lane)
+            px = np.zeros((b, c, c), np.float32)
+            dm = np.full((b, 2), self.cfg.min_dim, np.int32)
+
+            def primary():
+                mask, conv = fn(px, dm)
+                # nm03-lint: disable=NM321 the canary must prove the fetch path too — a wedged fetch is the same wedge (supervisor contract)
+                return np.asarray(mask), np.asarray(conv)
+
+            sup = self._new_supervisor()
+            with ctx.span("probe", lane=lane):
+                sup.run(
+                    primary,
+                    fallback=None,
+                    pre=self._pre(None, lane, lane_only=True),
+                    label="serve_probe",
+                )
+            return True
+        except BaseException as e:  # noqa: BLE001 — a failed canary is data
+            log.warning("lane %d probation probe failed: %s", lane, e)
+            return False
 
     # -- the serve-time entry point ----------------------------------------
 
@@ -341,15 +630,32 @@ class WarmExecutor:
         each supervised attempt records a ``device_dispatch`` + ``fetch``
         span pair (and the degraded path a ``cpu_fallback`` span) shared
         by every rider — retries show up as repeated attempts on the
-        timeline. Returns host-side ``(mask, converged)`` arrays. Raises
-        only when the PR-3 ladder is exhausted (deterministic error, or
-        degraded with fallback disabled); the batcher fails the batch's
-        requests with it.
+        timeline. Returns host-side ``(mask, converged)`` arrays.
+
+        Raises :class:`LaneQuarantined` when THIS lane's supervised
+        ladder gave up (deadline / exhausted transient retries) — the
+        batcher re-dispatches the chunk to a healthy lane. Raises the
+        original error unchanged on a deterministic failure (the riders
+        fail, the lane stays healthy). Once every lane is quarantined,
+        dispatches run the process-wide CPU fallback here (or raise
+        ``DeadlineExceeded`` with ``--no-fallback-cpu``).
         """
         trace = trace if trace is not None else NULL_TRACE
         bucket = int(pixels.shape[0])
+        devs = self._resolve_lanes()
+        if not 0 <= lane < len(devs):
+            raise ValueError(f"lane {lane} outside [0, {len(devs)})")
+        if self.degraded:
+            return self._run_degraded(pixels, dims, trace)
+        fleet = self.fleet
+        if fleet is not None and not fleet.is_healthy(lane):
+            # racing assignment: the batcher picked this lane before the
+            # quarantine landed — bounce the chunk back for re-dispatch
+            raise LaneQuarantined(lane, fleet.cause(lane) or "quarantined")
         fn = self._get_compiled(bucket, lane)
         index = next(self._dispatch_seq)
+        with self._lock:
+            sup = self._lane_supervisors[lane]
         reg = self.obs.registry if self.obs is not None else None
         if reg is not None:
             inflight_g = reg.gauge(
@@ -374,17 +680,19 @@ class WarmExecutor:
                 # nm03-lint: disable=NM321 the fetch span MEASURES this device sync — that is its entire purpose (trace schema, docs/OBSERVABILITY.md)
                 return np.asarray(mask), np.asarray(conv)
 
-        def fallback():
-            with trace.span("cpu_fallback"):
-                return self._fallback_call()(pixels, dims)
-
         try:
-            out = self.supervisor.run(
+            out = sup.run(
                 primary,
-                fallback=fallback,
-                pre=self._pre(index),
+                fallback=None,
+                pre=self._pre(index, lane),
                 label="serve_dispatch",
             )
+        except BaseException as e:  # noqa: BLE001 — classified below
+            cause = self._quarantine_cause(e)
+            if cause is None:
+                raise  # deterministic failure: the riders' problem
+            self._quarantine_lane(lane, cause, trace)
+            raise LaneQuarantined(lane, cause) from e
         finally:
             if reg is not None:
                 inflight_g.dec()
@@ -401,3 +709,27 @@ class WarmExecutor:
                 lane=str(lane),
             ).inc()
         return out
+
+    def _run_degraded(self, pixels: np.ndarray, dims: np.ndarray, trace):
+        """Every lane is quarantined: the one-way CPU fallback serves.
+
+        Mirrors the PR-3 degraded contract exactly — correct-but-slower
+        from host arrays, or an immediate ``DeadlineExceeded`` when the
+        operator disabled the fallback (``--no-fallback-cpu``)."""
+        if hasattr(trace, "served_by_fallback"):
+            # the chunk ran on NO lane: flag it on the chunk's OWN trace
+            # so the batcher's lane_batches credit agrees with
+            # serving_lane_batches_total without re-reading `degraded`
+            # after the dispatch (that read races a concurrent last-lane
+            # quarantine and would miscount a chunk that DID run on a
+            # lane). hasattr-gated: only ChunkTrace declares the slot —
+            # a TraceContext or the shared NULL_TRACE singleton passed
+            # directly to run_batch must be neither written nor crashed on
+            trace.served_by_fallback = True
+        if not self.res.fallback_cpu:
+            raise DeadlineExceeded(
+                f"all {self.lane_count} lanes quarantined "
+                f"({self.degraded_cause}) and CPU fallback is disabled"
+            )
+        with trace.span("cpu_fallback"):
+            return self._fallback_call()(pixels, dims)

@@ -307,7 +307,10 @@ def probe_server_topology(url: str, timeout_s: float = 5.0) -> dict:
     extended to serving: a p99 from one lane must not masquerade as an
     8-chip number).
     """
-    out = {"lanes": None, "mesh_shape": None, "buckets": None, "degraded": None}
+    out = {
+        "lanes": None, "mesh_shape": None, "buckets": None, "degraded": None,
+        "capacity": None, "lanes_quarantined": None,
+    }
     req = urllib.request.Request(f"{url}/readyz", method="GET")
     try:
         try:
@@ -323,7 +326,62 @@ def probe_server_topology(url: str, timeout_s: float = 5.0) -> dict:
     out["mesh_shape"] = st.get("mesh_shape")
     out["buckets"] = st.get("buckets")
     out["degraded"] = st.get("degraded")
+    # partial-capacity fields (ISSUE 8): the healthy-lane fraction and the
+    # quarantined count a chaos run's plateau is explained by
+    out["capacity"] = st.get("capacity")
+    out["lanes_quarantined"] = (st.get("lanes") or {}).get("quarantined")
     return out
+
+
+class CapacityWatch:
+    """Background ``/readyz`` poller for the duration of a load run.
+
+    A single post-run probe would miss a quarantine that probation already
+    healed; polling during the run records the partial-capacity PLATEAU a
+    chaos drill's throughput dip is explained by —
+    ``lanes_quarantined_observed`` is the peak quarantined count and
+    ``capacity_min_observed`` the floor the fleet served at.
+    """
+
+    def __init__(self, url: str, interval_s: float = 0.5):
+        self.url = url
+        self.interval_s = interval_s
+        # written by the poller thread, read by main after stop(): the
+        # lock (not the join fence alone) keeps start()'s inline sample,
+        # the poller, and stop()'s final sample coherent
+        self._lock = threading.Lock()
+        self.max_quarantined: Optional[int] = None
+        self.min_capacity: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="nm03-loadgen-capwatch", daemon=True
+        )
+
+    def _sample(self) -> None:
+        topo = probe_server_topology(self.url, timeout_s=2.0)
+        q, c = topo["lanes_quarantined"], topo["capacity"]
+        with self._lock:
+            if q is not None:
+                self.max_quarantined = max(self.max_quarantined or 0, int(q))
+            if c is not None:
+                self.min_capacity = (
+                    float(c) if self.min_capacity is None
+                    else min(self.min_capacity, float(c))
+                )
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._sample()
+
+    def start(self) -> "CapacityWatch":
+        self._sample()  # one guaranteed sample even on a very short run
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._sample()  # the post-run view (reinstated fleets read 0 here)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -405,10 +463,14 @@ def main(argv=None) -> int:
         run_load(endpoint, payloads, args.warmup, min(args.warmup, 4), 0.0,
                  args.timeout_s, warm)
     result = LoadResult()
+    # poll /readyz through the run: a mid-run quarantine that probation
+    # heals before the final probe must still land in the summary
+    watch = CapacityWatch(url).start()
     summary = run_load(
         endpoint, payloads, args.requests, args.concurrency, args.rate,
         args.timeout_s, result,
     )
+    watch.stop()
     summary["endpoint"] = endpoint
     # serving topology alongside the numbers (mesh_shape/lanes ride next to
     # the drivers' backend_requested/backend_actual honesty pair): probed
@@ -416,6 +478,11 @@ def main(argv=None) -> int:
     topo = probe_server_topology(url, timeout_s=args.timeout_s)
     summary["lanes"] = topo["lanes"]
     summary["mesh_shape"] = topo["mesh_shape"]
+    # the partial-capacity evidence (ISSUE 8): peak quarantined lanes and
+    # the capacity floor observed DURING the run, plus the final fraction
+    summary["lanes_quarantined_observed"] = watch.max_quarantined
+    summary["capacity_min_observed"] = watch.min_capacity
+    summary["capacity"] = topo["capacity"]
     if args.self_serve and app is not None:
         app.begin_drain(reason="loadgen_done")
         httpd.shutdown()
@@ -432,11 +499,14 @@ def main(argv=None) -> int:
         )
     print(json.dumps(summary, indent=2))
     lat, qw = summary["latency_ms"], summary["queue_wait_ms"]
+    cap = summary["capacity_min_observed"]
     print(
         f"loadgen: ok={summary['requests_ok']}/{summary['requests_total']} "
         f"p50={lat['p50']}ms p95={lat['p95']}ms "
         f"queue_wait_p95={qw['p95']}ms "
         f"lanes={summary['lanes_observed'] or '{}'} "
+        f"quarantined_max={summary['lanes_quarantined_observed']} "
+        f"capacity_min={'?' if cap is None else cap} "
         f"echo_mismatch={summary['trace_echo_mismatches']}",
         flush=True,
     )

@@ -22,6 +22,12 @@ SERVING_BATCHES_TOTAL = "serving_batches_total"
 # device batches per replica lane ({lane}): the fan-out evidence — under
 # load every lane's series grows, not just lane 0's
 SERVING_LANE_BATCHES_TOTAL = "serving_lane_batches_total"
+# lane quarantine transitions ({lane, cause}); cause is deadline /
+# device_lost (the supervised-dispatch outcomes) or probe_failed (a
+# probation canary failed and the lane went back to quarantine)
+SERVING_LANE_QUARANTINES_TOTAL = "serving_lane_quarantines_total"
+# probation probes that passed and returned the lane to traffic ({lane})
+SERVING_LANE_REINSTATED_TOTAL = "serving_lane_reinstated_total"
 
 # -- gauges -----------------------------------------------------------------
 # compile-cost accounting (ISSUE 7; labels: spec = CompileSpec.label()):
@@ -39,6 +45,11 @@ SERVING_DEGRADED = "serving_degraded"  # 1 = one-way CPU degradation tripped
 # multi-chip readiness signal check_telemetry's --expect-gauge asserts
 SERVING_LANES_READY = "serving_lanes_ready"
 SERVING_LANE_INFLIGHT = "serving_lane_inflight"  # {lane}: batches in flight
+# per-lane fault-domain state ({lane}); values from LANE_STATE_VALUES —
+# the series a chaos drill asserts with check_telemetry's labeled
+# --expect-gauge form (serving_lane_state{lane=2}=0)
+SERVING_LANE_STATE = "serving_lane_state"
+LANE_STATE_VALUES = {"healthy": 0, "probation": 1, "quarantined": 2}
 
 # -- histograms -------------------------------------------------------------
 SERVING_QUEUE_WAIT_SECONDS = "serving_queue_wait_seconds"

@@ -59,6 +59,10 @@ class ServeRequest:
     batch_size: int = 0
     queue_wait_s: float = 0.0
     lane: Optional[int] = None  # the replica lane that served it
+    # how many times this request's chunk was re-dispatched because its
+    # lane quarantined mid-flight (ISSUE 8): 0 on the happy path; >0 means
+    # the rider outlived a sick chip without ever seeing an error
+    requeues: int = 0
     error: Optional[BaseException] = None
     done: threading.Event = field(default_factory=threading.Event)
 

@@ -5,9 +5,14 @@
 * ``POST /v1/segment`` — one slice in (DICOM bytes or a raw float32
   array), segmentation out (JPEG pair or mask summary, JSON envelope);
 * ``GET /healthz`` — liveness (the process is up);
-* ``GET /readyz`` — readiness: 200 only when warmed, admitting, and NOT
-  degraded to the CPU fallback — a load balancer drains a degraded
-  replica while its in-flight work still completes;
+* ``GET /readyz`` — readiness: 200 while warmed, admitting, and at least
+  one replica lane is healthy; the payload carries ``capacity`` (the
+  healthy-lane fraction) and ``lanes.quarantined`` so a balancer can
+  WEIGH a partially-degraded replica instead of dropping it (ISSUE 8 —
+  a 3-of-4-lane replica is 75% of a replica, not zero). 503 only when
+  un-warm, draining, or EVERY lane is quarantined (the one-way CPU
+  degradation, the last resort) — then the balancer drains the replica
+  while its in-flight work still completes;
 * ``GET /metrics`` — Prometheus text exposition straight from the PR-1
   obs registry; ``GET /metrics.json`` — the ``nm03.metrics.v1`` snapshot
   (same schema ``check_telemetry.py --metrics`` validates).
@@ -99,8 +104,12 @@ class ServingApp:
         fault_plan=None,
         obs=None,
         lanes: Optional[int] = None,
+        lane_probe_interval_s: Optional[float] = None,
     ):
         from nm03_capstone_project_tpu.obs import RunContext
+        from nm03_capstone_project_tpu.serving.executor import (
+            DEFAULT_LANE_PROBE_INTERVAL_S,
+        )
 
         self.cfg = cfg if cfg is not None else PipelineConfig()
         self.obs = obs if obs is not None else RunContext.create(driver="serve")
@@ -112,6 +121,11 @@ class ServingApp:
             obs=self.obs,
             fault_plan=fault_plan,
             lanes=lanes,
+            lane_probe_interval_s=(
+                lane_probe_interval_s
+                if lane_probe_interval_s is not None
+                else DEFAULT_LANE_PROBE_INTERVAL_S
+            ),
         )
         self.batcher = DynamicBatcher(
             self.queue,
@@ -196,6 +210,14 @@ class ServingApp:
 
     @property
     def ready(self) -> bool:
+        """Warm, admitting, and not (fully) degraded.
+
+        ``executor.degraded`` flips only when the LAST healthy lane is
+        quarantined (serving/lanes.py): a replica with quarantined-but-
+        not-all lanes stays ready at reduced ``capacity`` — pulling it
+        out of the balancer would throw away its healthy chips, which is
+        exactly the PR-6 policy ISSUE 8 replaces.
+        """
         return (
             self.executor.warm and not self.draining and not self.executor.degraded
         )
@@ -219,8 +241,12 @@ class ServingApp:
             "lanes": {
                 "count": lane_count,
                 "ready": self.executor.lanes_ready,
+                "quarantined": self.executor.quarantined_count,
                 "per_lane": self.executor.lane_state(),
             },
+            # healthy-lane fraction (None before lane resolution): what a
+            # capacity-weighted balancer feeds on while ready stays 200
+            "capacity": self.executor.capacity,
             "mesh_shape": [lane_count] if lane_count else None,
             # stats() carries the total_compile_seconds rollup; the per-spec
             # map makes warmup cost visible without grepping logs (ISSUE 7)
@@ -398,6 +424,8 @@ class ServingApp:
             "batch_size": req.batch_size,
             "queue_wait_s": round(req.queue_wait_s, 6),
             "lane": req.lane,
+            # >0: the rider's chunk outlived a lane quarantine (re-dispatch)
+            "requeues": req.requeues,
             "degraded": self.executor.degraded,
             "mask_pixels": int(np.count_nonzero(req.mask)),
         }
@@ -655,6 +683,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="per-request wall budget from admission to response",
     )
     g.add_argument(
+        "--lane-probe-interval-s",
+        type=float,
+        default=None,
+        metavar="S",
+        help="probation probe cadence: how often quarantined lanes get a "
+        "supervised canary re-execution off the request path (default 5s; "
+        "the reinstatement-latency/probe-load knob — docs/OPERATIONS.md "
+        "quarantine triage)",
+    )
+    g.add_argument(
         "--jpeg-quality", type=int, default=90, help="JPEG encoder quality"
     )
     g.add_argument(
@@ -697,6 +735,7 @@ def app_from_args(args: argparse.Namespace, obs=None) -> ServingApp:
         fault_plan=plan,
         obs=obs,
         lanes=args.lanes or None,
+        lane_probe_interval_s=args.lane_probe_interval_s,
     )
 
 

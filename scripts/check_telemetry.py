@@ -50,7 +50,15 @@ metrics
     of NAME's gauge series to EQUAL VALUE — exact, not a floor, because
     the gauges this asserts are topology facts (e.g.
     ``--expect-gauge serving_lanes_ready=8``: a 7-lane fleet is a
-    degraded replica, not a lesser success).
+    degraded replica, not a lesser success);
+  * counter and gauge expectations accept a LABELED selector,
+    ``NAME{label=value,...}=N`` — only series carrying every listed label
+    pair are summed, and at least one series must match. The lane-drill
+    hook (ISSUE 8): ``--expect-gauge 'serving_lane_state{lane=2}=0'``
+    asserts lane 2 ended HEALTHY (a specific series, distinguishable from
+    "never reported"), ``--expect-counter
+    'serving_lane_quarantines_total{lane=2}=1'`` that it was quarantined
+    along the way. Histogram expectations stay name-only.
 
 trace (``--expect-trace FILE``)
   * FILE is a Chrome/Perfetto ``trace_event`` export (``nm03-trace``
@@ -89,6 +97,42 @@ RESILIENCE_LABELS = {
 }
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_SELECTOR_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?$")
+
+
+def parse_selector(spec: str) -> tuple:
+    """``name`` or ``name{label=value,...}`` -> (name, {label: value}).
+
+    The labeled form narrows an expectation to the series carrying every
+    listed pair (values compared as strings, optional double quotes
+    tolerated: ``lane=2`` and ``lane="2"`` are the same selector).
+    Raises ValueError on malformed syntax.
+    """
+    m = _SELECTOR_RE.match(spec.strip())
+    if not m:
+        raise ValueError(f"bad metric selector {spec!r}")
+    name, raw = m.group(1), m.group(2)
+    labels: dict = {}
+    if raw is not None:
+        if not raw.strip():
+            raise ValueError(f"empty label selector in {spec!r}")
+        for part in raw.split(","):
+            k, eq, v = part.partition("=")
+            k, v = k.strip(), v.strip().strip('"')
+            if not eq or not _LABEL_RE.match(k) or not v:
+                raise ValueError(
+                    f"bad label pair {part!r} in selector {spec!r}"
+                )
+            labels[k] = v
+    return name, labels
+
+
+def _select(series: list, sel: dict) -> list:
+    """Values of the (labels, value) series matching every selector pair."""
+    return [
+        v for lbls, v in series
+        if all(lbls.get(k) == want for k, want in sel.items())
+    ]
 
 
 class Checker:
@@ -271,8 +315,9 @@ def check_metrics(path: str, chk: Checker, expect_counters=None,
 
     kind_by_name: dict[str, str] = {}
     seen: set[tuple] = set()
-    counter_sums: dict[str, float] = {}
-    gauge_sums: dict[str, float] = {}
+    # name -> [(labels, value)] so labeled expectations can select series
+    counter_series: dict[str, list] = {}
+    gauge_series: dict[str, list] = {}
     histogram_counts: dict[str, int] = {}
     for j, rec in enumerate(metrics):
         where = f"{path}: metrics[{j}]"
@@ -317,24 +362,52 @@ def check_metrics(path: str, chk: Checker, expect_counters=None,
             elif kind == "counter" and v < 0:
                 chk.fail(where, f"{name}: counter value {v} is negative")
             if kind == "counter" and _is_num(v):
-                counter_sums[name] = counter_sums.get(name, 0.0) + v
+                counter_series.setdefault(name, []).append((labels, v))
             if kind == "gauge" and _is_num(v):
-                gauge_sums[name] = gauge_sums.get(name, 0.0) + v
-    for name, want in sorted((expect_counters or {}).items()):
-        got = counter_sums.get(name, 0.0)
+                gauge_series.setdefault(name, []).append((labels, v))
+    for spec, want in sorted((expect_counters or {}).items()):
+        try:
+            name, sel = parse_selector(spec)
+        except ValueError as e:
+            chk.fail(path, str(e))
+            continue
+        series = counter_series.get(name, [])
+        if not series and kind_by_name.get(name) not in (None, "counter"):
+            chk.fail(path, f"{name} is a {kind_by_name[name]}, not a counter")
+            continue
+        matched = _select(series, sel)
+        if sel and series and not matched:
+            chk.fail(path, f"counter {spec}: no series matches the selector")
+            continue
+        got = sum(matched)
         if got < want:
-            chk.fail(path, f"counter {name} totals {got}, expected >= {want}")
-    for name, want in sorted((expect_gauges or {}).items()):
-        if name not in gauge_sums:
+            chk.fail(path, f"counter {spec} totals {got}, expected >= {want}")
+    for spec, want in sorted((expect_gauges or {}).items()):
+        try:
+            name, sel = parse_selector(spec)
+        except ValueError as e:
+            chk.fail(path, str(e))
+            continue
+        if name not in gauge_series:
             kind = kind_by_name.get(name)
             if kind is not None and kind != "gauge":
                 chk.fail(path, f"{name} is a {kind}, not a gauge")
             else:
-                chk.fail(path, f"gauge {name} absent, expected == {want}")
+                chk.fail(path, f"gauge {spec} absent, expected == {want}")
             continue
-        got = gauge_sums[name]
+        matched = _select(gauge_series[name], sel)
+        if not matched:
+            # a labeled selector that matches nothing is ABSENCE, not 0 —
+            # "lane 2 healthy (state=0)" must never pass on a fleet that
+            # never reported lane 2 at all
+            chk.fail(
+                path,
+                f"gauge {spec}: no series matches, expected == {want}",
+            )
+            continue
+        got = sum(matched)
         if got != want:
-            chk.fail(path, f"gauge {name} totals {got}, expected == {want}")
+            chk.fail(path, f"gauge {spec} totals {got}, expected == {want}")
     for name, want in sorted((expect_histograms or {}).items()):
         if name not in histogram_counts and kind_by_name.get(name) is not None:
             chk.fail(path, f"{name} is a {kind_by_name[name]}, not a histogram")
@@ -426,9 +499,11 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--expect-counter", action="append", default=[], metavar="NAME=MIN",
-        help="require the summed value of counter NAME to be >= MIN "
+        help="require the summed value of counter NAME to be >= MIN; a "
+        "NAME{label=value,...} selector narrows to matching series "
         "(repeatable; chaos-suite assertions, e.g. "
-        "pipeline_degraded_total=1)",
+        "pipeline_degraded_total=1 or "
+        "'serving_lane_quarantines_total{lane=2}=1')",
     )
     ap.add_argument(
         "--expect-histogram", action="append", default=[],
@@ -439,9 +514,10 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--expect-gauge", action="append", default=[], metavar="NAME=VALUE",
-        help="require the summed value of gauge NAME to EQUAL VALUE "
+        help="require the summed value of gauge NAME to EQUAL VALUE; a "
+        "NAME{label=value,...} selector narrows to matching series "
         "(repeatable; serving-topology assertions, e.g. "
-        "serving_lanes_ready=8)",
+        "serving_lanes_ready=8 or 'serving_lane_state{lane=2}=0')",
     )
     ap.add_argument(
         "--expect-trace", action="append", default=[], metavar="FILE",
@@ -455,23 +531,37 @@ def main(argv=None) -> int:
             "nothing to check: pass --events, --metrics and/or --expect-trace"
         )
 
-    def parse_expectations(specs: list, flag: str) -> dict:
+    def parse_expectations(specs: list, flag: str, labeled: bool = False) -> dict:
         out = {}
         for spec in specs:
-            name, _, val = spec.partition("=")
+            # rpartition: a labeled selector (NAME{label=value}=N) carries
+            # '=' inside the braces; the expectation value is always last
+            sel, _, val = spec.rpartition("=")
             try:
-                out[name] = float(val)
+                out[sel] = float(val)
             except ValueError:
-                ap.error(f"{flag} wants NAME=MIN, got {spec!r}")
+                ap.error(f"{flag} wants NAME=N or NAME{{label=value}}=N, "
+                         f"got {spec!r}")
+            if labeled:
+                try:
+                    parse_selector(sel)
+                except ValueError as e:
+                    ap.error(f"{flag}: {e}")
+            elif not _NAME_RE.match(sel):
+                ap.error(f"{flag} wants a plain metric NAME, got {sel!r}")
         if out and not args.metrics:
             ap.error(f"{flag} needs --metrics")
         return out
 
-    expect_counters = parse_expectations(args.expect_counter, "--expect-counter")
+    expect_counters = parse_expectations(
+        args.expect_counter, "--expect-counter", labeled=True
+    )
     expect_histograms = parse_expectations(
         args.expect_histogram, "--expect-histogram"
     )
-    expect_gauges = parse_expectations(args.expect_gauge, "--expect-gauge")
+    expect_gauges = parse_expectations(
+        args.expect_gauge, "--expect-gauge", labeled=True
+    )
 
     chk = Checker()
     ev_ident = mt_ident = None

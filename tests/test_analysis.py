@@ -422,6 +422,43 @@ class TestThreadSharedState:
         )
         assert rules_of(fs) == [], [f.render() for f in fs]
 
+    def test_removing_the_lane_state_lock_fails(self, tmp_path):
+        """ISSUE 8 CI satellite: NM331 covers the lane fault-domain state
+        machine — the REAL serving/lanes.py with a quarantine transition
+        moved outside its lock must be a lint finding, not a race found
+        in production."""
+        src = (REPO / PKG / "serving" / "lanes.py").read_text()
+        guarded = (
+            "        with self._lock:\n"
+            "            if self._states[lane] != QUARANTINED:\n"
+            "                return False\n"
+            "            self._states[lane] = PROBATION"
+        )
+        assert guarded in src  # begin_probation's guarded transition
+        broken = src.replace(
+            guarded,
+            "        if True:\n"
+            "            if self._states[lane] != QUARANTINED:\n"
+            "                return False\n"
+            "            self._states[lane] = PROBATION",
+            1,
+        )
+        fs = lint_tree(
+            tmp_path,
+            {f"{PKG}/serving/lanes.py": broken},
+            rules=(check_thread_shared_state,),
+        )
+        assert "NM331" in rules_of(fs)
+
+    def test_real_lane_state_machine_is_clean(self, tmp_path):
+        src = (REPO / PKG / "serving" / "lanes.py").read_text()
+        fs = lint_tree(
+            tmp_path,
+            {f"{PKG}/serving/lanes.py": src},
+            rules=(check_thread_shared_state,),
+        )
+        assert rules_of(fs) == [], [f.render() for f in fs]
+
 
 class TestDtypeDiscipline:
     def test_float64_dtype_flagged_in_ops(self, tmp_path):

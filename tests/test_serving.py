@@ -496,16 +496,55 @@ class TestBackpressure:
 
 class TestDegradedReadyz:
     def test_degraded_flips_ready_off(self):
+        """Process-wide degradation (EVERY lane quarantined, ISSUE 8) is
+        still the one state that pulls /readyz to 503."""
         from nm03_capstone_project_tpu.serving.server import ServingApp
 
         app = ServingApp(cfg=PipelineConfig(canvas=CANVAS), buckets=(1,))
         app.executor.warm = True  # pretend warmup ran; no jax needed
         assert app.ready
-        app.executor.supervisor.degraded = True
-        app.executor.supervisor.degraded_cause = "deadline"
+        app.executor._process_degrade("deadline")
         assert not app.ready
         st = app.status()
         assert st["degraded"] and st["degraded_cause"] == "deadline"
+        app.close()
+
+    def test_partial_quarantine_keeps_ready_at_reduced_capacity(self):
+        """A quarantined lane (not all of them) must NOT pull the replica
+        out of the balancer: /readyz stays 200 and reports the healthy
+        fraction in ``capacity`` + ``lanes.quarantined`` (ISSUE 8)."""
+        from nm03_capstone_project_tpu.serving.lanes import LaneFaultDomains
+        from nm03_capstone_project_tpu.serving.server import ServingApp
+
+        app = ServingApp(cfg=PipelineConfig(canvas=CANVAS), buckets=(1,))
+        ex = app.executor
+        ex.warm = True
+        # simulate a resolved 4-lane fleet without touching a backend
+        ex._lane_devices = ["d0", "d1", "d2", "d3"]
+        ex._lane_warm = [True] * 4
+        ex._lane_inflight = [0] * 4
+        ex._lane_batches = [0] * 4
+        ex._lane_supervisors = [ex._new_supervisor() for _ in range(4)]
+        ex.fleet = LaneFaultDomains(4, obs=app.obs)
+        assert app.status()["capacity"] == 1.0
+        changed, healthy_left = ex.fleet.quarantine(2, "deadline")
+        assert changed and healthy_left == 3
+        assert app.ready  # 3 healthy chips are 75% of a replica, not zero
+        st = app.status()
+        assert st["capacity"] == 0.75
+        assert st["lanes"]["quarantined"] == 1
+        assert not st["degraded"]
+        per_lane = {row["lane"]: row for row in st["lanes"]["per_lane"]}
+        assert per_lane[2]["state"] == "quarantined"
+        assert per_lane[2]["quarantine_cause"] == "deadline"
+        assert per_lane[0]["state"] == "healthy"
+        assert app.registry.get("serving_lane_state", lane="2").value == 2
+        assert (
+            app.registry.get(
+                "serving_lane_quarantines_total", lane="2", cause="deadline"
+            ).value
+            == 1
+        )
         app.close()
 
 
