@@ -1,0 +1,169 @@
+"""NM381 — cache-key completeness: every CompileSpec field reaches the
+persistent cache key.
+
+The persistent executable cache (``compilehub/persist.py``) hands a
+process a *compiled binary* instead of compiling one. That is only sound
+while the on-disk key covers everything that makes two executables
+different — :class:`CompileSpec` is the in-process identity, so the
+moment someone adds a spec field (a new backend knob, a precision flag, a
+sharding variant) WITHOUT folding it into ``PersistKey.from_spec``, two
+genuinely different programs share one cache entry and one of them runs
+the other's binary. Silently. That is the worst failure mode this
+codebase can have — wrong masks with green telemetry — and it is
+invisible to tests until the exact collision is constructed.
+
+The rule therefore checks, statically, that every field declared on the
+``CompileSpec`` dataclass (``compilehub/hub.py``) is *read* inside the
+sibling ``compilehub/persist.py``'s **key derivation**: the
+``from_spec`` function, plus any module function it (transitively)
+hands the whole spec to — ``digest(spec)`` inside ``from_spec`` makes
+``digest``'s reads coverage. Deliberately NOT module-wide: persist.py's
+store/serialize paths legitimately read spec fields for other reasons
+(``_serialize`` consults ``spec.device``/``spec.donate`` to refuse the
+export fallback), and a read there must not silence the rule — only
+reads that can actually reach the key count. Fixture trees work too:
+any directory holding a ``hub.py`` that declares CompileSpec is matched
+with ITS sibling ``persist.py`` (tests/test_analysis.py red/green
+battery).
+
+Findings anchor at the field's declaration line in hub.py — the place
+the new field was added is the place the omission gets fixed.
+
+Rules:
+  NM381  CompileSpec field not consumed by the persist cache key
+"""
+
+from __future__ import annotations
+
+import ast
+import posixpath
+from typing import Dict, List, Optional, Sequence, Set
+
+from nm03_capstone_project_tpu.analysis.core import Finding, SourceFile
+
+_SPEC_CLASS = "CompileSpec"
+_HUB_FILENAME = "hub.py"
+_PERSIST_FILENAME = "persist.py"
+
+
+def _spec_fields(tree: ast.AST) -> Dict[str, int]:
+    """{field name: declaration line} of the CompileSpec dataclass, or {}."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == _SPEC_CLASS:
+            fields: Dict[str, int] = {}
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    fields[stmt.target.id] = stmt.lineno
+            return fields
+    return {}
+
+
+def _functions(tree: ast.AST) -> Dict[str, ast.FunctionDef]:
+    """Module-level (and class-method) function defs by name, last wins."""
+    return {
+        node.name: node
+        for node in ast.walk(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _spec_param(fn: ast.FunctionDef) -> Optional[str]:
+    """The spec-carrying parameter: the first arg that is not self/cls."""
+    for a in fn.args.args:
+        if a.arg not in ("self", "cls"):
+            return a.arg
+    return None
+
+
+def _reads_in(fn: ast.FunctionDef, param: str) -> Set[str]:
+    return {
+        node.attr
+        for node in ast.walk(fn)
+        if isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == param
+    }
+
+
+def _spec_reads(tree: ast.AST) -> Set[str]:
+    """Fields read along the KEY DERIVATION: inside ``from_spec`` and in
+    any function it (transitively) passes the whole spec object to.
+
+    NOT module-wide on purpose: the store path reads spec fields for
+    reasons that never reach the key (``_serialize`` refusing the export
+    fallback for pinned specs), and such a read silencing the rule is
+    exactly the false negative the break-drill test pins.
+    """
+    fns = _functions(tree)
+    root = fns.get("from_spec")
+    if root is None:
+        return set()
+    reads: Set[str] = set()
+    visited: Set[str] = set()
+    frontier = [(root, _spec_param(root))]
+    while frontier:
+        fn, param = frontier.pop()
+        if fn.name in visited or param is None:
+            continue
+        visited.add(fn.name)
+        reads |= _reads_in(fn, param)
+        # follow helper(spec): the whole object crossed the call, so the
+        # helper's reads of its matching parameter are key coverage
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)):
+                continue
+            callee = fns.get(node.func.id)
+            if callee is None:
+                continue
+            for pos, arg in enumerate(node.args):
+                if isinstance(arg, ast.Name) and arg.id == param:
+                    args = [
+                        a.arg for a in callee.args.args
+                        if a.arg not in ("self", "cls")
+                    ]
+                    if pos < len(args):
+                        frontier.append((callee, args[pos]))
+    return reads
+
+
+def check_cache_key(files: Sequence[SourceFile]) -> List[Finding]:
+    by_path = {f.relpath: f for f in files}
+    findings: List[Finding] = []
+    for src in files:
+        if src.tree is None or posixpath.basename(src.relpath) != _HUB_FILENAME:
+            continue
+        fields = _spec_fields(src.tree)
+        if not fields:
+            continue  # a hub.py without CompileSpec is not the contract file
+        persist_rel = posixpath.join(
+            posixpath.dirname(src.relpath), _PERSIST_FILENAME
+        )
+        persist: Optional[SourceFile] = by_path.get(persist_rel)
+        if persist is None or persist.tree is None:
+            # no persist module in this tree (fixture dirs for other rule
+            # families) — the completeness contract applies only where the
+            # persistent layer exists
+            continue
+        reads = _spec_reads(persist.tree)
+        for name, line in sorted(fields.items(), key=lambda kv: kv[1]):
+            if name in reads:
+                continue
+            findings.append(
+                Finding(
+                    rule="NM381",
+                    path=src.relpath,
+                    line=line,
+                    message=(
+                        f"CompileSpec field {name!r} is never read by "
+                        f"{persist_rel} — the persistent cache key cannot "
+                        "cover it, so two specs differing only in "
+                        f"{name!r} would share one on-disk executable; "
+                        "fold it into PersistKey.from_spec "
+                        "(docs/STATIC_ANALYSIS.md NM381)"
+                    ),
+                    source_line=src.line_text(line),
+                )
+            )
+    return findings

@@ -26,6 +26,7 @@ from nm03_capstone_project_tpu.analysis.atomicio import (
     check_atomic_io,
     check_obs_dump_io,
 )
+from nm03_capstone_project_tpu.analysis.cachekey import check_cache_key
 from nm03_capstone_project_tpu.analysis.compilehome import check_compile_home
 from nm03_capstone_project_tpu.analysis.contracts import check_import_contracts
 from nm03_capstone_project_tpu.analysis.core import (
@@ -52,6 +53,7 @@ ALL_RULES = (
     check_atomic_io,
     check_obs_dump_io,
     check_compile_home,
+    check_cache_key,
 )
 
 RULE_CATALOG = {
@@ -67,6 +69,7 @@ RULE_CATALOG = {
     "NM351": "atomic-io: truncating artifact write without tmp+rename",
     "NM361": "compile-home: jit/pjit/shard_map referenced outside compilehub/",
     "NM371": "obs-io: flight-recorder/trace module writes without atomic_write_*",
+    "NM381": "cache-key: CompileSpec field not consumed by the persist cache key",
     "NM390": "meta: suppression without a reason",
     "NM399": "meta: file does not parse",
 }

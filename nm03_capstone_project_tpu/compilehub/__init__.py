@@ -11,7 +11,11 @@ Public surface:
   executables;
 * :mod:`~.programs` — the named pipeline programs (slice/batch/volume/
   serve-lane), including :func:`~.programs.lane_devices` for the serving
-  fleet's per-chip replica lanes.
+  fleet's per-chip replica lanes;
+* :class:`~.persist.ExecutableCache` / :class:`~.persist.PersistKey` —
+  the persistent AOT executable cache (``nm03-serve
+  --compile-cache-dir`` / ``$NM03_COMPILE_CACHE_DIR``): a second process
+  start deserializes warm executables instead of compiling them.
 
 Importing this package never initializes a backend; jax is paid for when
 a program is built, not when the hub is named.
@@ -32,10 +36,16 @@ from nm03_capstone_project_tpu.compilehub.hub import (
     get_hub,
     hub_jit,
 )
+from nm03_capstone_project_tpu.compilehub.persist import (
+    ExecutableCache,
+    PersistKey,
+)
 
 __all__ = [
     "CompileHub",
     "CompileSpec",
+    "ExecutableCache",
+    "PersistKey",
     "aot_compile",
     "executable_cost",
     "distributed_is_initialized",

@@ -1,0 +1,272 @@
+"""``nm03-cache`` — admin surface for the persistent executable cache.
+
+The on-disk cache (:mod:`~.persist`) is self-defending at load time —
+corrupt or stale entries are silent misses — but an operator still needs
+to SEE it: what is in the directory, whether the entries a fleet depends
+on actually verify, and a retention policy that does not require hand-rm.
+
+Subcommands (docs/OPERATIONS.md, "Compile cache management"):
+
+* ``ls``     — one row per entry: size, age, program/shape/device, the
+  toolchain that built it, and its integrity status;
+* ``verify`` — full checksum + toolchain validation; exit 1 when any
+  entry is corrupt (stale entries are expected after an upgrade and do
+  not fail the check — they report, and ``gc`` reclaims them);
+* ``gc``     — retention: corrupt and stale entries always go (both can
+  only ever miss for this toolchain), then anything older than
+  ``--max-age``, then oldest-first until under ``--max-bytes``.
+
+Diagnostics go to stderr, results to stdout (``--format json`` for
+scripting) — the same discipline as the sibling CLIs. Exit codes:
+0 ok, 1 findings (corrupt entries on ``verify``), 2 usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from nm03_capstone_project_tpu.compilehub.persist import (
+    ENTRY_SUFFIX,
+    ENV_CACHE_DIR,
+    cache_dir_from_env,
+    gc_entries,
+    scan_entries,
+)
+
+
+def _fmt_age(seconds: float) -> str:
+    for unit, div in (("d", 86400.0), ("h", 3600.0), ("m", 60.0)):
+        if seconds >= div:
+            return f"{seconds / div:.1f}{unit}"
+    return f"{seconds:.0f}s"
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20), ("KiB", 1 << 10)):
+        if n >= div:
+            return f"{n / div:.1f} {unit}"
+    return f"{int(n)} B"
+
+
+def _resolve_dir(arg_dir: Optional[str]) -> Path:
+    # usage errors exit 2, never 1: a CI script must be able to tell "no
+    # such directory" from "verify found corrupt entries"
+    d = arg_dir or cache_dir_from_env()
+    if not d:
+        print(
+            f"nm03-cache: no cache directory (pass --dir or set "
+            f"${ENV_CACHE_DIR})",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    path = Path(d)
+    if not path.is_dir():
+        print(f"nm03-cache: {path} is not a directory", file=sys.stderr)
+        raise SystemExit(2)
+    return path
+
+
+def _parse_bytes(text: str) -> int:
+    """'512m', '2g', '100k' or plain bytes -> int."""
+    t = text.strip().lower()
+    mult = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}.get(t[-1:], None)
+    if mult is not None:
+        t = t[:-1]
+    try:
+        return int(float(t) * (mult or 1))
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(
+            f"bad byte size {text!r} (want e.g. 512m, 2g, 1048576)"
+        ) from e
+
+
+def _parse_age(text: str) -> float:
+    """'7d', '12h', '30m', '90s' or plain seconds -> float seconds."""
+    t = text.strip().lower()
+    mult = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}.get(t[-1:], None)
+    if mult is not None:
+        t = t[:-1]
+    try:
+        return float(t) * (mult or 1.0)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(
+            f"bad age {text!r} (want e.g. 7d, 12h, 3600)"
+        ) from e
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nm03-cache", description=__doc__.strip().splitlines()[0]
+    )
+    p.add_argument(
+        "--dir",
+        default=None,
+        metavar="DIR",
+        help=f"cache directory (default: ${ENV_CACHE_DIR})",
+    )
+    p.add_argument(
+        "--format",
+        choices=["text", "json"],
+        default="text",
+        help="output format",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+    sub.add_parser("ls", help="list entries with size/age/identity/status")
+    sub.add_parser(
+        "verify",
+        help="checksum + toolchain validation; exit 1 on corrupt entries",
+    )
+    gc = sub.add_parser("gc", help="apply the retention policy")
+    gc.add_argument(
+        "--max-bytes",
+        type=_parse_bytes,
+        default=None,
+        metavar="N",
+        help="total size budget (suffixes k/m/g); oldest entries beyond it go",
+    )
+    gc.add_argument(
+        "--max-age",
+        type=_parse_age,
+        default=None,
+        metavar="AGE",
+        help="entry age cap (suffixes s/m/h/d)",
+    )
+    gc.add_argument(
+        "--dry-run",
+        action="store_true",
+        help="report what WOULD be removed without touching the directory",
+    )
+    return p
+
+
+def _cmd_ls(rows: List[dict], fmt: str) -> int:
+    if fmt == "json":
+        print(json.dumps({"entries": rows}, indent=1))
+        return 0
+    if not rows:
+        print("(empty cache)")
+        return 0
+    header = f"{'SIZE':>9}  {'AGE':>7}  {'STATUS':8}  {'JAXLIB':10}  ENTRY"
+    print(header)
+    for r in rows:
+        ident = r["file"]
+        if r.get("name"):
+            shape = "x".join(str(d) for d in r["shape"] or [])
+            ident = f"{r['name']}[{shape}] @{r.get('device') or r.get('platform')}"
+        print(
+            f"{_fmt_bytes(r['bytes']):>9}  {_fmt_age(r['age_s']):>7}  "
+            f"{r['status']:8}  {r.get('jaxlib_version') or '?':10}  {ident}"
+        )
+    total = sum(r["bytes"] for r in rows)
+    print(f"{len(rows)} entries, {_fmt_bytes(total)} total")
+    return 0
+
+
+def _cmd_verify(rows: List[dict], fmt: str) -> int:
+    corrupt = [r for r in rows if r["status"] == "corrupt"]
+    stale = [r for r in rows if r["status"] == "stale"]
+    # reported but NOT a failure and never gc-fodder: the entry may be
+    # healthy under the service uid (permissions mismatch, NFS blip)
+    unreadable = [r for r in rows if r["status"] == "unreadable"]
+    ok = len(rows) - len(corrupt) - len(stale) - len(unreadable)
+    if fmt == "json":
+        print(
+            json.dumps(
+                {
+                    "entries": len(rows),
+                    "ok": ok,
+                    "stale": [r["file"] for r in stale],
+                    "unreadable": [
+                        {"file": r["file"], "error": r.get("error")}
+                        for r in unreadable
+                    ],
+                    "corrupt": [
+                        {"file": r["file"], "error": r.get("error")}
+                        for r in corrupt
+                    ],
+                },
+                indent=1,
+            )
+        )
+    else:
+        for r in corrupt:
+            print(f"corrupt: {r['file']}: {r.get('error')}")
+        for r in unreadable:
+            print(f"unreadable: {r['file']}: {r.get('error')}")
+        for r in stale:
+            print(
+                f"stale:   {r['file']}: built by "
+                f"{'/'.join(str(r.get(f)) for f in ('jax_version', 'jaxlib_version', 'nm03_version'))}"
+            )
+        print(
+            f"nm03-cache: {len(rows)} entries — "
+            f"{ok} ok, {len(stale)} stale, {len(unreadable)} unreadable, "
+            f"{len(corrupt)} corrupt"
+        )
+    return 1 if corrupt else 0
+
+
+def _cmd_gc(root: Path, args: argparse.Namespace, fmt: str) -> int:
+    report = gc_entries(
+        root,
+        max_bytes=args.max_bytes,
+        max_age_s=args.max_age,
+        dry_run=args.dry_run,
+    )
+    if fmt == "json":
+        report["dry_run"] = args.dry_run
+        print(json.dumps(report, indent=1))
+        return 0
+    verb = "would remove" if args.dry_run else "removed"
+    for name in report["removed"]:
+        print(f"{verb}: {name}")
+    print(
+        f"nm03-cache: {verb} {len(report['removed'])} entries "
+        f"({_fmt_bytes(report['freed_bytes'])}); kept {report['kept']} "
+        f"({_fmt_bytes(report['kept_bytes'])})"
+    )
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    root = _resolve_dir(args.dir)
+    # one guard around every directory read: an unreadable dir is a usage
+    # error (exit 2) on ANY subcommand, never a traceback or a fake
+    # "findings" exit 1
+    try:
+        rows: List[dict] = []
+        if args.command != "gc":
+            # ls is header-only (length-checked, not hashed) — a listing
+            # must not read a multi-GiB cache end to end; verify hashes.
+            # gc scans inside gc_entries — scanning here too would read
+            # the whole cache twice
+            rows = scan_entries(root, checksum=args.command != "ls")
+        stray = [
+            p.name
+            for p in root.iterdir()
+            if p.is_file() and not p.name.endswith(ENTRY_SUFFIX)
+            and not p.name.endswith(".tmp")  # gc reclaims orphaned temps
+        ]
+        if stray:
+            print(
+                f"nm03-cache: ignoring {len(stray)} non-cache file(s) in "
+                f"{root} (e.g. {stray[0]})",
+                file=sys.stderr,
+            )
+        if args.command == "ls":
+            return _cmd_ls(rows, args.format)
+        if args.command == "verify":
+            return _cmd_verify(rows, args.format)
+        return _cmd_gc(root, args, args.format)
+    except OSError as e:
+        print(f"nm03-cache: cannot read {root}: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

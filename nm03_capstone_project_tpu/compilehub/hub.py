@@ -56,6 +56,10 @@ __all__ = [
 # soft cap instead of silently growing.
 REGISTRY_SOFT_CAP = 64
 
+# one-time flag: a jaxlib whose Compiled refuses attribute attach disables
+# the persistent cache's export fallback — warned once, not per spec
+_EXPORT_SRC_WARNED = False
+
 
 @dataclasses.dataclass(frozen=True)
 class CompileSpec:
@@ -125,9 +129,34 @@ def aot_compile(jitted: Callable, *arg_structs) -> Tuple[Callable, bool]:
     behavior, kept as the documented fallback).
     """
     try:
-        return jitted.lower(*arg_structs).compile(), True
+        built = jitted.lower(*arg_structs).compile()
     except Exception:  # noqa: BLE001 — AOT is an optimization, not a contract
         return jitted, False
+    try:
+        # persist.py's jax-export fallback re-exports from the jitted
+        # callable when the PJRT executable itself is not serializable on
+        # this backend; attribute attach is best-effort (a jaxlib whose
+        # Compiled refuses attributes loses the fallback format). The hub
+        # deletes this after the store attempt; in a cache-less process
+        # it retains only the jitted wrapper + arg structs (no extra
+        # traced artifacts — the wrapper is lazy)
+        built._nm03_export_src = (jitted, arg_structs)
+    except Exception as e:  # noqa: BLE001 — see above
+        global _EXPORT_SRC_WARNED
+        if not _EXPORT_SRC_WARNED:
+            # once, not per spec: without the source the persistent
+            # cache's export fallback is silently unavailable, and a
+            # process paying full compiles every start deserves one line
+            # naming why
+            _EXPORT_SRC_WARNED = True
+            from nm03_capstone_project_tpu.utils.reporter import get_logger
+
+            get_logger("compilehub").warning(
+                "compiled executable refuses attribute attach (%s): the "
+                "persistent cache's jax-export fallback is unavailable in "
+                "this process", e,
+            )
+    return built, True
 
 
 def executable_cost(built: Any) -> Dict[str, float]:
@@ -197,19 +226,73 @@ class CompileHub:
         # XLA cost/memory analysis where the executable exposes it
         self._cost: Dict[CompileSpec, Dict[str, float]] = {}
         self._builds = 0
+        self._cache_loads = 0
         self._jit_wraps = 0
         self._cap_warned = False
+        # the persistent executable cache (compilehub/persist.py), attached
+        # by nm03-serve --compile-cache-dir / $NM03_COMPILE_CACHE_DIR; None
+        # = every miss compiles (the historical behavior)
+        self._persist = None
+
+    # -- the persistent layer ----------------------------------------------
+
+    def attach_cache(self, cache) -> None:
+        """Attach (or, with None, detach) the persistent executable cache.
+
+        Attach BEFORE warmup: specs built earlier were not consulted
+        against the disk and are not written back retroactively.
+        Detaching from the PROCESS hub re-arms the one-shot
+        ``$NM03_COMPILE_CACHE_DIR`` check, so a component detaching its
+        own cache (ServingApp.close) hands the next :func:`get_hub`
+        caller the env-requested cache back instead of silently disabling
+        it for the rest of the process — the env resolution and its
+        OSError degrade live HERE, in one place.
+        """
+        with self._lock:
+            self._persist = cache
+        if cache is None and self is _HUB:
+            global _ENV_CACHE_CHECKED
+            _ENV_CACHE_CHECKED = False
+
+    def persistent_cache(self):
+        with self._lock:
+            return self._persist
 
     # -- the registry ------------------------------------------------------
 
     def get(
         self, spec: CompileSpec, build: Callable[[CompileSpec], Callable]
     ) -> Callable:
-        """The spec's executable, building (and caching) it on first use."""
+        """The spec's executable: registry hit, persistent-cache load, or
+        build — in that order, cheapest first.
+
+        A persistent-cache load is accounted as a ``cache_load``, NEVER a
+        build, and its cost dict carries ``load_s`` instead of
+        ``compile_s`` — a deserialized executable must not report a fake
+        compile cost (``total_compile_seconds`` is the promise ``/readyz``
+        makes about what THIS process paid the compiler).
+        """
         with self._lock:
             fn = self._cache.get(spec)
+            persist = self._persist
         if fn is not None:
             return fn
+        # only shape-pinned (AOT) specs are persistable: a deferred-trace
+        # callable has no executable to serialize until first call, and a
+        # lookup for one must not pollute the hit/miss accounting
+        if persist is not None and spec.shape is not None:
+            loaded = persist.load(spec)
+            if loaded is not None:
+                # aot False = the jax-export fallback format: pre-lowered,
+                # but XLA still compiles at first execute — accounted like
+                # any deferred spec (serving warmup times that), never as
+                # a zero-cost compile
+                fn, load_s, aot = loaded
+                cost: Dict[str, float] = {"load_s": round(load_s, 4)}
+                if aot:
+                    cost.update(executable_cost(fn))
+                return self._publish(spec, fn, aot_ok=aot, cost=cost,
+                                     from_cache=True)
         t0 = time.perf_counter()
         built = build(spec)
         build_s = time.perf_counter() - t0
@@ -221,15 +304,46 @@ class CompileHub:
         # for AOT specs (deferred specs pay their compile at first call —
         # serving warmup times that separately); the XLA analyses only
         # exist on AOT executables
-        cost: Dict[str, float] = {"compile_s": round(build_s, 4)}
+        cost = {"compile_s": round(build_s, 4)}
         if aot_ok:
             cost.update(executable_cost(built))
+        out = self._publish(spec, built, aot_ok=aot_ok, cost=cost,
+                            from_cache=False)
+        if (
+            persist is not None and aot_ok and spec.shape is not None
+            and out is built  # the racing loser's twin is not worth a write
+        ):
+            persist.store(spec, built)
+        # the export source is dead weight from here in EVERY case — a
+        # spec stores at most once per process (first publisher wins), and
+        # a cache attached after warmup never stores retroactively — so
+        # drop it unconditionally: the never-evicting registry must not
+        # pin jitted wrappers and their closures for the process lifetime
+        if aot_ok:
+            try:
+                del built._nm03_export_src
+            except AttributeError:
+                pass
+        return out
+
+    def _publish(
+        self,
+        spec: CompileSpec,
+        built: Callable,
+        aot_ok: bool,
+        cost: Dict[str, float],
+        from_cache: bool,
+    ) -> Callable:
+        """First-publisher-wins registry insert + accounting + cap warning."""
         with self._lock:
             if spec not in self._cache:
                 self._cache[spec] = built
                 self._aot[spec] = aot_ok
                 self._cost[spec] = cost
-                self._builds += 1
+                if from_cache:
+                    self._cache_loads += 1
+                else:
+                    self._builds += 1
             over_cap = (
                 len(self._cache) > REGISTRY_SOFT_CAP and not self._cap_warned
             )
@@ -274,18 +388,27 @@ class CompileHub:
 
         ``total_compile_seconds`` is the warmup-cost rollup ISSUE 7's
         ``/readyz`` fix demands: what this process paid the compiler,
-        visible without grepping logs.
+        visible without grepping logs. ``builds`` counts real compiles
+        only; a persistent-cache hit counts under ``cache_loads`` and
+        contributes NOTHING to ``total_compile_seconds`` (its
+        deserialization wall lives in ``cache_load_seconds``) — the
+        ISSUE 9 honesty split.
         """
         with self._lock:
-            return {
+            out = {
                 "executables": len(self._cache),
                 "aot": sum(1 for ok in self._aot.values() if ok),
                 "builds": self._builds,
+                "cache_loads": self._cache_loads,
                 "jit_wraps": self._jit_wraps,
                 "total_compile_seconds": round(
                     sum(c.get("compile_s", 0.0) for c in self._cost.values()), 4
                 ),
             }
+            persist = self._persist
+        if persist is not None:
+            out.update(persist.readyz_stats())
+        return out
 
     def compile_seconds(self) -> Dict[str, float]:
         """Per-spec compile wall-time, keyed by :meth:`CompileSpec.label`.
@@ -327,12 +450,38 @@ class CompileHub:
 
 
 _HUB = CompileHub()
+_ENV_CACHE_CHECKED = False
 
 
 def get_hub() -> CompileHub:
     """The process-wide hub. One registry per process: executables are
     shared wherever the spec matches (two serving apps with one config
-    warm once), and the spec's fields are exactly what may differ."""
+    warm once), and the spec's fields are exactly what may differ.
+
+    ``$NM03_COMPILE_CACHE_DIR`` attaches the persistent executable cache
+    on first use (checked once per process — set it before the first
+    program builds; ``nm03-serve --compile-cache-dir`` attaches
+    explicitly and wins over the environment).
+    """
+    global _ENV_CACHE_CHECKED
+    if not _ENV_CACHE_CHECKED:
+        _ENV_CACHE_CHECKED = True
+        if _HUB.persistent_cache() is None:
+            from nm03_capstone_project_tpu.compilehub import persist
+
+            cache_dir = persist.cache_dir_from_env()
+            if cache_dir:
+                try:
+                    _HUB.attach_cache(persist.ExecutableCache(cache_dir))
+                except OSError as e:
+                    from nm03_capstone_project_tpu.utils.reporter import (
+                        get_logger,
+                    )
+
+                    get_logger("compilehub").warning(
+                        "compile cache dir %s unusable (%s); running "
+                        "without the persistent cache", cache_dir, e,
+                    )
     return _HUB
 
 

@@ -1,0 +1,680 @@
+"""Persistent AOT executable cache — cold start in milliseconds.
+
+Every serving replica, bench run and driver process used to re-pay full
+compilation at warmup: PR 7's compile-cost accounting measured ~26.8 s
+across 8 specs on CPU, and `/readyz` stayed 503 for exactly that long on
+every restart. This module persists the hub's AOT executables to disk so
+a *second* process start deserializes instead of compiling — the
+OpenCLIPER thesis (PAPERS.md) applied to the compiler itself: amortize
+device/compile overhead out of the startup path, not just the request
+path (ROADMAP open item 2).
+
+Layers:
+
+* :class:`PersistKey` — the versioned cache-key **contract** (ImageCL's
+  portability argument: a cache entry is only valid for the exact program
+  identity + toolchain that built it, so the key covers every
+  :class:`~.hub.CompileSpec` field plus the jax/jaxlib/nm03 versions and
+  the device identity. nm03-lint rule NM381 statically enforces that no
+  CompileSpec field is ever added without being folded in here).
+* :class:`ExecutableCache` — the on-disk store behind
+  :meth:`CompileHub.get`: ``store()`` serializes a compiled executable to
+  ``<dir>/<key>.nm03exe`` via the ``utils/atomicio`` tmp+rename idiom;
+  ``load()`` deserializes on a key-exact, checksum-verified hit. **Any**
+  mismatch, unreadable header, truncated payload or deserialization
+  failure is a silent miss that recompiles — a cache must never be able
+  to crash (or corrupt) the process it exists to speed up.
+* :func:`scan_entries` / :func:`gc_entries` — the ``nm03-cache`` admin
+  CLI's workhorses (``ls`` / ``verify`` / ``gc --max-bytes/--max-age``).
+
+Serialization formats, in preference order:
+
+* ``pjrt-pickle`` — ``jax.experimental.serialize_executable``: the real
+  compiled PJRT executable (plus pickled arg trees); loading it skips
+  tracing, lowering AND XLA compilation entirely.
+* ``jax-export`` — ``jax.export`` StableHLO serialization, the fallback
+  where the PJRT executable is not serializable on this backend: loading
+  skips tracing+lowering, and XLA re-compiles the pre-lowered module at
+  first execute (paid inside warmup, never by a request). The export is
+  device-id-agnostic, so device-pinned or buffer-donating specs refuse
+  this format (no entry beats one that collapses every lane onto the
+  default device) — on such backends they recompile every start.
+
+Trust boundary: both formats deserialize via pickle/StableHLO loading,
+which executes code paths that trust the bytes. The checksum defends
+against *corruption*, not tampering — point ``--compile-cache-dir`` at a
+directory with the same trust level as the installed packages
+(docs/OPERATIONS.md, "Compile cache management").
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import re
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from nm03_capstone_project_tpu.utils.atomicio import atomic_write_bytes
+
+__all__ = [
+    "ENTRY_SUFFIX",
+    "ENV_CACHE_DIR",
+    "ExecutableCache",
+    "PersistKey",
+    "cache_dir_from_env",
+    "config_digest",
+    "gc_entries",
+    "scan_entries",
+]
+
+SCHEMA = "nm03.exe.v1"
+ENTRY_SUFFIX = ".nm03exe"
+ENV_CACHE_DIR = "NM03_COMPILE_CACHE_DIR"
+
+FORMAT_PJRT = "pjrt-pickle"
+FORMAT_EXPORT = "jax-export"
+
+# the key fields whose mismatch means "this entry was built by a different
+# toolchain/package" — reported as `stale` (expected after an upgrade, the
+# runbook's invalidation case) rather than `corrupt` (bit rot / torn write)
+_VERSION_FIELDS = ("jax_version", "jaxlib_version", "nm03_version")
+
+_SAFE_CHARS = re.compile(r"[^A-Za-z0-9_.-]+")
+
+
+def cache_dir_from_env(environ=os.environ) -> Optional[str]:
+    """The ``NM03_COMPILE_CACHE_DIR`` value, or None when unset/empty."""
+    return environ.get(ENV_CACHE_DIR) or None
+
+
+def config_digest(cfg: Any) -> str:
+    """Stable digest of a pipeline config (or None) for the cache key.
+
+    Dataclasses digest their sorted field dict — two configs that compare
+    equal digest equal regardless of construction order; anything else
+    falls back to ``repr`` (stable for the frozen configs this codebase
+    uses; an unstable repr only costs a cache miss, never a wrong hit).
+    """
+    if cfg is None:
+        payload = "none"
+    elif dataclasses.is_dataclass(cfg) and not isinstance(cfg, type):
+        payload = json.dumps(
+            dataclasses.asdict(cfg), sort_keys=True, default=repr
+        )
+    else:
+        payload = repr(cfg)
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def _versions() -> Dict[str, str]:
+    import jax
+    import jaxlib
+
+    try:
+        from nm03_capstone_project_tpu import __version__ as nm03_version
+    except Exception:  # noqa: BLE001 — a dev tree without metadata still caches
+        nm03_version = "unknown"
+    return {
+        "jax_version": jax.__version__,
+        "jaxlib_version": jaxlib.__version__,
+        "nm03_version": str(nm03_version),
+    }
+
+
+@dataclasses.dataclass(frozen=True)
+class PersistKey:
+    """The versioned identity of one on-disk executable — the contract.
+
+    Built ONLY by :meth:`from_spec`, which must consume **every**
+    :class:`~.hub.CompileSpec` field (nm03-lint NM381 fails the build the
+    moment a spec field exists that this derivation does not read): a
+    field that names two different programs but is absent from the key
+    would hand one program the other's compiled binary, silently.
+    """
+
+    name: str
+    variant: str
+    shape: Optional[Tuple[int, ...]]
+    mesh: Optional[str]
+    device: Optional[str]
+    device_kind: Optional[str]
+    platform: str
+    lane: Optional[int]
+    backend: Optional[str]
+    donate: bool
+    cfg_digest: str
+    jax_version: str
+    jaxlib_version: str
+    nm03_version: str
+
+    @classmethod
+    def from_spec(cls, spec: Any) -> "PersistKey":
+        import jax
+
+        device = spec.device
+        mesh = spec.mesh
+        return cls(
+            name=spec.name,
+            variant=spec.variant,
+            shape=tuple(int(d) for d in spec.shape) if spec.shape else None,
+            # the mesh descriptor, not the object — but axis sizes ALONE
+            # are not an identity: two meshes of shape {'z': 4} over
+            # different chips must not share an entry (the serialized
+            # executable embeds the first mesh's device assignment), so
+            # the device list rides along, same rationale as `device`
+            mesh=(
+                json.dumps(
+                    {
+                        "shape": dict(mesh.shape),
+                        "devices": [
+                            str(d) for d in getattr(mesh, "devices", []).flat
+                        ]
+                        if getattr(mesh, "devices", None) is not None
+                        else [],
+                    },
+                    sort_keys=True,
+                )
+                if mesh is not None
+                else None
+            ),
+            # str(device) carries backend + id ("TFRT_CPU_3"): a lane's
+            # executable embeds its device assignment, so lane 3's entry
+            # must never satisfy lane 0's lookup
+            device=str(device) if device is not None else None,
+            device_kind=(
+                getattr(device, "device_kind", None)
+                if device is not None
+                else None
+            ),
+            platform=(
+                getattr(device, "platform", None) or jax.default_backend()
+            ),
+            lane=spec.lane,
+            backend=spec.backend,
+            donate=bool(spec.donate),
+            cfg_digest=config_digest(spec.cfg),
+            **_versions(),
+        )
+
+    def to_json(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["shape"] = list(self.shape) if self.shape else None
+        return d
+
+    def digest(self) -> str:
+        return hashlib.sha256(
+            json.dumps(self.to_json(), sort_keys=True).encode()
+        ).hexdigest()[:20]
+
+    def filename(self) -> str:
+        """``<readable-prefix>-<digest>.nm03exe`` — ls-able, collision-free.
+
+        The digest alone is the identity; the prefix only exists so
+        ``nm03-cache ls`` and a shell glob mean something to a human.
+        """
+        parts = [self.name]
+        if self.shape:
+            parts.append("x".join(str(d) for d in self.shape))
+        if self.device is not None:
+            parts.append(self.device)
+        prefix = _SAFE_CHARS.sub("_", "-".join(parts))[:80]
+        return f"{prefix}-{self.digest()}{ENTRY_SUFFIX}"
+
+
+class CacheEntryError(Exception):
+    """An unusable on-disk entry; ``kind`` classifies it for stats/CLI."""
+
+    def __init__(self, kind: str, message: str):
+        super().__init__(message)
+        self.kind = kind  # corrupt | stale | mismatch
+
+
+def _compose_entry(key: PersistKey, fmt: str, payload: bytes) -> bytes:
+    header = {
+        "schema": SCHEMA,
+        "format": fmt,
+        "key": key.to_json(),
+        "payload_sha256": hashlib.sha256(payload).hexdigest(),
+        "payload_len": len(payload),
+        "created_unix": time.time(),
+    }
+    line = json.dumps(header, sort_keys=True).encode()
+    if len(line) + 1 > _HEADER_CAP:
+        # enforced at WRITE time so every reader may trust the cap: a
+        # header the header-only scan would reject (and gc then delete)
+        # must never be written as an entry load() would accept
+        raise ValueError(
+            f"entry header of {len(line)} bytes exceeds the "
+            f"{_HEADER_CAP} cap (pathological key, e.g. a giant mesh "
+            "device list) — entry not persisted"
+        )
+    return line + b"\n" + payload
+
+
+def _parse_header(head: bytes) -> dict:
+    """The one header grammar, shared by the full and header-only readers."""
+    try:
+        header = json.loads(head.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise CacheEntryError("corrupt", f"unparseable header: {e}") from e
+    if not isinstance(header, dict) or header.get("schema") != SCHEMA:
+        raise CacheEntryError(
+            "corrupt", f"bad schema {header.get('schema')!r}"
+            if isinstance(header, dict) else "header is not an object"
+        )
+    return header
+
+
+def _split_entry(raw: bytes) -> Tuple[dict, bytes]:
+    """Parse header + verify checksum; CacheEntryError('corrupt') otherwise."""
+    head, sep, payload = raw.partition(b"\n")
+    if not sep:
+        raise CacheEntryError("corrupt", "no header/payload separator")
+    header = _parse_header(head)
+    if header.get("payload_len") != len(payload):
+        raise CacheEntryError(
+            "corrupt",
+            f"payload is {len(payload)} bytes, header says "
+            f"{header.get('payload_len')} (truncated write?)",
+        )
+    if hashlib.sha256(payload).hexdigest() != header.get("payload_sha256"):
+        raise CacheEntryError("corrupt", "payload checksum mismatch")
+    return header, payload
+
+
+def _classify_key_mismatch(want: dict, got: Any) -> CacheEntryError:
+    if not isinstance(got, dict):
+        return CacheEntryError("corrupt", "header key is not an object")
+    drift = [
+        f for f in _VERSION_FIELDS if got.get(f) != want.get(f)
+    ]
+    if drift:
+        pairs = ", ".join(
+            f"{f}={got.get(f)!r} (want {want.get(f)!r})" for f in drift
+        )
+        return CacheEntryError("stale", f"built by a different toolchain: {pairs}")
+    return CacheEntryError(
+        "mismatch",
+        "key digest collision or tampered header (entry ignored)",
+    )
+
+
+def _deserialize(fmt: str, payload: bytes) -> Callable:
+    """Payload -> callable executable; any failure raises (caller misses)."""
+    if fmt == FORMAT_PJRT:
+        from jax.experimental import serialize_executable
+
+        serialized, in_tree, out_tree = pickle.loads(payload)
+        return serialize_executable.deserialize_and_load(
+            serialized, in_tree, out_tree
+        )
+    if fmt == FORMAT_EXPORT:
+        import jax
+        from jax import export
+
+        exported = export.deserialize(bytearray(payload))
+        # pre-lowered StableHLO: jit here only pays the XLA compile of the
+        # serialized module at first call (inside warmup), never a retrace
+        return jax.jit(exported.call)
+    raise CacheEntryError("corrupt", f"unknown payload format {fmt!r}")
+
+
+def _serialize(spec: Any, built: Any) -> Tuple[str, bytes]:
+    """Compiled executable -> (format, payload); raises when unsupported."""
+    try:
+        from jax.experimental import serialize_executable
+
+        serialized, in_tree, out_tree = serialize_executable.serialize(built)
+        return FORMAT_PJRT, pickle.dumps((serialized, in_tree, out_tree))
+    except Exception:  # noqa: BLE001 — fall through to the export form
+        pass
+    if spec.device is not None or spec.donate:
+        # the StableHLO export is device-id-agnostic and reloads as a bare
+        # jax.jit — a lane-pinned executable would silently collapse every
+        # lane onto the default device (and donation would be dropped).
+        # Better no entry at all: these specs recompile every start on
+        # backends whose PJRT executables cannot serialize.
+        raise RuntimeError(
+            "export fallback cannot preserve device pinning/donation — "
+            "spec not persisted"
+        )
+    src = getattr(built, "_nm03_export_src", None)
+    if src is None:
+        raise RuntimeError(
+            "executable is not serializable on this backend and carries no "
+            "export source (aot_compile attaches one)"
+        )
+    from jax import export
+
+    jitted, arg_structs = src
+    exported = export.export(jitted)(*arg_structs)
+    return FORMAT_EXPORT, bytes(exported.serialize())
+
+
+class ExecutableCache:
+    """The on-disk executable store behind :meth:`CompileHub.get`.
+
+    Thread-safe (warmup threads race through the hub); every failure mode
+    is a counted miss, never an exception to the caller. ``fault_hook``
+    is the chaos-injection point (resilience.FaultPlan site ``cache``,
+    kind ``io_error``): called with the entry filename before a store
+    writes, so drills prove a failed write degrades to a clean recompile.
+    """
+
+    def __init__(
+        self,
+        root: "str | os.PathLike",
+        fault_hook: Optional[Callable[[str], None]] = None,
+    ):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self._fault_hook = fault_hook
+        self._lock = threading.Lock()
+        self._stats = {
+            "hits": 0,
+            "misses": 0,
+            "corrupt": 0,
+            "stale": 0,
+            "stores": 0,
+            "store_errors": 0,
+            "bytes_read": 0,
+            "bytes_written": 0,
+            "load_seconds": 0.0,
+        }
+
+    def _bump(self, **deltas: float) -> None:
+        with self._lock:
+            for k, v in deltas.items():
+                self._stats[k] += v
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            out = dict(self._stats)
+        out["load_seconds"] = round(out["load_seconds"], 4)
+        return out
+
+    def readyz_stats(self) -> Dict[str, float]:
+        """The ``/readyz`` ``compile_hub`` cache fields (ISSUE 9)."""
+        s = self.stats()
+        return {
+            "cache_hits": s["hits"],
+            "cache_misses": s["misses"],
+            "cache_bytes": s["bytes_read"] + s["bytes_written"],
+            "cache_load_seconds": s["load_seconds"],
+        }
+
+    # -- load / store ------------------------------------------------------
+
+    def load(self, spec: Any) -> Optional[Tuple[Callable, float, bool]]:
+        """``(executable, load_seconds, aot)`` for the spec, or None.
+
+        ``aot`` is True for the pjrt format (the real compiled binary —
+        nothing left to compile) and False for the jax-export fallback,
+        whose pre-lowered module still pays an XLA compile at first
+        execute: the hub must account it like any other deferred spec,
+        not report a compile the process will still pay as already free.
+
+        None means MISS — absent, corrupt, stale, mismatched or
+        undeserializable, each counted, none raised: the caller's
+        recompile path is the recovery for every one of them.
+        """
+        t0 = time.perf_counter()
+        try:
+            key = PersistKey.from_spec(spec)
+            path = self.root / key.filename()
+            try:
+                raw = path.read_bytes()
+            except OSError:
+                self._bump(misses=1)
+                return None
+            header, payload = _split_entry(raw)
+            if header.get("key") != key.to_json():
+                raise _classify_key_mismatch(key.to_json(), header.get("key"))
+            fmt = header.get("format")
+            fn = _deserialize(fmt, payload)
+        except CacheEntryError as e:
+            self._bump(
+                misses=1, **({e.kind: 1} if e.kind in ("corrupt", "stale") else {})
+            )
+            _log().warning(
+                "compile cache: ignoring %s entry for %s: %s",
+                e.kind, getattr(spec, "name", spec), e,
+            )
+            return None
+        except Exception as e:  # noqa: BLE001 — a cache must never crash a build
+            self._bump(misses=1, corrupt=1)
+            _log().warning(
+                "compile cache: load failed for %s (recompiling): %s",
+                getattr(spec, "name", spec), e,
+            )
+            return None
+        load_s = time.perf_counter() - t0
+        self._bump(hits=1, bytes_read=len(raw), load_seconds=load_s)
+        return fn, load_s, fmt == FORMAT_PJRT
+
+    def store(self, spec: Any, built: Any) -> bool:
+        """Persist one compiled executable; False (counted) on any failure."""
+        try:
+            key = PersistKey.from_spec(spec)
+            name = key.filename()
+            if self._fault_hook is not None:
+                self._fault_hook(name)
+            fmt, payload = _serialize(spec, built)
+            entry = _compose_entry(key, fmt, payload)
+            atomic_write_bytes(self.root / name, entry)
+        except Exception as e:  # noqa: BLE001 — persistence is best-effort
+            self._bump(store_errors=1)
+            _log().warning(
+                "compile cache: store failed for %s (entry skipped): %s",
+                getattr(spec, "name", spec), e,
+            )
+            return False
+        self._bump(stores=1, bytes_written=len(entry))
+        return True
+
+
+def _log():
+    from nm03_capstone_project_tpu.utils.reporter import get_logger
+
+    return get_logger("compilehub")
+
+
+# -- admin-surface helpers (nm03-cache) --------------------------------------
+
+
+# One header-size contract, enforced at BOTH ends: _compose_entry refuses
+# to write a header past the cap, so the header-only readers (ls/gc) may
+# reject anything larger as corrupt without ever disagreeing with load()/
+# verify about a valid entry. A real header is ~1 KiB.
+_HEADER_CAP = 1 << 16
+
+
+def _read_header_only(path: Path, file_size: int) -> dict:
+    """Header + cheap length validation WITHOUT reading the payload.
+
+    Catches every torn-write shape by size arithmetic (the file must be
+    exactly header-line + newline + payload_len bytes); only same-length
+    bit rot needs the full checksum (``nm03-cache verify``).
+    """
+    with open(path, "rb") as f:
+        head = f.readline(_HEADER_CAP)
+    if not head.endswith(b"\n"):
+        raise CacheEntryError("corrupt", "no header/payload separator")
+    header = _parse_header(head[:-1])
+    want = len(head) + header.get("payload_len", -1)
+    if want != file_size:
+        raise CacheEntryError(
+            "corrupt",
+            f"file is {file_size} bytes, header promises {want} "
+            "(truncated write?)",
+        )
+    return header
+
+
+def scan_entries(
+    root: "str | os.PathLike", checksum: bool = True
+) -> List[Dict[str, Any]]:
+    """One row per ``*.nm03exe`` file: header facts + integrity status.
+
+    ``status`` is ``ok`` (parses, length — and with ``checksum`` the
+    payload hash — verifies), ``stale`` (verifies but was built by a
+    different jax/jaxlib/nm03 than THIS process), ``corrupt``, or
+    ``unreadable`` (an I/O error reading it — possibly healthy, e.g. a
+    permissions mismatch; gc keeps these).
+    ``checksum=False`` reads only headers (``nm03-cache ls`` over a
+    multi-GiB production cache must not hash every binary; length
+    arithmetic still catches truncation). Never raises on entry content;
+    an unreadable directory raises OSError to the caller (that is an
+    operator error, not an entry).
+    """
+    rows: List[Dict[str, Any]] = []
+    want_versions = None
+    for path in sorted(Path(root).glob(f"*{ENTRY_SUFFIX}")):
+        try:
+            st = path.stat()
+        except OSError:
+            continue  # vanished between glob and stat (a concurrent gc)
+        row: Dict[str, Any] = {
+            "file": path.name,
+            "bytes": st.st_size,
+            "age_s": max(0.0, time.time() - st.st_mtime),
+            "mtime": st.st_mtime,
+        }
+        try:
+            try:
+                if checksum:
+                    header, _payload = _split_entry(path.read_bytes())
+                else:
+                    header = _read_header_only(path, st.st_size)
+            except OSError as e:
+                # EACCES/EIO/NFS blip — the ENTRY may be perfectly healthy
+                # (e.g. gc running under an account that cannot read the
+                # service uid's files). Distinct from corrupt on purpose:
+                # gc deletes corrupt unconditionally, and destroying a
+                # fleet's warm cache over a permissions problem is the
+                # worst thing a janitor can do.
+                row["status"] = "unreadable"
+                row["error"] = f"{type(e).__name__}: {e}"
+                rows.append(row)
+                continue
+            key = header.get("key") or {}
+            row.update(
+                {
+                    "name": key.get("name"),
+                    "shape": key.get("shape"),
+                    "device": key.get("device"),
+                    "platform": key.get("platform"),
+                    "format": header.get("format"),
+                    "jax_version": key.get("jax_version"),
+                    "jaxlib_version": key.get("jaxlib_version"),
+                    "nm03_version": key.get("nm03_version"),
+                    "created_unix": header.get("created_unix"),
+                }
+            )
+            if want_versions is None:
+                want_versions = _versions()
+            drift = [
+                f for f in _VERSION_FIELDS
+                if key.get(f) != want_versions[f]
+            ]
+            row["status"] = "stale" if drift else "ok"
+            if drift:
+                row["stale_fields"] = drift
+        except CacheEntryError as e:
+            row["status"] = "corrupt"
+            row["error"] = str(e)
+        except Exception as e:  # noqa: BLE001 — one bad entry never hides the rest
+            row["status"] = "corrupt"
+            row["error"] = f"{type(e).__name__}: {e}"
+        rows.append(row)
+    return rows
+
+
+# how old an orphaned atomic-write temp must be before gc reclaims it: a
+# real store's temp lives milliseconds, so anything past this is the
+# leavings of a SIGKILL/OOM mid-store, not a writer in flight
+TMP_ORPHAN_GRACE_S = 600.0
+
+
+def gc_entries(
+    root: "str | os.PathLike",
+    max_bytes: Optional[int] = None,
+    max_age_s: Optional[float] = None,
+    dry_run: bool = False,
+) -> Dict[str, Any]:
+    """Delete dead and expired entries, then the oldest to the byte budget.
+
+    Policy (docs/OPERATIONS.md): orphaned ``*.tmp`` files from killed
+    atomic writes (older than :data:`TMP_ORPHAN_GRACE_S`) and corrupt AND
+    stale entries always go — the latter two can only ever miss for THIS
+    toolchain (the entry filename digest embeds the versions, so a new
+    toolchain never even opens an old entry; do not run gc from one side
+    of a cache dir deliberately shared by mixed-version fleets) — then
+    anything older than ``max_age_s``, then oldest-mtime-first until
+    total size fits ``max_bytes``. Retention needs only header facts
+    (toolchain, length arithmetic, mtime, size), so the scan is
+    header-only; same-length bit rot is already a self-defending miss at
+    ``load()`` and ``nm03-cache verify``'s full checksum names it. Returns
+    ``{"removed": [names], "freed_bytes": n, "kept": n, "kept_bytes": n}``.
+    """
+    rows = scan_entries(root, checksum=False)
+    removed: List[str] = []
+    freed = 0
+    now = time.time()
+    for tmp in sorted(Path(root).glob("*.tmp")):
+        try:
+            st = tmp.stat()
+        except OSError:
+            continue
+        if now - st.st_mtime <= TMP_ORPHAN_GRACE_S:
+            continue  # possibly a live writer's temp — not ours to take
+        if not dry_run:
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
+        removed.append(tmp.name)
+        freed += st.st_size
+
+    def drop(row: Dict[str, Any]) -> None:
+        nonlocal freed
+        if not dry_run:
+            with contextlib.suppress(OSError):
+                os.unlink(Path(root) / row["file"])
+        removed.append(row["file"])
+        freed += row["bytes"]
+
+    keep: List[Dict[str, Any]] = []
+    protected: List[Dict[str, Any]] = []  # unreadable: NEVER gc-fodder
+    for row in rows:
+        if row["status"] == "unreadable":
+            # possibly healthy, just not ours to read (perms/NFS blip) —
+            # exempt from EVERY retention branch, age and byte budget
+            # included: a wrong-uid gc cron with --max-age must not
+            # mass-delete a fleet's warm cache
+            protected.append(row)
+        elif row["status"] in ("corrupt", "stale"):
+            drop(row)
+        elif max_age_s is not None and row["age_s"] > max_age_s:
+            drop(row)
+        else:
+            keep.append(row)
+    if max_bytes is not None:
+        keep.sort(key=lambda r: r["mtime"])  # oldest first
+        total = sum(r["bytes"] for r in keep)
+        while keep and total > max_bytes:
+            victim = keep.pop(0)
+            total -= victim["bytes"]
+            drop(victim)
+    keep += protected
+    return {
+        "removed": removed,
+        "freed_bytes": freed,
+        "kept": len(keep),
+        "kept_bytes": sum(r["bytes"] for r in keep),
+    }

@@ -44,7 +44,10 @@ Kinds by site:
   dispatch deadline exists for);
 * ``export``:   ``io_error`` (raise before the JPEG pair writes),
   ``sigterm`` (deliver SIGTERM to this process — the crash-safe-resume
-  drill).
+  drill);
+* ``cache``:    ``io_error`` (abort a persistent compile-cache entry write
+  — the next start recompiles instead of loading; ``stem`` selects the
+  entry filename).
 
 Injected faults are observable: every fire increments
 ``resilience_faults_injected_total{site,kind}`` and emits a
@@ -65,11 +68,16 @@ from nm03_capstone_project_tpu.resilience.policy import TransientDeviceError
 
 ENV_VAR = "NM03_FAULT_PLAN"
 
-SITES = ("decode", "dispatch", "export")
+SITES = ("decode", "dispatch", "export", "cache")
 KINDS_BY_SITE = {
     "decode": ("error", "corrupt"),
     "dispatch": ("transient", "hang"),
     "export": ("io_error", "sigterm"),
+    # the persistent compile cache's store path (compilehub/persist.py):
+    # io_error aborts the entry write, proving a failed persist degrades
+    # to a plain recompile on the next start — never a torn entry (the
+    # write itself is atomic; `stem` selects the entry filename)
+    "cache": ("io_error",),
 }
 
 

@@ -28,6 +28,15 @@ SERVING_LANE_BATCHES_TOTAL = "serving_lane_batches_total"
 SERVING_LANE_QUARANTINES_TOTAL = "serving_lane_quarantines_total"
 # probation probes that passed and returned the lane to traffic ({lane})
 SERVING_LANE_REINSTATED_TOTAL = "serving_lane_reinstated_total"
+# persistent compile cache (ISSUE 9): executables deserialized from /
+# missed in --compile-cache-dir during warmup. Published once after
+# warmup from the hub's cache stats (presence marks a cache-enabled run;
+# a warm restart's acceptance gate is hits == warm spec count AND the
+# builds stat at 0). compile_cache_load_seconds is the gauge twin:
+# total deserialization wall — what the warm start paid INSTEAD of
+# total_compile_seconds.
+COMPILE_CACHE_HITS_TOTAL = "compile_cache_hits_total"
+COMPILE_CACHE_MISSES_TOTAL = "compile_cache_misses_total"
 
 # -- gauges -----------------------------------------------------------------
 # compile-cost accounting (ISSUE 7; labels: spec = CompileSpec.label()):
@@ -38,6 +47,7 @@ SERVING_LANE_REINSTATED_TOTAL = "serving_lane_reinstated_total"
 COMPILE_SECONDS = "compile_seconds"
 EXECUTABLE_FLOPS = "executable_flops"
 EXECUTABLE_HBM_BYTES = "executable_hbm_bytes"
+COMPILE_CACHE_LOAD_SECONDS = "compile_cache_load_seconds"
 SERVING_INFLIGHT = "serving_inflight"  # admitted, not yet responded
 SERVING_READY = "serving_ready"  # 1 = warmed + admitting, 0 otherwise
 SERVING_DEGRADED = "serving_degraded"  # 1 = one-way CPU degradation tripped

@@ -55,6 +55,9 @@ from nm03_capstone_project_tpu.obs.trace import (
 from nm03_capstone_project_tpu.serving.batcher import DynamicBatcher
 from nm03_capstone_project_tpu.serving.executor import DEFAULT_BUCKETS, WarmExecutor
 from nm03_capstone_project_tpu.serving.metrics import (
+    COMPILE_CACHE_HITS_TOTAL,
+    COMPILE_CACHE_LOAD_SECONDS,
+    COMPILE_CACHE_MISSES_TOTAL,
     COMPILE_SECONDS,
     EXECUTABLE_FLOPS,
     EXECUTABLE_HBM_BYTES,
@@ -88,6 +91,28 @@ class RequestRejected(ValueError):
         self.status_label = status_label
 
 
+def _cache_fault_hook(fault_plan, obs):
+    """The compile cache's chaos hook (FaultPlan site ``cache``), or None.
+
+    Fired with the entry filename before each store; an ``io_error`` rule
+    aborts that write — the drill that proves a failed persist degrades
+    to a plain recompile on the next start, never a torn or missing-but-
+    claimed entry.
+    """
+    if fault_plan is None or not fault_plan.has_site("cache"):
+        return None
+    from nm03_capstone_project_tpu.resilience import InjectedExportError
+
+    def hook(entry_name: str) -> None:
+        rule = fault_plan.fire("cache", obs=obs, stem=entry_name)
+        if rule is not None:  # the site's only kind is io_error
+            raise InjectedExportError(
+                f"injected compile-cache io error ({entry_name})"
+            )
+
+    return hook
+
+
 class ServingApp:
     """Everything behind the HTTP handler: queue, batcher, executor, state."""
 
@@ -105,6 +130,7 @@ class ServingApp:
         obs=None,
         lanes: Optional[int] = None,
         lane_probe_interval_s: Optional[float] = None,
+        compile_cache_dir: Optional[str] = None,
     ):
         from nm03_capstone_project_tpu.obs import RunContext
         from nm03_capstone_project_tpu.serving.executor import (
@@ -113,6 +139,8 @@ class ServingApp:
 
         self.cfg = cfg if cfg is not None else PipelineConfig()
         self.obs = obs if obs is not None else RunContext.create(driver="serve")
+        self.compile_cache_dir = compile_cache_dir
+        self._attached_cache = None
         self.queue = AdmissionQueue(queue_capacity)
         self.executor = WarmExecutor(
             self.cfg,
@@ -141,6 +169,34 @@ class ServingApp:
         self._drained = threading.Event()
         self._t0 = time.monotonic()
         self.registry = self.obs.registry
+        if compile_cache_dir:
+            # attach LAST (after every fallible construction above, so a
+            # raising __init__ cannot strand the cache — and its
+            # obs-capturing fault hook — on the process-global hub with no
+            # close() ever coming) but still BEFORE warmup, so the lane
+            # executables load from (and populate) the persistent cache;
+            # an explicit dir wins over whatever $NM03_COMPILE_CACHE_DIR
+            # may have auto-attached
+            from nm03_capstone_project_tpu.compilehub import (
+                ExecutableCache,
+                get_hub,
+            )
+
+            try:
+                self._attached_cache = ExecutableCache(
+                    compile_cache_dir,
+                    fault_hook=_cache_fault_hook(fault_plan, self.obs),
+                )
+            except OSError as e:
+                # best-effort optimization, never a crash loop: one
+                # replica with a bad mount serves (slowly) instead of
+                # dying — same degrade get_hub() applies to the env path
+                log.warning(
+                    "compile cache dir %s unusable (%s); serving without "
+                    "the persistent cache", compile_cache_dir, e,
+                )
+            else:
+                get_hub().attach_cache(self._attached_cache)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -207,6 +263,31 @@ class ServingApp:
                 "(arguments+outputs+temps-aliased) per executable",
                 spec=spec,
             ).set(v)
+        # persistent-cache accounting (ISSUE 9): published when THIS app
+        # attached a cache — zeros included, so a cache-enabled cold
+        # start is distinguishable from a run without the cache, and
+        # check_telemetry can assert hits EXACTLY (compile_cache_hits_total==0
+        # on the cold start, ==spec-count on the warm restart). Read from
+        # our own cache object, not hub.stats(): a cache some OTHER
+        # component attached to the shared hub must not bleed its hits
+        # into this app's registry
+        if self._attached_cache is not None:
+            stats = self._attached_cache.readyz_stats()
+            self.registry.counter(
+                COMPILE_CACHE_HITS_TOTAL,
+                help="warm executables deserialized from --compile-cache-dir "
+                "instead of compiled",
+            ).inc(stats["cache_hits"])
+            self.registry.counter(
+                COMPILE_CACHE_MISSES_TOTAL,
+                help="persistent-cache lookups that fell through to a real "
+                "compile (absent, corrupt or stale entries)",
+            ).inc(stats["cache_misses"])
+            self.registry.gauge(
+                COMPILE_CACHE_LOAD_SECONDS,
+                help="total executable deserialization wall — what the warm "
+                "start paid instead of total_compile_seconds",
+            ).set(stats["cache_load_seconds"])
 
     @property
     def ready(self) -> bool:
@@ -293,6 +374,23 @@ class ServingApp:
         return drained
 
     def close(self, status: str = "ok") -> None:
+        with self._drain_lock:
+            cache, self._attached_cache = self._attached_cache, None
+        if cache is not None:
+            # detach OUR cache from the process-global hub: a later app in
+            # this process without a cache dir must not inherit this app's
+            # fault hook, which closes over this app's (now closed) obs.
+            # Identity-checked: if someone attached a different cache
+            # after us, it is theirs to manage. Detaching re-arms the
+            # hub's one-shot $NM03_COMPILE_CACHE_DIR check, so an
+            # env-requested cache (a process-wide request that must
+            # survive one serving app's lifecycle) comes back — hook-free
+            # — at the next get_hub().
+            from nm03_capstone_project_tpu.compilehub import get_hub
+
+            hub = get_hub()
+            if hub.persistent_cache() is cache:
+                hub.attach_cache(None)
         self.obs.close(status=status)
 
     # -- request plumbing (HTTP-free, directly testable) -------------------
@@ -693,6 +791,17 @@ def build_parser() -> argparse.ArgumentParser:
         "quarantine triage)",
     )
     g.add_argument(
+        "--compile-cache-dir",
+        default=None,
+        metavar="DIR",
+        help="persistent AOT executable cache: warmup serializes every "
+        "per-lane compiled executable here and a restart against the same "
+        "dir deserializes instead of compiling — /readyz in milliseconds, "
+        "not compile-minutes (default: $NM03_COMPILE_CACHE_DIR; unset = "
+        "compile every start; docs/OPERATIONS.md compile-cache runbook, "
+        "nm03-cache for ls/verify/gc)",
+    )
+    g.add_argument(
         "--jpeg-quality", type=int, default=90, help="JPEG encoder quality"
     )
     g.add_argument(
@@ -718,6 +827,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 def app_from_args(args: argparse.Namespace, obs=None) -> ServingApp:
     from nm03_capstone_project_tpu.cli import common
+    from nm03_capstone_project_tpu.compilehub.persist import cache_dir_from_env
     from nm03_capstone_project_tpu.resilience import FaultPlan
 
     cfg = common.pipeline_config_from_args(args)
@@ -736,6 +846,7 @@ def app_from_args(args: argparse.Namespace, obs=None) -> ServingApp:
         obs=obs,
         lanes=args.lanes or None,
         lane_probe_interval_s=args.lane_probe_interval_s,
+        compile_cache_dir=args.compile_cache_dir or cache_dir_from_env(),
     )
 
 

@@ -41,7 +41,13 @@ metrics
     ``pipeline_degraded_total{cause}``;
   * ``--expect-counter NAME=MIN`` (repeatable) requires the summed value
     of NAME's series to be at least MIN — the chaos suite's assertion
-    hook (e.g. ``--expect-counter pipeline_degraded_total=1``);
+    hook (e.g. ``--expect-counter pipeline_degraded_total=1``); the
+    double-equals form ``NAME==VALUE`` is the gauge-compatible EXACT
+    expectation: the counter must exist (absence fails, like a gauge) and
+    total exactly VALUE. The compile-cache drills need both directions —
+    ``compile_cache_hits_total==0`` proves a cold start really compiled,
+    ``compile_cache_misses_total==0`` that a warm restart loaded
+    everything (ISSUE 9);
   * ``--expect-histogram NAME=MINCOUNT`` (repeatable) requires the summed
     observation count across NAME's histogram series to be at least
     MINCOUNT — the serving load/chaos smoke's assertion hook (e.g.
@@ -287,8 +293,10 @@ def check_metrics(path: str, chk: Checker, expect_counters=None,
                   expect_histograms=None, expect_gauges=None):
     """Validate one metrics snapshot; returns (run_id, git_sha) or None.
 
-    ``expect_counters``: {name: min_total} — the summed value across NAME's
-    series must be >= min_total (chaos-suite assertions).
+    ``expect_counters``: {name: min_total | (value, exact)} — the summed
+    value across NAME's series must be >= min_total, or (exact form,
+    ``NAME==N`` on the CLI) present and EXACTLY equal (chaos-suite and
+    compile-cache assertions).
     ``expect_histograms``: {name: min_count} — the summed observation count
     across NAME's histogram series must be >= min_count (and NAME must
     actually be a histogram).
@@ -366,6 +374,7 @@ def check_metrics(path: str, chk: Checker, expect_counters=None,
             if kind == "gauge" and _is_num(v):
                 gauge_series.setdefault(name, []).append((labels, v))
     for spec, want in sorted((expect_counters or {}).items()):
+        want, exact = want if isinstance(want, tuple) else (want, False)
         try:
             name, sel = parse_selector(spec)
         except ValueError as e:
@@ -375,12 +384,21 @@ def check_metrics(path: str, chk: Checker, expect_counters=None,
         if not series and kind_by_name.get(name) not in (None, "counter"):
             chk.fail(path, f"{name} is a {kind_by_name[name]}, not a counter")
             continue
+        if exact and name not in counter_series:
+            # the exact form asserts presence too: "hits == 0" must fail
+            # on a run that never enabled the cache, exactly like a gauge
+            chk.fail(path, f"counter {spec} absent, expected == {want}")
+            continue
         matched = _select(series, sel)
         if sel and series and not matched:
             chk.fail(path, f"counter {spec}: no series matches the selector")
             continue
         got = sum(matched)
-        if got < want:
+        if exact:
+            if got != want:
+                chk.fail(path,
+                         f"counter {spec} totals {got}, expected == {want}")
+        elif got < want:
             chk.fail(path, f"counter {spec} totals {got}, expected >= {want}")
     for spec, want in sorted((expect_gauges or {}).items()):
         try:
@@ -531,14 +549,22 @@ def main(argv=None) -> int:
             "nothing to check: pass --events, --metrics and/or --expect-trace"
         )
 
-    def parse_expectations(specs: list, flag: str, labeled: bool = False) -> dict:
+    def parse_expectations(
+        specs: list, flag: str, labeled: bool = False,
+        allow_exact: bool = False,
+    ) -> dict:
         out = {}
         for spec in specs:
             # rpartition: a labeled selector (NAME{label=value}=N) carries
             # '=' inside the braces; the expectation value is always last
             sel, _, val = spec.rpartition("=")
+            exact = False
+            if allow_exact and sel.endswith("="):
+                # NAME==N / NAME{...}==N: exact, gauge-style equality
+                sel = sel[:-1]
+                exact = True
             try:
-                out[sel] = float(val)
+                out[sel] = (float(val), exact) if allow_exact else float(val)
             except ValueError:
                 ap.error(f"{flag} wants NAME=N or NAME{{label=value}}=N, "
                          f"got {spec!r}")
@@ -554,7 +580,8 @@ def main(argv=None) -> int:
         return out
 
     expect_counters = parse_expectations(
-        args.expect_counter, "--expect-counter", labeled=True
+        args.expect_counter, "--expect-counter", labeled=True,
+        allow_exact=True,
     )
     expect_histograms = parse_expectations(
         args.expect_histogram, "--expect-histogram"

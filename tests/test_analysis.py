@@ -23,6 +23,7 @@ from nm03_capstone_project_tpu.analysis.atomicio import (
     check_atomic_io,
     check_obs_dump_io,
 )
+from nm03_capstone_project_tpu.analysis.cachekey import check_cache_key
 from nm03_capstone_project_tpu.analysis.compilehome import check_compile_home
 from nm03_capstone_project_tpu.analysis.contracts import check_import_contracts
 from nm03_capstone_project_tpu.analysis.core import (
@@ -917,6 +918,124 @@ class TestCompileHome:
         )
         fs = run_rules(parsed, (check_compile_home,))
         assert rules_of(fs) == [], [f.render() for f in fs]
+
+
+class TestCacheKey:
+    """NM381 (ISSUE 9): cache-key completeness — every CompileSpec field
+    must be consumed by the sibling persist.py's key derivation, or two
+    different programs could share one on-disk executable."""
+
+    GOOD_HUB = f"""
+    import dataclasses
+    @dataclasses.dataclass(frozen=True)
+    class CompileSpec:
+        name: str
+        cfg: object = None
+        shape: tuple = None
+    """
+
+    def test_missing_field_flagged_at_its_declaration(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/compilehub/hub.py": self.GOOD_HUB,
+                f"{PKG}/compilehub/persist.py": """
+                def from_spec(spec):
+                    return (spec.name, spec.shape)  # cfg never read
+                """,
+            },
+            rules=(check_cache_key,),
+        )
+        assert rules_of(fs) == ["NM381"]
+        assert "cfg" in fs[0].message and fs[0].path.endswith("hub.py")
+
+    def test_full_coverage_clean(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/compilehub/hub.py": self.GOOD_HUB,
+                f"{PKG}/compilehub/persist.py": """
+                def digest(spec):
+                    return hash(spec.cfg)
+                def from_spec(spec):
+                    return (spec.name, spec.shape, digest(spec))
+                """,
+            },
+            rules=(check_cache_key,),
+        )
+        assert rules_of(fs) == []
+
+    def test_tree_without_persist_module_is_out_of_scope(self, tmp_path):
+        # fixture trees for other rule families carry hub-less layouts;
+        # the completeness contract only binds where the persistent layer
+        # exists next to the spec
+        fs = lint_tree(
+            tmp_path,
+            {f"{PKG}/compilehub/hub.py": self.GOOD_HUB},
+            rules=(check_cache_key,),
+        )
+        assert rules_of(fs) == []
+
+    def test_hub_without_compile_spec_is_out_of_scope(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/compilehub/hub.py": "class Other:\n    pass\n",
+                f"{PKG}/compilehub/persist.py": "def from_spec(spec): ...\n",
+            },
+            rules=(check_cache_key,),
+        )
+        assert rules_of(fs) == []
+
+    def test_suppression_with_reason_honored(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/compilehub/hub.py": """
+                import dataclasses
+                @dataclasses.dataclass(frozen=True)
+                class CompileSpec:
+                    name: str
+                    # nm03-lint: disable=NM381 display-only field, never affects the compiled program
+                    color: str = ""
+                """,
+                f"{PKG}/compilehub/persist.py": """
+                def from_spec(spec):
+                    return (spec.name,)
+                """,
+            },
+            rules=(check_cache_key,),
+        )
+        assert rules_of(fs) == []
+
+    def test_real_tree_clean_and_break_drill(self, tmp_path):
+        """Acceptance: the REAL hub/persist pair passes NM381, and the
+        same pair with one spec read stripped from persist.py fails —
+        the rule is wired to the actual contract, not a fixture echo."""
+        hub_src = (REPO / PKG / "compilehub" / "hub.py").read_text()
+        persist_src = (REPO / PKG / "compilehub" / "persist.py").read_text()
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/compilehub/hub.py": hub_src,
+                f"{PKG}/compilehub/persist.py": persist_src,
+            },
+            rules=(check_cache_key,),
+        )
+        assert rules_of(fs) == [], [f.render() for f in fs]
+        broken = persist_src.replace("donate=bool(spec.donate),", "")
+        assert broken != persist_src, "break-drill anchor moved in persist.py"
+        (tmp_path / "broken").mkdir()
+        fs = lint_tree(
+            tmp_path / "broken",
+            {
+                f"{PKG}/compilehub/hub.py": hub_src,
+                f"{PKG}/compilehub/persist.py": broken,
+            },
+            rules=(check_cache_key,),
+        )
+        assert rules_of(fs) == ["NM381"]
+        assert "donate" in fs[0].message
 
 
 class TestBaseline:

@@ -1,0 +1,708 @@
+"""Persistent AOT executable cache tests (ISSUE 9).
+
+Four layers:
+
+* key/format units: PersistKey completeness + determinism, entry
+  composition, corrupt/stale classification — no device work;
+* hub drills on the real pipeline: cold start compiles+stores, a fresh
+  hub against the same dir loads with ZERO builds and bit-identical
+  masks; corrupt (truncated) and stale (version-flipped) entries degrade
+  to clean recompiles, counted, never raised; a FaultPlan ``cache``
+  io_error aborts the store and the next start recompiles;
+* the ``nm03-cache`` admin CLI: ls/verify/gc red+green, byte and age
+  retention;
+* the acceptance drill: ``nm03-serve --lanes 2 --compile-cache-dir`` in
+  a subprocess, drained, then RESTARTED against the same dir under
+  concurrent traffic — the second start warms with zero hub builds,
+  ``total_compile_seconds`` ≤ 5% of the cold value, serves bit-identical
+  masks, and passes ``check_telemetry`` with the exact-form cache
+  counter expectations (``compile_cache_hits_total==N``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from nm03_capstone_project_tpu.compilehub.hub import (
+    CompileHub,
+    CompileSpec,
+    aot_compile,
+)
+from nm03_capstone_project_tpu.compilehub.persist import (
+    ENTRY_SUFFIX,
+    ExecutableCache,
+    PersistKey,
+    config_digest,
+    gc_entries,
+    scan_entries,
+)
+from nm03_capstone_project_tpu.config import PipelineConfig
+from nm03_capstone_project_tpu.data.synthetic import phantom_slice
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECKER = os.path.join(REPO, "scripts", "check_telemetry.py")
+CANVAS = 96  # hub-drill canvas (small = fast compiles)
+SERVE_CANVAS = 128  # the serving drill must clear the min_dim=100 guard
+
+
+def _mask_build(spec):
+    """The serving-style AOT build: vmapped mask program at the spec shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_slice
+
+    def one(px, dm):
+        out = process_slice(px, dm, spec.cfg)
+        return out["mask"], out["grow_converged"]
+
+    b, c = spec.shape[0], spec.cfg.canvas
+    return aot_compile(
+        jax.jit(jax.vmap(one)),
+        jax.ShapeDtypeStruct((b, c, c), jnp.float32),
+        jax.ShapeDtypeStruct((b, 2), jnp.int32),
+    )
+
+
+def _spec(cfg, batch=1, **kw):
+    return CompileSpec(
+        name="serve_mask", cfg=cfg, shape=(batch, cfg.canvas, cfg.canvas), **kw
+    )
+
+
+def _batch(cfg, batch=1, seed=3):
+    px = np.stack(
+        [phantom_slice(cfg.canvas, cfg.canvas, seed=seed + i) for i in range(batch)]
+    ).astype(np.float32)
+    dm = np.full((batch, 2), cfg.canvas, np.int32)
+    return px, dm
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return PipelineConfig(canvas=CANVAS)
+
+
+# -- key / format units ------------------------------------------------------
+
+
+class TestPersistKey:
+    def test_covers_every_compile_spec_field(self, cfg):
+        """The NM381 contract, asserted dynamically: from_spec's output
+        must change when ANY CompileSpec field changes (version fields
+        aside, every key field traces back to a spec field)."""
+        base = PersistKey.from_spec(_spec(cfg))
+        variations = {
+            "name": dataclasses.replace(_spec(cfg), name="other"),
+            "variant": _spec(cfg, variant="pinned"),
+            "shape": _spec(cfg, batch=2),
+            "lane": _spec(cfg, lane=3),
+            "backend": _spec(cfg, backend="cpu"),
+            "donate": _spec(cfg, donate=True),
+            "cfg": _spec(dataclasses.replace(cfg, grow_low=0.5)),
+        }
+        for field, spec in variations.items():
+            other = PersistKey.from_spec(spec)
+            assert other != base, f"CompileSpec.{field} does not reach the key"
+            assert other.digest() != base.digest()
+
+    def test_device_identity_in_key(self, cfg):
+        import jax
+
+        devs = jax.local_devices()
+        assert len(devs) >= 2  # conftest forces 8 virtual devices
+        k0 = PersistKey.from_spec(_spec(cfg, device=devs[0]))
+        k1 = PersistKey.from_spec(_spec(cfg, device=devs[1]))
+        assert k0.digest() != k1.digest()
+        assert k0.filename() != k1.filename()
+
+    def test_key_deterministic_and_config_equality(self, cfg):
+        assert PersistKey.from_spec(_spec(cfg)) == PersistKey.from_spec(
+            _spec(PipelineConfig(canvas=CANVAS))
+        )
+        assert config_digest(cfg) == config_digest(PipelineConfig(canvas=CANVAS))
+        assert config_digest(cfg) != config_digest(
+            dataclasses.replace(cfg, clip_high=1.0)
+        )
+        assert config_digest(None) != config_digest(cfg)
+
+    def test_filename_is_safe_and_suffixed(self, cfg):
+        import jax
+
+        name = PersistKey.from_spec(
+            _spec(cfg, device=jax.local_devices()[0])
+        ).filename()
+        assert name.endswith(ENTRY_SUFFIX)
+        assert "/" not in name and " " not in name
+
+
+# -- hub drills on the real pipeline ----------------------------------------
+
+
+class TestHubCachePath:
+    def test_cold_then_warm_bit_identical_zero_builds(self, cfg, tmp_path):
+        cold = CompileHub()
+        cold.attach_cache(ExecutableCache(tmp_path))
+        fn1 = cold.get(_spec(cfg), _mask_build)
+        s1 = cold.stats()
+        assert s1["builds"] == 1 and s1["cache_loads"] == 0
+        assert s1["cache_misses"] == 1 and s1["cache_hits"] == 0
+        assert s1["total_compile_seconds"] > 0
+        assert list(tmp_path.glob(f"*{ENTRY_SUFFIX}"))
+
+        warm = CompileHub()
+        warm.attach_cache(ExecutableCache(tmp_path))
+        fn2 = warm.get(_spec(cfg), _mask_build)
+        s2 = warm.stats()
+        assert s2["builds"] == 0 and s2["cache_loads"] == 1
+        assert s2["cache_hits"] == 1 and s2["cache_misses"] == 0
+        # the honesty split: a loaded executable reports NO compile cost
+        assert s2["total_compile_seconds"] == 0.0
+        assert s2["cache_load_seconds"] > 0
+
+        px, dm = _batch(cfg)
+        m1, c1 = fn1(px, dm)
+        m2, c2 = fn2(px, dm)
+        assert np.array_equal(np.asarray(m1), np.asarray(m2))
+        assert np.array_equal(np.asarray(c1), np.asarray(c2))
+        assert np.asarray(m1).any()  # the phantom actually segments
+
+    def test_corrupt_entry_is_silent_miss_with_recompile(self, cfg, tmp_path):
+        """The torn-write drill: a truncated entry (the exact artifact a
+        mid-write kill would leave WITHOUT atomic_write_bytes) recompiles
+        cleanly, counted as corrupt, masks bit-identical."""
+        seeder = CompileHub()
+        seeder.attach_cache(ExecutableCache(tmp_path))
+        ref = seeder.get(_spec(cfg), _mask_build)
+        px, dm = _batch(cfg)
+        want = np.asarray(ref(px, dm)[0])
+
+        entry = next(tmp_path.glob(f"*{ENTRY_SUFFIX}"))
+        raw = entry.read_bytes()
+        for cut in (len(raw) // 2, 64, 0):  # payload torn, header torn, empty
+            entry.write_bytes(raw[:cut])
+            hub = CompileHub()
+            cache = ExecutableCache(tmp_path)
+            hub.attach_cache(cache)
+            fn = hub.get(_spec(cfg), _mask_build)
+            assert hub.stats()["builds"] == 1, f"cut={cut}"
+            st = cache.stats()
+            assert st["misses"] == 1 and st["corrupt"] == 1 and st["hits"] == 0
+            assert np.array_equal(np.asarray(fn(px, dm)[0]), want)
+            # the rebuild re-stored a good entry each round
+            assert entry.read_bytes() != raw[:cut]
+            raw = entry.read_bytes()
+
+    def test_stale_version_is_silent_miss_with_recompile(self, cfg, tmp_path):
+        seeder = CompileHub()
+        seeder.attach_cache(ExecutableCache(tmp_path))
+        ref = seeder.get(_spec(cfg), _mask_build)
+        px, dm = _batch(cfg)
+        want = np.asarray(ref(px, dm)[0])
+
+        entry = next(tmp_path.glob(f"*{ENTRY_SUFFIX}"))
+        head, _, payload = entry.read_bytes().partition(b"\n")
+        header = json.loads(head)
+        header["key"]["jaxlib_version"] = "0.0.0-stale"
+        entry.write_bytes(
+            json.dumps(header, sort_keys=True).encode() + b"\n" + payload
+        )
+        hub = CompileHub()
+        cache = ExecutableCache(tmp_path)
+        hub.attach_cache(cache)
+        fn = hub.get(_spec(cfg), _mask_build)
+        assert hub.stats()["builds"] == 1
+        st = cache.stats()
+        assert st["stale"] == 1 and st["misses"] == 1 and st["corrupt"] == 0
+        assert np.array_equal(np.asarray(fn(px, dm)[0]), want)
+
+    def test_fault_plan_cache_io_error_aborts_store(self, cfg, tmp_path):
+        """The chaos satellite: a FaultPlan ``cache`` io_error rule kills
+        the entry write; the hub still serves the freshly built
+        executable and the NEXT start recompiles (miss, not crash)."""
+        from nm03_capstone_project_tpu.resilience import FaultPlan
+        from nm03_capstone_project_tpu.serving.server import _cache_fault_hook
+
+        plan = FaultPlan.from_spec(
+            {"faults": [{"site": "cache", "kind": "io_error", "count": 1}]}
+        )
+        hub = CompileHub()
+        cache = ExecutableCache(tmp_path, fault_hook=_cache_fault_hook(plan, None))
+        hub.attach_cache(cache)
+        fn = hub.get(_spec(cfg), _mask_build)
+        px, dm = _batch(cfg)
+        assert np.asarray(fn(px, dm)[0]).any()
+        assert plan.fired_total() == 1
+        assert cache.stats()["store_errors"] == 1
+        assert not list(tmp_path.glob(f"*{ENTRY_SUFFIX}"))
+        # second start: plain miss+recompile, and (budget spent) the store
+        # now succeeds — the cache heals itself
+        hub2 = CompileHub()
+        hub2.attach_cache(ExecutableCache(tmp_path, fault_hook=_cache_fault_hook(plan, None)))
+        hub2.get(_spec(cfg), _mask_build)
+        assert hub2.stats()["builds"] == 1
+        assert len(list(tmp_path.glob(f"*{ENTRY_SUFFIX}"))) == 1
+
+    def test_export_fallback_unpinned_only(self, cfg, tmp_path, monkeypatch):
+        """Backends whose PJRT executables cannot serialize fall back to
+        the jax-export StableHLO form — accounted as a DEFERRED load (aot
+        False; XLA still compiles at first execute), masks bit-identical;
+        device-pinned specs refuse the fallback entirely (an entry that
+        collapses every lane onto the default device is worse than none)."""
+        import jax
+        from jax.experimental import serialize_executable
+
+        from nm03_capstone_project_tpu.compilehub import persist as persist_mod
+
+        def boom(*a, **k):
+            raise RuntimeError("pjrt serialization unsupported here")
+
+        monkeypatch.setattr(serialize_executable, "serialize", boom)
+
+        seeder = CompileHub()
+        cache = ExecutableCache(tmp_path)
+        seeder.attach_cache(cache)
+        ref = seeder.get(_spec(cfg), _mask_build)
+        entry = next(tmp_path.glob(f"*{ENTRY_SUFFIX}"))
+        head, _, _ = entry.read_bytes().partition(b"\n")
+        assert json.loads(head)["format"] == persist_mod.FORMAT_EXPORT
+
+        warm = CompileHub()
+        warm.attach_cache(ExecutableCache(tmp_path))
+        fn = warm.get(_spec(cfg), _mask_build)
+        st = warm.stats()
+        assert st["builds"] == 0 and st["cache_loads"] == 1
+        assert st["aot"] == 0  # deferred: the export pays compile at first call
+        px, dm = _batch(cfg)
+        assert np.array_equal(np.asarray(fn(px, dm)[0]), np.asarray(ref(px, dm)[0]))
+
+        # pinned spec: no entry, counted store_error, hub still serves
+        pinned = _spec(cfg, device=jax.local_devices()[1], lane=1,
+                       variant="pinned")
+        hub2 = CompileHub()
+        cache2 = ExecutableCache(tmp_path)
+        hub2.attach_cache(cache2)
+        fn2 = hub2.get(pinned, _mask_build)
+        assert np.asarray(fn2(px, dm)[0]).any()
+        assert cache2.stats()["store_errors"] == 1
+        assert len(list(tmp_path.glob(f"*{ENTRY_SUFFIX}"))) == 1  # only the unpinned
+
+    def test_different_cfg_never_false_hits(self, cfg, tmp_path):
+        seeder = CompileHub()
+        seeder.attach_cache(ExecutableCache(tmp_path))
+        seeder.get(_spec(cfg), _mask_build)
+        other_cfg = dataclasses.replace(cfg, grow_low=0.99, grow_high=0.999)
+        hub = CompileHub()
+        cache = ExecutableCache(tmp_path)
+        hub.attach_cache(cache)
+        hub.get(_spec(other_cfg), _mask_build)
+        assert hub.stats()["builds"] == 1  # no cross-config hit
+        assert cache.stats()["hits"] == 0
+        assert len(list(tmp_path.glob(f"*{ENTRY_SUFFIX}"))) == 2
+
+    def test_deferred_specs_bypass_the_cache(self, cfg, tmp_path):
+        """shape=None (deferred-trace) specs must neither store nor count
+        misses — only AOT executables are persistable."""
+        hub = CompileHub()
+        cache = ExecutableCache(tmp_path)
+        hub.attach_cache(cache)
+
+        def build(spec):
+            return lambda x: x  # stands in for a deferred jit callable
+
+        hub.get(CompileSpec(name="deferred", cfg=cfg), build)
+        st = cache.stats()
+        assert st["misses"] == 0 and st["stores"] == 0
+        assert not list(tmp_path.glob(f"*{ENTRY_SUFFIX}"))
+
+
+# -- the nm03-cache admin CLI -----------------------------------------------
+
+
+class TestCacheCli:
+    @pytest.fixture()
+    def seeded_dir(self, cfg, tmp_path_factory):
+        d = tmp_path_factory.mktemp("cachecli")
+        hub = CompileHub()
+        hub.attach_cache(ExecutableCache(d))
+        for b in (1, 2):
+            hub.get(_spec(cfg, batch=b), _mask_build)
+        return d
+
+    def _run(self, d, *args):
+        return subprocess.run(
+            [
+                sys.executable, "-m",
+                "nm03_capstone_project_tpu.compilehub.cache_cli",
+                "--dir", str(d), "--format", "json", *args,
+            ],
+            capture_output=True, text=True, timeout=120, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+
+    def test_ls_and_verify_green(self, seeded_dir):
+        res = self._run(seeded_dir, "ls")
+        assert res.returncode == 0, res.stderr
+        rows = json.loads(res.stdout)["entries"]
+        assert len(rows) == 2
+        assert all(r["status"] == "ok" for r in rows)
+        assert {tuple(r["shape"]) for r in rows} == {
+            (1, CANVAS, CANVAS), (2, CANVAS, CANVAS),
+        }
+        res = self._run(seeded_dir, "verify")
+        assert res.returncode == 0, res.stdout
+        assert json.loads(res.stdout)["ok"] == 2
+
+    def test_verify_red_on_corrupt(self, seeded_dir):
+        victim = sorted(seeded_dir.glob(f"*{ENTRY_SUFFIX}"))[0]
+        victim.write_bytes(victim.read_bytes()[:-7])
+        res = self._run(seeded_dir, "verify")
+        assert res.returncode == 1
+        out = json.loads(res.stdout)
+        assert [c["file"] for c in out["corrupt"]] == [victim.name]
+
+    def test_gc_age_and_byte_retention(self, seeded_dir):
+        entries = sorted(seeded_dir.glob(f"*{ENTRY_SUFFIX}"))
+        old, young = entries[0], entries[1]
+        past = time.time() - 7200
+        os.utime(old, (past, past))
+        # dry run: nothing deleted
+        rep = gc_entries(seeded_dir, max_age_s=3600, dry_run=True)
+        assert rep["removed"] == [old.name] and old.exists()
+        res = self._run(seeded_dir, "gc", "--max-age", "1h")
+        assert res.returncode == 0, res.stderr
+        assert json.loads(res.stdout)["removed"] == [old.name]
+        assert not old.exists() and young.exists()
+        # byte budget of 0 clears the rest
+        res = self._run(seeded_dir, "gc", "--max-bytes", "0")
+        assert json.loads(res.stdout)["removed"] == [young.name]
+        assert not list(seeded_dir.glob(f"*{ENTRY_SUFFIX}"))
+
+    def test_gc_removes_corrupt_unconditionally(self, seeded_dir):
+        victim = sorted(seeded_dir.glob(f"*{ENTRY_SUFFIX}"))[0]
+        victim.write_bytes(b"garbage")
+        rep = gc_entries(seeded_dir)  # no budgets at all
+        assert rep["removed"] == [victim.name]
+        assert not victim.exists()
+
+    def test_gc_reclaims_orphaned_tmp_files(self, seeded_dir):
+        """A SIGKILL mid-store leaks the atomic write's private temp; gc
+        reclaims it once past the grace window (a fresh temp — possibly a
+        live writer's — is left alone)."""
+        orphan = seeded_dir / f"x{ENTRY_SUFFIX}.abc123.tmp"
+        orphan.write_bytes(b"half-written entry")
+        fresh = seeded_dir / f"y{ENTRY_SUFFIX}.def456.tmp"
+        fresh.write_bytes(b"live writer")
+        past = time.time() - 3600
+        os.utime(orphan, (past, past))
+        rep = gc_entries(seeded_dir)
+        assert orphan.name in rep["removed"] and not orphan.exists()
+        assert fresh.exists() and fresh.name not in rep["removed"]
+        assert rep["kept"] == 2  # the real entries untouched
+
+    def test_gc_removes_stale_unconditionally(self, seeded_dir):
+        """Post-upgrade reclamation: a stale entry's filename digest embeds
+        the old versions, so the new toolchain can never even open it —
+        gc drops it with no budget flags, as the runbook promises."""
+        victim = sorted(seeded_dir.glob(f"*{ENTRY_SUFFIX}"))[0]
+        head, _, payload = victim.read_bytes().partition(b"\n")
+        header = json.loads(head)
+        header["key"]["jax_version"] = "0.0.0-old"
+        victim.write_bytes(
+            json.dumps(header, sort_keys=True).encode() + b"\n" + payload
+        )
+        rep = gc_entries(seeded_dir)
+        assert rep["removed"] == [victim.name]
+        assert not victim.exists() and rep["kept"] == 1
+
+    @pytest.mark.skipif(os.geteuid() == 0, reason="root ignores file modes")
+    def test_unreadable_entry_is_kept_by_gc(self, seeded_dir):
+        """EACCES is not bit rot: a permissions mismatch (gc cron under a
+        different uid) must report `unreadable` and survive gc — deleting
+        a fleet's warm cache over a perms problem is the worst thing a
+        janitor can do."""
+        victim = sorted(seeded_dir.glob(f"*{ENTRY_SUFFIX}"))[0]
+        victim.chmod(0)
+        try:
+            rows = {r["file"]: r for r in scan_entries(seeded_dir)}
+            assert rows[victim.name]["status"] == "unreadable"
+            rep = gc_entries(seeded_dir)
+            assert victim.name not in rep["removed"] and victim.exists()
+            # exempt from the age and byte budgets too, not just the
+            # unconditional branch
+            past = time.time() - 7200
+            os.utime(victim, (past, past))
+            rep = gc_entries(seeded_dir, max_age_s=60, max_bytes=0)
+            assert victim.name not in rep["removed"] and victim.exists()
+        finally:
+            victim.chmod(0o644)
+
+    def test_scan_reports_stale(self, seeded_dir):
+        victim = sorted(seeded_dir.glob(f"*{ENTRY_SUFFIX}"))[0]
+        head, _, payload = victim.read_bytes().partition(b"\n")
+        header = json.loads(head)
+        header["key"]["nm03_version"] = "0.0.0-old"
+        victim.write_bytes(
+            json.dumps(header, sort_keys=True).encode() + b"\n" + payload
+        )
+        rows = {r["file"]: r for r in scan_entries(seeded_dir)}
+        assert rows[victim.name]["status"] == "stale"
+        assert rows[victim.name]["stale_fields"] == ["nm03_version"]
+
+
+# -- check_telemetry: the exact-form counter expectation ---------------------
+
+
+class TestExactCounterExpectations:
+    """``--expect-counter NAME==N`` (ISSUE 9 satellite): gauge-compatible
+    exact equality for the cache counters — presence required, value
+    exact; the single-equals floor form unchanged."""
+
+    def _check(self, tmp_path, metrics, *expectations):
+        snap = {
+            "schema": "nm03.metrics.v1", "run_id": "r", "git_sha": "s",
+            "created_unix": 1.0, "metrics": metrics,
+        }
+        p = tmp_path / "m.json"
+        p.write_text(json.dumps(snap))
+        return subprocess.run(
+            [sys.executable, CHECKER, "--metrics", str(p), *expectations],
+            capture_output=True, text=True, timeout=60,
+        )
+
+    def _counter(self, name, value, **labels):
+        return {"name": name, "type": "counter",
+                "labels": {k: str(v) for k, v in labels.items()},
+                "value": value}
+
+    def test_exact_green_and_red(self, tmp_path):
+        metrics = [
+            self._counter("compile_cache_hits_total", 8),
+            self._counter("compile_cache_misses_total", 0),
+        ]
+        ok = self._check(
+            tmp_path, metrics,
+            "--expect-counter", "compile_cache_hits_total==8",
+            "--expect-counter", "compile_cache_misses_total==0",
+        )
+        assert ok.returncode == 0, ok.stderr
+        red = self._check(
+            tmp_path, metrics, "--expect-counter",
+            "compile_cache_hits_total==7",
+        )
+        assert red.returncode == 1 and "expected == 7" in red.stderr
+
+    def test_exact_requires_presence(self, tmp_path):
+        # ==0 on an ABSENT counter must fail: a run without the cache
+        # enabled is not a run that proved zero misses
+        res = self._check(
+            tmp_path, [self._counter("other_total", 1)],
+            "--expect-counter", "compile_cache_misses_total==0",
+        )
+        assert res.returncode == 1 and "absent" in res.stderr
+
+    def test_floor_form_unchanged(self, tmp_path):
+        metrics = [self._counter("compile_cache_hits_total", 8)]
+        assert self._check(
+            tmp_path, metrics,
+            "--expect-counter", "compile_cache_hits_total=4",
+        ).returncode == 0
+        assert self._check(
+            tmp_path, metrics,
+            "--expect-counter", "compile_cache_hits_total=9",
+        ).returncode == 1
+
+    def test_exact_with_labeled_selector(self, tmp_path):
+        metrics = [
+            self._counter("serving_lane_batches_total", 3, lane=0),
+            self._counter("serving_lane_batches_total", 5, lane=1),
+        ]
+        ok = self._check(
+            tmp_path, metrics,
+            "--expect-counter", "serving_lane_batches_total{lane=1}==5",
+        )
+        assert ok.returncode == 0, ok.stderr
+        red = self._check(
+            tmp_path, metrics,
+            "--expect-counter", "serving_lane_batches_total{lane=1}==3",
+        )
+        assert red.returncode == 1
+
+
+# -- serving integration ------------------------------------------------------
+
+
+class TestServingColdStart:
+    def test_in_process_cold_start_publishes_cache_telemetry(
+        self, cfg, tmp_path
+    ):
+        """A cache-enabled ServingApp cold start: every (lane, bucket) spec
+        misses then stores, /readyz's compile_hub carries the cache
+        fields, and the counters are published at their exact values."""
+        from nm03_capstone_project_tpu.compilehub import get_hub
+        from nm03_capstone_project_tpu.serving.server import ServingApp
+
+        app = ServingApp(
+            cfg=cfg,
+            buckets=(1,),
+            lanes=1,
+            compile_cache_dir=str(tmp_path),
+        )
+        try:
+            app.start()
+            st = app.status()
+            hub_st = st["compile_hub"]
+            assert hub_st["cache_hits"] == 0
+            # misses >= the serve_mask spec count (other AOT programs the
+            # process builds also go through the attached cache)
+            assert hub_st["cache_misses"] >= 1
+            assert hub_st["cache_bytes"] > 0
+            assert list(tmp_path.glob(f"*{ENTRY_SUFFIX}"))
+            snap = {
+                (m["name"]): m["value"]
+                for m in app.obs.metrics_snapshot()["metrics"]
+                if m["name"].startswith("compile_cache")
+            }
+            assert snap["compile_cache_hits_total"] == 0
+            assert snap["compile_cache_misses_total"] == hub_st["cache_misses"]
+            assert "compile_cache_load_seconds" in snap
+        finally:
+            app.begin_drain(reason="test")
+            app.close()
+            get_hub().attach_cache(None)  # never leak into other tests
+
+    def test_two_start_subprocess_drill(self, cfg, tmp_path):
+        """The ISSUE 9 acceptance bar: nm03-serve --lanes 2, drain,
+        restart against the same --compile-cache-dir under concurrent
+        traffic. Second start: ZERO hub builds of serve specs (hits ==
+        warm spec count, misses == 0), total_compile_seconds <= 5% of
+        cold, masks bit-identical, exact-form counter gate green."""
+        cache_dir = tmp_path / "cache"
+        img = phantom_slice(SERVE_CANVAS, SERVE_CANVAS, seed=1)
+        body = img.astype("<f4").tobytes()
+
+        first = self._serve_round(
+            tmp_path / "r1", cache_dir, body, n_requests=4
+        )
+        second = self._serve_round(
+            tmp_path / "r2", cache_dir, body, n_requests=8
+        )
+        # same pixels in, same mask out, across a process boundary and a
+        # compile-vs-deserialize divide
+        assert first["mask_pixels"] == second["mask_pixels"]
+        assert first["mask_pixels"] > 0
+
+        cold_hub, warm_hub = first["compile_hub"], second["compile_hub"]
+        specs = cold_hub["executables"]  # 2 lanes x 1 bucket = 2 AOT specs
+        assert specs >= 2
+        assert cold_hub["cache_hits"] == 0
+        assert cold_hub["builds"] == specs
+        assert warm_hub["cache_hits"] == specs
+        assert warm_hub["cache_misses"] == 0
+        assert warm_hub["builds"] == 0 and warm_hub["cache_loads"] == specs
+        assert (
+            warm_hub["total_compile_seconds"]
+            <= 0.05 * cold_hub["total_compile_seconds"]
+        ), (cold_hub, warm_hub)
+
+        for metrics, hits, misses in (
+            (first["metrics"], 0, specs),
+            (second["metrics"], specs, 0),
+        ):
+            res = subprocess.run(
+                [
+                    sys.executable, CHECKER,
+                    "--metrics", str(metrics),
+                    "--expect-counter", f"compile_cache_hits_total=={hits}",
+                    "--expect-counter", f"compile_cache_misses_total=={misses}",
+                    "--expect-gauge", "serving_lanes_ready=2",
+                ],
+                capture_output=True, text=True, timeout=60,
+            )
+            assert res.returncode == 0, res.stderr
+
+    def _serve_round(self, workdir, cache_dir, body, n_requests):
+        workdir.mkdir()
+        port_file = workdir / "port"
+        metrics = workdir / "metrics.json"
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        )
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("NM03_COMPILE_CACHE_DIR", None)
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m",
+                "nm03_capstone_project_tpu.serving.server",
+                "--device", "cpu", "--port", "0",
+                "--port-file", str(port_file),
+                "--canvas", str(SERVE_CANVAS), "--buckets", "1", "--lanes", "2",
+                "--compile-cache-dir", str(cache_dir),
+                "--max-wait-ms", "20", "--heartbeat-s", "0",
+                "--metrics-out", str(metrics),
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO,
+        )
+        try:
+            deadline = time.monotonic() + 300
+            while not port_file.exists() and time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    pytest.fail(f"server died: {proc.stdout.read()}")
+                time.sleep(0.2)
+            assert port_file.exists(), "server never became ready"
+            base = f"http://127.0.0.1:{int(port_file.read_text())}"
+            results = []
+            lock = threading.Lock()
+
+            def one():
+                req = urllib.request.Request(
+                    base + "/v1/segment?output=mask",
+                    data=body,
+                    headers={
+                        "Content-Type": "application/octet-stream",
+                        "X-Nm03-Height": str(SERVE_CANVAS),
+                        "X-Nm03-Width": str(SERVE_CANVAS),
+                    },
+                )
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    payload = json.loads(r.read())
+                with lock:
+                    results.append((r.status, payload))
+
+            threads = [threading.Thread(target=one) for _ in range(n_requests)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert len(results) == n_requests
+            assert all(s == 200 for s, _ in results)
+            pix = {p["mask_pixels"] for _, p in results}
+            assert len(pix) == 1  # every rider identical
+            with urllib.request.urlopen(base + "/readyz", timeout=10) as r:
+                st = json.loads(r.read())
+            proc.send_signal(signal.SIGTERM)
+            out, _ = proc.communicate(timeout=120)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate(timeout=30)
+        assert proc.returncode == 0, out
+        return {
+            "mask_pixels": pix.pop(),
+            "compile_hub": st["compile_hub"],
+            "metrics": metrics,
+        }
