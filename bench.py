@@ -911,6 +911,73 @@ def _cold_start_record(batch: int) -> dict:
     }
 
 
+def _feed_stall_record(batch: int, reps: int) -> dict:
+    """The serial decode→stage→dispatch→fetch feed, accounted (ISSUE 10).
+
+    Re-runs the batch drivers' per-batch feed shape — synthesize (decode
+    stand-in), device_put (stage), execute the AOT mask program
+    (dispatch), pull the mask (fetch), strictly serially — while a
+    PhaseAccountant records each phase's busy intervals. ``feed_stall_
+    ratio`` is the fraction of wall the device sat idle waiting on the
+    feed: the pinned before/after number the streaming-ingest work
+    (ROADMAP item 3) must drive toward zero. Checksum-gated like the
+    Pallas and cold-start legs: the ratio only counts when every fetched
+    mask's checksum equals an independently-computed reference — a feed
+    loop that computed the wrong masks reports null, never a number.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nm03_capstone_project_tpu.config import PipelineConfig
+    from nm03_capstone_project_tpu.obs.saturation import PhaseAccountant
+    from nm03_capstone_project_tpu.pipeline.slice_pipeline import process_batch
+
+    cfg = PipelineConfig()
+    fn = _hub_jit(lambda px, dm: process_batch(px, dm, cfg)["mask"])
+    compiled = fn.lower(
+        jax.ShapeDtypeStruct((batch, CANVAS, CANVAS), jnp.float32),
+        jax.ShapeDtypeStruct((batch, 2), jnp.int32),
+    ).compile()
+    dev = jax.devices()[0]
+    # independent reference checksum: the SAME program via the deferred
+    # path, off the feed clock (compile time must not ride the report)
+    ref_pixels, ref_dims = _make_batch(batch)
+    ref = int(np.asarray(fn(ref_pixels, ref_dims)).astype(np.int64).sum())
+
+    feed = PhaseAccountant()
+    sums = []
+    for _ in range(reps):
+        with feed.busy("decode"):
+            pixels, dims = _make_batch(batch)  # synthetic decode stand-in
+        with feed.busy("stage"):
+            px = jax.device_put(pixels, dev)
+            dm = jax.device_put(dims, dev)
+        with feed.busy("dispatch"):
+            mask = compiled(px, dm)
+            # the serial contract under measurement: the driver waits for
+            # THIS batch before feeding the next
+            jax.block_until_ready(mask)
+        with feed.busy("fetch"):
+            host = np.asarray(mask)
+        sums.append(int(host.astype(np.int64).sum()))
+    rep = feed.report()
+    checksum_ok = bool(sums) and all(s == ref for s in sums)
+    return {
+        "batch": batch,
+        "reps": reps,
+        "wall_s": rep["wall_s"],
+        "busy_s": rep["busy_s"],
+        "busy_fraction": rep["busy_fraction"],
+        # the gated headline: null unless the masks were bit-equivalent
+        "feed_stall_ratio": (
+            rep["feed_stall_ratio"] if checksum_ok else None
+        ),
+        "stall_s": rep["stall_s"] if checksum_ok else None,
+        "checksum_ok": checksum_ok,
+    }
+
+
 def probe(platform: str | None) -> None:
     """Tunnel health check: devices + a tiny jit round trip, nothing more."""
     _pin_platform(platform)
@@ -1047,6 +1114,19 @@ def worker(
     except Exception as e:  # noqa: BLE001 — never lose the headline
         emit({"cold_start_error": f"{e!r:.500}"})
         _log(f"cold-start leg skipped: {e!r:.500}")
+    try:
+        # feed-stall leg (ISSUE 10): the serial per-batch feed accounted —
+        # the idle fraction ROADMAP item 3's streaming ingest must erase,
+        # pinned next to the throughput it caps
+        fs = _feed_stall_record(batch, reps=min(reps, 8))
+        emit({"feed_stall": fs})
+        _log(
+            f"feed stall @batch={batch}: {fs['feed_stall_ratio']} of wall "
+            f"starved (busy {fs['busy_fraction']}, checksum "
+            f"{'matches' if fs['checksum_ok'] else 'MISMATCH'})"
+        )
+    except Exception as e:  # noqa: BLE001 — never lose the headline
+        _log(f"feed-stall leg skipped: {e!r:.500}")
 
     if want_scan:
         try:
@@ -1495,7 +1575,7 @@ def _copy_optional(out: dict, rec: dict) -> None:
                 "fused_min_traffic_gbps", "profile_dir", "student_tput",
                 "volume", "xla_scan_tput", "scan_chunk",
                 "scan_checksum_ok", "batch_note", "compile_cost",
-                "cold_start"):
+                "cold_start", "feed_stall"):
         if key in rec:
             out[key] = rec[key]
 

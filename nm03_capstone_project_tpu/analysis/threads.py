@@ -39,6 +39,9 @@ from nm03_capstone_project_tpu.analysis.core import Finding, SourceFile
 THREADED_FILES: Tuple[str, ...] = (
     "nm03_capstone_project_tpu/serving/",
     "nm03_capstone_project_tpu/resilience/supervisor.py",
+    # the saturation monitor's rings are written by executor/batcher/lane
+    # threads and read by scrape handlers (ISSUE 10): same discipline
+    "nm03_capstone_project_tpu/obs/saturation.py",
 )
 
 _SYNC_TYPE_NAMES = {

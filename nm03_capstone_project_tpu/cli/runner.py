@@ -25,6 +25,7 @@ Fault tolerance mirrors the reference at both granularities
 from __future__ import annotations
 
 import concurrent.futures as cf
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
@@ -38,7 +39,11 @@ from nm03_capstone_project_tpu.data.discovery import (
     load_dicom_files_for_patient,
 )
 from nm03_capstone_project_tpu.data.prefetch import prefetch_to_device
-from nm03_capstone_project_tpu.obs import RESILIENCE_RETRIES_TOTAL, RunContext
+from nm03_capstone_project_tpu.obs import (
+    RESILIENCE_RETRIES_TOTAL,
+    PhaseAccountant,
+    RunContext,
+)
 from nm03_capstone_project_tpu.render.export import (
     clean_directory,
     export_pairs,
@@ -269,6 +274,15 @@ class CohortProcessor:
         # the registry is thread-safe by design.
         self.obs = obs if obs is not None else RunContext.create(driver=mode)
         self.timer = self.obs.spans
+        # feed-phase accounting (ISSUE 10): both execution strategies
+        # record decode/stage/dispatch/fetch/export busy intervals so the
+        # drivers' results carry a `feed_stall` report — the fraction of
+        # wall the device sat starved by the serial feed, the number
+        # ROADMAP item 3's streaming ingest must drive toward zero. The
+        # recorded "dispatch" interval spans enqueue -> fetch completion
+        # (an upper bound on device busy, so the reported stall is a LOWER
+        # bound: every second of it is real starvation).
+        self.feed = PhaseAccountant()
         # resilience: retry/deadline policies, CPU degradation, chaos layer
         # (docs/RESILIENCE.md). Defaults are behavior-preserving: no dispatch
         # deadline, no fault plan (unless NM03_FAULT_PLAN activates one).
@@ -596,12 +610,17 @@ class CohortProcessor:
                 # timing report (the enqueue-only "compute" section cannot
                 # carry it)
                 if host_render:
-                    with self.timer.section("export"):
+                    with self.timer.section("export"), self.feed.busy("fetch"):
                         # nm03-lint: disable=NM321 deliberate: this driver charges the per-slice device wait to "export" (see comment above); the sync IS the measurement
                         mask = np.asarray(p["mask_dev"])  # device sync
+                    if p.get("t_disp0") is not None:
+                        # device-in-flight interval: enqueue -> fetch done
+                        self.feed.record(
+                            "dispatch", p["t_disp0"], time.monotonic()
+                        )
                     if self.mask_sink is not None:
                         self.mask_sink(patient_id, stem, mask)
-                    with self.timer.section("export"):
+                    with self.timer.section("export"), self.feed.busy("export"):
                         written = render_export_pairs(
                             [(stem, p["padded"], mask, p["dims"])],
                             out_dir,
@@ -612,16 +631,22 @@ class CohortProcessor:
                         )
                 else:
                     with self.timer.section("export"):
-                        # nm03-lint: disable=NM321 deliberate: device wait charged to "export" by design, as on the host_render path above
-                        orig = np.asarray(p["orig_dev"])
-                        proc = np.asarray(p["proc_dev"])  # nm03-lint: disable=NM321 see above
-                        written = export_pairs(
-                            [(stem, orig, proc)],
-                            out_dir,
-                            max_workers=1,
-                            fault_hook=export_fault,
-                            retry=self.retry,
-                        )
+                        with self.feed.busy("fetch"):
+                            # nm03-lint: disable=NM321 deliberate: device wait charged to "export" by design, as on the host_render path above
+                            orig = np.asarray(p["orig_dev"])
+                            proc = np.asarray(p["proc_dev"])  # nm03-lint: disable=NM321 see above
+                        if p.get("t_disp0") is not None:
+                            self.feed.record(
+                                "dispatch", p["t_disp0"], time.monotonic()
+                            )
+                        with self.feed.busy("export"):
+                            written = export_pairs(
+                                [(stem, orig, proc)],
+                                out_dir,
+                                max_workers=1,
+                                fault_hook=export_fault,
+                                retry=self.retry,
+                            )
                 if stem not in written:
                     raise IOError("JPEG export failed")
                 # after the export check: truncated means "the pair exists
@@ -672,23 +697,27 @@ class CohortProcessor:
         for di, f in enumerate(files):
             stem = f.stem
             try:
-                with self.timer.section("decode"):
+                with self.timer.section("decode"), self.feed.busy("decode"):
                     pixels = self._read_slice(f, patient=patient_id, index=di)
                 if pixels is None:
                     raise ValueError("decode/guard failed")
-                padded, dims = self._pad_one(pixels)
+                with self.feed.busy("stage"):
+                    padded, dims = self._pad_one(pixels)
                 with self.timer.section("compute"):
+                    t_disp0 = time.monotonic()
                     if host_render:
                         mask_dev, conv = run_dispatch(padded, dims, di)
                         cur = {
                             "stem": stem, "mask_dev": mask_dev, "conv": conv,
                             "padded": padded, "dims": dims,
+                            "t_disp0": t_disp0,
                         }
                     else:
                         orig_dev, proc_dev, conv = run_dispatch(padded, dims, di)
                         cur = {
                             "stem": stem, "orig_dev": orig_dev,
                             "proc_dev": proc_dev, "conv": conv,
+                            "t_disp0": t_disp0,
                         }
             except Exception as e:  # noqa: BLE001 - reference: don't throw
                 # a decode/dispatch failure rides the pipeline as a record,
@@ -812,10 +841,12 @@ class CohortProcessor:
                 for bi, batch_files in enumerate(batches):
                     prefetch(bi + depth)
                     if use_native:
-                        with self.timer.section("decode"):
+                        with self.timer.section("decode"), self.feed.busy(
+                            "decode"
+                        ):
                             yield decode_futures.pop(bi).result()
                         continue
-                    with self.timer.section("decode"):
+                    with self.timer.section("decode"), self.feed.busy("decode"):
                         decoded = [f.result() for f in decode_futures.pop(bi)]
                     stems = [f.stem for f in batch_files]
                     bad = [s for s, p in zip(stems, decoded) if p is None]
@@ -823,9 +854,11 @@ class CohortProcessor:
                     if not good:
                         yield {"stems": [], "bad": bad, "pixels": None, "dims": None}
                         continue
-                    padded, dims = self._pad_stack(
-                        [p for _, p in good], pad_to=pad_target(len(batch_files))
-                    )
+                    with self.feed.busy("stage"):
+                        padded, dims = self._pad_stack(
+                            [p for _, p in good],
+                            pad_to=pad_target(len(batch_files)),
+                        )
                     yield {
                         "stems": [s for s, _ in good],
                         "bad": bad,
@@ -854,8 +887,9 @@ class CohortProcessor:
                     # degradation escaped — keep the batch on the host
                     return item
                 out = dict(item)
-                out["pixels"] = jax.device_put(out["pixels"], batch_sharding)
-                out["dims"] = jax.device_put(out["dims"], batch_sharding)
+                with self.feed.busy("stage"):
+                    out["pixels"] = jax.device_put(out["pixels"], batch_sharding)
+                    out["dims"] = jax.device_put(out["dims"], batch_sharding)
                 return out
 
             def with_host_refs(gen):
@@ -921,6 +955,7 @@ class CohortProcessor:
                         )
                     else:
                         primary = lambda pix=pix, dm=dm: fn(pix, dm)  # noqa: E731
+                    t_disp0 = time.monotonic()
                     with self.timer.section("dispatch"):
                         # --sanitize (upload-only guard): inputs were staged
                         # by to_device, so an implicit h2d inside this window
@@ -935,10 +970,16 @@ class CohortProcessor:
                             )
 
                     def fetch_render_export(
-                        mask_dev=mask_dev, conv_dev=conv_dev, batch=batch
+                        mask_dev=mask_dev, conv_dev=conv_dev, batch=batch,
+                        t_disp0=t_disp0,
                     ):
-                        mask_b = np.asarray(mask_dev)
-                        conv_b = np.asarray(conv_dev)
+                        with self.feed.busy("fetch"):
+                            mask_b = np.asarray(mask_dev)
+                            conv_b = np.asarray(conv_dev)
+                        # device-in-flight interval for the feed report:
+                        # enqueue -> fetch complete (an upper bound on
+                        # device busy; the reported stall is a lower bound)
+                        self.feed.record("dispatch", t_disp0, time.monotonic())
                         for i, s in enumerate(batch["stems"]):
                             conv_by_stem[s] = bool(conv_b[i])
                         if self.mask_sink is not None:
@@ -953,19 +994,22 @@ class CohortProcessor:
                             )
                             for i, s in enumerate(batch["stems"])
                         ]
-                        return render_export_pairs(
-                            items,
-                            out_dir,
-                            self.cfg,
-                            4,
-                            fault_hook=export_fault,
-                            retry=self.retry,
-                            success_hook=journal_slice,
-                        )
+                        with self.feed.busy("export"):
+                            return render_export_pairs(
+                                items,
+                                out_dir,
+                                self.cfg,
+                                4,
+                                fault_hook=export_fault,
+                                retry=self.retry,
+                                success_hook=journal_slice,
+                            )
 
                     export_futures.append(io_pool.submit(fetch_render_export))
                 else:
-                    with self.timer.section("compute"):
+                    with self.timer.section("compute"), self.feed.busy(
+                        "dispatch"
+                    ):
                         with sanitize.guard_dispatch():
                             orig_b, proc_b, conv_b = self.dispatch.run(
                                 lambda pix=pix, dm=dm: tuple(
@@ -980,18 +1024,22 @@ class CohortProcessor:
                     items = [
                         (s, orig_b[i], proc_b[i]) for i, s in enumerate(batch["stems"])
                     ]
-                    # hand encoding to the IO pool; overlap with next batch compute
-                    export_futures.append(
-                        io_pool.submit(
-                            export_pairs,
-                            items,
-                            out_dir,
-                            4,
-                            fault_hook=export_fault,
-                            retry=self.retry,
-                            success_hook=journal_slice,
-                        )
-                    )
+
+                    # hand encoding to the IO pool; overlap with next batch
+                    # compute (wrapped so the export phase lands in the
+                    # feed report from the worker thread too)
+                    def encode_export(items=items):
+                        with self.feed.busy("export"):
+                            return export_pairs(
+                                items,
+                                out_dir,
+                                4,
+                                fault_hook=export_fault,
+                                retry=self.retry,
+                                success_hook=journal_slice,
+                            )
+
+                    export_futures.append(io_pool.submit(encode_export))
                 expected_stems.extend(batch["stems"])
             with self.timer.section("export"):
                 written = set()

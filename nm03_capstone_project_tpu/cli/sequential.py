@@ -111,9 +111,29 @@ def run(args: argparse.Namespace, mode: str) -> int:
                     f"across {world} processes."
                 )
 
+        from nm03_capstone_project_tpu.obs.metrics import (
+            PIPELINE_FEED_STALL_RATIO,
+            RUN_WALL_SECONDS,
+        )
+
         run_ctx.registry.gauge(
-            "nm03_run_wall_seconds", help="end-to-end driver wall clock"
+            RUN_WALL_SECONDS, help="end-to-end driver wall clock"
         ).set(wall_s)
+        # feed-stall accounting (ISSUE 10): the fraction of wall the device
+        # sat starved by the serial decode->stage->dispatch->fetch feed —
+        # the before/after number ROADMAP item 3's streaming ingest lands
+        # on. Both drivers run through here; the report also rides the
+        # event stream and (when a device batch ran at all) the gauge.
+
+        feed_stall = proc.feed.report()
+        if feed_stall["feed_stall_ratio"] is not None:
+            run_ctx.registry.gauge(
+                PIPELINE_FEED_STALL_RATIO,
+                help="fraction of wall time no device dispatch was in "
+                "flight — serial-feed starvation (obs.saturation; a lower "
+                "bound: the dispatch interval is enqueue->fetch complete)",
+            ).set(feed_stall["feed_stall_ratio"])
+        run_ctx.events.emit("feed_stall", mode=mode, **feed_stall)
         if args.results_json and rank == 0:
             import jax
 
@@ -141,6 +161,9 @@ def run(args: argparse.Namespace, mode: str) -> int:
                 # export wait, so per-section times don't partition it
                 "wall_s": round(wall_s, 3),
                 "timing_s": proc.timer.report(),
+                # the feed_stall report (docs/OBSERVABILITY.md): per-phase
+                # busy unions + the device-starvation headline
+                "feed_stall": feed_stall,
                 # the full observability snapshot rides in the results JSON
                 # too, so one artifact carries outcome counters + stage
                 # latency distributions next to the wall-clock headline

@@ -366,12 +366,17 @@ def _train(args: argparse.Namespace, rank: int, world: int, run_ctx) -> int:
     unit = "volumes" if args.model_3d else "slices"
     if rank == 0:
         print(f"student-vs-teacher IoU over {n_scored} {unit}: {iou:.3f}")
+    from nm03_capstone_project_tpu.obs.metrics import (
+        TRAIN_FINAL_LOSS,
+        TRAIN_IOU_VS_TEACHER,
+    )
+
     run_ctx.registry.gauge(
-        "nm03_train_iou_vs_teacher", help="student-vs-teacher IoU"
+        TRAIN_IOU_VS_TEACHER, help="student-vs-teacher IoU"
     ).set(iou)
     if losses:
         run_ctx.registry.gauge(
-            "nm03_train_final_loss", help="last training-step loss"
+            TRAIN_FINAL_LOSS, help="last training-step loss"
         ).set(float(losses[-1]))
     run_ctx.events.emit(
         "train_scored", iou_vs_teacher=iou, n_scored=n_scored, unit=unit

@@ -253,6 +253,17 @@ class CompileHub:
         if cache is None and self is _HUB:
             global _ENV_CACHE_CHECKED
             _ENV_CACHE_CHECKED = False
+        if cache is not None and self is _HUB:
+            # sidecar (ISSUE 10 satellite): the same dir also backs jax's
+            # own compilation cache, so DEFERRED-trace programs (driver
+            # jit paths, the CPU fallback) stop retracing cold each start.
+            # Process-hub only — a test's private hub against a tmp dir
+            # must not repoint the process-global jax config. Accounted
+            # under jax_cache_*, never compile_cache_* (the ISSUE 9
+            # honesty split covers deserialized executables only).
+            from nm03_capstone_project_tpu.compilehub import persist
+
+            persist.attach_jax_compilation_cache(cache.root)
 
     def persistent_cache(self):
         with self._lock:
@@ -408,6 +419,15 @@ class CompileHub:
             persist = self._persist
         if persist is not None:
             out.update(persist.readyz_stats())
+        if self is _HUB:
+            # the jax-compilation-cache sidecar is process-global state, so
+            # only the process hub reports it (a private test hub must not
+            # claim another component's cache)
+            from nm03_capstone_project_tpu.compilehub.persist import (
+                jax_cache_stats,
+            )
+
+            out.update(jax_cache_stats())
         return out
 
     def compile_seconds(self) -> Dict[str, float]:

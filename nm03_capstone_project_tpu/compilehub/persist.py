@@ -68,15 +68,116 @@ __all__ = [
     "ENV_CACHE_DIR",
     "ExecutableCache",
     "PersistKey",
+    "attach_jax_compilation_cache",
     "cache_dir_from_env",
     "config_digest",
     "gc_entries",
+    "jax_cache_stats",
     "scan_entries",
 ]
 
 SCHEMA = "nm03.exe.v1"
 ENTRY_SUFFIX = ".nm03exe"
 ENV_CACHE_DIR = "NM03_COMPILE_CACHE_DIR"
+# opt-out for the jax-compilation-cache sidecar (below): the jax cache has
+# misbehaved on exotic backends before (cli/common.enable_compile_cache's
+# history) and an operator must be able to keep the nm03 executable cache
+# while refusing the jax one
+ENV_JAX_CACHE_OPT_OUT = "NM03_JAX_CACHE"
+# subdirectory of the executable cache the jax compilation cache lives in
+# (separate namespace: nm03 entries are *.nm03exe, jax writes its own
+# layout — nm03-cache ls/verify/gc deliberately never touch it)
+JAX_CACHE_SUBDIR = "jax"
+
+# the configured jax compilation cache dir (None = never attached); module
+# state because the jax config itself is process-global
+_JAX_CACHE_LOCK = threading.Lock()
+_JAX_CACHE_DIR: Optional[str] = None
+
+
+def attach_jax_compilation_cache(root: "str | os.PathLike") -> Optional[str]:
+    """Point jax's OWN persistent compilation cache at ``<root>/jax``.
+
+    The nm03 executable cache (ISSUE 9) covers shape-pinned AOT specs;
+    deferred-trace programs — the batch drivers' jit paths, the CPU
+    fallback — still retraced and recompiled cold every process start.
+    jax's builtin compilation cache (``jax_compilation_cache_dir``) closes
+    exactly that gap, so attaching an ``--compile-cache-dir`` now wires
+    both layers (ISSUE 10 satellite). Accounting stays SEPARATE by
+    design: jax's cache hits shorten deferred first-call compiles but are
+    never counted under ``compile_cache_*`` (those series are the ISSUE 9
+    honesty split for *deserialized executables*) — ``jax_cache_*`` in
+    ``/readyz``'s compile_hub block reports this layer's dir/entries/bytes.
+
+    Returns the configured dir, or None when unavailable or refused via
+    ``NM03_JAX_CACHE=0``. Idempotent; never raises (an optimization layer
+    must not cost a start).
+    """
+    if os.environ.get(ENV_JAX_CACHE_OPT_OUT, "") == "0":
+        return None
+    global _JAX_CACHE_DIR
+    path = os.path.join(str(root), JAX_CACHE_SUBDIR)
+    with _JAX_CACHE_LOCK:
+        if _JAX_CACHE_DIR == path:
+            return path
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        # deferred driver programs compile in ~seconds; the default 1 s
+        # floor would skip caching exactly the cheap-but-numerous ones
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        # jax lazily builds ONE cache object at the dir configured when
+        # the first compile happens; a later config.update alone keeps
+        # writing to the old dir — reset the singleton so re-attaching
+        # (a second ServingApp in one process, tests) really re-points it
+        try:
+            from jax._src import compilation_cache as _jax_cc
+
+            _jax_cc.reset_cache()
+        except Exception:  # noqa: BLE001 — private surface; fresh processes
+            pass  # never configured a dir before, so there is nothing stale
+    except Exception as e:  # noqa: BLE001 — best-effort layer, never a crash
+        from nm03_capstone_project_tpu.utils.reporter import get_logger
+
+        get_logger("compilehub").warning(
+            "jax compilation cache at %s unavailable (%s); deferred-trace "
+            "programs recompile cold each start", path, e,
+        )
+        return None
+    with _JAX_CACHE_LOCK:
+        _JAX_CACHE_DIR = path
+    return path
+
+
+def jax_cache_stats() -> Dict[str, Any]:
+    """The jax-compilation-cache sidecar's accounting (``jax_cache_*``).
+
+    Entry/byte counts come from listing the dir (jax exposes no hit/miss
+    counters); a growing entry count across starts is the evidence the
+    deferred-trace layer is being warmed.
+    """
+    with _JAX_CACHE_LOCK:
+        path = _JAX_CACHE_DIR
+    out: Dict[str, Any] = {"jax_cache_dir": path}
+    if path is None:
+        return out
+    entries = 0
+    size = 0
+    try:
+        for dirpath, _dirnames, filenames in os.walk(path):
+            for fname in filenames:
+                entries += 1
+                try:
+                    size += os.stat(os.path.join(dirpath, fname)).st_size
+                except OSError:
+                    continue
+    except OSError:
+        pass
+    out["jax_cache_entries"] = entries
+    out["jax_cache_bytes"] = size
+    return out
 
 FORMAT_PJRT = "pjrt-pickle"
 FORMAT_EXPORT = "jax-export"

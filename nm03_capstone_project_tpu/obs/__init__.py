@@ -51,6 +51,12 @@ from nm03_capstone_project_tpu.obs.run import (  # noqa: F401
     SLICES_TOTAL,
     RunContext,
 )
+from nm03_capstone_project_tpu.obs.saturation import (  # noqa: F401
+    FEED_PHASES,
+    PhaseAccountant,
+    SaturationMonitor,
+    peak_flops_for,
+)
 from nm03_capstone_project_tpu.obs.spans import (  # noqa: F401
     STAGE_LATENCY_METRIC,
     SpanRecorder,

@@ -29,6 +29,50 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 SCHEMA_METRICS = "nm03.metrics.v1"
 
+# -- canonical metric names ---------------------------------------------------
+# This module (with serving/metrics.py) owns every metric NAME the package
+# registers, by contract: lint rule NM392 cross-checks these constants
+# against the docs/OBSERVABILITY.md tables in both directions, so a series
+# can neither ship undocumented nor linger documented after removal. Other
+# modules import their names from here (obs.run, obs.spans, utils.sanitize).
+
+# spans / driver accounting
+STAGE_LATENCY_METRIC = "nm03_stage_latency_seconds"
+PATIENT_OUTCOMES_TOTAL = "nm03_patient_outcomes_total"
+SLICES_TOTAL = "nm03_slices_total"
+GROW_TRUNCATED_TOTAL = "pipeline_grow_truncated_total"
+HEARTBEATS_TOTAL = "nm03_heartbeats_total"
+RUN_WALL_SECONDS = "nm03_run_wall_seconds"
+TRAIN_FINAL_LOSS = "nm03_train_final_loss"
+TRAIN_IOU_VS_TEACHER = "nm03_train_iou_vs_teacher"
+PIPELINE_PATH_INFO = "nm03_pipeline_path_info"
+MEDIAN_COMPARATOR_OPS = "nm03_median_comparator_minmax_ops"
+# resilience subsystem (docs/RESILIENCE.md; validated by check_telemetry.py)
+RESILIENCE_RETRIES_TOTAL = "resilience_retries_total"
+RESILIENCE_FAULTS_INJECTED_TOTAL = "resilience_faults_injected_total"
+PIPELINE_DEGRADED_TOTAL = "pipeline_degraded_total"
+# --sanitize recompile watchdog (utils.sanitize; docs/STATIC_ANALYSIS.md)
+PIPELINE_RECOMPILES_TOTAL = "pipeline_recompiles_total"
+# driver feed accounting (obs.saturation.PhaseAccountant, ISSUE 10): the
+# fraction of wall the device sat starved by the serial feed
+PIPELINE_FEED_STALL_RATIO = "pipeline_feed_stall_ratio"
+
+# saturation / goodput telemetry (obs.saturation, ISSUE 10). These are
+# serving_* series, but they are DEFINED here, not in serving/metrics.py:
+# the SaturationMonitor lives in obs/ (jax-/numpy-free by the package
+# contract) and obs must not import the serving package, whose __init__
+# pulls numpy. serving/metrics.py re-exports them for serving-side callers.
+SERVING_LANE_BUSY_FRACTION = "serving_lane_busy_fraction"
+SERVING_BUSY_FRACTION = "serving_busy_fraction"
+SERVING_LANE_IDLE_GAP_SECONDS = "serving_lane_idle_gap_seconds"
+SERVING_LANE_MFU = "serving_lane_mfu"
+SERVING_MFU = "serving_mfu"
+SERVING_LANE_PEAK_FLOPS = "serving_lane_peak_flops"
+SERVING_PADDING_WASTE_RATIO = "serving_padding_waste_ratio"
+SERVING_WINDOW_OCCUPANCY_RATIO = "serving_window_occupancy_ratio"
+SERVING_BATCH_ROWS_TOTAL = "serving_batch_rows_total"
+SERVING_BUCKET_FILL_RATIO = "serving_bucket_fill_ratio"
+
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 

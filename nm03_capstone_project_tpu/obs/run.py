@@ -24,18 +24,22 @@ import threading
 from typing import Dict, Optional
 
 from nm03_capstone_project_tpu.obs.events import EventLog, Heartbeat, LogBridge
-from nm03_capstone_project_tpu.obs.metrics import MetricsRegistry
-from nm03_capstone_project_tpu.obs.spans import SpanRecorder
 
-# canonical metric names (docs/OBSERVABILITY.md documents these)
-PATIENT_OUTCOMES_TOTAL = "nm03_patient_outcomes_total"
-SLICES_TOTAL = "nm03_slices_total"
-GROW_TRUNCATED_TOTAL = "pipeline_grow_truncated_total"
-HEARTBEATS_TOTAL = "nm03_heartbeats_total"
-# resilience subsystem (docs/RESILIENCE.md; validated by check_telemetry.py)
-RESILIENCE_RETRIES_TOTAL = "resilience_retries_total"
-RESILIENCE_FAULTS_INJECTED_TOTAL = "resilience_faults_injected_total"
-PIPELINE_DEGRADED_TOTAL = "pipeline_degraded_total"
+# canonical metric names live in obs.metrics (the NM392-gated name home);
+# re-exported here because every driver imports them from this module
+from nm03_capstone_project_tpu.obs.metrics import (  # noqa: F401
+    GROW_TRUNCATED_TOTAL,
+    HEARTBEATS_TOTAL,
+    MEDIAN_COMPARATOR_OPS,
+    PATIENT_OUTCOMES_TOTAL,
+    PIPELINE_DEGRADED_TOTAL,
+    PIPELINE_PATH_INFO,
+    RESILIENCE_FAULTS_INJECTED_TOTAL,
+    RESILIENCE_RETRIES_TOTAL,
+    SLICES_TOTAL,
+    MetricsRegistry,
+)
+from nm03_capstone_project_tpu.obs.spans import SpanRecorder
 
 PATIENT_STATUSES = ("ok", "failed")
 
@@ -291,7 +295,7 @@ class RunContext:
             # among the XLA implementations
             median_impl = "pallas_shared_pruned"
         self.registry.gauge(
-            "nm03_pipeline_path_info",
+            PIPELINE_PATH_INFO,
             help="pipeline implementation choices for this run (value is "
             "always 1; the labels carry the information)",
             median_impl=str(median_impl),
@@ -303,7 +307,7 @@ class RunContext:
         for key in self._COMPARATOR_COUNT_KEYS:
             if key in (comparators or {}):
                 self.registry.gauge(
-                    "nm03_median_comparator_minmax_ops",
+                    MEDIAN_COMPARATOR_OPS,
                     help="min/max ops per pixel of the median merge phase by "
                     "network variant (ops.selection_network)",
                     variant=key,

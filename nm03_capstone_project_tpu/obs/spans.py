@@ -28,7 +28,11 @@ import threading
 import time
 from typing import Dict, Optional
 
-STAGE_LATENCY_METRIC = "nm03_stage_latency_seconds"
+# canonical name home is obs.metrics (NM392); re-exported here because the
+# span API is where every caller historically imported it from
+from nm03_capstone_project_tpu.obs.metrics import (  # noqa: F401
+    STAGE_LATENCY_METRIC,
+)
 
 
 def _annotation(name: str):

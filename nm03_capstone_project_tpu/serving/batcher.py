@@ -49,6 +49,7 @@ from nm03_capstone_project_tpu.serving.metrics import (
     SERVING_BATCHES_TOTAL,
     SERVING_BATCH_SIZE,
     SERVING_QUEUE_WAIT_SECONDS,
+    SERVING_REQUEUES_TOTAL,
 )
 from nm03_capstone_project_tpu.serving.queue import AdmissionQueue, ServeRequest
 from nm03_capstone_project_tpu.utils.reporter import get_logger
@@ -281,6 +282,12 @@ class DynamicBatcher:
         trace = ChunkTrace([r.trace for r in reqs], lane=lane)
         with trace.span("pad_stack"):
             pixels, dims = self.pad_batch(reqs)
+        sat = getattr(self.executor, "saturation", None)
+        if sat is not None:
+            # goodput accounting (ISSUE 10): real riders vs the bucket rows
+            # they were padded into — the dead-row fraction the padding
+            # waste gauge reports
+            sat.record_chunk(len(reqs), int(pixels.shape[0]))
         # flight-recorder marker BEFORE the dispatch that may wedge: a
         # post-mortem dump must carry the in-flight trace ids even when
         # the dispatch span never closes
@@ -326,6 +333,15 @@ class DynamicBatcher:
                 # same-size chunks fleeing one quarantined lane must spread
                 # over the survivors, not herd onto one chip
                 next_lane = healthy[next(self._requeue_seq) % len(healthy)]
+                if self.obs is not None:
+                    # the counter twin of the requeue span: nm03-top reads
+                    # a requeue RATE from scrape deltas of this series
+                    self.obs.registry.counter(
+                        SERVING_REQUEUES_TOTAL,
+                        help="chunks re-dispatched off a quarantined lane "
+                        "(each is one extra supervised dispatch for its "
+                        "riders)",
+                    ).inc()
                 with trace.span(
                     "requeue", from_lane=q.lane, to_lane=next_lane,
                     cause=q.cause,
@@ -397,6 +413,15 @@ class DynamicBatcher:
         # three and never waits on the sick chip
         targets = self.healthy_lanes()
         chunks = self._chunk(reqs, len(targets))
+        sat = getattr(self.executor, "saturation", None)
+        if sat is not None:
+            # occupancy: this window's riders against what the HEALTHY
+            # fleet could have carried (largest bucket x healthy lanes) —
+            # a persistently low ratio means the fleet is oversized for
+            # the offered load, not that batching is broken
+            sat.record_window(
+                len(reqs), self.executor.max_batch * len(targets)
+            )
         if reg is not None:
             wait_h = reg.histogram(
                 SERVING_QUEUE_WAIT_SECONDS,

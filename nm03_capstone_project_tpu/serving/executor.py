@@ -65,6 +65,7 @@ from nm03_capstone_project_tpu.serving.metrics import (
     SERVING_LANE_BATCHES_TOTAL,
     SERVING_LANE_INFLIGHT,
     SERVING_LANES_READY,
+    SERVING_WARMUP_SECONDS,
 )
 from nm03_capstone_project_tpu.utils.reporter import get_logger
 
@@ -114,6 +115,7 @@ class WarmExecutor:
         fault_plan: Optional[FaultPlan] = None,
         lanes: Optional[int] = None,
         lane_probe_interval_s: float = DEFAULT_LANE_PROBE_INTERVAL_S,
+        saturation=None,
     ):
         if not buckets or list(buckets) != sorted(set(int(b) for b in buckets)):
             raise ValueError(
@@ -133,6 +135,10 @@ class WarmExecutor:
         self.res = resilience if resilience is not None else ResilienceConfig()
         self.fault_plan = fault_plan
         self.lane_probe_interval_s = float(lane_probe_interval_s)
+        # efficiency accounting (obs.saturation.SaturationMonitor, ISSUE
+        # 10): every supervised dispatch records its busy interval (+ the
+        # executable's flops for MFU); None = no accounting (tests' fakes)
+        self.saturation = saturation
         self._fallback_fn = None
         self._lock = threading.Lock()
         self._dispatch_seq = itertools.count()
@@ -184,6 +190,19 @@ class WarmExecutor:
                     self._new_supervisor() for _ in devs
                 ]
                 self.fleet = LaneFaultDomains(len(devs), obs=self.obs)
+                sat = self.saturation
+            else:
+                sat = None
+        if sat is not None:
+            # outside the lock (set_lanes publishes gauges); winner-only,
+            # like the fleet: a losing racer must not reset the rings
+            sat.set_lanes(
+                [
+                    (d.platform, getattr(d, "device_kind", ""))
+                    for d in devs
+                ]
+            )
+        with self._lock:
             return self._lane_devices
 
     @property
@@ -354,6 +373,18 @@ class WarmExecutor:
                 mask, conv = fn(px, dm)
                 np.asarray(mask), np.asarray(conv)  # block until executed
                 lane_t[b] = round(time.perf_counter() - t0, 3)
+                if self.saturation is not None:
+                    # pin the executable's flops once: every serve-time
+                    # dispatch of this (lane, bucket) credits them to the
+                    # MFU window (executable_cost returns {} where the
+                    # jaxlib exposes no analysis — MFU is then unpublished)
+                    from nm03_capstone_project_tpu.compilehub import (
+                        executable_cost,
+                    )
+
+                    self.saturation.set_lane_bucket_flops(
+                        lane, b, executable_cost(fn).get("flops")
+                    )
             timings[f"lane{lane}"] = lane_t
             with self._lock:
                 self._lane_warm[lane] = True
@@ -362,7 +393,7 @@ class WarmExecutor:
             for lane_key, lane_t in timings.items():
                 for b, s in lane_t.items():
                     self.obs.registry.gauge(
-                        "serving_warmup_seconds",
+                        SERVING_WARMUP_SECONDS,
                         help="startup compile+first-execute time per lane and batch bucket",
                         bucket=str(b),
                         lane=lane_key[len("lane"):],
@@ -680,6 +711,8 @@ class WarmExecutor:
                 # nm03-lint: disable=NM321 the fetch span MEASURES this device sync — that is its entire purpose (trace schema, docs/OBSERVABILITY.md)
                 return np.asarray(mask), np.asarray(conv)
 
+        t_busy0 = time.monotonic()
+        dispatched_ok = False
         try:
             out = sup.run(
                 primary,
@@ -687,6 +720,7 @@ class WarmExecutor:
                 pre=self._pre(index, lane),
                 label="serve_dispatch",
             )
+            dispatched_ok = True
         except BaseException as e:  # noqa: BLE001 — classified below
             cause = self._quarantine_cause(e)
             if cause is None:
@@ -694,6 +728,14 @@ class WarmExecutor:
             self._quarantine_lane(lane, cause, trace)
             raise LaneQuarantined(lane, cause) from e
         finally:
+            if self.saturation is not None:
+                # busy is busy either way — a dispatch that hung to its
+                # deadline occupied the chip; only a SUCCESS credits the
+                # executable's flops to the MFU window
+                self.saturation.record_dispatch(
+                    lane, t_busy0, time.monotonic(), bucket=bucket,
+                    counted=dispatched_ok,
+                )
             if reg is not None:
                 inflight_g.dec()
             with self._lock:

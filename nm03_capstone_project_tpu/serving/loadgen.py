@@ -41,6 +41,13 @@ from typing import List, Optional
 
 import numpy as np
 
+from nm03_capstone_project_tpu.serving.metrics import (
+    SERVING_BATCHES_TOTAL,
+    SERVING_BUSY_FRACTION,
+    SERVING_MFU,
+    SERVING_PADDING_WASTE_RATIO,
+)
+
 
 def _percentile(sorted_vals: List[float], p: float) -> float:
     """Nearest-rank percentile on an already-sorted list."""
@@ -333,14 +340,55 @@ def probe_server_topology(url: str, timeout_s: float = 5.0) -> dict:
     return out
 
 
+def probe_server_efficiency(url: str, timeout_s: float = 5.0) -> dict:
+    """Best-effort saturation read from ``/metrics.json`` (ISSUE 10).
+
+    Returns ``{busy_fraction, padding_waste_ratio, mfu, batches_total}``
+    (Nones when unreachable or the server predates the saturation layer).
+    The scrape itself refreshes the server's sliding-window gauges, so a
+    poll DURING the run reads live utilization, not a stale publish.
+    """
+    out = {
+        "busy_fraction": None, "padding_waste_ratio": None, "mfu": None,
+        "batches_total": None,
+    }
+    req = urllib.request.Request(f"{url}/metrics.json", method="GET")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            snap = json.loads(resp.read())
+    except Exception:  # noqa: BLE001 — a probe failure must not fail the run
+        return out
+    batches = 0.0
+    for rec in snap.get("metrics", []):
+        name, value = rec.get("name"), rec.get("value")
+        if not isinstance(value, (int, float)):
+            continue
+        if rec.get("type") == "gauge" and not rec.get("labels"):
+            if name == SERVING_BUSY_FRACTION:
+                out["busy_fraction"] = float(value)
+            elif name == SERVING_PADDING_WASTE_RATIO:
+                out["padding_waste_ratio"] = float(value)
+            elif name == SERVING_MFU:
+                out["mfu"] = float(value)
+        elif rec.get("type") == "counter" and name == SERVING_BATCHES_TOTAL:
+            batches += float(value)
+            out["batches_total"] = batches
+    return out
+
+
 class CapacityWatch:
-    """Background ``/readyz`` poller for the duration of a load run.
+    """Background ``/readyz`` + ``/metrics.json`` poller for a load run.
 
     A single post-run probe would miss a quarantine that probation already
     healed; polling during the run records the partial-capacity PLATEAU a
     chaos drill's throughput dip is explained by —
     ``lanes_quarantined_observed`` is the peak quarantined count and
-    ``capacity_min_observed`` the floor the fleet served at.
+    ``capacity_min_observed`` the floor the fleet served at. The
+    efficiency join (ISSUE 10): ``busy_fraction_min_observed`` is the
+    utilization floor once traffic began (samples before the first device
+    batch are skipped — a cold fleet's honest 0.0 would say nothing about
+    the run), ``padding_waste_max_observed``/``mfu_max_observed`` the
+    worst padding and best flops utilization seen live.
     """
 
     def __init__(self, url: str, interval_s: float = 0.5):
@@ -352,6 +400,9 @@ class CapacityWatch:
         self._lock = threading.Lock()
         self.max_quarantined: Optional[int] = None
         self.min_capacity: Optional[float] = None
+        self.min_busy: Optional[float] = None
+        self.max_padding: Optional[float] = None
+        self.max_mfu: Optional[float] = None
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="nm03-loadgen-capwatch", daemon=True
@@ -359,6 +410,7 @@ class CapacityWatch:
 
     def _sample(self) -> None:
         topo = probe_server_topology(self.url, timeout_s=2.0)
+        eff = probe_server_efficiency(self.url, timeout_s=2.0)
         q, c = topo["lanes_quarantined"], topo["capacity"]
         with self._lock:
             if q is not None:
@@ -368,6 +420,17 @@ class CapacityWatch:
                     float(c) if self.min_capacity is None
                     else min(self.min_capacity, float(c))
                 )
+            busy = eff["busy_fraction"]
+            if busy is not None and (eff["batches_total"] or 0) > 0:
+                self.min_busy = (
+                    busy if self.min_busy is None else min(self.min_busy, busy)
+                )
+            if eff["padding_waste_ratio"] is not None:
+                self.max_padding = max(
+                    self.max_padding or 0.0, eff["padding_waste_ratio"]
+                )
+            if eff["mfu"] is not None:
+                self.max_mfu = max(self.max_mfu or 0.0, eff["mfu"])
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
@@ -483,6 +546,11 @@ def main(argv=None) -> int:
     summary["lanes_quarantined_observed"] = watch.max_quarantined
     summary["capacity_min_observed"] = watch.min_capacity
     summary["capacity"] = topo["capacity"]
+    # server-side efficiency joined to the client-side numbers (ISSUE 10):
+    # a p99 means something different at 20% lane utilization than at 95%
+    summary["busy_fraction_min_observed"] = watch.min_busy
+    summary["padding_waste_max_observed"] = watch.max_padding
+    summary["mfu_max_observed"] = watch.max_mfu
     if args.self_serve and app is not None:
         app.begin_drain(reason="loadgen_done")
         httpd.shutdown()
@@ -500,6 +568,13 @@ def main(argv=None) -> int:
     print(json.dumps(summary, indent=2))
     lat, qw = summary["latency_ms"], summary["queue_wait_ms"]
     cap = summary["capacity_min_observed"]
+
+    def _pct(v):
+        # 3 significant digits, not a fixed point: 8 virtual CPU lanes
+        # sharing one core legitimately sit at 0.04% busy, and "0.0%"
+        # would misread as "never worked"
+        return "?" if v is None else f"{v * 100:.3g}%"
+
     print(
         f"loadgen: ok={summary['requests_ok']}/{summary['requests_total']} "
         f"p50={lat['p50']}ms p95={lat['p95']}ms "
@@ -507,6 +582,9 @@ def main(argv=None) -> int:
         f"lanes={summary['lanes_observed'] or '{}'} "
         f"quarantined_max={summary['lanes_quarantined_observed']} "
         f"capacity_min={'?' if cap is None else cap} "
+        f"busy_min={_pct(summary['busy_fraction_min_observed'])} "
+        f"padding_max={_pct(summary['padding_waste_max_observed'])} "
+        f"mfu_max={_pct(summary['mfu_max_observed'])} "
         f"echo_mismatch={summary['trace_echo_mismatches']}",
         flush=True,
     )

@@ -10,6 +10,23 @@ batch-era counters (``resilience_retries_total``, ``pipeline_degraded_total``
 
 from __future__ import annotations
 
+# saturation/goodput series (ISSUE 10) are DEFINED in obs.metrics — the
+# SaturationMonitor lives in jax-/numpy-free obs/ and cannot import this
+# package — and re-exported here so serving-side callers keep one import
+# home for every serving series name (NM392 counts the definition site).
+from nm03_capstone_project_tpu.obs.metrics import (  # noqa: F401
+    SERVING_BATCH_ROWS_TOTAL,
+    SERVING_BUCKET_FILL_RATIO,
+    SERVING_BUSY_FRACTION,
+    SERVING_LANE_BUSY_FRACTION,
+    SERVING_LANE_IDLE_GAP_SECONDS,
+    SERVING_LANE_MFU,
+    SERVING_LANE_PEAK_FLOPS,
+    SERVING_MFU,
+    SERVING_PADDING_WASTE_RATIO,
+    SERVING_WINDOW_OCCUPANCY_RATIO,
+)
+
 # -- counters ---------------------------------------------------------------
 # terminal request outcomes by status: ok | error | shed | invalid | timeout
 SERVING_REQUESTS_TOTAL = "serving_requests_total"
@@ -37,6 +54,9 @@ SERVING_LANE_REINSTATED_TOTAL = "serving_lane_reinstated_total"
 # total_compile_seconds.
 COMPILE_CACHE_HITS_TOTAL = "compile_cache_hits_total"
 COMPILE_CACHE_MISSES_TOTAL = "compile_cache_misses_total"
+# chunks re-dispatched off a quarantined lane (ISSUE 8's requeue span,
+# counted so nm03-top can show a requeue RATE from scrape deltas)
+SERVING_REQUEUES_TOTAL = "serving_requeues_total"
 
 # -- gauges -----------------------------------------------------------------
 # compile-cost accounting (ISSUE 7; labels: spec = CompileSpec.label()):
@@ -60,6 +80,8 @@ SERVING_LANE_INFLIGHT = "serving_lane_inflight"  # {lane}: batches in flight
 # --expect-gauge form (serving_lane_state{lane=2}=0)
 SERVING_LANE_STATE = "serving_lane_state"
 LANE_STATE_VALUES = {"healthy": 0, "probation": 1, "quarantined": 2}
+# startup compile+first-execute per lane and bucket (set by warmup)
+SERVING_WARMUP_SECONDS = "serving_warmup_seconds"
 
 # -- histograms -------------------------------------------------------------
 SERVING_QUEUE_WAIT_SECONDS = "serving_queue_wait_seconds"

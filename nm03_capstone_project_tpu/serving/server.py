@@ -142,6 +142,12 @@ class ServingApp:
         self.compile_cache_dir = compile_cache_dir
         self._attached_cache = None
         self.queue = AdmissionQueue(queue_capacity)
+        # efficiency telemetry (obs.saturation, ISSUE 10): lane busy/idle,
+        # padding waste, occupancy and MFU over a sliding window — fed by
+        # the executor/batcher, pull-refreshed on every scrape
+        from nm03_capstone_project_tpu.obs.saturation import SaturationMonitor
+
+        self.saturation = SaturationMonitor(registry=self.obs.registry)
         self.executor = WarmExecutor(
             self.cfg,
             buckets=tuple(buckets),
@@ -154,6 +160,7 @@ class ServingApp:
                 if lane_probe_interval_s is not None
                 else DEFAULT_LANE_PROBE_INTERVAL_S
             ),
+            saturation=self.saturation,
         )
         self.batcher = DynamicBatcher(
             self.queue,
@@ -335,6 +342,11 @@ class ServingApp:
                 **get_hub().stats(),
                 "compile_seconds": get_hub().compile_seconds(),
             },
+            # the efficiency view (ISSUE 10): per-lane busy fractions and
+            # MFU, padding waste, window occupancy — publish() also
+            # refreshes the serving_* saturation gauges, so a /readyz
+            # probe and a /metrics scrape can never disagree
+            "saturation": self.saturation.publish(),
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }
 
@@ -357,6 +369,13 @@ class ServingApp:
         )
         self.queue.close()
         drained = self.batcher.join(timeout_s=timeout_s)
+        # final gauge refresh BEFORE the snapshot flush: the --metrics-out
+        # artifact must carry the run's last efficiency window (the
+        # subprocess drills gate on these gauges post-drain)
+        try:
+            self.saturation.publish()
+        except Exception as e:  # noqa: BLE001 — telemetry never blocks a drain
+            log.warning("drain: saturation publish failed: %s", e)
         if not drained:
             # a wedged drain still must answer whoever is parked on wait():
             # fail the un-popped tail so handler threads return 500, not 504
@@ -606,10 +625,12 @@ def make_handler(app: ServingApp):
                 st = app.status()
                 self._reply(200 if st["ready"] else 503, st)
             elif path == "/metrics":
+                app.saturation.publish()  # pull-refresh the sliding window
                 self._reply_text(
                     200, app.registry.to_prometheus(), "text/plain; version=0.0.4"
                 )
             elif path == "/metrics.json":
+                app.saturation.publish()  # pull-refresh the sliding window
                 self._reply_text(
                     200,
                     json.dumps(app.obs.metrics_snapshot(), indent=1),

@@ -1,0 +1,267 @@
+"""``nm03-top``: a live one-screen saturation view of a serving replica.
+
+``top`` for the fleet: polls a running ``nm03-serve``'s ``/metrics.json``
+and ``/readyz`` and renders a refreshing console view of *how much of the
+hardware the replica is using* — per-lane state + busy fraction + MFU,
+queue depth, window occupancy, padding waste, and request/shed/requeue
+RATES computed from counter deltas between polls (ISSUE 10). Where
+``nm03-loadgen`` answers "what latency did clients see", this answers the
+operator's capacity question: "are my chips actually working?"
+(docs/OPERATIONS.md, "Capacity planning").
+
+Pure stdlib, read-only — it issues only GETs, so pointing it at a
+production replica is always safe. ``--once`` prints a single view and
+exits (``--format json`` makes that machine-readable: the subprocess
+drills assert nm03-top renders the same numbers the gauges carry).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, Optional, Tuple
+
+from nm03_capstone_project_tpu.serving.metrics import (
+    SERVING_BUSY_FRACTION,
+    SERVING_LANE_BUSY_FRACTION,
+    SERVING_LANE_MFU,
+    SERVING_MFU,
+    SERVING_PADDING_WASTE_RATIO,
+    SERVING_REQUESTS_TOTAL,
+    SERVING_REQUEUES_TOTAL,
+    SERVING_SHED_TOTAL,
+    SERVING_WINDOW_OCCUPANCY_RATIO,
+)
+
+CLEAR = "\x1b[2J\x1b[H"  # clear screen + home (ANSI)
+
+
+class Sample:
+    """One poll: parsed metrics snapshot + the /readyz status payload."""
+
+    def __init__(self, metrics: dict, readyz: dict, ts: float):
+        self.ts = ts
+        self.readyz = readyz
+        # gauges: (name, sorted label items) -> value; counters: summed by
+        # name (rates never need label splits) and kept per-label for lanes
+        self.gauges: Dict[Tuple[str, tuple], float] = {}
+        self.counter_totals: Dict[str, float] = {}
+        for rec in metrics.get("metrics", []):
+            name, kind = rec.get("name"), rec.get("type")
+            labels = tuple(sorted((rec.get("labels") or {}).items()))
+            value = rec.get("value")
+            if not isinstance(value, (int, float)):
+                continue
+            if kind == "gauge":
+                self.gauges[(name, labels)] = float(value)
+            elif kind == "counter":
+                self.counter_totals[name] = (
+                    self.counter_totals.get(name, 0.0) + float(value)
+                )
+
+    def gauge(self, name: str, **labels) -> Optional[float]:
+        return self.gauges.get((name, tuple(sorted(labels.items()))))
+
+
+def fetch_sample(url: str, timeout_s: float) -> Sample:
+    """GET /metrics.json + /readyz (any status; a 503 body still carries
+    the fleet payload). Raises URLError/OSError when the server is gone."""
+    with urllib.request.urlopen(
+        f"{url}/metrics.json", timeout=timeout_s
+    ) as resp:
+        metrics = json.loads(resp.read())
+    try:
+        with urllib.request.urlopen(f"{url}/readyz", timeout=timeout_s) as r:
+            readyz = json.loads(r.read())
+    except urllib.error.HTTPError as e:  # 503 carries the payload too
+        try:
+            readyz = json.loads(e.read() or b"{}")
+        except json.JSONDecodeError:
+            readyz = {}
+    return Sample(metrics, readyz, time.monotonic())
+
+
+def _rate(cur: Sample, prev: Optional[Sample], name: str) -> Optional[float]:
+    if prev is None:
+        return None
+    dt = cur.ts - prev.ts
+    if dt <= 0:
+        return None
+    return round(
+        max(
+            cur.counter_totals.get(name, 0.0)
+            - prev.counter_totals.get(name, 0.0),
+            0.0,
+        )
+        / dt,
+        2,
+    )
+
+
+def build_view(cur: Sample, prev: Optional[Sample] = None) -> dict:
+    """One renderable/JSON-able view from a poll (+ rates vs the prior).
+
+    Every number is sourced from the same registry the ``/metrics``
+    scrape and the ``check_telemetry`` gates read — nm03-top shows the
+    gauges, it never recomputes them.
+    """
+    st = cur.readyz or {}
+    lanes_info = st.get("lanes") or {}
+    rows = []
+    for lane_row in lanes_info.get("per_lane") or []:
+        lane = lane_row.get("lane")
+        busy = cur.gauge(SERVING_LANE_BUSY_FRACTION, lane=str(lane))
+        mfu = cur.gauge(SERVING_LANE_MFU, lane=str(lane))
+        rows.append(
+            {
+                "lane": lane,
+                "state": lane_row.get("state", "?"),
+                "busy_fraction": busy,
+                "mfu": mfu,
+                "inflight": lane_row.get("inflight"),
+                "batches": lane_row.get("batches"),
+                "quarantines": lane_row.get("quarantines"),
+            }
+        )
+    return {
+        "schema": "nm03.top.v1",
+        "ready": st.get("ready"),
+        "draining": st.get("draining"),
+        "degraded": st.get("degraded"),
+        "capacity": st.get("capacity"),
+        "uptime_s": st.get("uptime_s"),
+        "queue_depth": st.get("queue_depth"),
+        "queue_capacity": st.get("queue_capacity"),
+        "lanes": rows,
+        "busy_fraction": cur.gauge(SERVING_BUSY_FRACTION),
+        "mfu": cur.gauge(SERVING_MFU),
+        "padding_waste_ratio": cur.gauge(SERVING_PADDING_WASTE_RATIO),
+        "window_occupancy_ratio": cur.gauge(SERVING_WINDOW_OCCUPANCY_RATIO),
+        # rates from counter deltas between polls (null on the first poll
+        # and in --once mode: one sample has no delta)
+        "rates_per_s": {
+            "requests": _rate(cur, prev, SERVING_REQUESTS_TOTAL),
+            "shed": _rate(cur, prev, SERVING_SHED_TOTAL),
+            "requeues": _rate(cur, prev, SERVING_REQUEUES_TOTAL),
+        },
+    }
+
+
+def _fmt(v, pct: bool = False, width: int = 7) -> str:
+    if v is None:
+        return "-".rjust(width)
+    if pct:
+        # 3 significant digits: a virtual-CPU lane's honest 0.04% busy
+        # (or a 3e-4% MFU) must not render as a misleading "0.0%"
+        return f"{v * 100:.3g}%".rjust(width)
+    return f"{v:.6g}".rjust(width)
+
+
+def render_text(view: dict, url: str) -> str:
+    """The one-screen console rendering of a view."""
+    state = (
+        "DRAINING" if view.get("draining")
+        else "DEGRADED" if view.get("degraded")
+        else "ready" if view.get("ready")
+        else "not-ready"
+    )
+    rates = view["rates_per_s"]
+    lines = [
+        f"nm03-top — {url}   [{state}]   uptime "
+        f"{view.get('uptime_s') if view.get('uptime_s') is not None else '?'}s",
+        (
+            f"queue {view.get('queue_depth')}/{view.get('queue_capacity')}   "
+            f"capacity {_fmt(view.get('capacity'), pct=True).strip()}   "
+            f"busy {_fmt(view.get('busy_fraction'), pct=True).strip()}   "
+            f"mfu {_fmt(view.get('mfu'), pct=True).strip()}"
+        ),
+        (
+            f"occupancy {_fmt(view.get('window_occupancy_ratio'), pct=True).strip()}   "
+            f"padding waste "
+            f"{_fmt(view.get('padding_waste_ratio'), pct=True).strip()}   "
+            f"req/s {rates['requests'] if rates['requests'] is not None else '-'}   "
+            f"shed/s {rates['shed'] if rates['shed'] is not None else '-'}   "
+            f"requeue/s {rates['requeues'] if rates['requeues'] is not None else '-'}"
+        ),
+        "",
+        f"{'lane':>4} {'state':<12} {'busy':>8} {'mfu':>8} "
+        f"{'inflight':>8} {'batches':>8} {'quar':>5}",
+    ]
+    for row in view["lanes"]:
+        lines.append(
+            f"{str(row['lane']):>4} {str(row['state']):<12} "
+            f"{_fmt(row['busy_fraction'], pct=True, width=8)} "
+            f"{_fmt(row['mfu'], pct=True, width=8)} "
+            f"{str(row['inflight']):>8} {str(row['batches']):>8} "
+            f"{str(row['quarantines']):>5}"
+        )
+    if not view["lanes"]:
+        lines.append("  (no lanes resolved yet — server still warming?)")
+    return "\n".join(lines)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nm03-top", description=__doc__.strip().splitlines()[0]
+    )
+    p.add_argument(
+        "--url", default="http://127.0.0.1:8077", help="server base URL"
+    )
+    p.add_argument(
+        "--interval-s", type=float, default=2.0,
+        help="refresh period (each refresh is one /metrics.json + /readyz "
+        "poll; rates are deltas over this period)",
+    )
+    p.add_argument(
+        "--once", action="store_true",
+        help="print one view and exit (rates are null: one sample has no "
+        "delta)",
+    )
+    p.add_argument(
+        "--format", choices=["text", "json"], default="text",
+        help="output format (json is the machine/CI interface)",
+    )
+    p.add_argument(
+        "--timeout-s", type=float, default=5.0, help="per-poll HTTP timeout"
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.interval_s <= 0:
+        print("nm03-top: --interval-s must be > 0", file=sys.stderr)
+        return 2
+    prev: Optional[Sample] = None
+    try:
+        while True:
+            try:
+                cur = fetch_sample(args.url, args.timeout_s)
+            except Exception as e:  # noqa: BLE001 — unreachable server is the exit
+                print(f"nm03-top: {args.url} unreachable: {e}", file=sys.stderr)
+                return 2
+            view = build_view(cur, prev)
+            if args.format == "json":
+                out = json.dumps(view, indent=None if args.once else 1)
+                print(out, flush=True)
+            else:
+                screen = render_text(view, args.url)
+                if args.once:
+                    print(screen, flush=True)
+                else:
+                    sys.stdout.write(CLEAR + screen + "\n")
+                    sys.stdout.flush()
+            if args.once:
+                return 0
+            prev = cur
+            time.sleep(args.interval_s)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -35,7 +35,10 @@ import contextlib
 import logging
 from typing import Optional
 
-RECOMPILES_TOTAL = "pipeline_recompiles_total"
+# canonical name home is obs.metrics (NM392); aliased for the call sites
+from nm03_capstone_project_tpu.obs.metrics import (
+    PIPELINE_RECOMPILES_TOTAL as RECOMPILES_TOTAL,
+)
 
 _COMPILE_PREFIXES = ("Compiling ",)
 

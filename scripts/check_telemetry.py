@@ -64,7 +64,15 @@ metrics
     asserts lane 2 ended HEALTHY (a specific series, distinguishable from
     "never reported"), ``--expect-counter
     'serving_lane_quarantines_total{lane=2}=1'`` that it was quarantined
-    along the way. Histogram expectations stay name-only.
+    along the way. Histogram expectations stay name-only;
+  * ``--expect-gauge-range NAME=LO..HI`` (repeatable) requires EVERY gauge
+    series matching the selector to lie in the range INDIVIDUALLY — no
+    summing, because fractions don't add — with ``(``/``)`` making a
+    bound exclusive. The saturation-drill hook (ISSUE 10):
+    ``'serving_lane_busy_fraction=(0..1]'`` asserts every lane did real
+    work (one idle lane fails), ``'serving_padding_waste_ratio=[0..1)'``
+    that padding stayed sane — property assertions that cannot flake on
+    exact values.
 
 trace (``--expect-trace FILE``)
   * FILE is a Chrome/Perfetto ``trace_event`` export (``nm03-trace``
@@ -139,6 +147,49 @@ def _select(series: list, sel: dict) -> list:
         v for lbls, v in series
         if all(lbls.get(k) == want for k, want in sel.items())
     ]
+
+
+def parse_range(spec: str) -> tuple:
+    """``LO..HI`` with optional open-bound brackets -> (lo, hi, lo_open,
+    hi_open).
+
+    ``(0..1]`` excludes 0 and includes 1; bare ``0..1`` is inclusive on
+    both ends. Open bounds exist because the saturation gates need "in
+    (0, 1]": a busy fraction of exactly 0 means the lane never worked,
+    and no epsilon floor can express that without flaking.
+    """
+    raw = spec.strip()
+    lo_open = hi_open = False
+    # explicit truthiness first: '' is a member of any string, so a bare
+    # slice-membership test would IndexError on an empty/bracket-only spec
+    # instead of reaching the ValueError the CLI maps to a usage error
+    if raw and raw[0] in "([":
+        lo_open = raw[0] == "("
+        raw = raw[1:]
+    if raw and raw[-1] in ")]":
+        hi_open = raw[-1] == ")"
+        raw = raw[:-1]
+    lo_s, sep, hi_s = raw.partition("..")
+    if not sep:
+        raise ValueError(f"range wants LO..HI, got {spec!r}")
+    try:
+        return float(lo_s), float(hi_s), lo_open, hi_open
+    except ValueError:
+        raise ValueError(f"range bounds must be numbers in {spec!r}") from None
+
+
+def _in_range(v: float, rng: tuple) -> bool:
+    lo, hi, lo_open, hi_open = rng
+    if v < lo or (lo_open and v == lo):
+        return False
+    if v > hi or (hi_open and v == hi):
+        return False
+    return True
+
+
+def _render_range(rng: tuple) -> str:
+    lo, hi, lo_open, hi_open = rng
+    return f"{'(' if lo_open else '['}{lo:g}..{hi:g}{')' if hi_open else ']'}"
 
 
 class Checker:
@@ -290,7 +341,8 @@ def _check_histogram(where: str, rec: dict, chk: Checker) -> None:
 
 
 def check_metrics(path: str, chk: Checker, expect_counters=None,
-                  expect_histograms=None, expect_gauges=None):
+                  expect_histograms=None, expect_gauges=None,
+                  expect_gauge_ranges=None):
     """Validate one metrics snapshot; returns (run_id, git_sha) or None.
 
     ``expect_counters``: {name: min_total | (value, exact)} — the summed
@@ -302,6 +354,12 @@ def check_metrics(path: str, chk: Checker, expect_counters=None,
     actually be a histogram).
     ``expect_gauges``: {name: value} — the summed value across NAME's gauge
     series must EQUAL value (serving-topology assertions).
+    ``expect_gauge_ranges``: {selector: (lo, hi, lo_open, hi_open)} — EVERY
+    gauge series matching the selector must lie in the range
+    *individually* (no summing: fractions don't add), and at least one
+    series must match. ``serving_lane_busy_fraction=(0..1]`` therefore
+    asserts every lane worked — one idle lane fails the gate
+    (saturation-drill assertions, ISSUE 10).
     """
     try:
         with open(path) as f:
@@ -426,6 +484,40 @@ def check_metrics(path: str, chk: Checker, expect_counters=None,
         got = sum(matched)
         if got != want:
             chk.fail(path, f"gauge {spec} totals {got}, expected == {want}")
+    for spec, rng in sorted((expect_gauge_ranges or {}).items()):
+        try:
+            name, sel = parse_selector(spec)
+        except ValueError as e:
+            chk.fail(path, str(e))
+            continue
+        if name not in gauge_series:
+            kind = kind_by_name.get(name)
+            if kind is not None and kind != "gauge":
+                chk.fail(path, f"{name} is a {kind}, not a gauge")
+            else:
+                chk.fail(
+                    path,
+                    f"gauge {spec} absent, expected in {_render_range(rng)}",
+                )
+            continue
+        matched_series = [
+            (lbls, v) for lbls, v in gauge_series[name]
+            if all(lbls.get(k) == want for k, want in sel.items())
+        ]
+        if not matched_series:
+            chk.fail(
+                path,
+                f"gauge {spec}: no series matches, expected in "
+                f"{_render_range(rng)}",
+            )
+            continue
+        for lbls, v in matched_series:
+            if not _in_range(v, rng):
+                chk.fail(
+                    path,
+                    f"gauge {name}{lbls or ''} = {v}, expected in "
+                    f"{_render_range(rng)}",
+                )
     for name, want in sorted((expect_histograms or {}).items()):
         if name not in histogram_counts and kind_by_name.get(name) is not None:
             chk.fail(path, f"{name} is a {kind_by_name[name]}, not a histogram")
@@ -538,6 +630,15 @@ def main(argv=None) -> int:
         "serving_lanes_ready=8 or 'serving_lane_state{lane=2}=0')",
     )
     ap.add_argument(
+        "--expect-gauge-range", action="append", default=[],
+        metavar="NAME=LO..HI",
+        help="require EVERY gauge series matching NAME (labeled selectors "
+        "compose) to lie in the range individually — no summing; '(' / ')' "
+        "make a bound exclusive (repeatable; saturation assertions, e.g. "
+        "'serving_lane_busy_fraction=(0..1]' = every lane worked, "
+        "'serving_padding_waste_ratio=[0..1)')",
+    )
+    ap.add_argument(
         "--expect-trace", action="append", default=[], metavar="FILE",
         help="validate a Perfetto/Chrome trace_event export (nm03-trace "
         "output): non-empty, monotonic ts, matched B/E pairs, every "
@@ -589,6 +690,16 @@ def main(argv=None) -> int:
     expect_gauges = parse_expectations(
         args.expect_gauge, "--expect-gauge", labeled=True
     )
+    expect_gauge_ranges = {}
+    for spec in args.expect_gauge_range:
+        sel, _, val = spec.rpartition("=")
+        try:
+            parse_selector(sel)
+            expect_gauge_ranges[sel] = parse_range(val)
+        except ValueError as e:
+            ap.error(f"--expect-gauge-range: {e}")
+    if expect_gauge_ranges and not args.metrics:
+        ap.error("--expect-gauge-range needs --metrics")
 
     chk = Checker()
     ev_ident = mt_ident = None
@@ -597,7 +708,7 @@ def main(argv=None) -> int:
     if args.metrics:
         mt_ident = check_metrics(
             args.metrics, chk, expect_counters, expect_histograms,
-            expect_gauges,
+            expect_gauges, expect_gauge_ranges,
         )
     for trace_path in args.expect_trace:
         check_trace(trace_path, chk)

@@ -33,6 +33,7 @@ from nm03_capstone_project_tpu.analysis.core import (
 )
 from nm03_capstone_project_tpu.analysis.dtypes import check_dtype_discipline
 from nm03_capstone_project_tpu.analysis.hostsync import check_host_sync
+from nm03_capstone_project_tpu.analysis.metricsdocs import check_metrics_docs
 from nm03_capstone_project_tpu.analysis.retrace import check_retrace
 from nm03_capstone_project_tpu.analysis.threads import check_thread_shared_state
 
@@ -456,6 +457,38 @@ class TestThreadSharedState:
         fs = lint_tree(
             tmp_path,
             {f"{PKG}/serving/lanes.py": src},
+            rules=(check_thread_shared_state,),
+        )
+        assert rules_of(fs) == [], [f.render() for f in fs]
+
+    def test_removing_the_saturation_lock_fails(self, tmp_path):
+        """ISSUE 10 satellite: NM331's scope covers obs/saturation.py —
+        the REAL sliding-window monitor with its lane-table write moved
+        outside the lock must be a lint finding."""
+        src = (REPO / PKG / "obs" / "saturation.py").read_text()
+        guarded = (
+            "        with self._lock:\n"
+            "            self._lanes = rows"
+        )
+        assert guarded in src  # set_lanes' guarded fleet-table write
+        broken = src.replace(
+            guarded,
+            "        if True:\n"
+            "            self._lanes = rows",
+            1,
+        )
+        fs = lint_tree(
+            tmp_path,
+            {f"{PKG}/obs/saturation.py": broken},
+            rules=(check_thread_shared_state,),
+        )
+        assert "NM331" in rules_of(fs)
+
+    def test_real_saturation_monitor_is_clean(self, tmp_path):
+        src = (REPO / PKG / "obs" / "saturation.py").read_text()
+        fs = lint_tree(
+            tmp_path,
+            {f"{PKG}/obs/saturation.py": src},
             rules=(check_thread_shared_state,),
         )
         assert rules_of(fs) == [], [f.render() for f in fs]
@@ -1036,6 +1069,153 @@ class TestCacheKey:
         )
         assert rules_of(fs) == ["NM381"]
         assert "donate" in fs[0].message
+
+
+class TestMetricsDocs:
+    """NM392 (ISSUE 10): metrics↔docs drift — every metric-name constant
+    in serving/metrics.py / obs/metrics.py has a docs/OBSERVABILITY.md
+    table row and vice versa."""
+
+    DOC = """
+    # Observability
+    | name | type | labels | meaning |
+    |---|---|---|---|
+    | `serving_foo_total` | counter | — | foos served |
+    """
+
+    def test_undocumented_constant_flagged_at_declaration(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/serving/metrics.py": """
+                SERVING_FOO_TOTAL = "serving_foo_total"
+                SERVING_BAR = "serving_bar_ratio"
+                """,
+                "docs/OBSERVABILITY.md": self.DOC,
+            },
+            rules=(check_metrics_docs,),
+        )
+        assert rules_of(fs) == ["NM392"]
+        assert "serving_bar_ratio" in fs[0].message
+        assert fs[0].path.endswith("serving/metrics.py")
+
+    def test_stale_docs_row_flagged_at_docs_line(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/serving/metrics.py": (
+                    'SERVING_FOO_TOTAL = "serving_foo_total"\n'
+                ),
+                "docs/OBSERVABILITY.md": self.DOC + (
+                    "    | `serving_gone_total` | counter | — | removed |\n"
+                ),
+            },
+            rules=(check_metrics_docs,),
+        )
+        assert rules_of(fs) == ["NM392"]
+        assert "serving_gone_total" in fs[0].message
+        assert fs[0].path == "docs/OBSERVABILITY.md"
+
+    def test_full_agreement_clean(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/serving/metrics.py": (
+                    'SERVING_FOO_TOTAL = "serving_foo_total"\n'
+                ),
+                f"{PKG}/obs/metrics.py": 'OBS_GAUGE = "obs_gauge"\n',
+                "docs/OBSERVABILITY.md": self.DOC + (
+                    "    | `obs_gauge` | gauge | — | a gauge |\n"
+                ),
+            },
+            rules=(check_metrics_docs,),
+        )
+        assert rules_of(fs) == [], [f.render() for f in fs]
+
+    def test_non_metric_constants_excluded(self, tmp_path):
+        # schema ids (dots), lowercase names, non-strings and re-exports
+        # are not metric names — none may demand a docs row
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/serving/metrics.py": """
+                from os.path import sep as SEP_REEXPORT  # not an Assign
+                SCHEMA_X = "nm03.metrics.v1"
+                BUCKETS = (1.0, 2.0)
+                _PRIVATE = "serving_hidden_total"
+                lower_case = "serving_also_hidden"
+                SERVING_FOO_TOTAL = "serving_foo_total"
+                """,
+                "docs/OBSERVABILITY.md": self.DOC,
+            },
+            rules=(check_metrics_docs,),
+        )
+        assert rules_of(fs) == [], [f.render() for f in fs]
+
+    def test_missing_docs_file_is_a_finding(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/serving/metrics.py": (
+                    'SERVING_FOO_TOTAL = "serving_foo_total"\n'
+                )
+            },
+            rules=(check_metrics_docs,),
+        )
+        assert rules_of(fs) == ["NM392"]
+        assert "no docs/OBSERVABILITY.md" in fs[0].message
+
+    def test_other_metrics_modules_out_of_scope(self, tmp_path):
+        # only serving/metrics.py and obs/metrics.py own names; a
+        # data/metrics.py is not bound to the contract
+        fs = lint_tree(
+            tmp_path,
+            {f"{PKG}/data/metrics.py": 'X = "data_things_total"\n'},
+            rules=(check_metrics_docs,),
+        )
+        assert rules_of(fs) == []
+
+    def test_real_tree_clean_and_break_drill(self, tmp_path):
+        """Acceptance: the REAL name modules agree with the REAL docs at
+        zero findings, and deleting one docs row (or adding one
+        undocumented constant) fails — the gate is wired to the actual
+        contract, not a fixture echo."""
+        serving_src = (REPO / PKG / "serving" / "metrics.py").read_text()
+        obs_src = (REPO / PKG / "obs" / "metrics.py").read_text()
+        doc_src = (REPO / "docs" / "OBSERVABILITY.md").read_text()
+        tree = {
+            f"{PKG}/serving/metrics.py": serving_src,
+            f"{PKG}/obs/metrics.py": obs_src,
+            "docs/OBSERVABILITY.md": doc_src,
+        }
+        fs = lint_tree(tmp_path, tree, rules=(check_metrics_docs,))
+        assert rules_of(fs) == [], [f.render() for f in fs]
+        # drill 1: drop the serving_mfu docs row -> undocumented constant
+        row = next(
+            line for line in doc_src.splitlines()
+            if line.startswith("| `serving_mfu` |")
+        )
+        (tmp_path / "drill1").mkdir()
+        fs = lint_tree(
+            tmp_path / "drill1",
+            {**tree, "docs/OBSERVABILITY.md": doc_src.replace(row, "", 1)},
+            rules=(check_metrics_docs,),
+        )
+        assert rules_of(fs) == ["NM392"]
+        assert "serving_mfu" in fs[0].message
+        # drill 2: a brand-new constant with no docs row
+        (tmp_path / "drill2").mkdir()
+        fs = lint_tree(
+            tmp_path / "drill2",
+            {
+                **tree,
+                f"{PKG}/serving/metrics.py": serving_src
+                + '\nSERVING_NEW_THING = "serving_new_thing_total"\n',
+            },
+            rules=(check_metrics_docs,),
+        )
+        assert rules_of(fs) == ["NM392"]
+        assert "serving_new_thing_total" in fs[0].message
 
 
 class TestBaseline:

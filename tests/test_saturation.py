@@ -1,0 +1,818 @@
+"""Saturation & goodput telemetry tests (ISSUE 10).
+
+Layers, mirroring the serving test files' structure:
+
+* jax-free units: the sliding-window ring (eviction, bounds, injected
+  monotonic clock), idle gaps, MFU from a pinned fake cost table, the
+  peak-flops table, and the PhaseAccountant's interval algebra;
+* padding/occupancy math against a lane-aware fake batcher (no jax);
+* the ``--expect-gauge-range`` red/green battery (subprocess, like the
+  other check_telemetry hooks);
+* the jax-compilation-cache sidecar wiring;
+* driver feed_stall reports (both batch drivers, in-process) and bench's
+  checksum-gated ``feed_stall`` record;
+* ``nm03-top --once --format json`` against an in-process server;
+* the acceptance subprocess drill: ``nm03-serve --lanes 4`` under a real
+  ``nm03-loadgen`` run — every lane's busy fraction > 0, padding ratio
+  in [0, 1), MFU > 0, gated by labeled ``--expect-gauge-range``
+  expectations, with ``nm03-top --once`` rendering the same numbers from
+  the live server.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from nm03_capstone_project_tpu.obs.metrics import MetricsRegistry
+from nm03_capstone_project_tpu.obs.saturation import (
+    CPU_PEAK_FLOPS_ESTIMATE,
+    PhaseAccountant,
+    SaturationMonitor,
+    peak_flops_for,
+)
+from nm03_capstone_project_tpu.serving.batcher import DynamicBatcher
+from nm03_capstone_project_tpu.serving.queue import AdmissionQueue, ServeRequest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECKER = os.path.join(REPO, "scripts", "check_telemetry.py")
+CANVAS = 128
+
+
+class FakeClock:
+    """Injected monotonic clock for deterministic window math."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+# -- sliding-window units ----------------------------------------------------
+
+
+class TestSaturationWindow:
+    def test_busy_fraction_over_window(self):
+        clk = FakeClock()
+        mon = SaturationMonitor(window_s=10.0, clock=clk)
+        mon.set_lanes([("cpu", "cpu")])
+        t0 = clk.t
+        mon.record_dispatch(0, t0, t0 + 2.0)
+        clk.advance(4.0)
+        snap = mon.snapshot()
+        # window start clamps to the epoch: 2 busy seconds over 4 elapsed
+        assert snap["lanes"][0]["busy_fraction"] == pytest.approx(0.5)
+        assert snap["busy_fraction"] == pytest.approx(0.5)
+
+    def test_overlapping_intervals_union_not_sum(self):
+        clk = FakeClock()
+        mon = SaturationMonitor(window_s=10.0, clock=clk)
+        mon.set_lanes([("cpu", "cpu")])
+        t0 = clk.t
+        # two overlapping dispatches (a requeue landing on a busy lane)
+        mon.record_dispatch(0, t0, t0 + 2.0)
+        mon.record_dispatch(0, t0 + 1.0, t0 + 3.0)
+        clk.advance(4.0)
+        # union is 3 s, not 4 — a fraction > 1 would be nonsense
+        assert mon.snapshot()["lanes"][0]["busy_fraction"] == pytest.approx(
+            0.75
+        )
+
+    def test_eviction_slides_old_busy_out(self):
+        clk = FakeClock()
+        mon = SaturationMonitor(window_s=5.0, clock=clk)
+        mon.set_lanes([("cpu", "cpu")])
+        mon.record_dispatch(0, clk.t, clk.t + 1.0)
+        clk.advance(100.0)  # far past the window
+        snap = mon.snapshot()
+        assert snap["lanes"][0]["busy_fraction"] == 0.0
+        # the ring itself was evicted, not just clipped to zero weight
+        assert len(mon._dispatches[0]) == 0
+
+    def test_ring_is_bounded(self):
+        clk = FakeClock()
+        mon = SaturationMonitor(window_s=1e9, max_entries=8, clock=clk)
+        mon.set_lanes([("cpu", "cpu")])
+        for i in range(100):
+            mon.record_dispatch(0, clk.t + i, clk.t + i + 0.5)
+        assert len(mon._dispatches[0]) == 8
+
+    def test_idle_gap_histogram(self):
+        clk = FakeClock()
+        reg = MetricsRegistry()
+        mon = SaturationMonitor(registry=reg, window_s=60.0, clock=clk)
+        mon.set_lanes([("cpu", "cpu")])
+        t0 = clk.t
+        mon.record_dispatch(0, t0, t0 + 1.0)
+        mon.record_dispatch(0, t0 + 3.0, t0 + 4.0)  # 2 s gap
+        h = reg.get("serving_lane_idle_gap_seconds", lane="0")
+        assert h is not None and h.count == 1
+        assert h.sum == pytest.approx(2.0)
+
+    def test_lane_gauges_exist_at_zero_from_resolution(self):
+        reg = MetricsRegistry()
+        mon = SaturationMonitor(registry=reg)
+        mon.set_lanes([("cpu", ""), ("cpu", "")])
+        for lane in ("0", "1"):
+            g = reg.get("serving_lane_busy_fraction", lane=lane)
+            assert g is not None and g.value == 0.0
+
+    def test_mfu_from_pinned_fake_cost_table(self):
+        clk = FakeClock()
+        mon = SaturationMonitor(window_s=10.0, clock=clk)
+        # fake platform with a real peak via cpu; pin flops per dispatch
+        mon.set_lanes([("cpu", "cpu"), ("cpu", "cpu")])
+        mon.set_lane_bucket_flops(0, 4, 1e9)
+        mon.set_lane_bucket_flops(1, 4, 1e9)
+        t0 = clk.t
+        # 4 dispatches on lane 0, 1 on lane 1, over 2 s of window
+        for i in range(4):
+            mon.record_dispatch(0, t0 + i * 0.1, t0 + i * 0.1 + 0.05, bucket=4)
+        mon.record_dispatch(1, t0, t0 + 0.05, bucket=4)
+        clk.advance(2.0)
+        snap = mon.snapshot()
+        span = 2.0
+        want0 = (4e9 / span) / CPU_PEAK_FLOPS_ESTIMATE
+        want1 = (1e9 / span) / CPU_PEAK_FLOPS_ESTIMATE
+        assert snap["lanes"][0]["mfu"] == pytest.approx(want0, rel=1e-3)
+        assert snap["lanes"][1]["mfu"] == pytest.approx(want1, rel=1e-3)
+        # process-wide: total flops over total fleet peak
+        want = (5e9 / span) / (2 * CPU_PEAK_FLOPS_ESTIMATE)
+        assert snap["mfu"] == pytest.approx(want, rel=1e-3)
+
+    def test_failed_dispatch_is_busy_but_earns_no_flops(self):
+        clk = FakeClock()
+        mon = SaturationMonitor(window_s=10.0, clock=clk)
+        mon.set_lanes([("cpu", "cpu")])
+        mon.set_lane_bucket_flops(0, 2, 1e9)
+        mon.record_dispatch(0, clk.t, clk.t + 1.0, bucket=2, counted=False)
+        clk.advance(2.0)
+        snap = mon.snapshot()
+        assert snap["lanes"][0]["busy_fraction"] == pytest.approx(0.5)
+        assert snap["lanes"][0]["mfu"] == 0.0
+
+    def test_unknown_platform_has_no_mfu(self):
+        clk = FakeClock()
+        mon = SaturationMonitor(clock=clk)
+        mon.set_lanes([("gpu", "NVIDIA H100")])
+        mon.record_dispatch(0, clk.t, clk.t + 1.0, bucket=2)
+        clk.advance(2.0)
+        snap = mon.snapshot()
+        assert snap["lanes"][0]["mfu"] is None
+        assert snap["mfu"] is None
+
+    def test_peak_table(self):
+        assert peak_flops_for("cpu") == CPU_PEAK_FLOPS_ESTIMATE
+        assert peak_flops_for("tpu", "TPU v4") == 275e12
+        assert peak_flops_for("tpu", "TPU v5 lite") == 197e12
+        # unknown TPU kind falls back conservatively, never None
+        assert peak_flops_for("tpu", "TPU v99") == 45e12
+        assert peak_flops_for("gpu", "H100") is None
+
+    def test_publish_sets_gauges(self):
+        clk = FakeClock()
+        reg = MetricsRegistry()
+        mon = SaturationMonitor(registry=reg, window_s=10.0, clock=clk)
+        mon.set_lanes([("cpu", "cpu")])
+        mon.set_lane_bucket_flops(0, 1, 1e9)
+        mon.record_dispatch(0, clk.t, clk.t + 1.0, bucket=1)
+        mon.record_chunk(3, 4)
+        mon.record_window(3, 8)
+        clk.advance(2.0)
+        mon.publish()
+        assert reg.get(
+            "serving_lane_busy_fraction", lane="0"
+        ).value == pytest.approx(0.5)
+        assert reg.get("serving_padding_waste_ratio").value == pytest.approx(
+            0.25
+        )
+        assert reg.get(
+            "serving_window_occupancy_ratio"
+        ).value == pytest.approx(3 / 8)
+        assert reg.get("serving_mfu").value > 0
+        assert reg.get("serving_batch_rows_total", kind="real").value == 3
+        assert reg.get("serving_batch_rows_total", kind="padded").value == 1
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SaturationMonitor(window_s=0)
+        with pytest.raises(ValueError):
+            SaturationMonitor(max_entries=0)
+
+
+# -- PhaseAccountant units ---------------------------------------------------
+
+
+class TestPhaseAccountant:
+    def test_disjoint_and_overlapping_merge(self):
+        pa = PhaseAccountant()
+        pa.record("dispatch", 10.0, 12.0)
+        pa.record("dispatch", 11.0, 13.0)  # overlaps -> union 3
+        pa.record("dispatch", 20.0, 21.0)
+        assert pa.busy_seconds("dispatch") == pytest.approx(4.0)
+
+    def test_out_of_order_threads(self):
+        pa = PhaseAccountant()
+        pa.record("decode", 20.0, 21.0)
+        pa.record("decode", 10.0, 11.0)  # arrives late (another thread)
+        pa.record("decode", 10.5, 20.5)  # bridges both
+        assert pa.busy_seconds("decode") == pytest.approx(11.0)
+
+    def test_stall_ratio_and_report(self):
+        pa = PhaseAccountant()
+        pa.record("decode", 0.0, 2.0)
+        pa.record("dispatch", 2.0, 8.0)
+        pa.record("export", 8.0, 10.0)
+        rep = pa.report()
+        assert rep["wall_s"] == pytest.approx(10.0)
+        assert rep["busy_s"]["dispatch"] == pytest.approx(6.0)
+        assert rep["feed_stall_ratio"] == pytest.approx(0.4)
+        assert rep["stall_s"] == pytest.approx(4.0)
+        assert rep["busy_fraction"]["decode"] == pytest.approx(0.2)
+
+    def test_no_dispatch_means_null_stall(self):
+        pa = PhaseAccountant()
+        pa.record("decode", 0.0, 1.0)
+        rep = pa.report()
+        assert rep["feed_stall_ratio"] is None
+        assert rep["stall_s"] is None
+
+    def test_busy_context_uses_injected_clock(self):
+        clk = FakeClock()
+        pa = PhaseAccountant(clock=clk)
+        with pa.busy("fetch"):
+            clk.advance(1.5)
+        assert pa.busy_seconds("fetch") == pytest.approx(1.5)
+
+    def test_bounded_collapse_keeps_exact_totals(self):
+        pa = PhaseAccountant(max_intervals=8)
+        # 100 disjoint 0.5 s intervals: far past the cap
+        for i in range(100):
+            pa.record("dispatch", float(i), i + 0.5)
+        assert len(pa._runs["dispatch"]) <= 8
+        assert pa.busy_seconds("dispatch") == pytest.approx(50.0)
+        rep = pa.report()
+        assert rep["wall_s"] == pytest.approx(99.5)
+        assert rep["feed_stall_ratio"] == pytest.approx(
+            1 - 50.0 / 99.5, abs=1e-3
+        )
+
+    def test_late_interval_never_double_counts_collapsed_time(self):
+        # a slow worker's interval arriving AFTER its time range was
+        # collapsed into the closed sum must not count that range twice
+        pa = PhaseAccountant(max_intervals=8)
+        for i in range(20):  # trips the collapse; [0, 9.5) mostly closed
+            pa.record("export", float(i), i + 0.5)
+        before = pa.busy_seconds("export")
+        pa.record("export", 0.0, 2.0)  # overlaps the collapsed prefix
+        # the clamp forfeits the pre-horizon part; busy may only grow by
+        # genuinely-new post-horizon time, never by re-counting [0, 2)
+        assert pa.busy_seconds("export") <= before + 2.0 - 1.0
+        assert pa.busy_seconds("export") <= 20 * 0.5 + 1.5
+
+    def test_busy_records_on_raise(self):
+        clk = FakeClock()
+        pa = PhaseAccountant(clock=clk)
+        with pytest.raises(RuntimeError):
+            with pa.busy("decode"):
+                clk.advance(1.0)
+                raise RuntimeError("decoder died")
+        assert pa.busy_seconds("decode") == pytest.approx(1.0)
+
+
+# -- batcher goodput math against a lane-aware fake --------------------------
+
+
+class FakeSaturatedExecutor:
+    """Lane-aware executor stand-in carrying a real SaturationMonitor."""
+
+    supports_trace = False
+
+    def __init__(self, buckets=(1, 2, 4), lanes=4, canvas=16, min_dim=4,
+                 clock=None):
+        self.cfg = SimpleNamespace(canvas=canvas, min_dim=min_dim)
+        self.buckets = tuple(buckets)
+        self.lane_count = lanes
+        self.registry = MetricsRegistry()
+        self.saturation = SaturationMonitor(
+            registry=self.registry, clock=clock or time.monotonic
+        )
+        self.saturation.set_lanes([("cpu", "cpu")] * lanes)
+        self.calls = []
+        self._lock = threading.Lock()
+
+    @property
+    def max_batch(self):
+        return self.buckets[-1]
+
+    def bucket_for(self, n):
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(n)
+
+    def run_batch(self, pixels, dims, lane=0):
+        with self._lock:
+            self.calls.append((pixels.shape[0], lane))
+        mask = (pixels > 0).astype(np.uint8)
+        return mask, np.ones(pixels.shape[0], bool)
+
+
+def _reqs(n, hw=16):
+    return [
+        ServeRequest(
+            request_id=f"r{i}",
+            pixels=np.ones((hw, hw), np.float32),
+            dims=(hw, hw),
+        )
+        for i in range(n)
+    ]
+
+
+class TestBatcherGoodput:
+    def test_padding_and_occupancy_accounting(self):
+        # no bucket-1: the 1-rider tail chunk MUST pad into bucket 2
+        ex = FakeSaturatedExecutor(buckets=(2, 4), lanes=4)
+        b = DynamicBatcher(AdmissionQueue(32), ex, max_wait_s=0.0)
+        # 7 riders over 4 lanes: per = ceil(7/4)=2 -> chunks 2,2,2,1 — the
+        # last chunk pads 1 dead row into bucket 2
+        b.execute(_reqs(7))
+        snap = ex.saturation.snapshot()
+        assert snap["rows"] == {"real": 7, "padded": 1}
+        assert snap["padding_waste_ratio"] == pytest.approx(1 / 8)
+        # one window of 7 riders against 4 lanes x bucket 4 = 16 capacity
+        assert snap["window_occupancy_ratio"] == pytest.approx(7 / 16)
+        # counters + fill histogram landed in the registry
+        assert ex.registry.get(
+            "serving_batch_rows_total", kind="real"
+        ).value == 7
+        fill = ex.registry.get("serving_bucket_fill_ratio", bucket="2")
+        assert fill is not None and fill.count == 4
+        # three full buckets (1.0) + one half-full (0.5)
+        assert fill.sum == pytest.approx(3.5)
+
+    def test_full_windows_have_zero_waste(self):
+        ex = FakeSaturatedExecutor(buckets=(1, 2, 4), lanes=2)
+        b = DynamicBatcher(AdmissionQueue(32), ex, max_wait_s=0.0)
+        b.execute(_reqs(8))  # 2 lanes x bucket 4, exactly
+        snap = ex.saturation.snapshot()
+        assert snap["rows"] == {"real": 8, "padded": 0}
+        assert snap["padding_waste_ratio"] == 0.0
+        assert snap["window_occupancy_ratio"] == pytest.approx(1.0)
+
+    def test_lane_unaware_fake_records_nothing(self):
+        # executors without a .saturation attr (the historical fakes) keep
+        # working: the batcher's accounting is strictly opt-in
+        class Bare:
+            def __init__(self):
+                self.cfg = SimpleNamespace(canvas=16, min_dim=4)
+                self.buckets = (4,)
+                self.max_batch = 4
+
+            def bucket_for(self, n):
+                return 4
+
+            def run_batch(self, pixels, dims):
+                return (pixels > 0).astype(np.uint8), np.ones(
+                    pixels.shape[0], bool
+                )
+
+        b = DynamicBatcher(AdmissionQueue(8), Bare(), max_wait_s=0.0)
+        b.execute(_reqs(3))  # must simply not raise
+
+
+# -- the jax-compilation-cache sidecar ---------------------------------------
+
+
+class TestJaxCacheSidecar:
+    def test_attach_wires_jax_cache_and_stats(self, tmp_path, monkeypatch):
+        import jax
+
+        from nm03_capstone_project_tpu.compilehub import (
+            ExecutableCache,
+            get_hub,
+            hub_jit,
+        )
+        from nm03_capstone_project_tpu.compilehub import persist
+
+        monkeypatch.delenv(persist.ENV_JAX_CACHE_OPT_OUT, raising=False)
+        prev_dir = jax.config.jax_compilation_cache_dir
+        hub = get_hub()
+        prev_cache = hub.persistent_cache()
+        try:
+            hub.attach_cache(ExecutableCache(str(tmp_path)))
+            want = str(tmp_path / persist.JAX_CACHE_SUBDIR)
+            assert jax.config.jax_compilation_cache_dir == want
+            # a deferred-trace compile now writes jax cache entries
+            import jax.numpy as jnp
+
+            f = hub_jit(lambda x: (x * 3).sum())
+            float(f(jnp.ones((32, 32))))
+            st = hub.stats()
+            assert st["jax_cache_dir"] == want
+            assert st["jax_cache_entries"] >= 1
+            assert st["jax_cache_bytes"] > 0
+            # the honesty split survives: no executable-cache hits were
+            # invented by the sidecar
+            assert st["cache_hits"] == 0
+        finally:
+            hub.attach_cache(prev_cache)
+            with contextlib.suppress(Exception):
+                jax.config.update("jax_compilation_cache_dir", prev_dir)
+
+    def test_opt_out_env(self, tmp_path, monkeypatch):
+        from nm03_capstone_project_tpu.compilehub import persist
+
+        monkeypatch.setenv(persist.ENV_JAX_CACHE_OPT_OUT, "0")
+        assert persist.attach_jax_compilation_cache(tmp_path) is None
+
+    def test_private_hub_never_repoints_process_config(self, tmp_path):
+        import jax
+
+        from nm03_capstone_project_tpu.compilehub import ExecutableCache
+        from nm03_capstone_project_tpu.compilehub.hub import CompileHub
+
+        prev = jax.config.jax_compilation_cache_dir
+        hub = CompileHub()  # NOT the process hub
+        hub.attach_cache(ExecutableCache(str(tmp_path)))
+        assert jax.config.jax_compilation_cache_dir == prev
+        assert "jax_cache_dir" not in hub.stats()
+
+
+# -- driver feed_stall reports -----------------------------------------------
+
+
+class TestDriverFeedStall:
+    @pytest.mark.parametrize("mode", ["sequential", "parallel"])
+    def test_both_drivers_emit_feed_stall(self, tmp_path, mode):
+        from nm03_capstone_project_tpu.cli import parallel, sequential
+
+        mod = sequential if mode == "sequential" else parallel
+        rj = tmp_path / "r.json"
+        ej = tmp_path / "e.jsonl"
+        rc = mod.main(
+            [
+                "--synthetic", "1", "--synthetic-slices", "3",
+                "--device", "cpu", "--canvas", str(CANVAS),
+                "--output", str(tmp_path / "out"),
+                "--results-json", str(rj), "--log-json", str(ej),
+            ]
+        )
+        assert rc == 0
+        rec = json.loads(rj.read_text())
+        fs = rec["feed_stall"]
+        assert fs["wall_s"] > 0
+        assert 0.0 <= fs["feed_stall_ratio"] < 1.0
+        assert set(fs["busy_s"]) >= {"decode", "dispatch"}
+        # the gauge twin landed in the embedded snapshot
+        names = {m["name"]: m for m in rec["metrics"]["metrics"]}
+        assert names["pipeline_feed_stall_ratio"]["value"] == pytest.approx(
+            fs["feed_stall_ratio"]
+        )
+        # and the event rode the stream
+        events = [
+            json.loads(line) for line in ej.read_text().splitlines() if line
+        ]
+        feed_events = [e for e in events if e["event"] == "feed_stall"]
+        assert len(feed_events) == 1
+        assert feed_events[0]["mode"] == mode
+
+
+class TestBenchFeedStall:
+    def test_record_is_checksum_gated_and_carried(self, monkeypatch):
+        import bench
+
+        monkeypatch.setattr(bench, "CANVAS", 96)
+        rec = bench._feed_stall_record(batch=2, reps=3)
+        assert rec["checksum_ok"] is True
+        assert 0.0 <= rec["feed_stall_ratio"] <= 1.0
+        assert rec["busy_s"]["dispatch"] > 0
+        # rides _compose via _copy_optional -> the slim line
+        out = {}
+        bench._copy_optional(out, {"feed_stall": rec})
+        assert out["feed_stall"] is rec
+
+    def test_mismatched_checksum_nulls_the_headline(self, monkeypatch):
+        # force the fed batches to differ from the reference batch: the
+        # gate must null the ratio rather than report a number measured
+        # on wrong masks (same contract as the Pallas/cold-start legs)
+        import bench
+
+        monkeypatch.setattr(bench, "CANVAS", 96)
+        real_make = bench._make_batch
+        calls = {"n": 0}
+
+        def skewed(batch=None):
+            pixels, dims = real_make(batch)
+            calls["n"] += 1
+            if calls["n"] > 1:  # the ref batch is the first call
+                pixels = np.zeros_like(pixels)
+            return pixels, dims
+
+        monkeypatch.setattr(bench, "_make_batch", skewed)
+        rec = bench._feed_stall_record(batch=2, reps=2)
+        assert rec["checksum_ok"] is False
+        assert rec["feed_stall_ratio"] is None
+        assert rec["stall_s"] is None
+        # the evidence fields stay: an operator can still see the phases
+        assert rec["busy_s"]["dispatch"] > 0
+
+
+# -- nm03-top ----------------------------------------------------------------
+
+
+class TestTopCli:
+    def test_once_json_against_inprocess_server(self):
+        from nm03_capstone_project_tpu.data.synthetic import phantom_slice
+        from nm03_capstone_project_tpu.serving import top
+        from nm03_capstone_project_tpu.serving.server import (
+            ServingApp,
+            serve_in_thread,
+        )
+
+        app = ServingApp(
+            queue_capacity=16, buckets=(1, 2), max_wait_s=0.005, lanes=1
+        )
+        httpd = None
+        try:
+            httpd, _t, port = serve_in_thread(app)
+            url = f"http://127.0.0.1:{port}"
+            img = phantom_slice(CANVAS, CANVAS, seed=1).astype(np.float32)
+            for _ in range(3):
+                app.segment(img, render=False)
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                rc = top.main(["--url", url, "--once", "--format", "json"])
+            assert rc == 0
+            view = json.loads(buf.getvalue())
+            assert view["schema"] == "nm03.top.v1"
+            assert view["ready"] is True
+            assert len(view["lanes"]) == 1
+            lane = view["lanes"][0]
+            assert lane["state"] == "healthy"
+            assert lane["busy_fraction"] > 0
+            assert lane["batches"] >= 1
+            assert view["mfu"] is not None and view["mfu"] > 0
+            assert 0.0 <= view["padding_waste_ratio"] < 1.0
+            # one sample has no delta: rates are honest nulls
+            assert view["rates_per_s"]["requests"] is None
+            # the text renderer draws the same view without raising
+            text = top.render_text(view, url)
+            assert "lane" in text and "busy" in text
+        finally:
+            if httpd is not None:
+                httpd.shutdown()
+                httpd.server_close()
+            app.begin_drain(reason="test")
+            app.close()
+
+    def test_unreachable_server_exits_2(self):
+        from nm03_capstone_project_tpu.serving import top
+
+        with contextlib.redirect_stderr(io.StringIO()):
+            rc = top.main(
+                ["--url", "http://127.0.0.1:9", "--once", "--timeout-s", "1"]
+            )
+        assert rc == 2
+
+
+# -- --expect-gauge-range battery --------------------------------------------
+
+
+class TestExpectGaugeRange:
+    def _snap(self, tmp_path, metrics):
+        path = tmp_path / "m.json"
+        path.write_text(
+            json.dumps(
+                {
+                    "schema": "nm03.metrics.v1",
+                    "run_id": "r",
+                    "git_sha": "g",
+                    "created_unix": 1.0,
+                    "metrics": metrics,
+                }
+            )
+        )
+        return str(path)
+
+    def _run(self, path, *flags):
+        return subprocess.run(
+            [sys.executable, CHECKER, "--metrics", path, *flags],
+            capture_output=True, text=True, timeout=60,
+        )
+
+    def test_green_open_and_closed_bounds(self, tmp_path):
+        path = self._snap(
+            tmp_path,
+            [
+                {"name": "serving_lane_busy_fraction", "type": "gauge",
+                 "labels": {"lane": "0"}, "value": 0.3},
+                {"name": "serving_lane_busy_fraction", "type": "gauge",
+                 "labels": {"lane": "1"}, "value": 1.0},
+                {"name": "serving_padding_waste_ratio", "type": "gauge",
+                 "labels": {}, "value": 0.0},
+            ],
+        )
+        res = self._run(
+            path,
+            "--expect-gauge-range", "serving_lane_busy_fraction=(0..1]",
+            "--expect-gauge-range", "serving_padding_waste_ratio=[0..1)",
+        )
+        assert res.returncode == 0, res.stderr
+
+    def test_every_series_checked_individually(self, tmp_path):
+        # one idle lane fails the every-lane form — values are NOT summed
+        path = self._snap(
+            tmp_path,
+            [
+                {"name": "serving_lane_busy_fraction", "type": "gauge",
+                 "labels": {"lane": "0"}, "value": 0.9},
+                {"name": "serving_lane_busy_fraction", "type": "gauge",
+                 "labels": {"lane": "1"}, "value": 0.0},
+            ],
+        )
+        res = self._run(
+            path, "--expect-gauge-range", "serving_lane_busy_fraction=(0..1]"
+        )
+        assert res.returncode == 1
+        assert "lane" in res.stderr and "(0..1]" in res.stderr
+
+    def test_open_bound_excludes_endpoint(self, tmp_path):
+        path = self._snap(
+            tmp_path,
+            [{"name": "serving_padding_waste_ratio", "type": "gauge",
+              "labels": {}, "value": 1.0}],
+        )
+        res = self._run(
+            path, "--expect-gauge-range", "serving_padding_waste_ratio=[0..1)"
+        )
+        assert res.returncode == 1
+
+    def test_labeled_selector_composes(self, tmp_path):
+        path = self._snap(
+            tmp_path,
+            [
+                {"name": "serving_lane_busy_fraction", "type": "gauge",
+                 "labels": {"lane": "0"}, "value": 0.0},
+                {"name": "serving_lane_busy_fraction", "type": "gauge",
+                 "labels": {"lane": "2"}, "value": 0.5},
+            ],
+        )
+        res = self._run(
+            path,
+            "--expect-gauge-range",
+            "serving_lane_busy_fraction{lane=2}=(0..1]",
+        )
+        assert res.returncode == 0, res.stderr
+
+    def test_absent_and_unmatched_are_drift(self, tmp_path):
+        path = self._snap(
+            tmp_path,
+            [{"name": "serving_mfu", "type": "gauge", "labels": {},
+              "value": 0.1}],
+        )
+        assert self._run(
+            path, "--expect-gauge-range", "serving_nope=[0..1]"
+        ).returncode == 1
+        assert self._run(
+            path, "--expect-gauge-range", "serving_mfu{lane=3}=[0..1]"
+        ).returncode == 1
+
+    def test_wrong_kind_is_drift(self, tmp_path):
+        path = self._snap(
+            tmp_path,
+            [{"name": "serving_shed_total", "type": "counter", "labels": {},
+              "value": 3}],
+        )
+        res = self._run(
+            path, "--expect-gauge-range", "serving_shed_total=[0..10]"
+        )
+        assert res.returncode == 1
+        assert "not a gauge" in res.stderr
+
+    def test_malformed_range_is_usage_error(self, tmp_path):
+        path = self._snap(tmp_path, [])
+        res = self._run(path, "--expect-gauge-range", "serving_mfu=low..high")
+        assert res.returncode == 2
+
+
+# -- the acceptance drill ----------------------------------------------------
+
+
+class TestSaturationAcceptance:
+    def test_four_lane_drill_with_loadgen_and_top(self, tmp_path):
+        """The ISSUE 10 acceptance bar: ``nm03-serve --lanes 4`` under a
+        32-request loadgen reports per-lane busy fractions, padding waste
+        and MFU, gated by labeled ``--expect-gauge-range`` expectations
+        (every lane busy > 0, padding in [0, 1), MFU > 0), with
+        ``nm03-top --once`` rendering the same numbers live and
+        ``nm03-loadgen`` printing the server-side efficiency columns.
+        """
+        port_file = tmp_path / "port"
+        metrics = tmp_path / "metrics.json"
+        results = tmp_path / "loadgen.json"
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        )
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m",
+                "nm03_capstone_project_tpu.serving.server",
+                "--device", "cpu", "--port", "0",
+                "--port-file", str(port_file),
+                "--canvas", str(CANVAS), "--buckets", "1,2", "--lanes", "4",
+                "--max-wait-ms", "60", "--heartbeat-s", "0",
+                "--metrics-out", str(metrics),
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO,
+        )
+        try:
+            deadline = time.monotonic() + 300
+            while not port_file.exists() and time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    pytest.fail(f"server died: {proc.stdout.read()}")
+                time.sleep(0.2)
+            assert port_file.exists(), "server never became ready"
+            base = f"http://127.0.0.1:{int(port_file.read_text())}"
+            lg = subprocess.run(
+                [
+                    sys.executable, "-m",
+                    "nm03_capstone_project_tpu.serving.loadgen",
+                    "--url", base, "--requests", "32", "--concurrency", "16",
+                    "--mode", "mask", "--height", str(CANVAS),
+                    "--width", str(CANVAS), "--warmup", "4",
+                    "--results-json", str(results),
+                ],
+                capture_output=True, text=True, timeout=300, cwd=REPO,
+            )
+            assert lg.returncode == 0, lg.stdout + lg.stderr
+            summary = json.loads(results.read_text())
+            assert summary["requests_ok"] == 32
+            # the efficiency join: utilization/padding/MFU polled through
+            # the run and printed next to the capacity columns
+            assert summary["busy_fraction_min_observed"] is not None
+            assert summary["busy_fraction_min_observed"] > 0
+            assert 0.0 <= summary["padding_waste_max_observed"] < 1.0
+            assert summary["mfu_max_observed"] > 0
+            assert "busy_min=" in lg.stdout and "padding_max=" in lg.stdout
+            # nm03-top renders the same numbers from the live server
+            tp = subprocess.run(
+                [
+                    sys.executable, "-m",
+                    "nm03_capstone_project_tpu.serving.top",
+                    "--url", base, "--once", "--format", "json",
+                ],
+                capture_output=True, text=True, timeout=60, cwd=REPO,
+            )
+            assert tp.returncode == 0, tp.stdout + tp.stderr
+            view = json.loads(tp.stdout)
+            assert view["ready"] is True and len(view["lanes"]) == 4
+            assert all(
+                row["busy_fraction"] is not None and row["busy_fraction"] > 0
+                for row in view["lanes"]
+            ), view["lanes"]
+            assert view["mfu"] > 0
+            assert 0.0 <= view["padding_waste_ratio"] < 1.0
+            proc.send_signal(signal.SIGTERM)
+            out, _ = proc.communicate(timeout=120)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate(timeout=30)
+        assert proc.returncode == 0, out
+        # the post-drain snapshot passes the labeled range gates: every
+        # lane busy, padding sane, MFU real
+        res = subprocess.run(
+            [
+                sys.executable, CHECKER,
+                "--metrics", str(metrics),
+                "--expect-gauge", "serving_lanes_ready=4",
+                "--expect-gauge-range", "serving_lane_busy_fraction=(0..1]",
+                "--expect-gauge-range", "serving_padding_waste_ratio=[0..1)",
+                "--expect-gauge-range", "serving_mfu=(0..100]",
+                "--expect-gauge-range", "serving_busy_fraction=(0..1]",
+                "--expect-histogram", "serving_bucket_fill_ratio=4",
+            ],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert res.returncode == 0, res.stderr
