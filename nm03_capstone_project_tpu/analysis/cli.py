@@ -43,6 +43,7 @@ from nm03_capstone_project_tpu.analysis.dtypes import check_dtype_discipline
 from nm03_capstone_project_tpu.analysis.hostsync import check_host_sync
 from nm03_capstone_project_tpu.analysis.metricsdocs import check_metrics_docs
 from nm03_capstone_project_tpu.analysis.retrace import check_retrace
+from nm03_capstone_project_tpu.analysis.staginghome import check_staging_home
 from nm03_capstone_project_tpu.analysis.threads import check_thread_shared_state
 
 ALL_RULES = (
@@ -56,6 +57,7 @@ ALL_RULES = (
     check_compile_home,
     check_cache_key,
     check_metrics_docs,
+    check_staging_home,
 )
 
 RULE_CATALOG = {
@@ -73,6 +75,7 @@ RULE_CATALOG = {
     "NM371": "obs-io: flight-recorder/trace module writes without atomic_write_*",
     "NM381": "cache-key: CompileSpec field not consumed by the persist cache key",
     "NM392": "metrics-docs: metric name and docs/OBSERVABILITY.md table drifted",
+    "NM401": "staging-home: device_put referenced outside ingest/",
     "NM390": "meta: suppression without a reason",
     "NM399": "meta: file does not parse",
 }

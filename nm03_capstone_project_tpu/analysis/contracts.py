@@ -54,6 +54,11 @@ CONTRACT_REGISTRY: Dict[str, Tuple[str, ...]] = {
     # numpy for the batcher/server exports
     "nm03_capstone_project_tpu.serving.lanes": ("jax",),
     "nm03_capstone_project_tpu.utils.reporter": ("jax", "numpy"),
+    # the streaming-ingest orchestration layer (ISSUE 11): ring,
+    # pipeline and telemetry must be unit-testable backend-free — jax
+    # enters only through the staging callables at call time (the
+    # device_put sites in ingest/staging.py import jax lazily)
+    "nm03_capstone_project_tpu.ingest": ("jax", "numpy"),
     # the linter itself runs in pre-backend CI processes; the gate gates
     # itself so a convenience import can never make the gate cost a backend
     "nm03_capstone_project_tpu.analysis": ("jax", "numpy"),

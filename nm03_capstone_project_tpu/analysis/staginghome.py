@@ -1,0 +1,109 @@
+"""NM401 — staging-home discipline: host→HBM staging lives in ingest/.
+
+The streaming-ingest subsystem (ISSUE 11) exists because the scattered
+alternative already cost this repo its headline: both batch drivers carried
+their own ``jax.device_put`` staging loops, each serial, each invisible to
+the others, and PR 10's telemetry measured the device starved for a large
+fraction of wall (the pinned ``feed_stall``). A staging call outside
+``ingest/`` is one refactor away from the same regression — and, more
+quietly, from an upload the ingest telemetry cannot see (ring occupancy,
+decode lookahead and the upload-overlap ratio only cover what the pipeline
+stages) and the ``--sanitize`` transfer guard cannot attribute.
+
+The rule mirrors NM361's compile-home contract: any *reference* to jax's
+host→device placement entry points outside the sanctioned homes is a
+finding —
+
+* ``from jax... import device_put`` (any jax module) — the binding itself
+  is the violation; suppressing it sanctions the uses;
+* dotted references — ``jax.device_put``, an aliased ``j.device_put``
+  where ``j`` was imported from jax — in calls, wrappers and
+  ``functools.partial`` arguments alike (AST references, so strings and
+  docstrings never trip it).
+
+Sanctioned homes (no finding):
+
+* ``nm03_capstone_project_tpu/ingest/`` — THE staging home;
+* ``nm03_capstone_project_tpu/compilehub/`` — warmup/AOT staging is the
+  hub's own job (pinning a lane executable's canary inputs is part of
+  compiling for that lane, not batch feeding);
+* ``nm03_capstone_project_tpu/utils/sanitize.py`` — the runtime twin that
+  polices this very hazard documents the sanctioned idiom.
+
+Everything else suppresses with a reason (docs/STATIC_ANALYSIS.md): the
+CPU-degradation fallbacks (committing host arrays to the *fallback*
+device is the escape from the wedged one), one-time model-parameter
+placement (weights are not the data path), and bench's measurement
+harness (the upload IS the thing being measured there).
+
+Rule:
+  NM401  device_put referenced outside ingest/
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence, Set, Tuple
+
+from nm03_capstone_project_tpu.analysis.compilehome import _dotted, _jax_module_aliases
+from nm03_capstone_project_tpu.analysis.core import Finding, SourceFile
+
+_FORBIDDEN = {"device_put", "device_put_sharded", "device_put_replicated"}
+_HOME_PREFIX = "nm03_capstone_project_tpu/ingest/"
+# staging the compile hub / sanitize runtime twin may do themselves
+_SANCTIONED_PREFIXES = (
+    _HOME_PREFIX,
+    "nm03_capstone_project_tpu/compilehub/",
+    "nm03_capstone_project_tpu/utils/sanitize.py",
+)
+
+
+def check_staging_home(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in files:
+        if src.tree is None or src.relpath.startswith(_SANCTIONED_PREFIXES):
+            continue
+        aliases = _jax_module_aliases(src.tree)
+        seen: Set[Tuple[int, str]] = set()
+
+        def emit(line: int, what: str) -> None:
+            if (line, what) in seen:
+                return
+            seen.add((line, what))
+            findings.append(
+                Finding(
+                    rule="NM401",
+                    path=src.relpath,
+                    line=line,
+                    message=(
+                        f"{what} referenced outside ingest/ — host->HBM "
+                        "staging belongs to the streaming-ingest subsystem "
+                        "(use ingest.stage_batch / an IngestPipeline stage "
+                        "callable); CPU-fallback, parameter-placement and "
+                        "bench measurement sites suppress with a reason "
+                        "(docs/STATIC_ANALYSIS.md)"
+                    ),
+                    source_line=src.line_text(line),
+                )
+            )
+
+        for node in ast.walk(src.tree):
+            # the binding: from jax[...] import device_put[_*]
+            if isinstance(node, ast.ImportFrom) and node.module and (
+                node.module == "jax" or node.module.startswith("jax.")
+            ):
+                for a in node.names:
+                    if a.name in _FORBIDDEN:
+                        emit(node.lineno, f"{node.module}.{a.name}")
+            # the reference: <jax-ish>.device_put[_*]
+            elif isinstance(node, ast.Attribute) and node.attr in _FORBIDDEN:
+                base = _dotted(node.value)
+                if base is None:
+                    continue
+                head = base.split(".")[0]
+                resolved = aliases.get(head)
+                if resolved is not None:
+                    base = base.replace(head, resolved, 1)
+                if base == "jax" or base.startswith("jax."):
+                    emit(node.lineno, f"{base}.{node.attr}")
+    return findings

@@ -42,6 +42,10 @@ THREADED_FILES: Tuple[str, ...] = (
     # the saturation monitor's rings are written by executor/batcher/lane
     # threads and read by scrape handlers (ISSUE 10): same discipline
     "nm03_capstone_project_tpu/obs/saturation.py",
+    # the streaming-ingest pipeline (ISSUE 11): feeder/stager/decode-pool
+    # threads share the ring, counters and interval rings with the
+    # consumer — the package is threaded by construction
+    "nm03_capstone_project_tpu/ingest/",
 )
 
 _SYNC_TYPE_NAMES = {

@@ -427,6 +427,33 @@ def add_batch_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--prefetch-depth", type=int, default=d.prefetch_depth)
 
 
+def add_ingest_args(parser: argparse.ArgumentParser) -> None:
+    """The streaming-ingest knobs (ingest/; docs/OPERATIONS.md "Feeding
+    the chip"). Both batch drivers feed the device through the ingest
+    pipeline, so both take these."""
+    d = BatchConfig()
+    g = parser.add_argument_group(
+        "ingest", "host->HBM streaming pipeline (docs/OPERATIONS.md)"
+    )
+    g.add_argument(
+        "--ingest-depth",
+        type=int,
+        default=d.ingest_depth,
+        help="staging-ring capacity: host batches decoded ahead of the "
+        "chip. The backpressure bound — decode blocks when the ring is "
+        "full, so host memory for staged batches is capped at roughly "
+        "(ingest-depth + decode workers + prefetch-depth) batches",
+    )
+    g.add_argument(
+        "--ingest-decode-workers",
+        type=int,
+        default=d.ingest_decode_workers,
+        help="decode pool size for the ingest pipeline (0 = --io-workers). "
+        "The same pool streams result fetch/export back while the next "
+        "batch computes",
+    )
+
+
 def add_distributed_args(parser: argparse.ArgumentParser, extra_help: str = "") -> None:
     """The multi-host job flags (drivers that support --distributed)."""
     d = parser.add_argument_group(

@@ -30,6 +30,7 @@ def build_parser() -> argparse.ArgumentParser:
     common.add_common_args(p)
     common.add_pipeline_args(p)
     common.add_batch_args(p)
+    common.add_ingest_args(p)
     common.add_render_stage_arg(p)
     common.add_model_arg(p)
     common.add_resilience_args(p)

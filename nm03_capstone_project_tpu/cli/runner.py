@@ -38,7 +38,11 @@ from nm03_capstone_project_tpu.data.discovery import (
     find_patient_dirs,
     load_dicom_files_for_patient,
 )
-from nm03_capstone_project_tpu.data.prefetch import prefetch_to_device
+from nm03_capstone_project_tpu.ingest import (
+    IngestFailure,
+    IngestPipeline,
+    stage_batch,
+)
 from nm03_capstone_project_tpu.obs import (
     RESILIENCE_RETRIES_TOTAL,
     PhaseAccountant,
@@ -283,6 +287,13 @@ class CohortProcessor:
         # (an upper bound on device busy, so the reported stall is a LOWER
         # bound: every second of it is real starvation).
         self.feed = PhaseAccountant()
+        # streaming ingest (ISSUE 11): both execution strategies feed the
+        # device through an ingest/ IngestPipeline (decode pool -> bounded
+        # staging ring -> upload-ahead stager); one drained stats snapshot
+        # is kept per patient pipeline so the run can report aggregate
+        # ring occupancy / decode lookahead / upload overlap next to the
+        # feed_stall record it erases
+        self._ingest_reports: List[dict] = []
         # resilience: retry/deadline policies, CPU degradation, chaos layer
         # (docs/RESILIENCE.md). Defaults are behavior-preserving: no dispatch
         # deadline, no fault plan (unless NM03_FAULT_PLAN activates one).
@@ -429,8 +440,8 @@ class CohortProcessor:
                 # fns donate their pixel arg, and donation of an uncommitted
                 # numpy arg is a no-op that warns on every fallback batch
                 out = inner(
-                    jax.device_put(np.asarray(px), cpu),
-                    jax.device_put(np.asarray(dm), cpu),
+                    jax.device_put(np.asarray(px), cpu),  # nm03-lint: disable=NM401 CPU-degradation target: committing host arrays to the FALLBACK device is the escape from the wedged one — routing through ingest would touch the very device path being escaped
+                    jax.device_put(np.asarray(dm), cpu),  # nm03-lint: disable=NM401 CPU-degradation target: committing host arrays to the FALLBACK device is the escape from the wedged one — routing through ingest would touch the very device path being escaped
                 )
             return tuple(np.asarray(a) for a in out)
 
@@ -453,13 +464,14 @@ class CohortProcessor:
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
+            # nm03-lint: disable=NM401 one-time model-weight placement, not the batch data path the ingest pipeline owns
             params = jax.device_put(
                 self.model_params, NamedSharding(mesh, PartitionSpec())
             )
         elif device is not None:
-            params = jax.device_put(self.model_params, device)
+            params = jax.device_put(self.model_params, device)  # nm03-lint: disable=NM401 one-time model-weight placement, not the batch data path the ingest pipeline owns
         else:
-            params = jax.device_put(self.model_params)
+            params = jax.device_put(self.model_params)  # nm03-lint: disable=NM401 one-time model-weight placement, not the batch data path the ingest pipeline owns
 
         import jax.numpy as jnp
 
@@ -677,58 +689,98 @@ class CohortProcessor:
         # adds the transient-error retry policy around it.
         supervised = self.dispatch.supervised
 
-        def run_dispatch(padded, dims, index):
+        def run_dispatch(pixels_dev, dims_dev, pixels_host, dims_host, index):
+            # dispatch consumes the ingest-staged device arrays; the CPU
+            # degradation fallback recomputes from the HOST copies the
+            # stager preserved (a fetch from the wedged device is the
+            # wedge). --sanitize: inputs were staged, so an implicit h2d
+            # inside this window is a hidden re-stage and raises.
             if supervised:
                 primary = lambda: tuple(  # noqa: E731
-                    np.asarray(a) for a in fn(padded, dims)
+                    np.asarray(a) for a in fn(pixels_dev, dims_dev)
                 )
             else:
-                primary = lambda: fn(padded, dims)  # noqa: E731
+                primary = lambda: fn(pixels_dev, dims_dev)  # noqa: E731
             fallback = lambda: self._fallback_call(  # noqa: E731
                 batched=False, host_render=host_render
-            )(padded, dims)
-            return self.dispatch.run(
-                primary,
-                fallback=fallback,
-                pre=self._dispatch_pre(patient_id, index),
-            )
+            )(pixels_host, dims_host)
+            with sanitize.guard_dispatch():
+                return self.dispatch.run(
+                    primary,
+                    fallback=fallback,
+                    pre=self._dispatch_pre(patient_id, index),
+                    staged_inputs=True,
+                )
+
+        # streaming ingest (ISSUE 11): the decode pool runs slices ahead,
+        # the stager uploads slice N+1 while slice N computes, and the
+        # bounded ring caps how far decode may outrun the chip. Processing
+        # and export remain strictly in slice order with per-slice
+        # containment — the reference's sequential contract
+        # (main_sequential.cpp:170-272) is about ORDER and interleaving,
+        # not about stalling the device between slices.
+        def decode_one(job):
+            di, f = job
+            pixels = self._read_slice(f, patient=patient_id, index=di)
+            if pixels is None:
+                raise ValueError("decode/guard failed")
+            padded, dims = self._pad_one(pixels)
+            return {"stem": f.stem, "index": di, "pixels": padded, "dims": dims}
+
+        def stage_one(item):
+            # degraded run keeps the slice on the host (host_only —
+            # rationale in staging.stage_batch)
+            return stage_batch(item, host_only=self.dispatch.degraded)
 
         pending = None
-        for di, f in enumerate(files):
-            stem = f.stem
-            try:
-                with self.timer.section("decode"), self.feed.busy("decode"):
-                    pixels = self._read_slice(f, patient=patient_id, index=di)
-                if pixels is None:
-                    raise ValueError("decode/guard failed")
-                with self.feed.busy("stage"):
-                    padded, dims = self._pad_one(pixels)
-                with self.timer.section("compute"):
-                    t_disp0 = time.monotonic()
-                    if host_render:
-                        mask_dev, conv = run_dispatch(padded, dims, di)
-                        cur = {
-                            "stem": stem, "mask_dev": mask_dev, "conv": conv,
-                            "padded": padded, "dims": dims,
-                            "t_disp0": t_disp0,
-                        }
-                    else:
-                        orig_dev, proc_dev, conv = run_dispatch(padded, dims, di)
-                        cur = {
-                            "stem": stem, "orig_dev": orig_dev,
-                            "proc_dev": proc_dev, "conv": conv,
-                            "t_disp0": t_disp0,
-                        }
-            except Exception as e:  # noqa: BLE001 - reference: don't throw
-                # a decode/dispatch failure rides the pipeline as a record,
-                # so resolve() logs and counts it AFTER the previous slice
-                # completes — failure handling stays in slice order
-                cur = {"stem": stem, "error": e}
+        pipe = self._ingest_pipeline(
+            list(enumerate(files)), decode_one, stage_one, patient_id
+        )
+        with pipe:
+            for rec in pipe:
+                if isinstance(rec, IngestFailure):
+                    # decode failure contained as a record: resolve() logs
+                    # and counts it AFTER the previous slice completes —
+                    # failure handling stays in slice order
+                    _, f = rec.item
+                    cur = {"stem": f.stem, "error": rec.error}
+                else:
+                    stem = rec["stem"]
+                    try:
+                        with self.timer.section("compute"):
+                            t_disp0 = time.monotonic()
+                            if host_render:
+                                mask_dev, conv = run_dispatch(
+                                    rec["pixels"], rec["dims"],
+                                    rec["pixels_host"], rec["dims_host"],
+                                    rec["index"],
+                                )
+                                cur = {
+                                    "stem": stem, "mask_dev": mask_dev,
+                                    "conv": conv,
+                                    "padded": rec["pixels_host"],
+                                    "dims": rec["dims_host"],
+                                    "t_disp0": t_disp0,
+                                }
+                            else:
+                                orig_dev, proc_dev, conv = run_dispatch(
+                                    rec["pixels"], rec["dims"],
+                                    rec["pixels_host"], rec["dims_host"],
+                                    rec["index"],
+                                )
+                                cur = {
+                                    "stem": stem, "orig_dev": orig_dev,
+                                    "proc_dev": proc_dev, "conv": conv,
+                                    "t_disp0": t_disp0,
+                                }
+                    except Exception as e:  # noqa: BLE001 - reference: don't throw
+                        cur = {"stem": stem, "error": e}
+                if pending is not None:
+                    resolve(pending)
+                pending = cur
             if pending is not None:
                 resolve(pending)
-            pending = cur
-        if pending is not None:
-            resolve(pending)
+        self._note_ingest(pipe)
         return ok, failed, truncated
 
     def _run_parallel(
@@ -808,119 +860,124 @@ class CohortProcessor:
         export_futures = []
         expected_stems: List[str] = []
         use_native = self.batch_cfg.use_native and _native_available()
-        with cf.ThreadPoolExecutor(self.batch_cfg.io_workers) as io_pool:
-            # decode runs `prefetch_depth` batches ahead of device compute
-            depth = max(self.batch_cfg.prefetch_depth, 1)
-            decode_futures: Dict[int, list] = {}
+        # decode concurrency: up to `ingest_decode_workers` batches in
+        # flight on the ingest pool; the per-batch slice decode then
+        # splits the io_workers budget so a small cohort (few batches)
+        # still decodes its slices in parallel while a deep one pipelines
+        # across batches (_decode_thread_split is the one formula)
+        inner_threads = self._decode_thread_split(len(batches))
 
-            def prefetch(idx: int):
-                if idx < len(batches) and idx not in decode_futures:
-                    if use_native:
-                        # one future per batch: the C++ thread pool decodes
-                        # + pads the whole batch (csrc nm03_load_batch)
-                        decode_futures[idx] = io_pool.submit(
-                            self._decode_batch_native,
-                            batches[idx],
-                            pad_target(len(batches[idx])),
-                            patient_id,
-                            idx * bs,
-                        )
-                    else:
-                        decode_futures[idx] = [
-                            io_pool.submit(
-                                self._read_slice, f, patient_id, idx * bs + j
-                            )
-                            for j, f in enumerate(batches[idx])
-                        ]
-
-            for i in range(depth):
-                prefetch(i)
-
-            def staged():
-                """Decode + pad batches; device staging handled downstream."""
-                for bi, batch_files in enumerate(batches):
-                    prefetch(bi + depth)
-                    if use_native:
-                        with self.timer.section("decode"), self.feed.busy(
-                            "decode"
-                        ):
-                            yield decode_futures.pop(bi).result()
-                        continue
-                    with self.timer.section("decode"), self.feed.busy("decode"):
-                        decoded = [f.result() for f in decode_futures.pop(bi)]
-                    stems = [f.stem for f in batch_files]
-                    bad = [s for s, p in zip(stems, decoded) if p is None]
-                    good = [(s, p) for s, p in zip(stems, decoded) if p is not None]
-                    if not good:
-                        yield {"stems": [], "bad": bad, "pixels": None, "dims": None}
-                        continue
-                    with self.feed.busy("stage"):
-                        padded, dims = self._pad_stack(
-                            [p for _, p in good],
-                            pad_to=pad_target(len(batch_files)),
-                        )
-                    yield {
-                        "stems": [s for s, _ in good],
-                        "bad": bad,
-                        "pixels": padded,
-                        "dims": dims,
-                    }
-
-            if mesh is not None:
-                from jax.sharding import NamedSharding, PartitionSpec
-
-                batch_sharding = NamedSharding(mesh, PartitionSpec("data"))
-            else:
-                batch_sharding = None
-
-            def to_device(item):
-                # move only the compute inputs; the host copy of the pixel
-                # stack stays behind for the host-render export path. With a
-                # mesh the host->device copy is already batch-sharded, so
-                # each device receives only its shard.
-                if item.get("pixels") is None:
-                    return item
-                if self.dispatch.degraded:
-                    # degraded run: the supervisor routes every batch to the
-                    # CPU fallback, so staging onto the (wedged/lost) device
-                    # would be at best wasted and at worst the very hang the
-                    # degradation escaped — keep the batch on the host
-                    return item
-                out = dict(item)
-                with self.feed.busy("stage"):
-                    out["pixels"] = jax.device_put(out["pixels"], batch_sharding)
-                    out["dims"] = jax.device_put(out["dims"], batch_sharding)
-                return out
-
-            def with_host_refs(gen):
-                for b in gen:
-                    b["pixels_host"], b["dims_host"] = b["pixels"], b["dims"]
-                    yield b
-
-            export_fault = self._export_fault_hook(patient_id)
-            supervised = self.dispatch.supervised
-
-            def journal_slice(stem):
-                # slice-grain crash record the moment the pair is on disk
-                # (fires per slice from the export pool threads, so a kill
-                # mid-batch loses at most the slice in flight; the journal
-                # is thread-safe). conv_by_stem is populated before the
-                # batch's export writes begin in both render paths.
-                if journal is not None:
-                    journal.record(
-                        stem,
-                        STATUS_DONE
-                        if conv_by_stem.get(stem, True)
-                        else STATUS_TRUNCATED,
-                    )
-
-            # host->HBM double buffering: the next batch's device_put is
-            # enqueued while the current batch computes
-            for bi, batch in enumerate(
-                prefetch_to_device(
-                    with_host_refs(staged()), depth=depth, to_device=to_device
+        def decode_batch(job):
+            """One ingest work item: (batch index, files) -> decoded host
+            batch (the pipeline accounts it as the feed's decode phase)."""
+            bi, batch_files = job
+            if use_native:
+                # the C++ thread pool decodes + pads the whole batch
+                # (csrc nm03_load_batch); same batch-count-clamped thread
+                # split as the Python path below, so a one-batch cohort
+                # keeps the full io_workers budget
+                return self._decode_batch_native(
+                    batch_files,
+                    pad_target(len(batch_files)),
+                    patient_id,
+                    bi * bs,
+                    threads=inner_threads,
                 )
-            ):
+            idx0 = bi * bs
+            if inner_threads > 1 and len(batch_files) > 1:
+                with cf.ThreadPoolExecutor(inner_threads) as slice_pool:
+                    decoded = list(
+                        slice_pool.map(
+                            lambda jf: self._read_slice(
+                                jf[1], patient_id, idx0 + jf[0]
+                            ),
+                            enumerate(batch_files),
+                        )
+                    )
+            else:
+                decoded = [
+                    self._read_slice(f, patient_id, idx0 + j)
+                    for j, f in enumerate(batch_files)
+                ]
+            stems = [f.stem for f in batch_files]
+            bad = [s for s, p in zip(stems, decoded) if p is None]
+            good = [(s, p) for s, p in zip(stems, decoded) if p is not None]
+            if not good:
+                return {"stems": [], "bad": bad, "pixels": None, "dims": None}
+            padded, dims = self._pad_stack(
+                [p for _, p in good], pad_to=pad_target(len(batch_files))
+            )
+            return {
+                "stems": [s for s, _ in good],
+                "bad": bad,
+                "pixels": padded,
+                "dims": dims,
+            }
+
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            batch_sharding = NamedSharding(mesh, PartitionSpec("data"))
+        else:
+            batch_sharding = None
+
+        def stage(item):
+            # move only the compute inputs; the host copy of the pixel
+            # stack stays behind (as <key>_host) for the host-render
+            # export path and the CPU fallback. With a mesh the
+            # host->device copy is already batch-sharded, so each device
+            # receives only its shard. A degraded run keeps the batch on
+            # the host (host_only — rationale in staging.stage_batch).
+            if item.get("pixels") is None:
+                return item
+            return stage_batch(
+                item,
+                placement=batch_sharding,
+                host_only=self.dispatch.degraded,
+            )
+
+        export_fault = self._export_fault_hook(patient_id)
+        supervised = self.dispatch.supervised
+
+        def journal_slice(stem):
+            # slice-grain crash record the moment the pair is on disk
+            # (fires per slice from the export pool threads, so a kill
+            # mid-batch loses at most the slice in flight; the journal
+            # is thread-safe). conv_by_stem is populated before the
+            # batch's export writes begin in both render paths.
+            if journal is not None:
+                journal.record(
+                    stem,
+                    STATUS_DONE
+                    if conv_by_stem.get(stem, True)
+                    else STATUS_TRUNCATED,
+                )
+
+        # streaming ingest (ISSUE 11): the decode pool runs `workers`
+        # batches ahead into the bounded staging ring; the stager enqueues
+        # batch N+1's (async) device_put while batch N computes; result
+        # fetch + export stream back on the same pool. Backpressure: a
+        # full ring blocks the feeder, so decode can never outrun HBM.
+        pipe = self._ingest_pipeline(
+            list(enumerate(batches)), decode_batch, stage, patient_id
+        )
+        with pipe:
+            for bi, batch in enumerate(pipe):
+                if isinstance(batch, IngestFailure):
+                    # whole-batch decode failure (injected ingest fault or
+                    # an unexpected decode-layer error): every slice of the
+                    # batch is counted failed — contained, never propagated
+                    _, batch_files = batch.item
+                    log.warning(
+                        "ingest decode failed for batch %d: %s",
+                        batch.index, batch.error,
+                    )
+                    for f in batch_files:
+                        failed.append(f.stem)
+                        self.manifest.record(patient_id, f.stem, STATUS_FAILED)
+                        if journal is not None:
+                            journal.record(f.stem, STATUS_FAILED)
+                    continue
                 for s in batch["bad"]:
                     failed.append(s)
                     self.manifest.record(patient_id, s, STATUS_FAILED)
@@ -958,9 +1015,9 @@ class CohortProcessor:
                     t_disp0 = time.monotonic()
                     with self.timer.section("dispatch"):
                         # --sanitize (upload-only guard): inputs were staged
-                        # by to_device, so an implicit h2d inside this window
-                        # is a hidden re-stage; the primary's d2h fetch is
-                        # sanctioned (it must sit inside the deadline)
+                        # by the ingest stager, so an implicit h2d inside
+                        # this window is a hidden re-stage; the primary's
+                        # d2h fetch is sanctioned (inside the deadline)
                         with sanitize.guard_dispatch():
                             mask_dev, conv_dev = self.dispatch.run(
                                 primary,
@@ -1005,7 +1062,9 @@ class CohortProcessor:
                                 success_hook=journal_slice,
                             )
 
-                    export_futures.append(io_pool.submit(fetch_render_export))
+                    # hand fetch+render+export to the ingest pool: the mask
+                    # streams back while the next batch computes
+                    export_futures.append(pipe.submit(fetch_render_export))
                 else:
                     with self.timer.section("compute"), self.feed.busy(
                         "dispatch"
@@ -1039,12 +1098,13 @@ class CohortProcessor:
                                 success_hook=journal_slice,
                             )
 
-                    export_futures.append(io_pool.submit(encode_export))
+                    export_futures.append(pipe.submit(encode_export))
                 expected_stems.extend(batch["stems"])
             with self.timer.section("export"):
                 written = set()
                 for fut in export_futures:
                     written.update(fut.result())
+        self._note_ingest(pipe)
         # success is "the JPEG pair exists", not "compute finished"
         truncated: List[str] = []
         for s in expected_stems:
@@ -1069,19 +1129,23 @@ class CohortProcessor:
         pad_to: int,
         patient_id: Optional[str] = None,
         base_index: int = 0,
+        threads: Optional[int] = None,
     ) -> dict:
         """Decode one batch via the C++ thread-pool loader.
 
         Same output contract as the Python path in ``staged()``: good slices
         compacted into the leading rows of a fixed (pad_to, canvas, canvas)
-        stack, failed stems listed in ``bad``.
+        stack, failed stems listed in ``bad``. ``threads`` is the per-call
+        C++ pool size — _run_parallel passes its batch-count-clamped split
+        of the io_workers budget (a one-batch cohort gets the whole
+        budget, a deep one pipelines across batches instead).
         """
         from nm03_capstone_project_tpu import native
 
-        # `prefetch_depth` batches decode concurrently; split the io_workers
-        # budget between them instead of spawning depth x io_workers threads
-        depth = max(self.batch_cfg.prefetch_depth, 1)
-        threads = max(1, self.batch_cfg.io_workers // depth)
+        if threads is None:
+            # direct callers (tests) decode one batch in isolation: the
+            # same formula, clamped to a single batch in flight
+            threads = self._decode_thread_split(1)
         pixels, dims, okf, errs = native.load_batch_native(
             batch_files,
             canvas=self.cfg.canvas,
@@ -1188,6 +1252,104 @@ class CohortProcessor:
             out[i, : a.shape[0], : a.shape[1]] = a
             dims[i] = a.shape
         return out, dims
+
+    # -- streaming ingest --------------------------------------------------
+
+    def _decode_thread_split(self, n_batches: int) -> int:
+        """Per-batch decode thread budget: io_workers divided by how many
+        batches can actually decode concurrently (the ingest pool's bound,
+        clamped by the cohort's batch count) — a one-batch cohort keeps
+        the whole budget, a deep one pipelines across batches. THE one
+        formula for both the Python slice pool and the C++ native loader."""
+        workers = max(
+            1, self.batch_cfg.ingest_decode_workers or self.batch_cfg.io_workers
+        )
+        concurrent = max(1, min(workers, max(n_batches, 1)))
+        return max(1, self.batch_cfg.io_workers // concurrent)
+
+    def _ingest_pipeline(
+        self, source, decode, stage, patient_id: str
+    ) -> IngestPipeline:
+        """One host→HBM pipeline per patient run (docs/OPERATIONS.md
+        "Feeding the chip"): ring depth and decode pool from BatchConfig,
+        feed/span/fault plumbing shared with the rest of the driver."""
+        workers = self.batch_cfg.ingest_decode_workers or self.batch_cfg.io_workers
+        return IngestPipeline(
+            source=source,
+            decode=decode,
+            stage=stage,
+            depth=max(self.batch_cfg.ingest_depth, 1),
+            decode_workers=max(workers, 1),
+            staged_depth=max(self.batch_cfg.prefetch_depth, 1),
+            feed=self.feed,
+            spans=self.timer,
+            obs=self.obs,
+            fault_plan=self.fault_plan,
+            fault_patient=patient_id,
+        )
+
+    def _note_ingest(self, pipe: IngestPipeline) -> None:
+        """Collect one pipeline's drained snapshot + refresh the live
+        ``ingest_*`` gauges. Telemetry never costs a run."""
+        try:
+            self._ingest_reports.append(pipe.publish(self.obs.registry))
+        except Exception as e:  # noqa: BLE001 — telemetry never costs a run
+            log.warning("ingest telemetry failed: %s", e)
+
+    def ingest_report(self) -> Optional[dict]:
+        """Run-level aggregate of the per-patient pipeline snapshots
+        (the ``ingest`` record in the drivers' --results-json)."""
+        reps = self._ingest_reports
+        if not reps:
+            return None
+        counts: Dict[str, int] = {}
+        for r in reps:
+            for k, v in r["counts"].items():
+                counts[k] = counts.get(k, 0) + v
+        weighted = [
+            r for r in reps
+            if r["upload_overlap_ratio"] is not None and r["upload_s"] > 0
+        ]
+        up_s = sum(r["upload_s"] for r in weighted)
+        overlap = (
+            round(
+                sum(r["upload_overlap_ratio"] * r["upload_s"] for r in weighted)
+                / up_s,
+                4,
+            )
+            if up_s > 0
+            else None
+        )
+        return {
+            "patients": len(reps),
+            "ring_capacity": reps[-1]["ring"]["capacity"],
+            "ring_peak": max(r["ring"]["peak"] for r in reps),
+            "ring_occupancy_ratio": round(
+                sum(r["ring"]["occupancy_ratio"] for r in reps) / len(reps), 4
+            ),
+            "decode_queue_peak": max(r["decode_queue_peak"] for r in reps),
+            "upload_s": round(sum(r["upload_s"] for r in reps), 4),
+            "upload_overlap_ratio": overlap,
+            "counts": counts,
+        }
+
+    def publish_ingest(self) -> Optional[dict]:
+        """The drained-at-exit gauge refresh (drivers call this right
+        before the final --metrics-out snapshot): occupancy = mean over
+        patient pipelines, queue depth = the run's decode-lookahead
+        high-water mark, overlap = upload-weighted mean."""
+        rep = self.ingest_report()
+        if rep is None:
+            return None
+        from nm03_capstone_project_tpu.ingest.pipeline import publish_gauges
+
+        publish_gauges(
+            self.obs.registry,
+            occupancy=rep["ring_occupancy_ratio"],
+            queue_depth=rep["decode_queue_peak"],
+            overlap=rep["upload_overlap_ratio"],
+        )
+        return rep
 
     # -- cohort loop -------------------------------------------------------
 

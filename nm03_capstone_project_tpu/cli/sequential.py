@@ -22,6 +22,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output", default="out-sequential", help="output root directory")
     common.add_common_args(p)
     common.add_pipeline_args(p)
+    common.add_ingest_args(p)
     common.add_render_stage_arg(p)
     common.add_model_arg(p)
     common.add_resilience_args(p)
@@ -55,6 +56,10 @@ def run(args: argparse.Namespace, mode: str) -> int:
         batch_size=getattr(args, "batch_size", BatchConfig.batch_size),
         io_workers=getattr(args, "io_workers", BatchConfig.io_workers),
         prefetch_depth=getattr(args, "prefetch_depth", BatchConfig.prefetch_depth),
+        ingest_depth=getattr(args, "ingest_depth", BatchConfig.ingest_depth),
+        ingest_decode_workers=getattr(
+            args, "ingest_decode_workers", BatchConfig.ingest_decode_workers
+        ),
         use_native=not getattr(args, "no_native", False),
         render_stage=getattr(args, "render_stage", BatchConfig.render_stage),
     )
@@ -134,6 +139,14 @@ def run(args: argparse.Namespace, mode: str) -> int:
                 "bound: the dispatch interval is enqueue->fetch complete)",
             ).set(feed_stall["feed_stall_ratio"])
         run_ctx.events.emit("feed_stall", mode=mode, **feed_stall)
+        # streaming-ingest drain (ISSUE 11): refresh the ingest_* gauges
+        # from the run-level aggregate (so the final --metrics-out carries
+        # ring occupancy / decode lookahead / upload overlap) and put the
+        # same numbers on the event stream next to the feed_stall they
+        # exist to explain
+        ingest_rep = proc.publish_ingest()
+        if ingest_rep is not None:
+            run_ctx.events.emit("ingest_drained", mode=mode, **ingest_rep)
         if args.results_json and rank == 0:
             import jax
 
@@ -164,6 +177,9 @@ def run(args: argparse.Namespace, mode: str) -> int:
                 # the feed_stall report (docs/OBSERVABILITY.md): per-phase
                 # busy unions + the device-starvation headline
                 "feed_stall": feed_stall,
+                # the streaming-ingest aggregate (ring occupancy, decode
+                # lookahead, upload overlap — docs/OBSERVABILITY.md)
+                "ingest": ingest_rep,
                 # the full observability snapshot rides in the results JSON
                 # too, so one artifact carries outcome counters + stage
                 # latency distributions next to the wall-clock headline

@@ -171,7 +171,7 @@ def _make_student_volume_fn(model_params, cfg):
     from nm03_capstone_project_tpu.core.image import valid_mask
     from nm03_capstone_project_tpu.models import predict_mask3d, prepare_student_inputs
 
-    params = jax.device_put(model_params)
+    params = jax.device_put(model_params)  # nm03-lint: disable=NM401 one-time model-weight placement, not the batch data path the ingest pipeline owns
     dtype = jnp.bfloat16 if is_tpu_backend() else jnp.float32
     pool_multiple = 2 ** len(model_params["enc"])  # one halving per level
 

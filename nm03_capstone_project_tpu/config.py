@@ -161,8 +161,15 @@ class BatchConfig:
     """
 
     batch_size: int = 25
-    prefetch_depth: int = 2  # host->device double buffering
+    prefetch_depth: int = 2  # staged (device-side) lookahead: double buffering
     io_workers: int = 8  # DICOM decode thread pool
+    # streaming ingest (ingest/, docs/OPERATIONS.md "Feeding the chip"):
+    # ring capacity in host batches decoded ahead of the chip — the
+    # backpressure bound (decode can never outrun HBM by more than
+    # ingest_depth + in-flight decodes + prefetch_depth batches)
+    ingest_depth: int = 3
+    # decode pool size for the ingest pipeline; 0 = use io_workers
+    ingest_decode_workers: int = 0
     use_native: bool = True  # C++ batch decoder (csrc/) when buildable
     # 'host': device returns only the mask (65 KB/slice) and the 512x512
     # export renders are computed host-side in the IO pool — the default,
@@ -175,6 +182,15 @@ class BatchConfig:
         if self.render_stage not in ("host", "device"):
             raise ValueError(
                 f"render_stage must be 'host' or 'device', got {self.render_stage!r}"
+            )
+        if self.ingest_depth < 1:
+            raise ValueError(
+                f"ingest_depth must be >= 1, got {self.ingest_depth}"
+            )
+        if self.ingest_decode_workers < 0:
+            raise ValueError(
+                f"ingest_decode_workers must be >= 0 (0 = io_workers), "
+                f"got {self.ingest_decode_workers}"
             )
 
 

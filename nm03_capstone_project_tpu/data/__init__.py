@@ -1,4 +1,4 @@
-"""Data layer: discovery, DICOM-lite IO, synthetic cohorts, prefetch."""
+"""Data layer: discovery, DICOM-lite IO, synthetic cohorts."""
 
 from nm03_capstone_project_tpu.data.dicomlite import (  # noqa: F401
     DicomParseError,

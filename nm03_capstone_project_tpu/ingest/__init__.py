@@ -1,0 +1,33 @@
+"""Device-resident streaming ingest: the host→HBM data path, once.
+
+The single home for getting bytes onto the chip (ROADMAP item 1): a
+decode thread-pool fills a bounded staging ring of host batches, a stager
+overlaps batch N+1's upload with batch N's compute, backpressure
+propagates from the ring so decode can never outrun HBM, and result
+fetch streams back on the same pool while the next batch runs. Both
+batch drivers and bench's streamed feed leg run through here; the
+``jax.device_put`` call sites are confined to :mod:`.staging` (lint rule
+NM401, mirroring NM361's compile-home contract).
+
+jax-free AND numpy-free at import by the package import contract
+(NM301): the orchestration layer must be unit-testable — and its
+telemetry drainable — without a backend; jax enters only through the
+staging callables at call time.
+"""
+
+from nm03_capstone_project_tpu.ingest.pipeline import (  # noqa: F401
+    DEFAULT_DEPTH,
+    DEFAULT_STAGED_DEPTH,
+    IngestFailure,
+    IngestPipeline,
+)
+from nm03_capstone_project_tpu.ingest.ring import (  # noqa: F401
+    RingClosed,
+    RingFinished,
+    StagingRing,
+)
+from nm03_capstone_project_tpu.ingest.staging import (  # noqa: F401
+    prefetch_to_device,
+    stage_arrays,
+    stage_batch,
+)

@@ -1,0 +1,127 @@
+"""Device staging: THE home of host→HBM placement (NM401).
+
+Every ``jax.device_put`` that feeds batch compute lives here, the way
+every jit lives in ``compilehub/`` (NM361): a scattered staging site is a
+hidden re-upload the transfer guard can't attribute and the ingest
+telemetry can't see. The lint rule NM401 (``analysis/staginghome.py``)
+enforces the contract; the reasoned escapes (CPU-degradation fallbacks,
+one-time model-parameter placement, bench's measurement harness) carry
+suppressions at their sites.
+
+``jax.device_put`` is asynchronous: enqueuing the next batch's H2D copy
+while the current batch computes hides the transfer entirely — the
+:class:`~nm03_capstone_project_tpu.ingest.pipeline.IngestPipeline`
+stager calls :func:`stage_batch` one-to-two batches ahead for exactly
+that reason (double buffering; SURVEY.md section 7 step 4 "hard part
+#2"). jax is imported lazily so the module costs nothing in jax-free
+processes (the package import contract, NM301).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence, TypeVar
+
+T = TypeVar("T")
+
+# the batch keys the drivers stage by default (host copies kept as
+# <key>_host for host-side render/export and the CPU-degradation fallback)
+DEFAULT_STAGE_KEYS = ("pixels", "dims")
+
+
+def stage_batch(
+    item: dict,
+    keys: Sequence[str] = DEFAULT_STAGE_KEYS,
+    placement: Optional[Any] = None,
+    keep_host: bool = True,
+    host_only: bool = False,
+) -> dict:
+    """Stage the named array leaves of one batch dict onto the device.
+
+    ``placement`` is a ``jax.Device`` or ``Sharding`` (None = default
+    device); with a mesh sharding the H2D copy is already batch-sharded,
+    so each chip receives only its shard. ``keep_host=True`` preserves the
+    host array as ``<key>_host`` — the host-render export path reads it,
+    and the CPU-degradation fallback must never have to fetch from the
+    (possibly wedged) device it is escaping.
+
+    ``host_only=True`` skips the device_put entirely but still writes the
+    ``<key>_host`` aliases (and never imports jax): the degraded-run mode
+    — every dispatch is served by the CPU fallback, so staging onto the
+    wedged/lost device would be at best wasted and at worst the very hang
+    the degradation escaped, while downstream consumers keep reading one
+    key contract.
+    """
+    out = dict(item)
+    if host_only:
+        for k in keys:
+            if out.get(k) is not None:
+                out[f"{k}_host"] = out[k]
+        return out
+    import jax
+
+    for k in keys:
+        v = out.get(k)
+        if v is None:
+            continue
+        if keep_host:
+            out[f"{k}_host"] = v
+        out[k] = jax.device_put(v, placement)
+    return out
+
+
+def stage_arrays(arrays: Iterable[Any], placement: Optional[Any] = None) -> list:
+    """Stage a flat list of arrays (the single-slice drivers' shape)."""
+    import jax
+
+    return [jax.device_put(a, placement) for a in arrays]
+
+
+def prefetch_to_device(
+    iterator: Iterable[T],
+    depth: int = 2,
+    device: Optional[Any] = None,
+    to_device: Optional[Callable[[Any], Any]] = None,
+) -> Iterator[T]:
+    """Yield items from ``iterator`` with arrays staged on device ``depth``
+    ahead (absorbed from the retired ``data/prefetch.py`` helper).
+
+    Each item is a pytree; its array leaves are moved with
+    ``jax.device_put`` (asynchronous — the copy overlaps whatever the
+    device is running). Non-array leaves (strings, metadata) pass through
+    untouched. The full :class:`..pipeline.IngestPipeline` supersedes this
+    for the drivers (it adds the decode pool, backpressure ring, fault
+    site and telemetry); this stays as the minimal generator form for
+    library callers with pre-decoded streams.
+
+    Args:
+      iterator: source of pytree batches.
+      depth: how many batches to keep in flight (2 = double buffering).
+      device: target `jax.Device` or `Sharding` (default backend's device 0).
+      to_device: override the per-item transfer (e.g. to apply a
+        NamedSharding to some leaves only).
+    """
+    import jax
+
+    it = iter(iterator)
+    if to_device is None:
+        tgt = device if device is not None else jax.devices()[0]
+
+        def to_device(item):
+            return jax.tree.map(
+                lambda x: jax.device_put(x, tgt) if hasattr(x, "shape") else x,
+                item,
+            )
+
+    queue: collections.deque = collections.deque()
+
+    def enqueue(n: int) -> None:
+        for item in itertools.islice(it, n):
+            queue.append(to_device(item))
+
+    enqueue(max(depth, 1))
+    while queue:
+        out = queue.popleft()
+        enqueue(1)
+        yield out

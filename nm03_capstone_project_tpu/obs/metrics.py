@@ -56,6 +56,13 @@ PIPELINE_RECOMPILES_TOTAL = "pipeline_recompiles_total"
 # driver feed accounting (obs.saturation.PhaseAccountant, ISSUE 10): the
 # fraction of wall the device sat starved by the serial feed
 PIPELINE_FEED_STALL_RATIO = "pipeline_feed_stall_ratio"
+# streaming ingest (ingest/ subsystem, ISSUE 11): how the host->HBM
+# pipeline is doing — ring fill, decode lookahead, upload/compute overlap.
+# Published live by IngestPipeline.publish() and once at drain so the
+# final --metrics-out snapshot carries the run's totals.
+INGEST_RING_OCCUPANCY_RATIO = "ingest_ring_occupancy_ratio"
+INGEST_DECODE_QUEUE_DEPTH = "ingest_decode_queue_depth"
+INGEST_UPLOAD_OVERLAP_RATIO = "ingest_upload_overlap_ratio"
 
 # saturation / goodput telemetry (obs.saturation, ISSUE 10). These are
 # serving_* series, but they are DEFINED here, not in serving/metrics.py:

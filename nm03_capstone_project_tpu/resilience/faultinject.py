@@ -47,7 +47,11 @@ Kinds by site:
   drill);
 * ``cache``:    ``io_error`` (abort a persistent compile-cache entry write
   — the next start recompiles instead of loading; ``stem`` selects the
-  entry filename).
+  entry filename);
+* ``ingest``:   ``decode_error`` (fail one work item on the streaming
+  ingest's decode pool — contained, counted, never propagated),
+  ``stall`` (wedge the stager ``hang_s`` seconds — the backpressure
+  drill for the staging ring).
 
 Injected faults are observable: every fire increments
 ``resilience_faults_injected_total{site,kind}`` and emits a
@@ -68,7 +72,7 @@ from nm03_capstone_project_tpu.resilience.policy import TransientDeviceError
 
 ENV_VAR = "NM03_FAULT_PLAN"
 
-SITES = ("decode", "dispatch", "export", "cache")
+SITES = ("decode", "dispatch", "export", "cache", "ingest")
 KINDS_BY_SITE = {
     "decode": ("error", "corrupt"),
     "dispatch": ("transient", "hang"),
@@ -78,6 +82,14 @@ KINDS_BY_SITE = {
     # to a plain recompile on the next start — never a torn entry (the
     # write itself is atomic; `stem` selects the entry filename)
     "cache": ("io_error",),
+    # the streaming-ingest pipeline (ingest/, ISSUE 11): `decode_error`
+    # fails one work item on the decode pool (contained as an
+    # IngestFailure record the driver counts); `stall` wedges the stager
+    # for hang_s — the drill proving ring backpressure holds (decode
+    # blocks, nothing reorders, the run completes late, never wrong).
+    # `index` selects the work item (batch index for the parallel driver,
+    # slice index for the sequential one).
+    "ingest": ("decode_error", "stall"),
 }
 
 

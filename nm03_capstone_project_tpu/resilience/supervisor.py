@@ -100,9 +100,9 @@ class DispatchSupervisor:
         device_put — under ``--sanitize`` the supervised worker thread then
         re-arms the (thread-local) upload guard around the primary, so a
         hidden per-dispatch re-stage raises even in the supervised
-        configuration. Callers whose primaries upload host arrays by
-        design (the sequential per-slice path, the serving executor) leave
-        it False.
+        configuration. Both batch drivers stage through the ingest
+        pipeline and pass True; callers whose primaries upload host
+        arrays by design (the serving executor) leave it False.
         """
         if self.degraded:
             if fallback is not None and self.cfg.fallback_cpu:

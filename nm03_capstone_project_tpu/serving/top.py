@@ -25,6 +25,11 @@ import urllib.error
 import urllib.request
 from typing import Dict, Optional, Tuple
 
+from nm03_capstone_project_tpu.obs.metrics import (
+    INGEST_DECODE_QUEUE_DEPTH,
+    INGEST_RING_OCCUPANCY_RATIO,
+    INGEST_UPLOAD_OVERLAP_RATIO,
+)
 from nm03_capstone_project_tpu.serving.metrics import (
     SERVING_BUSY_FRACTION,
     SERVING_LANE_BUSY_FRACTION,
@@ -141,6 +146,19 @@ def build_view(cur: Sample, prev: Optional[Sample] = None) -> dict:
         "mfu": cur.gauge(SERVING_MFU),
         "padding_waste_ratio": cur.gauge(SERVING_PADDING_WASTE_RATIO),
         "window_occupancy_ratio": cur.gauge(SERVING_WINDOW_OCCUPANCY_RATIO),
+        # streaming-ingest column (ISSUE 11): present whenever the scraped
+        # snapshot carries the ingest_* gauges (a process feeding the chip
+        # through ingest/), null otherwise — nm03-top renders what the
+        # registry knows, it never guesses
+        "ingest": (
+            {
+                "ring_occupancy_ratio": cur.gauge(INGEST_RING_OCCUPANCY_RATIO),
+                "decode_queue_depth": cur.gauge(INGEST_DECODE_QUEUE_DEPTH),
+                "upload_overlap_ratio": cur.gauge(INGEST_UPLOAD_OVERLAP_RATIO),
+            }
+            if cur.gauge(INGEST_RING_OCCUPANCY_RATIO) is not None
+            else None
+        ),
         # rates from counter deltas between polls (null on the first poll
         # and in --once mode: one sample has no delta)
         "rates_per_s": {
@@ -191,6 +209,18 @@ def render_text(view: dict, url: str) -> str:
         f"{'lane':>4} {'state':<12} {'busy':>8} {'mfu':>8} "
         f"{'inflight':>8} {'batches':>8} {'quar':>5}",
     ]
+    ing = view.get("ingest")
+    if ing is not None:
+        lines.insert(
+            3,
+            (
+                f"ingest ring "
+                f"{_fmt(ing['ring_occupancy_ratio'], pct=True).strip()}   "
+                f"decode-q {ing['decode_queue_depth'] if ing['decode_queue_depth'] is not None else '-'}   "
+                f"upload overlap "
+                f"{_fmt(ing['upload_overlap_ratio'], pct=True).strip()}"
+            ),
+        )
     for row in view["lanes"]:
         lines.append(
             f"{str(row['lane']):>4} {str(row['state']):<12} "

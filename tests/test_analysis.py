@@ -35,6 +35,7 @@ from nm03_capstone_project_tpu.analysis.dtypes import check_dtype_discipline
 from nm03_capstone_project_tpu.analysis.hostsync import check_host_sync
 from nm03_capstone_project_tpu.analysis.metricsdocs import check_metrics_docs
 from nm03_capstone_project_tpu.analysis.retrace import check_retrace
+from nm03_capstone_project_tpu.analysis.staginghome import check_staging_home
 from nm03_capstone_project_tpu.analysis.threads import check_thread_shared_state
 
 REPO = pathlib.Path(__file__).resolve().parents[1]
@@ -951,6 +952,136 @@ class TestCompileHome:
         )
         fs = run_rules(parsed, (check_compile_home,))
         assert rules_of(fs) == [], [f.render() for f in fs]
+
+
+class TestStagingHome:
+    def test_direct_device_put_reference_flagged(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/cli/thing.py": """
+                import jax
+                x = jax.device_put([1, 2, 3])
+                """
+            },
+            rules=(check_staging_home,),
+        )
+        assert "NM401" in rules_of(fs)
+
+    def test_import_binding_flagged(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/cli/thing.py": """
+                from jax import device_put
+                """
+            },
+            rules=(check_staging_home,),
+        )
+        assert "NM401" in rules_of(fs)
+
+    def test_aliased_module_attribute_flagged(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/cli/thing.py": """
+                import jax as j
+                stage = j.device_put
+                """
+            },
+            rules=(check_staging_home,),
+        )
+        assert "NM401" in rules_of(fs)
+
+    def test_ingest_is_the_sanctioned_home(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/ingest/staging.py": """
+                import jax
+                def stage(x):
+                    return jax.device_put(x)
+                """
+            },
+            rules=(check_staging_home,),
+        )
+        assert rules_of(fs) == []
+
+    def test_compilehub_and_sanitize_exempt(self, tmp_path):
+        # warmup staging is the hub's own job; the sanitize runtime twin
+        # documents the sanctioned idiom — both are reasoned exemptions
+        # named by the rule itself, not suppressions
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/compilehub/hub.py": """
+                import jax
+                canary = jax.device_put(0)
+                """,
+                f"{PKG}/utils/sanitize.py": """
+                import jax
+                probe = jax.device_put(1)
+                """,
+            },
+            rules=(check_staging_home,),
+        )
+        assert rules_of(fs) == []
+
+    def test_ingest_consumers_clean(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/cli/thing.py": f"""
+                from {PKG}.ingest import stage_batch
+                out = stage_batch({{"pixels": None}})
+                """
+            },
+            rules=(check_staging_home,),
+        )
+        assert rules_of(fs) == []
+
+    def test_suppression_with_reason_honored(self, tmp_path):
+        fs = lint_tree(
+            tmp_path,
+            {
+                f"{PKG}/cli/thing.py": """
+                import jax
+                p = jax.device_put(0)  # nm03-lint: disable=NM401 one-time model-weight placement, not the batch data path
+                """
+            },
+            rules=(check_staging_home,),
+        )
+        assert rules_of(fs) == []
+
+    def test_real_tree_staging_home_clean(self):
+        """The acceptance bar: zero NM401 findings outside ingest/ on the
+        real tree (the CPU-fallback, parameter-placement and bench
+        measurement suppressions are the only sanctioned escapes)."""
+        parsed = collect_files(
+            [REPO / PKG, REPO / "bench.py", REPO / "scripts"], REPO
+        )
+        fs = run_rules(parsed, (check_staging_home,))
+        assert rules_of(fs) == [], [f.render() for f in fs]
+
+    def test_break_drill_stripped_suppression_trips(self, tmp_path):
+        """Break drill: the real runner.py with its NM401 suppressions
+        stripped must fail the rule — proving the real tree is clean
+        BECAUSE of the reasoned suppressions, not because the rule is
+        blind to the drivers."""
+        src = (REPO / PKG / "cli" / "runner.py").read_text()
+        assert "disable=NM401" in src
+        stripped = "\n".join(
+            line.split("# nm03-lint: disable=NM401")[0].rstrip()
+            if "disable=NM401" in line and line.strip().startswith("#") is False
+            else ("" if "disable=NM401" in line else line)
+            for line in src.splitlines()
+        )
+        tree = tmp_path / PKG / "cli"
+        tree.mkdir(parents=True)
+        (tree / "runner.py").write_text(stripped)
+        parsed = collect_files([tmp_path / PKG], tmp_path)
+        fs = run_rules(parsed, (check_staging_home,))
+        assert "NM401" in rules_of(fs), "stripping the suppressions must trip NM401"
 
 
 class TestCacheKey:

@@ -59,7 +59,15 @@ SURFACE = {
         "phantom_volume",
         "write_synthetic_cohort",
     ],
-    "nm03_capstone_project_tpu.data.prefetch": ["prefetch_to_device"],
+    # streaming ingest (ISSUE 11): the host->HBM data path, including the
+    # prefetch helper absorbed from the retired data/prefetch.py
+    "nm03_capstone_project_tpu.ingest": [
+        "IngestPipeline",
+        "IngestFailure",
+        "StagingRing",
+        "stage_batch",
+        "prefetch_to_device",
+    ],
     "nm03_capstone_project_tpu.data.codecs": [
         "rle_encode_frame",
         "rle_decode_frame",
