@@ -59,6 +59,13 @@ CONTRACT_REGISTRY: Dict[str, Tuple[str, ...]] = {
     # enters only through the staging callables at call time (the
     # device_put sites in ingest/staging.py import jax lazily)
     "nm03_capstone_project_tpu.ingest": ("jax", "numpy"),
+    # the replica-fleet front-end (ISSUE 13): routing, ejection/probation
+    # and rolling-restart orchestration are pure stdlib byte-shuffling —
+    # the router must start in milliseconds and never claim a chip, so
+    # the whole package is jax- AND numpy-banned (it is not under the
+    # serving package precisely so no numpy-importing ancestor __init__
+    # weakens the contract the way serving.queue's does)
+    "nm03_capstone_project_tpu.fleet": ("jax", "numpy"),
     # the linter itself runs in pre-backend CI processes; the gate gates
     # itself so a convenience import can never make the gate cost a backend
     "nm03_capstone_project_tpu.analysis": ("jax", "numpy"),

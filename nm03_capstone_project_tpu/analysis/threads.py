@@ -46,6 +46,10 @@ THREADED_FILES: Tuple[str, ...] = (
     # threads share the ring, counters and interval rings with the
     # consumer — the package is threaded by construction
     "nm03_capstone_project_tpu/ingest/",
+    # the fleet front-end (ISSUE 13): HTTP handler threads, the health
+    # poller and the drain thread share the replica state table, the
+    # routing weights and the signal cache — same discipline
+    "nm03_capstone_project_tpu/fleet/",
 )
 
 _SYNC_TYPE_NAMES = {

@@ -1,0 +1,50 @@
+"""Replica-level fault domains: the ``nm03-fleet`` front-end (ISSUE 13).
+
+The fleet layer of the serving story (ROADMAP item 3): where PR 8 made
+the *lane* the fault domain inside one ``nm03-serve`` process, this
+package makes the *replica process* the fault domain across a host —
+capacity-weighted routing from the replicas' own published signals,
+outlier ejection through a HEALTHY → EJECTED → PROBATION → HEALTHY
+machine, bounded-hop failover for in-flight riders, backpressure
+(Retry-After) propagation, and rolling-restart orchestration that rides
+the PR-9 compile cache so a redeploy is milliseconds-cold and never
+drops below (N−1)/N capacity.
+
+jax- AND numpy-free at import by contract (NM301 pins the package,
+NM331 scans its lock discipline): the router is pure stdlib
+orchestration and must never pay a backend import or claim a chip.
+"""
+
+from nm03_capstone_project_tpu.fleet.manager import (
+    RestartError,
+    rolling_restart,
+)
+from nm03_capstone_project_tpu.fleet.replicas import (
+    EJECTED,
+    HEALTHY,
+    PROBATION,
+    REPLICA_STATE_VALUES,
+    ReplicaStates,
+    normalize_target,
+    target_label,
+)
+from nm03_capstone_project_tpu.fleet.router import (
+    FleetApp,
+    make_http_server,
+    serve_in_thread,
+)
+
+__all__ = [
+    "EJECTED",
+    "HEALTHY",
+    "PROBATION",
+    "REPLICA_STATE_VALUES",
+    "FleetApp",
+    "ReplicaStates",
+    "RestartError",
+    "make_http_server",
+    "normalize_target",
+    "rolling_restart",
+    "serve_in_thread",
+    "target_label",
+]

@@ -1,0 +1,245 @@
+"""``nm03-fleet``: the replica-fleet front-end and its orchestration.
+
+Two subcommands (docs/OPERATIONS.md, "Running a fleet"):
+
+* ``nm03-fleet serve --replicas URL,URL,...`` — the routing front-end:
+  proxies ``POST /v1/segment`` across the replicas with capacity-weighted
+  routing, outlier ejection, failover and backpressure propagation, and
+  serves its own ``/healthz`` / ``/readyz`` / ``/metrics`` /
+  ``/metrics.json`` (the ``fleet_*`` series);
+* ``nm03-fleet restart --replicas URL,URL,...`` — rolling-restart
+  orchestration: drain → relaunch → warm-wait, one replica at a time, so
+  a redeploy never drops the fleet below (N−1)/N capacity (pass a shared
+  ``--compile-cache-dir`` to make every warm-wait a PR-9 cache hit).
+
+jax-/numpy-free at import by contract (NM301 pins the package): a fleet
+front-end must start in milliseconds and never claim a chip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nm03-fleet", description=__doc__.strip().splitlines()[0]
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    s = sub.add_parser(
+        "serve", help="run the fleet routing front-end",
+        description="Proxy POST /v1/segment across N nm03-serve replicas "
+        "with capacity-weighted routing, ejection/probation, failover and "
+        "Retry-After propagation (docs/OPERATIONS.md, 'Running a fleet').",
+    )
+    s.add_argument(
+        "--replicas", required=True, metavar="URL[,URL...]",
+        help="comma list of replica base URLs (host:port accepted)",
+    )
+    s.add_argument("--host", default="127.0.0.1", help="bind address")
+    s.add_argument(
+        "--port", type=int, default=8070, help="bind port (0 = ephemeral)"
+    )
+    s.add_argument(
+        "--port-file", default=None, metavar="PATH",
+        help="write the bound port here once listening (written atomically)",
+    )
+    s.add_argument(
+        "--health-interval-s", type=float, default=1.0,
+        help="replica /readyz poll cadence — the ejection detection latency",
+    )
+    s.add_argument(
+        "--probe-interval-s", type=float, default=5.0,
+        help="probation canary cadence for ejected replicas (an off-path "
+        "POST /v1/segment on a synthetic slice; success reinstates)",
+    )
+    s.add_argument(
+        "--health-timeout-s", type=float, default=2.0,
+        help="per-poll HTTP timeout; a poll past this ejects (cause timeout)",
+    )
+    s.add_argument(
+        "--proxy-timeout-s", type=float, default=90.0,
+        help="per-hop proxied-request timeout; expiry ejects the replica "
+        "and fails the request over",
+    )
+    s.add_argument(
+        "--canary-hw", type=int, default=32, metavar="N",
+        help="probation canary slice is NxN zeros, auto-clamped into the "
+        "replica's published min-dim..canvas window (this flag is the "
+        "floor when the replica publishes neither)",
+    )
+    s.add_argument(
+        "--fault-plan", default=None, metavar="SPEC",
+        help="chaos plan (site 'fleet': replica_unreachable / "
+        "proxy_io_error; docs/RESILIENCE.md). Default: $NM03_FAULT_PLAN",
+    )
+    s.add_argument(
+        "--metrics-out", default=None, metavar="PATH",
+        help="write the fleet_* metrics snapshot here at drain",
+    )
+    s.add_argument(
+        "--log-json", default=None, metavar="PATH",
+        help="append fleet events (replica_ejected/reinstated, fleet_drain) "
+        "as nm03.events.v1 JSONL here",
+    )
+    s.add_argument("--verbose", action="store_true", help="enable INFO logging")
+
+    r = sub.add_parser(
+        "restart", help="rolling-restart the replicas, one at a time",
+        description="SIGTERM -> drain-wait -> relaunch (from each "
+        "replica's own /readyz relaunch_argv) -> /readyz warm-wait, one "
+        "replica at a time; same-host by construction.",
+    )
+    r.add_argument(
+        "--replicas", required=True, metavar="URL[,URL...]",
+        help="comma list of replica base URLs, restarted in order",
+    )
+    r.add_argument(
+        "--compile-cache-dir", default=None, metavar="DIR",
+        help="ensure every relaunch carries this persistent AOT cache dir "
+        "(PR 9) so the warm-wait is a deserialization, not a compile",
+    )
+    r.add_argument(
+        "--fleet-url", default=None, metavar="URL",
+        help="an nm03-fleet front-end to consult: wait until it reinstates "
+        "each restarted replica before draining the next (guarantees at "
+        "most one replica out of rotation)",
+    )
+    r.add_argument(
+        "--drain-timeout-s", type=float, default=120.0,
+        help="max wait for a SIGTERMed replica's listener to close",
+    )
+    r.add_argument(
+        "--warm-timeout-s", type=float, default=600.0,
+        help="max wait for a relaunched replica's /readyz 200",
+    )
+    r.add_argument(
+        "--format", choices=["text", "json"], default="text",
+        help="report format (json = the machine/CI interface)",
+    )
+    return p
+
+
+def _split_targets(spec: str):
+    targets = [t.strip() for t in str(spec).split(",") if t.strip()]
+    if not targets:
+        raise SystemExit("nm03-fleet: --replicas needs at least one URL")
+    return targets
+
+
+def _serve(args) -> int:
+    from nm03_capstone_project_tpu.fleet.router import (
+        FleetApp,
+        make_http_server,
+    )
+    from nm03_capstone_project_tpu.obs import RunContext
+    from nm03_capstone_project_tpu.resilience import FaultPlan
+    from nm03_capstone_project_tpu.utils.reporter import configure_reporting
+
+    configure_reporting(verbose=args.verbose)
+    plan = (
+        FaultPlan.from_spec(args.fault_plan)
+        if args.fault_plan else FaultPlan.from_env()
+    )
+    obs = RunContext.create(
+        "fleet", metrics_out=args.metrics_out, log_json=args.log_json,
+        argv=sys.argv[1:],
+    )
+    app = FleetApp(
+        _split_targets(args.replicas),
+        obs=obs,
+        health_interval_s=args.health_interval_s,
+        probe_interval_s=args.probe_interval_s,
+        health_timeout_s=args.health_timeout_s,
+        proxy_timeout_s=args.proxy_timeout_s,
+        canary_hw=args.canary_hw,
+        fault_plan=plan,
+    )
+    httpd = make_http_server(app, args.host, args.port)
+    port = httpd.server_address[1]
+    app.start()
+    if args.port_file:
+        from nm03_capstone_project_tpu.utils.atomicio import atomic_write_text
+
+        atomic_write_text(args.port_file, f"{port}\n")
+    print(
+        f"nm03-fleet: listening on {args.host}:{port} "
+        f"({app.replicas.healthy_count()}/{len(app.replicas)} replicas "
+        "healthy)",
+        flush=True,
+    )
+
+    def _drain_and_stop(signum, frame):
+        def work():
+            app.begin_drain(reason=signal.Signals(signum).name.lower())
+            httpd.shutdown()
+
+        threading.Thread(target=work, name="nm03-fleet-drain", daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _drain_and_stop)
+    signal.signal(signal.SIGINT, _drain_and_stop)
+    try:
+        httpd.serve_forever()
+    finally:
+        httpd.server_close()
+        app.begin_drain(reason="exit")  # idempotent after a signal drain
+        app.close(status="ok")
+    print("nm03-fleet: drained and stopped", flush=True)
+    return 0
+
+
+def _restart(args) -> int:
+    from nm03_capstone_project_tpu.fleet.manager import (
+        RestartError,
+        rolling_restart,
+    )
+
+    def emit(msg: str) -> None:
+        print(msg, file=sys.stderr, flush=True)
+
+    try:
+        report = rolling_restart(
+            _split_targets(args.replicas),
+            compile_cache_dir=args.compile_cache_dir,
+            drain_timeout_s=args.drain_timeout_s,
+            warm_timeout_s=args.warm_timeout_s,
+            fleet_url=args.fleet_url,
+            emit=emit,
+        )
+    except RestartError as e:
+        report = getattr(e, "report", {"ok": False, "replicas": []})
+        print(json.dumps(report, indent=2))
+        print(f"nm03-fleet restart: FAILED: {e}", file=sys.stderr, flush=True)
+        return 1
+    if args.format == "json":
+        print(json.dumps(report, indent=2))
+    else:
+        for r in report["replicas"]:
+            print(
+                f"{r['replica']:<22} pid {r['old_pid']} -> {r['new_pid']}  "
+                f"drain {r['drain_s']}s  warm {r['warm_s']}s  "
+                f"builds {r['builds']}  cache_hits {r['cache_hits']}"
+            )
+        done = sum(1 for r in report["replicas"] if r.get("ok"))
+        print(
+            f"nm03-fleet restart: {done}/{len(report['replicas'])} replicas "
+            "restarted",
+            flush=True,
+        )
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "serve":
+        return _serve(args)
+    return _restart(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,674 @@
+"""The fleet front-end: capacity-weighted routing, failover, backpressure.
+
+``nm03-fleet serve`` puts a stdlib :class:`ThreadingHTTPServer` in front
+of N ``nm03-serve`` replicas (ROADMAP item 3 — the source paper spreads a
+patient batch across OpenMP workers inside one host; at production scale
+the same move is spreading traffic across replica *processes*, so one
+process death is 1/N capacity, not 100%):
+
+* ``POST /v1/segment`` proxies to one replica, chosen by **smooth
+  weighted round-robin** over the currently-healthy set with weights from
+  the replicas' own published signals — ``/readyz`` ``capacity`` (the
+  healthy-lane fraction, PR 8) × admission-queue headroom (PR 4) —
+  refreshed by a background health-poll loop;
+* a replica that times out, refuses connections, answers 503, or reports
+  zero capacity is **ejected** through the same HEALTHY → EJECTED →
+  PROBATION → HEALTHY machine ``serving/lanes.py`` runs for chips
+  (probation = an off-path canary ``POST /v1/segment`` on a synthetic
+  zero slice; reinstatement on success);
+* a proxied request that dies on a dying replica (connection reset,
+  timeout, aborted body) **fails over** to a healthy replica under a
+  bounded hop budget — riders never fail; ``X-Nm03-Replica`` and
+  ``replica_hops`` in the payload tell the truth;
+* a replica's 503 is **backpressure, honored**: the request reroutes
+  while a healthy alternative exists, and when none does the client gets
+  the replica's own ``Retry-After`` back instead of having the shed
+  swallowed by the middle tier;
+* 4xx/5xx application verdicts (a malformed body is malformed on every
+  replica) propagate as-is — only transport failures and shed reroute.
+
+``GET /healthz`` / ``/readyz`` / ``/metrics`` / ``/metrics.json`` serve
+the FLEET's own state: ``/readyz`` is 200 while ≥1 replica is healthy
+(the payload carries the per-replica table and the routed ``capacity``
+fraction a chaos drill's plateau is read from) and the ``fleet_*`` series
+live in an ordinary obs registry.
+
+jax-/numpy-free at import by contract (NM301 pins the package): the
+router is pure orchestration — bytes in, bytes out — and must start in
+milliseconds on a host that never pays a backend import.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, FrozenSet, List, Optional, Tuple
+from urllib.parse import urlsplit
+
+from nm03_capstone_project_tpu.fleet.replicas import (
+    EJECTED,
+    ReplicaStates,
+    normalize_target,
+    target_label,
+)
+from nm03_capstone_project_tpu.obs.metrics import (
+    FLEET_FAILOVERS_TOTAL,
+    FLEET_PROBES_TOTAL,
+    FLEET_REPLICAS_EJECTED,
+    FLEET_REPLICAS_READY,
+    FLEET_REQUESTS_ROUTED_TOTAL,
+    FLEET_ROUTED_CAPACITY,
+    FLEET_SHED_TOTAL,
+)
+from nm03_capstone_project_tpu.utils.reporter import get_logger
+
+log = get_logger("fleet")
+
+RETRY_AFTER_S = 1  # the fleet-wide shed hint when no replica named one
+# request headers forwarded replica-ward (lowercase); responses echo
+# every X-Nm03-*
+_FORWARD_HEADERS = ("content-type",)
+_FORWARD_PREFIX = "x-nm03-"
+_MAX_BODY_BYTES = 64 << 20  # replicas enforce their own canvas-derived cap
+_WEIGHT_FLOOR = 0.01  # a healthy replica with a full queue is still pickable
+
+
+class FleetApp:
+    """Everything behind the fleet HTTP handler: states, poller, proxy."""
+
+    def __init__(
+        self,
+        targets,
+        obs=None,
+        health_interval_s: float = 1.0,
+        probe_interval_s: float = 5.0,
+        health_timeout_s: float = 2.0,
+        proxy_timeout_s: float = 90.0,
+        canary_hw: int = 32,
+        canary_timeout_s: float = 30.0,
+        fault_plan=None,
+    ):
+        if obs is None:
+            from nm03_capstone_project_tpu.obs import RunContext
+
+            obs = RunContext.create(driver="fleet")
+        self.obs = obs
+        self.registry = obs.registry
+        self.fault_plan = fault_plan
+        self.replicas = ReplicaStates(targets, obs=obs)
+        self.health_interval_s = float(health_interval_s)
+        self.probe_interval_s = float(probe_interval_s)
+        self.health_timeout_s = float(health_timeout_s)
+        self.proxy_timeout_s = float(proxy_timeout_s)
+        self.canary_hw = int(canary_hw)
+        self.canary_timeout_s = float(canary_timeout_s)
+        self._lock = threading.Lock()
+        # smooth-WRR current weights; the picker state (nginx algorithm:
+        # add each candidate's weight, pick the max, subtract the total —
+        # deterministic, proportional, no starvation)
+        self._wrr: Dict[str, float] = {t: 0.0 for t in self.replicas.targets}
+        self._seq = 0  # proxied-request ordinal (the fault-plan index key)
+        self._probe_seq = 0
+        self._last_probe: Dict[str, float] = {}
+        self.draining = False
+        self._stop = threading.Event()
+        self._poller = threading.Thread(
+            target=self._poll_loop, name="nm03-fleet-health", daemon=True
+        )
+        self._t0 = time.monotonic()
+        # the shed counter exists at 0 from startup so a clean run's
+        # snapshot proves "nothing shed" rather than saying nothing (the
+        # labeled failover/routed counters appear with their first real
+        # labels — an empty-label placeholder would be a phantom series)
+        self.registry.counter(
+            FLEET_SHED_TOTAL,
+            help="requests answered 503 by the fleet (every replica shed "
+            "or unhealthy); carries the replica's own Retry-After through",
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "FleetApp":
+        """One synchronous health sweep (routing starts informed), then
+        the background poll loop."""
+        self._sweep()
+        self._poller.start()
+        self.obs.events.emit(
+            "fleet_ready",
+            targets=[target_label(t) for t in self.replicas.targets],
+            healthy=self.replicas.healthy_count(),
+        )
+        return self
+
+    def begin_drain(self, reason: str = "sigterm") -> None:
+        """Stop the poll loop, flush telemetry. Idempotent."""
+        with self._lock:
+            if self.draining:
+                return
+            self.draining = True
+        self._stop.set()
+        self._poller.join(timeout=10.0)
+        self.obs.events.emit("fleet_drain", level="WARNING", reason=reason)
+        try:
+            self.publish_gauges()
+            self.obs.write_metrics()
+        except Exception as e:  # noqa: BLE001 — telemetry never blocks a drain
+            log.warning("fleet drain: metrics flush failed: %s", e)
+
+    def close(self, status: str = "ok") -> None:
+        self.obs.close(status=status)
+
+    # -- health loop -------------------------------------------------------
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.health_interval_s):
+            try:
+                self._sweep()
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                log.warning("fleet health sweep failed: %s", e)
+
+    def _sweep(self) -> None:
+        """One pass: poll every replica, canary the due ejected ones.
+
+        Polls run CONCURRENTLY (one short-lived thread per target): a
+        wedged replica that accepts but never answers costs its own
+        ``health_timeout_s``, not a serial N× stretch of every other
+        replica's ejection-detection latency — the contract
+        ``--health-interval-s`` advertises. A poll that outlives the
+        join grace is treated as not-ok for this sweep (its late signal
+        write is lock-guarded and harmless).
+        """
+        targets = self.replicas.targets
+        if len(targets) == 1:
+            outcomes = {targets[0]: self._poll_one(targets[0])}
+        else:
+            outcomes: Dict[str, bool] = {}
+            guard = threading.Lock()
+
+            def poll(t: str) -> None:
+                ok = self._poll_one(t)
+                with guard:
+                    outcomes[t] = ok
+
+            threads = [
+                threading.Thread(
+                    target=poll, args=(t,),
+                    name=f"nm03-fleet-poll-{target_label(t)}", daemon=True,
+                )
+                for t in targets
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=self.health_timeout_s + 5.0)
+        for target in targets:
+            if outcomes.get(target) and self.replicas.state(target) == EJECTED:
+                self._maybe_probe(target)
+        self.publish_gauges()
+
+    def _poll_one(self, target: str) -> bool:
+        """GET ``/readyz``; classify. True = 200 with routable capacity."""
+        plan = self.fault_plan
+        if plan is not None and plan.has_site("fleet"):
+            rule = plan.fire(
+                "fleet", obs=self.obs, stem=target_label(target),
+                kinds=("replica_unreachable",),
+            )
+            if rule is not None:
+                # the drill's deterministic outage: the poll "refused"
+                self._handle_unhealthy(target, "refused")
+                return False
+        try:
+            req = urllib.request.Request(f"{target}/readyz", method="GET")
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=self.health_timeout_s
+                ) as resp:
+                    status, body = resp.status, resp.read()
+            except urllib.error.HTTPError as e:  # 503 still carries a payload
+                status, body = e.code, e.read()
+        except Exception as e:  # noqa: BLE001 — classified, never raised
+            cause = "timeout" if "timed out" in str(e).lower() else "refused"
+            self._handle_unhealthy(target, cause)
+            return False
+        try:
+            st = json.loads(body or b"{}")
+        except json.JSONDecodeError:
+            st = {}
+        capacity = st.get("capacity")
+        if status != 200:
+            self._handle_unhealthy(target, f"http_{status}")
+            return False
+        if capacity is not None and float(capacity) <= 0.0:
+            self._handle_unhealthy(target, "zero_capacity")
+            return False
+        self.replicas.update_signals(
+            target,
+            capacity=capacity,
+            queue_depth=st.get("queue_depth"),
+            queue_capacity=st.get("queue_capacity"),
+            identity=st.get("replica"),
+            canvas=st.get("canvas"),
+            min_dim=st.get("min_dim"),
+        )
+        return True
+
+    def _handle_unhealthy(self, target: str, cause: str) -> None:
+        self.replicas.eject(target, cause)  # no-op unless HEALTHY
+
+    def _maybe_probe(self, target: str) -> None:
+        """Probation canary for an ejected replica whose poll just passed.
+
+        Gated on the probe cadence AND on the same-sweep ``/readyz``
+        success, so a replica that is simply down never costs a canary —
+        and an injected ``replica_unreachable`` outage (which fails the
+        poll) deterministically holds the replica out. The canary itself
+        runs on its own daemon thread: a wedged replica that accepts the
+        connection but never answers would otherwise hold the single
+        sweep thread for ``canary_timeout_s``, blinding the health poll
+        to every OTHER replica for the duration (the begin_probation
+        claim keeps two canaries off one target).
+        """
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_probe.get(target, -1e9) < self.probe_interval_s:
+                return
+            self._last_probe[target] = now
+            self._probe_seq += 1
+            n = self._probe_seq
+        threading.Thread(
+            target=self._probe_one, args=(target, n),
+            name=f"nm03-fleet-probe-{target_label(target)}", daemon=True,
+        ).start()
+
+    def _probe_one(self, target: str, n: int) -> None:
+        """One probation canary: claim, POST, reinstate or re-eject."""
+        if not self.replicas.begin_probation(target):
+            return
+        # size the canary inside the replica's own published guards: a
+        # 32x32 default against a --min-dim 100 replica would be a 400
+        # on every probe and an ejection that never heals (the bug the
+        # first live drill caught) — the replica tells us what fits
+        sig = self.replicas.signals(target)
+        hw = self.canary_hw
+        if sig.get("min_dim"):
+            hw = max(hw, int(sig["min_dim"]))
+        if sig.get("canvas"):
+            hw = min(hw, int(sig["canvas"]))
+        body = bytes(hw * hw * 4)  # a zero float32 slice — the warmup input
+        headers = {
+            "Content-Type": "application/octet-stream",
+            "X-Nm03-Height": str(hw),
+            "X-Nm03-Width": str(hw),
+            "X-Nm03-Request-Id": f"fleet-probe-{target_label(target)}-{n}",
+        }
+        outcome = "failed"
+        try:
+            req = urllib.request.Request(
+                f"{target}/v1/segment?output=mask", data=body,
+                headers=headers, method="POST",
+            )
+            with urllib.request.urlopen(
+                req, timeout=self.canary_timeout_s
+            ) as resp:
+                resp.read()
+                ok = resp.status == 200
+        except Exception:  # noqa: BLE001 — a failed canary is an outcome
+            ok = False
+        if ok:
+            outcome = "passed"
+            self.replicas.reinstate(target)
+        else:
+            self.replicas.fail_probation(target)
+        try:
+            self.registry.counter(
+                FLEET_PROBES_TOTAL,
+                help="probation canary requests by replica and outcome",
+                replica=target_label(target), outcome=outcome,
+            ).inc()
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- routing -----------------------------------------------------------
+
+    def pick(self, exclude: FrozenSet[str] = frozenset()) -> Optional[str]:
+        """Smooth weighted round-robin over healthy, non-excluded targets."""
+        healthy = [
+            t for t in self.replicas.healthy_targets() if t not in exclude
+        ]
+        if not healthy:
+            return None
+        weights = {
+            t: max(self.replicas.weight(t), _WEIGHT_FLOOR) for t in healthy
+        }
+        total = sum(weights.values())
+        with self._lock:
+            for t, w in weights.items():
+                self._wrr[t] = self._wrr.get(t, 0.0) + w
+            best = max(healthy, key=lambda t: self._wrr[t])
+            self._wrr[best] -= total
+        return best
+
+    def _next_seq(self) -> int:
+        with self._lock:
+            self._seq += 1
+            return self._seq
+
+    def _forward(
+        self, target: str, body: bytes, headers: dict, query: str
+    ) -> Tuple[int, bytes, List[Tuple[str, str]]]:
+        """One proxied POST to ``target``; HTTP errors return, transport
+        errors raise (the caller's failover trigger)."""
+        url = f"{target}/v1/segment" + (f"?{query}" if query else "")
+        req = urllib.request.Request(
+            url, data=body, headers=headers, method="POST"
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.proxy_timeout_s
+            ) as resp:
+                return resp.status, resp.read(), list(resp.getheaders())
+        except urllib.error.HTTPError as e:
+            data = e.read()
+            return e.code, data, list(e.headers.items()) if e.headers else []
+
+    def _count_failover(self, target: str, cause: str) -> None:
+        self.registry.counter(
+            FLEET_FAILOVERS_TOTAL,
+            help="proxied requests moved off a replica mid-flight by "
+            "replica and cause (io_error = transport death, shed = "
+            "rerouted 503 backpressure)",
+            replica=target_label(target), cause=cause,
+        ).inc()
+
+    def proxy_segment(
+        self, body: bytes, headers: dict, query: str = ""
+    ) -> Tuple[int, bytes, List[Tuple[str, str]]]:
+        """Route one ``POST /v1/segment``; (status, body, response headers).
+
+        The failover ladder: transport death ejects the replica and moves
+        the request on; a 503 remembers the replica's Retry-After and
+        tries an alternative; each replica is tried at most once, and the
+        budget is bounded by the fleet size — no infinite ping-pong even
+        against a racing reinstatement.
+        """
+        seq = self._next_seq()
+        plan = self.fault_plan
+        tried: set = set()
+        hops = 0
+        shed: Optional[Tuple[int, bytes, List[Tuple[str, str]]]] = None
+        while True:
+            target = self.pick(exclude=frozenset(tried))
+            if target is None:
+                break
+            tried.add(target)
+            if plan is not None and plan.has_site("fleet"):
+                rule = plan.fire(
+                    "fleet", obs=self.obs, stem=target_label(target),
+                    index=seq, kinds=("proxy_io_error",),
+                )
+                if rule is not None:
+                    # the drill's deterministic mid-body abort: same path
+                    # a real connection reset takes
+                    self.replicas.eject(target, "proxy_error")
+                    self._count_failover(target, "io_error")
+                    hops += 1
+                    continue
+            try:
+                status, data, resp_headers = self._forward(
+                    target, body, headers, query
+                )
+            except Exception as e:  # noqa: BLE001 — transport death → failover
+                log.warning(
+                    "proxy to %s failed (%s); failing over",
+                    target_label(target), e,
+                )
+                self.replicas.eject(target, "proxy_error")
+                self._count_failover(target, "io_error")
+                hops += 1
+                continue
+            if status == 503:
+                # backpressure: reroute while an alternative exists,
+                # propagate the replica's own Retry-After when none does
+                shed = (status, data, resp_headers)
+                self._count_failover(target, "shed")
+                hops += 1
+                continue
+            # a routed verdict (200 or an application error) returns as-is
+            self.registry.counter(
+                FLEET_REQUESTS_ROUTED_TOTAL,
+                help="requests served to completion by each replica "
+                "(non-503 responses returned to the client)",
+                replica=target_label(target),
+            ).inc()
+            out_headers = self._response_headers(resp_headers, target, hops)
+            if status == 200:
+                data = self._augment_payload(data, target, hops)
+            return status, data, out_headers
+        # no healthy replica left (or every one shed / died)
+        self.registry.counter(
+            FLEET_SHED_TOTAL,
+            help="requests answered 503 by the fleet (every replica shed "
+            "or unhealthy); carries the replica's own Retry-After through",
+        ).inc()
+        if shed is not None:
+            status, data, resp_headers = shed
+            retry_after = next(
+                (v for k, v in resp_headers if k.lower() == "retry-after"),
+                str(RETRY_AFTER_S),
+            )
+        else:
+            retry_after = str(RETRY_AFTER_S)
+            data = json.dumps({
+                "error": "no healthy replica "
+                f"({self.replicas.ejected_count()} of "
+                f"{len(self.replicas)} ejected)",
+                "replica_hops": hops,
+            }).encode()
+        return 503, data, [
+            ("Content-Type", "application/json"),
+            ("Retry-After", retry_after),
+        ]
+
+    def _response_headers(
+        self, resp_headers: List[Tuple[str, str]], target: str, hops: int
+    ) -> List[Tuple[str, str]]:
+        """Replica ``X-Nm03-*``/Content-Type headers + the fleet's own.
+
+        The prefix filter drops the replica's Content-Length by
+        construction — the handler recomputes it against the (possibly
+        augmented) body, so a stale length can never reach the client.
+        """
+        out = [
+            (k, v) for k, v in resp_headers
+            if k.lower().startswith(_FORWARD_PREFIX)
+            or k.lower() == "content-type"
+        ]
+        out.append(("X-Nm03-Replica", target_label(target)))
+        out.append(("X-Nm03-Replica-Hops", str(hops)))
+        return out
+
+    def _augment_payload(self, data: bytes, target: str, hops: int) -> bytes:
+        """Add ``replica``/``replica_id``/``replica_hops`` to a 200 payload."""
+        try:
+            payload = json.loads(data)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return data  # non-JSON passes through untouched
+        if not isinstance(payload, dict):
+            return data
+        payload["replica"] = target_label(target)
+        identity = self.replicas.signals(target).get("identity") or {}
+        payload["replica_id"] = identity.get("id")
+        payload["replica_hops"] = hops
+        return json.dumps(payload).encode()
+
+    # -- status / telemetry ------------------------------------------------
+
+    def publish_gauges(self) -> None:
+        """Refresh the fleet-level gauges from the current state table."""
+        healthy = self.replicas.healthy_count()
+        self.registry.gauge(
+            FLEET_REPLICAS_READY,
+            help="replicas currently HEALTHY and taking routed traffic",
+        ).set(healthy)
+        self.registry.gauge(
+            FLEET_REPLICAS_EJECTED,
+            help="replicas currently out of rotation (ejected or under "
+            "probation)",
+        ).set(self.replicas.ejected_count())
+        self.registry.gauge(
+            FLEET_ROUTED_CAPACITY,
+            help="the fleet's routed capacity fraction: mean healthy-replica "
+            "published capacity (one dead replica of three reads 0.667)",
+        ).set(round(self.replicas.capacity_fraction(), 6))
+
+    @property
+    def ready(self) -> bool:
+        with self._lock:
+            draining = self.draining
+        return self.replicas.healthy_count() >= 1 and not draining
+
+    def status(self) -> dict:
+        snap = self.replicas.snapshot()
+        return {
+            "ready": self.ready,
+            "draining": self.draining,
+            "fleet": True,
+            "capacity": round(self.replicas.capacity_fraction(), 6),
+            "replicas": {
+                "count": len(self.replicas),
+                "ready": self.replicas.healthy_count(),
+                "ejected": self.replicas.ejected_count(),
+                "per_replica": snap,
+            },
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+        }
+
+
+# -- the HTTP layer ---------------------------------------------------------
+
+
+def make_handler(app: FleetApp):
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        server_version = "nm03-fleet/1.0"
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # noqa: A003
+            log.debug("%s %s", self.address_string(), fmt % args)
+
+        def _reply(self, status: int, data: bytes, headers=()):
+            self.send_response(status)
+            seen_ct = False
+            for k, v in headers:
+                if k.lower() == "content-type":
+                    seen_ct = True
+                self.send_header(k, v)
+            if not seen_ct:
+                self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _reply_json(self, status: int, body: dict, headers=()):
+            self._reply(status, json.dumps(body).encode(), headers)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            path = urlsplit(self.path).path
+            if path == "/healthz":
+                self._reply_json(
+                    200,
+                    {"status": "alive",
+                     "uptime_s": round(time.monotonic() - app._t0, 3)},
+                )
+            elif path == "/readyz":
+                app.publish_gauges()
+                st = app.status()
+                self._reply_json(200 if st["ready"] else 503, st)
+            elif path == "/metrics":
+                app.publish_gauges()
+                self._reply(
+                    200, app.registry.to_prometheus().encode(),
+                    [("Content-Type", "text/plain; version=0.0.4")],
+                )
+            elif path == "/metrics.json":
+                app.publish_gauges()
+                self._reply(
+                    200,
+                    json.dumps(app.obs.metrics_snapshot(), indent=1).encode(),
+                    [("Content-Type", "application/json")],
+                )
+            else:
+                self._reply_json(404, {"error": f"unknown path {path}"})
+
+        def do_POST(self):  # noqa: N802
+            split = urlsplit(self.path)
+            if split.path != "/v1/segment":
+                self._reply_json(404, {"error": f"unknown path {split.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+            except ValueError:
+                self._reply_json(400, {"error": "bad Content-Length"})
+                return
+            if length <= 0:
+                self._reply_json(400, {"error": "empty body"})
+                return
+            if length > _MAX_BODY_BYTES:
+                self._reply_json(
+                    413,
+                    {"error": f"body of {length} bytes exceeds the fleet cap"},
+                )
+                return
+            body = self.rfile.read(length)
+            headers = {
+                k: v for k, v in self.headers.items()
+                if k.lower().startswith(_FORWARD_PREFIX)
+                or k.lower() in _FORWARD_HEADERS
+            }
+            try:
+                status, data, resp_headers = app.proxy_segment(
+                    body, headers, split.query
+                )
+            except Exception as e:  # noqa: BLE001 — per-request containment
+                log.warning("fleet request failed: %s", e)
+                self._reply_json(
+                    500, {"error": str(e), "error_class": type(e).__name__}
+                )
+                return
+            self._reply(status, data, resp_headers)
+
+    return Handler
+
+
+def make_http_server(app: FleetApp, host: str = "127.0.0.1", port: int = 0):
+    """Bind (port 0 = ephemeral); ``.server_address`` carries the real port."""
+    from http.server import ThreadingHTTPServer
+
+    httpd = ThreadingHTTPServer((host, port), make_handler(app))
+    httpd.daemon_threads = True
+    return httpd
+
+
+def serve_in_thread(app: FleetApp, host: str = "127.0.0.1", port: int = 0):
+    """Start a fleet on a daemon thread; ``(httpd, thread, port)`` (tests)."""
+    httpd = make_http_server(app, host, port)
+    app.start()
+    t = threading.Thread(
+        target=httpd.serve_forever, name="nm03-fleet-http", daemon=True
+    )
+    t.start()
+    return httpd, t, httpd.server_address[1]
+
+
+__all__ = [
+    "FleetApp",
+    "make_handler",
+    "make_http_server",
+    "normalize_target",
+    "serve_in_thread",
+]

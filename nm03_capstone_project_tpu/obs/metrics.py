@@ -70,6 +70,24 @@ INGEST_UPLOAD_OVERLAP_RATIO = "ingest_upload_overlap_ratio"
 # contract) and obs must not import the serving package, whose __init__
 # pulls numpy. serving/metrics.py re-exports them for serving-side callers.
 SERVING_LANE_BUSY_FRACTION = "serving_lane_busy_fraction"
+# fleet front-end (fleet/ subsystem, ISSUE 13): the replica-level fault
+# domain's telemetry — replica routing state, routed/failover/shed
+# accounting and the routed-capacity fraction. Defined HERE (not in a
+# fleet-local module) for the same reason as the serving saturation
+# names: the fleet package is jax-/numpy-free by contract and this
+# module is the NM392-checked definition home, so a fleet series can
+# neither ship undocumented nor linger documented after removal.
+FLEET_REPLICAS_READY = "fleet_replicas_ready"
+FLEET_REPLICAS_EJECTED = "fleet_replicas_ejected"
+FLEET_ROUTED_CAPACITY = "fleet_routed_capacity"
+FLEET_REPLICA_STATE = "fleet_replica_state"
+FLEET_REPLICA_CAPACITY = "fleet_replica_capacity"
+FLEET_REQUESTS_ROUTED_TOTAL = "fleet_requests_routed_total"
+FLEET_FAILOVERS_TOTAL = "fleet_failovers_total"
+FLEET_SHED_TOTAL = "fleet_shed_total"
+FLEET_REPLICA_EJECTIONS_TOTAL = "fleet_replica_ejections_total"
+FLEET_REPLICA_REINSTATED_TOTAL = "fleet_replica_reinstated_total"
+FLEET_PROBES_TOTAL = "fleet_probes_total"
 SERVING_BUSY_FRACTION = "serving_busy_fraction"
 SERVING_LANE_IDLE_GAP_SECONDS = "serving_lane_idle_gap_seconds"
 SERVING_LANE_MFU = "serving_lane_mfu"

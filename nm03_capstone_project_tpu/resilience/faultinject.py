@@ -51,7 +51,12 @@ Kinds by site:
 * ``ingest``:   ``decode_error`` (fail one work item on the streaming
   ingest's decode pool — contained, counted, never propagated),
   ``stall`` (wedge the stager ``hang_s`` seconds — the backpressure
-  drill for the staging ring).
+  drill for the staging ring);
+* ``fleet``:    ``replica_unreachable`` (the router's health poll for one
+  chosen replica behaves as connection-refused; ``stem`` = the replica's
+  host:port — the deterministic ejection drill), ``proxy_io_error``
+  (abort one proxied request mid-flight; ``index`` = proxied-request
+  ordinal — the deterministic failover drill).
 
 Injected faults are observable: every fire increments
 ``resilience_faults_injected_total{site,kind}`` and emits a
@@ -72,7 +77,7 @@ from nm03_capstone_project_tpu.resilience.policy import TransientDeviceError
 
 ENV_VAR = "NM03_FAULT_PLAN"
 
-SITES = ("decode", "dispatch", "export", "cache", "ingest")
+SITES = ("decode", "dispatch", "export", "cache", "ingest", "fleet")
 KINDS_BY_SITE = {
     "decode": ("error", "corrupt"),
     "dispatch": ("transient", "hang"),
@@ -90,6 +95,16 @@ KINDS_BY_SITE = {
     # `index` selects the work item (batch index for the parallel driver,
     # slice index for the sequential one).
     "ingest": ("decode_error", "stall"),
+    # the replica-fleet front-end (fleet/, ISSUE 13): `replica_unreachable`
+    # makes the router's health poll for one chosen replica behave as
+    # connection-refused (`stem` selects the replica's host:port label) —
+    # the deterministic ejection drill; `proxy_io_error` aborts one
+    # proxied request mid-flight on its way to a replica (`index` selects
+    # the proxied-request ordinal) — the deterministic failover drill.
+    # The router's two injection points share this site and disambiguate
+    # with fire()'s `kinds` filter, so one kind's rules never consume the
+    # other's after/count budget.
+    "fleet": ("replica_unreachable", "proxy_io_error"),
 }
 
 
@@ -233,7 +248,7 @@ class FaultPlan:
 
     def fire(
         self, site: str, obs=None, patient=None, stem=None, index=None,
-        lane=None, lane_only=False,
+        lane=None, lane_only=False, kinds=None,
     ):
         """Return the first rule firing at this check site, else None.
 
@@ -244,6 +259,13 @@ class FaultPlan:
         deliberately-wedged chip, but must never consume a generic
         dispatch rule's ``count``/``after`` budget meant for request
         traffic.
+
+        ``kinds`` restricts the check to rules of the listed kinds, with
+        the same budget-untouched skip semantics. It exists for sites
+        whose kinds live at DIFFERENT call points (the fleet router's
+        health poll vs its proxy path): without it, a ``proxy_io_error``
+        rule would match — and consume its ``count`` budget at — every
+        health-poll check it was never meant for.
 
         Consumes ordinal (``after``) and budget (``count``) state; emits the
         ``resilience_faults_injected_total`` counter + ``fault_injected``
@@ -256,6 +278,8 @@ class FaultPlan:
         with self._lock:
             for i, r in enumerate(self.rules):
                 if lane_only and r.lane is None:
+                    continue
+                if kinds is not None and r.kind not in kinds:
                     continue
                 if r.site != site or not r.selectors_match(
                     patient, stem, index, lane

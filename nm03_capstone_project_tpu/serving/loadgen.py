@@ -20,6 +20,13 @@ Two traffic models:
   matter how the server is doing — the model that actually exposes queue
   growth and shedding (closed loops self-throttle and hide both).
 
+``--targets URL[,URL...]`` is the multi-target mode (ISSUE 13): drive an
+``nm03-fleet`` front-end (or replicas directly) with request *i* going to
+``targets[i % n]``; the summary gains ``replicas_observed`` /
+``failovers_observed`` / ``fleet_capacity_min_observed`` from the fleet
+payload's truth fields and its ``/readyz`` — a chaos run's throughput dip
+comes explained.
+
 ``--self-serve`` brings up an in-process server (ephemeral port) first —
 the zero-setup smoke: ``nm03-loadgen --self-serve --requests 40``. Pure
 stdlib HTTP client; payloads are synthetic phantom slices sent as raw
@@ -35,6 +42,7 @@ import json
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 import uuid
 from typing import List, Optional
@@ -71,6 +79,11 @@ class LoadResult:
         self.batch_sizes: collections.Counter = collections.Counter()
         self.queue_waits_s: List[float] = []
         self.lanes: collections.Counter = collections.Counter()
+        # fleet attribution (ISSUE 13): which replica answered (the
+        # fleet payload's `replica`, or the target URL when driving
+        # replicas directly) and how many failover hops riders took
+        self.replicas: collections.Counter = collections.Counter()
+        self.failovers = 0
         self.requests_dropped = 0
         self.requests: List[dict] = []
         self.echo_mismatches = 0
@@ -79,7 +92,9 @@ class LoadResult:
     def record(self, status: str, latency_s: float, batch_size: int = 0,
                error: str = "", sent_id: str = "", echoed_id: str = "",
                queue_wait_s: Optional[float] = None,
-               lane: Optional[int] = None) -> None:
+               lane: Optional[int] = None,
+               replica: Optional[str] = None,
+               replica_hops: Optional[int] = None) -> None:
         with self._lock:
             self.statuses[status] += 1
             if status == "ok":
@@ -90,6 +105,10 @@ class LoadResult:
                     self.queue_waits_s.append(queue_wait_s)
                 if lane is not None:
                     self.lanes[lane] += 1
+                if replica is not None:
+                    self.replicas[replica] += 1
+                if replica_hops:
+                    self.failovers += 1
             elif error and len(self.errors) < 20:
                 self.errors.append(error)
             if sent_id and echoed_id and sent_id != echoed_id:
@@ -107,6 +126,10 @@ class LoadResult:
                     rec["lane"] = lane
                 if batch_size:
                     rec["batch_size"] = batch_size
+                if replica is not None:
+                    rec["replica"] = replica
+                if replica_hops is not None:
+                    rec["replica_hops"] = replica_hops
                 self.requests.append(rec)
             else:
                 # counted, not silent: a soak past the cap must say so in
@@ -149,6 +172,13 @@ class LoadResult:
             "mean": round(sum(qw) / len(qw) * 1e3, 2) if qw else 0.0,
         }
         out["lanes_observed"] = {str(k): v for k, v in sorted(self.lanes.items())}
+        # fleet attribution (ISSUE 13): ok-request counts by answering
+        # replica (>1 keys = the fleet really spread the load) and the
+        # riders that outlived a replica via failover (replica_hops >= 1)
+        out["replicas_observed"] = {
+            str(k): v for k, v in sorted(self.replicas.items())
+        }
+        out["failovers_observed"] = self.failovers
         out["trace_echo_mismatches"] = self.echo_mismatches
         if self.requests_dropped:
             out["requests_record_cap"] = self.MAX_REQUEST_RECORDS
@@ -205,7 +235,7 @@ def _one_request(url: str, body: bytes, headers: dict, timeout_s: float,
     req = urllib.request.Request(url, data=body, headers=headers, method="POST")
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-            resp.read()
+            data = resp.read()
             bs = int(resp.headers.get("X-Nm03-Batch-Size", 0))
             echoed = resp.headers.get("X-Nm03-Request-Id", "")
             qw_hdr = resp.headers.get("X-Nm03-Queue-Wait-Ms")
@@ -218,9 +248,27 @@ def _one_request(url: str, body: bytes, headers: dict, timeout_s: float,
                 lane = int(lane_hdr) if lane_hdr not in (None, "None") else None
             except ValueError:
                 lane = None
+            # fleet attribution (ISSUE 13): the payload's replica /
+            # replica_hops (the fleet front-end's truth fields), header
+            # then target-host:port fallback — so replicas_observed is
+            # meaningful whether --targets drives a fleet or replicas
+            # directly (a bare replica names no replica itself)
+            replica = (
+                resp.headers.get("X-Nm03-Replica")
+                or urllib.parse.urlsplit(url).netloc
+            )
+            hops = None
+            try:
+                payload = json.loads(data)
+                if isinstance(payload, dict):
+                    replica = payload.get("replica") or replica
+                    hops = payload.get("replica_hops")
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                pass
             result.record(
                 "ok", time.monotonic() - t0, batch_size=bs, sent_id=req_id,
                 echoed_id=echoed, queue_wait_s=qw, lane=lane,
+                replica=replica, replica_hops=hops,
             )
     except urllib.error.HTTPError as e:
         echoed = e.headers.get("X-Nm03-Request-Id", "") if e.headers else ""
@@ -234,7 +282,7 @@ def _one_request(url: str, body: bytes, headers: dict, timeout_s: float,
 
 
 def run_load(
-    url: str,
+    url,
     payloads,
     n_requests: int,
     concurrency: int,
@@ -244,11 +292,16 @@ def run_load(
 ) -> dict:
     """Drive the load; returns the summary dict.
 
-    Every request carries a unique ``X-Nm03-Request-Id`` (``lg-<run>-<n>``)
-    that the server honors as the trace id and echoes back — the handle
-    that joins a loadgen record to its server-side span tree
-    (``nm03-trace``) and flight-recorder entries.
+    ``url`` is one endpoint or a list of them (``--targets`` multi-target
+    mode, ISSUE 13): request *i* goes to ``urls[i % len(urls)]`` — an
+    even spread whether the targets are one fleet front-end or the
+    replicas driven directly. Every request carries a unique
+    ``X-Nm03-Request-Id`` (``lg-<run>-<n>``) that the server honors as
+    the trace id and echoes back — the handle that joins a loadgen
+    record to its server-side span tree (``nm03-trace``) and
+    flight-recorder entries.
     """
+    urls = [url] if isinstance(url, str) else list(url)
     result = result if result is not None else LoadResult()
     run_tag = uuid.uuid4().hex[:6]
 
@@ -269,7 +322,8 @@ def run_load(
             body, headers = payloads[i % len(payloads)]
             t = threading.Thread(
                 target=_one_request,
-                args=(url, body, headers, timeout_s, result, req_id(i)),
+                args=(urls[i % len(urls)], body, headers, timeout_s, result,
+                      req_id(i)),
                 daemon=True,
             )
             t.start()
@@ -289,7 +343,8 @@ def run_load(
                 if i is None:
                     return
                 body, headers = payloads[i % len(payloads)]
-                _one_request(url, body, headers, timeout_s, result, req_id(i))
+                _one_request(urls[i % len(urls)], body, headers, timeout_s,
+                             result, req_id(i))
 
         workers = [
             threading.Thread(target=worker, daemon=True)
@@ -317,6 +372,8 @@ def probe_server_topology(url: str, timeout_s: float = 5.0) -> dict:
     out = {
         "lanes": None, "mesh_shape": None, "buckets": None, "degraded": None,
         "capacity": None, "lanes_quarantined": None,
+        "is_fleet": False, "replicas": None, "replicas_ready": None,
+        "replicas_ejected": None,
     }
     req = urllib.request.Request(f"{url}/readyz", method="GET")
     try:
@@ -337,6 +394,15 @@ def probe_server_topology(url: str, timeout_s: float = 5.0) -> dict:
     # quarantined count a chaos run's plateau is explained by
     out["capacity"] = st.get("capacity")
     out["lanes_quarantined"] = (st.get("lanes") or {}).get("quarantined")
+    # fleet front-end fields (ISSUE 13): when the probed URL is an
+    # nm03-fleet router, `capacity` above is the ROUTED fraction and the
+    # replicas block explains a chaos run's plateau one level up
+    reps = st.get("replicas")
+    if isinstance(reps, dict):
+        out["is_fleet"] = True
+        out["replicas"] = reps.get("count")
+        out["replicas_ready"] = reps.get("ready")
+        out["replicas_ejected"] = reps.get("ejected")
     return out
 
 
@@ -403,6 +469,11 @@ class CapacityWatch:
         self.min_busy: Optional[float] = None
         self.max_padding: Optional[float] = None
         self.max_mfu: Optional[float] = None
+        # fleet-level floors (ISSUE 13): only move when the watched URL
+        # is an nm03-fleet front-end (its /readyz carries a replicas
+        # block) — the ⅔ plateau a kill-a-replica drill is read from
+        self.min_fleet_capacity: Optional[float] = None
+        self.max_replicas_ejected: Optional[int] = None
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="nm03-loadgen-capwatch", daemon=True
@@ -431,6 +502,17 @@ class CapacityWatch:
                 )
             if eff["mfu"] is not None:
                 self.max_mfu = max(self.max_mfu or 0.0, eff["mfu"])
+            if topo["is_fleet"]:
+                if c is not None:
+                    self.min_fleet_capacity = (
+                        float(c) if self.min_fleet_capacity is None
+                        else min(self.min_fleet_capacity, float(c))
+                    )
+                if topo["replicas_ejected"] is not None:
+                    self.max_replicas_ejected = max(
+                        self.max_replicas_ejected or 0,
+                        int(topo["replicas_ejected"]),
+                    )
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
@@ -453,6 +535,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--url", default="http://127.0.0.1:8077", help="server base URL"
+    )
+    p.add_argument(
+        "--targets", default=None, metavar="URL[,URL...]",
+        help="multi-target mode (ISSUE 13): comma list of base URLs — an "
+        "nm03-fleet front-end or replicas driven directly; request i goes "
+        "to targets[i %% n] and the summary gains replicas_observed / "
+        "failovers_observed / fleet_capacity_min_observed. Overrides --url",
     )
     p.add_argument("--requests", type=int, default=100, help="total requests")
     p.add_argument(
@@ -519,22 +608,37 @@ def main(argv=None) -> int:
         url = f"http://127.0.0.1:{port}"
         print(f"loadgen: self-serve listening on {url}", flush=True)
 
-    endpoint = f"{url}/v1/segment?output={args.mode}"
+    if args.targets:
+        # multi-target mode (ISSUE 13): spread requests over the list; the
+        # capacity watch and the topology probe read the FIRST target
+        # (point it at the fleet front-end to watch the routed capacity)
+        bases = [t.strip().rstrip("/") for t in args.targets.split(",")
+                 if t.strip()]
+        if not bases:
+            print("loadgen: --targets needs at least one URL", flush=True)
+            return 2
+        url = bases[0]
+    else:
+        bases = [url]
+    endpoints = [f"{b}/v1/segment?output={args.mode}" for b in bases]
+    endpoint = endpoints[0]
     payloads = _make_payloads(args.height, args.width, args.distinct, args.dicom)
     if args.warmup > 0:
         warm = LoadResult()  # discarded: compile/cache effects stay out
-        run_load(endpoint, payloads, args.warmup, min(args.warmup, 4), 0.0,
+        run_load(endpoints, payloads, args.warmup, min(args.warmup, 4), 0.0,
                  args.timeout_s, warm)
     result = LoadResult()
     # poll /readyz through the run: a mid-run quarantine that probation
     # heals before the final probe must still land in the summary
     watch = CapacityWatch(url).start()
     summary = run_load(
-        endpoint, payloads, args.requests, args.concurrency, args.rate,
+        endpoints, payloads, args.requests, args.concurrency, args.rate,
         args.timeout_s, result,
     )
     watch.stop()
     summary["endpoint"] = endpoint
+    if args.targets:
+        summary["targets"] = bases
     # serving topology alongside the numbers (mesh_shape/lanes ride next to
     # the drivers' backend_requested/backend_actual honesty pair): probed
     # from the live server so the record describes what actually served
@@ -551,6 +655,14 @@ def main(argv=None) -> int:
     summary["busy_fraction_min_observed"] = watch.min_busy
     summary["padding_waste_max_observed"] = watch.max_padding
     summary["mfu_max_observed"] = watch.max_mfu
+    # fleet-level evidence (ISSUE 13): the routed-capacity floor and the
+    # peak ejected count observed DURING the run — the numbers that
+    # explain a kill-a-replica drill's throughput dip (None when the
+    # watched URL is not an nm03-fleet front-end)
+    summary["fleet_capacity_min_observed"] = watch.min_fleet_capacity
+    summary["replicas_ejected_max_observed"] = watch.max_replicas_ejected
+    summary["replicas"] = topo["replicas"]
+    summary["replicas_ready"] = topo["replicas_ready"]
     if args.self_serve and app is not None:
         app.begin_drain(reason="loadgen_done")
         httpd.shutdown()
@@ -575,6 +687,16 @@ def main(argv=None) -> int:
         # would misread as "never worked"
         return "?" if v is None else f"{v * 100:.3g}%"
 
+    fleet_cap = summary["fleet_capacity_min_observed"]
+    fleet_cols = ""
+    if summary.get("targets") or summary["replicas"] is not None:
+        # the fleet columns (ISSUE 13): printed on --targets runs and
+        # whenever the watched /readyz was a fleet front-end
+        fleet_cols = (
+            f"replicas={len(summary['replicas_observed']) or '?'} "
+            f"failovers={summary['failovers_observed']} "
+            f"fleet_cap_min={'?' if fleet_cap is None else fleet_cap} "
+        )
     print(
         f"loadgen: ok={summary['requests_ok']}/{summary['requests_total']} "
         f"p50={lat['p50']}ms p95={lat['p95']}ms "
@@ -585,6 +707,7 @@ def main(argv=None) -> int:
         f"busy_min={_pct(summary['busy_fraction_min_observed'])} "
         f"padding_max={_pct(summary['padding_waste_max_observed'])} "
         f"mfu_max={_pct(summary['mfu_max_observed'])} "
+        f"{fleet_cols}"
         f"echo_mismatch={summary['trace_echo_mismatches']}",
         flush=True,
     )

@@ -35,7 +35,9 @@ from __future__ import annotations
 import argparse
 import base64
 import json
+import os
 import signal
+import sys
 import threading
 import time
 import uuid
@@ -141,6 +143,17 @@ class ServingApp:
         self.obs = obs if obs is not None else RunContext.create(driver="serve")
         self.compile_cache_dir = compile_cache_dir
         self._attached_cache = None
+        # the stable replica identity block (ISSUE 13): what the fleet
+        # router's per-replica metrics and the rolling-restart log name
+        # this process by. `id` is per-incarnation (a restart mints a new
+        # one — that is the point: the restart drill proves the pid AND
+        # id changed); `relaunch_argv`/`cwd` are filled by the CLI path
+        # (main()) only — an in-process app is not restartable
+        self.replica_identity = {
+            "id": uuid.uuid4().hex[:12],
+            "pid": os.getpid(),
+            "start_unix": round(time.time(), 3),
+        }
         self.queue = AdmissionQueue(queue_capacity)
         # efficiency telemetry (obs.saturation, ISSUE 10): lane busy/idle,
         # padding waste, occupancy and MFU over a sliding window — fed by
@@ -314,14 +327,32 @@ class ServingApp:
         from nm03_capstone_project_tpu.compilehub import get_hub
 
         lane_count = self.executor.lane_count
+        cache_stats = (
+            self._attached_cache.readyz_stats()
+            if self._attached_cache is not None else None
+        )
         return {
             "ready": self.ready,
+            # who is answering (ISSUE 13): id (per-incarnation), pid,
+            # start time, warmup cache hits — the fields the fleet
+            # router's metrics and the rolling-restart log key on;
+            # relaunch_argv/cwd appear on CLI-launched replicas only
+            "replica": {
+                **self.replica_identity,
+                "compile_cache_hits": (
+                    cache_stats["cache_hits"] if cache_stats else None
+                ),
+            },
             "warm": self.executor.warm,
             "draining": self.draining,
             "degraded": self.executor.degraded,
             "degraded_cause": self.executor.degraded_cause,
             "queue_depth": len(self.queue),
             "queue_capacity": self.queue.capacity,
+            # the request-size guards (ISSUE 13): what a fleet front-end's
+            # probation canary must fit inside to be admissible here
+            "canvas": self.cfg.canvas,
+            "min_dim": self.cfg.min_dim,
             "buckets": list(self.executor.buckets),
             "batcher": self.batcher.stats(),
             # the sharded fleet: per-lane warm/inflight state, the replica
@@ -871,6 +902,41 @@ def app_from_args(args: argparse.Namespace, obs=None) -> ServingApp:
     )
 
 
+def _relaunch_recipe(effective_argv, port: int):
+    """The ``-m``-form argv a fleet orchestrator relaunches us with.
+
+    The BOUND port is substituted for whatever ``--port`` said (an
+    ephemeral ``--port 0`` republished verbatim would relaunch the
+    replica on a different random port and the orchestrator's warm-wait
+    against the old address could never succeed), and added explicitly
+    when the flag was defaulted — the recipe must be reproducible on its
+    own, not relative to this build's default.
+    """
+    argv = list(effective_argv)
+    out = []
+    i = 0
+    saw_port = False
+    while i < len(argv):
+        arg = argv[i]
+        if arg == "--port":
+            out += ["--port", str(port)]
+            saw_port = True
+            i += 2
+        elif arg.startswith("--port="):
+            out.append(f"--port={port}")
+            saw_port = True
+            i += 1
+        else:
+            out.append(arg)
+            i += 1
+    if not saw_port:
+        out += ["--port", str(port)]
+    return [
+        sys.executable, "-m", "nm03_capstone_project_tpu.serving.server",
+        *out,
+    ]
+
+
 def _write_port_file(path: str, port: int) -> None:
     import os
 
@@ -904,6 +970,17 @@ def main(argv=None) -> int:
     app = app_from_args(args, obs=run_ctx)
     httpd = make_http_server(app, args.host, args.port)
     port = httpd.server_address[1]
+    # the relaunch recipe for `nm03-fleet restart` (ISSUE 13): always the
+    # `-m` module form (console-script and `python -m` launches converge
+    # on it) plus the flags THIS process was started with — with the
+    # BOUND port substituted — and the cwd they resolve against;
+    # published on /readyz so the orchestrator needs no side-channel
+    # deploy manifest
+    effective_argv = list(argv) if argv is not None else list(sys.argv[1:])
+    app.replica_identity["relaunch_argv"] = _relaunch_recipe(
+        effective_argv, port
+    )
+    app.replica_identity["cwd"] = os.getcwd()
     timings = app.start()
     if args.port_file:
         _write_port_file(args.port_file, port)

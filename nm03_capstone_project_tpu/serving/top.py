@@ -13,6 +13,11 @@ Pure stdlib, read-only — it issues only GETs, so pointing it at a
 production replica is always safe. ``--once`` prints a single view and
 exits (``--format json`` makes that machine-readable: the subprocess
 drills assert nm03-top renders the same numbers the gauges carry).
+``--fleet`` points it at an ``nm03-fleet`` front-end instead (ISSUE 13):
+it reads the router's per-replica table and aggregates every replica's
+``/metrics.json`` + ``/readyz`` into one screen — per-replica
+state/capacity/busy/MFU rows plus fleet routed/failover/shed rates
+(schema ``nm03.fleettop.v1``).
 """
 
 from __future__ import annotations
@@ -26,6 +31,9 @@ import urllib.request
 from typing import Dict, Optional, Tuple
 
 from nm03_capstone_project_tpu.obs.metrics import (
+    FLEET_FAILOVERS_TOTAL,
+    FLEET_REQUESTS_ROUTED_TOTAL,
+    FLEET_SHED_TOTAL,
     INGEST_DECODE_QUEUE_DEPTH,
     INGEST_RING_OCCUPANCY_RATIO,
     INGEST_UPLOAD_OVERLAP_RATIO,
@@ -234,12 +242,145 @@ def render_text(view: dict, url: str) -> str:
     return "\n".join(lines)
 
 
+# -- the fleet view (ISSUE 13) ----------------------------------------------
+
+
+def fetch_fleet_sample(url: str, timeout_s: float):
+    """One fleet poll: the router's /readyz table + its /metrics.json,
+    plus a per-replica :class:`Sample` for every reachable replica.
+
+    Returns ``(fleet_sample, {target: replica Sample or None})``. Raises
+    when the FLEET itself is unreachable; an unreachable replica is a row
+    with nulls — exactly what an ejected replica should look like.
+    """
+    fleet = fetch_sample(url, timeout_s)
+    per: Dict[str, Optional[Sample]] = {}
+    table = (fleet.readyz.get("replicas") or {}).get("per_replica") or []
+    for row in table:
+        target = row.get("target")
+        if not target:
+            continue
+        try:
+            per[target] = fetch_sample(target, timeout_s)
+        except Exception:  # noqa: BLE001 — a dead replica is a null row
+            per[target] = None
+    return fleet, per
+
+
+def build_fleet_view(
+    fleet: Sample,
+    per: Dict[str, Optional[Sample]],
+    prev_fleet: Optional[Sample] = None,
+    prev_per: Optional[Dict[str, Optional[Sample]]] = None,
+) -> dict:
+    """One renderable/JSON-able aggregate of the whole fleet.
+
+    Fleet-level numbers come from the router's own /readyz + fleet_*
+    counters; each replica row aggregates that replica's /metrics.json
+    (busy/MFU/queue) next to the router's verdict on it (state/cause) —
+    the one-screen answer to "which replica is the outlier".
+    """
+    st = fleet.readyz or {}
+    table = (st.get("replicas") or {}).get("per_replica") or []
+    prev_per = prev_per or {}
+    rows = []
+    for entry in table:
+        target = entry.get("target")
+        s = per.get(target)
+        ps = prev_per.get(target)
+        r_ready = s.readyz if s is not None else {}
+        rows.append({
+            "replica": entry.get("replica"),
+            "target": target,
+            "state": entry.get("state", "?"),
+            "cause": entry.get("cause"),
+            "ejections": entry.get("ejections"),
+            "capacity": entry.get("capacity"),
+            "queue_depth": r_ready.get("queue_depth"),
+            "lanes_ready": (r_ready.get("lanes") or {}).get("ready"),
+            "busy_fraction": (
+                s.gauge(SERVING_BUSY_FRACTION) if s is not None else None
+            ),
+            "mfu": s.gauge(SERVING_MFU) if s is not None else None,
+            "requests_per_s": (
+                _rate(s, ps, SERVING_REQUESTS_TOTAL)
+                if s is not None and ps is not None else None
+            ),
+            "id": (entry.get("identity") or {}).get("id"),
+            "pid": (entry.get("identity") or {}).get("pid"),
+        })
+    return {
+        "schema": "nm03.fleettop.v1",
+        "ready": st.get("ready"),
+        "draining": st.get("draining"),
+        "capacity": st.get("capacity"),
+        "uptime_s": st.get("uptime_s"),
+        "replicas_ready": (st.get("replicas") or {}).get("ready"),
+        "replicas_ejected": (st.get("replicas") or {}).get("ejected"),
+        "replicas": rows,
+        "rates_per_s": {
+            "routed": _rate(fleet, prev_fleet, FLEET_REQUESTS_ROUTED_TOTAL),
+            "failovers": _rate(fleet, prev_fleet, FLEET_FAILOVERS_TOTAL),
+            "shed": _rate(fleet, prev_fleet, FLEET_SHED_TOTAL),
+        },
+    }
+
+
+def render_fleet_text(view: dict, url: str) -> str:
+    """The one-screen console rendering of a fleet view."""
+    state = (
+        "DRAINING" if view.get("draining")
+        else "ready" if view.get("ready")
+        else "NOT-READY"
+    )
+    rates = view["rates_per_s"]
+
+    def _r(k):
+        return rates[k] if rates[k] is not None else "-"
+
+    lines = [
+        f"nm03-top — fleet {url}   [{state}]   uptime "
+        f"{view.get('uptime_s') if view.get('uptime_s') is not None else '?'}s",
+        (
+            f"replicas {view.get('replicas_ready')}/"
+            f"{(view.get('replicas_ready') or 0) + (view.get('replicas_ejected') or 0)} "
+            f"ready   capacity {_fmt(view.get('capacity'), pct=True).strip()}   "
+            f"routed/s {_r('routed')}   failover/s {_r('failovers')}   "
+            f"shed/s {_r('shed')}"
+        ),
+        "",
+        f"{'replica':<22} {'state':<10} {'cap':>6} {'lanes':>5} "
+        f"{'queue':>5} {'busy':>8} {'mfu':>8} {'req/s':>7} {'eject':>5}",
+    ]
+    for row in view["replicas"]:
+        lines.append(
+            f"{str(row['replica']):<22} {str(row['state']):<10} "
+            f"{_fmt(row['capacity'], pct=True, width=6)} "
+            f"{str(row['lanes_ready'] if row['lanes_ready'] is not None else '-'):>5} "
+            f"{str(row['queue_depth'] if row['queue_depth'] is not None else '-'):>5} "
+            f"{_fmt(row['busy_fraction'], pct=True, width=8)} "
+            f"{_fmt(row['mfu'], pct=True, width=8)} "
+            f"{str(row['requests_per_s'] if row['requests_per_s'] is not None else '-'):>7} "
+            f"{str(row['ejections']):>5}"
+        )
+    if not view["replicas"]:
+        lines.append("  (no replicas in the fleet table yet)")
+    return "\n".join(lines)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="nm03-top", description=__doc__.strip().splitlines()[0]
     )
     p.add_argument(
         "--url", default="http://127.0.0.1:8077", help="server base URL"
+    )
+    p.add_argument(
+        "--fleet", action="store_true",
+        help="treat --url as an nm03-fleet front-end: aggregate every "
+        "replica's /metrics.json + /readyz behind it into one screen "
+        "(per-replica state/capacity/busy/MFU + fleet routed/failover/"
+        "shed rates; ISSUE 13)",
     )
     p.add_argument(
         "--interval-s", type=float, default=2.0,
@@ -267,19 +408,29 @@ def main(argv=None) -> int:
         print("nm03-top: --interval-s must be > 0", file=sys.stderr)
         return 2
     prev: Optional[Sample] = None
+    prev_per: Optional[Dict[str, Optional[Sample]]] = None
     try:
         while True:
             try:
-                cur = fetch_sample(args.url, args.timeout_s)
+                if args.fleet:
+                    cur, per = fetch_fleet_sample(args.url, args.timeout_s)
+                else:
+                    cur = fetch_sample(args.url, args.timeout_s)
             except Exception as e:  # noqa: BLE001 — unreachable server is the exit
                 print(f"nm03-top: {args.url} unreachable: {e}", file=sys.stderr)
                 return 2
-            view = build_view(cur, prev)
+            if args.fleet:
+                view = build_fleet_view(cur, per, prev, prev_per)
+            else:
+                view = build_view(cur, prev)
             if args.format == "json":
                 out = json.dumps(view, indent=None if args.once else 1)
                 print(out, flush=True)
             else:
-                screen = render_text(view, args.url)
+                screen = (
+                    render_fleet_text(view, args.url) if args.fleet
+                    else render_text(view, args.url)
+                )
                 if args.once:
                     print(screen, flush=True)
                 else:
@@ -288,6 +439,8 @@ def main(argv=None) -> int:
             if args.once:
                 return 0
             prev = cur
+            if args.fleet:
+                prev_per = per
             time.sleep(args.interval_s)
     except KeyboardInterrupt:
         return 0

@@ -494,6 +494,63 @@ class TestThreadSharedState:
         )
         assert rules_of(fs) == [], [f.render() for f in fs]
 
+    def test_removing_the_replica_health_table_lock_fails(self, tmp_path):
+        """ISSUE 13 satellite: NM331's scope covers the fleet router's
+        health table — the REAL fleet/replicas.py with the signal-table
+        write moved outside its lock must be a lint finding (the table
+        is written by the health poller and read by every routing pick
+        and /readyz render)."""
+        src = (REPO / PKG / "fleet" / "replicas.py").read_text()
+        guarded = (
+            "        with self._lock:\n"
+            "            if target not in self._signals:\n"
+            '                raise KeyError(f"unknown replica target '
+            '{target!r}")\n'
+            "            self._signals[target] = sig"
+        )
+        assert guarded in src  # update_signals' guarded table write
+        broken = src.replace(
+            guarded,
+            "        if True:\n"
+            "            if target not in self._signals:\n"
+            '                raise KeyError(f"unknown replica target '
+            '{target!r}")\n'
+            "            self._signals[target] = sig",
+            1,
+        )
+        fs = lint_tree(
+            tmp_path,
+            {f"{PKG}/fleet/replicas.py": broken},
+            rules=(check_thread_shared_state,),
+        )
+        assert "NM331" in rules_of(fs)
+
+    def test_real_fleet_modules_are_clean(self, tmp_path):
+        for mod in ("replicas.py", "router.py", "manager.py"):
+            src = (REPO / PKG / "fleet" / mod).read_text()
+            fs = lint_tree(
+                tmp_path,
+                {f"{PKG}/fleet/{mod}": src},
+                rules=(check_thread_shared_state,),
+            )
+            assert rules_of(fs) == [], [f.render() for f in fs]
+
+    def test_fleet_package_is_contract_registered(self, tmp_path):
+        """ISSUE 13: the fleet package is NM301-pinned jax- AND
+        numpy-free — a backend import smuggled into the router must be a
+        lint finding, not a compile-hub claim paid by a byte-shuffler."""
+        from nm03_capstone_project_tpu.analysis.contracts import (
+            CONTRACT_REGISTRY,
+        )
+
+        assert CONTRACT_REGISTRY[f"{PKG}.fleet"] == ("jax", "numpy")
+        fs = lint_tree(
+            tmp_path,
+            {f"{PKG}/fleet/router.py": "import numpy\n"},
+            rules=(check_import_contracts,),
+        )
+        assert "NM301" in rules_of(fs)
+
 
 class TestDtypeDiscipline:
     def test_float64_dtype_flagged_in_ops(self, tmp_path):

@@ -68,6 +68,15 @@ SURFACE = {
         "stage_batch",
         "prefetch_to_device",
     ],
+    # the replica-fleet front-end (ISSUE 13): router, state machine,
+    # rolling-restart orchestration — what docs/API.md's fleet section names
+    "nm03_capstone_project_tpu.fleet": [
+        "FleetApp",
+        "ReplicaStates",
+        "rolling_restart",
+        "serve_in_thread",
+        "RestartError",
+    ],
     "nm03_capstone_project_tpu.data.codecs": [
         "rle_encode_frame",
         "rle_decode_frame",
