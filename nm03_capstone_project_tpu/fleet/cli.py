@@ -1,16 +1,24 @@
 """``nm03-fleet``: the replica-fleet front-end and its orchestration.
 
-Two subcommands (docs/OPERATIONS.md, "Running a fleet"):
+Four subcommands (docs/OPERATIONS.md, "Running a fleet"):
 
 * ``nm03-fleet serve --replicas URL,URL,...`` — the routing front-end:
   proxies ``POST /v1/segment`` across the replicas with capacity-weighted
   routing, outlier ejection, failover and backpressure propagation, and
   serves its own ``/healthz`` / ``/readyz`` / ``/metrics`` /
-  ``/metrics.json`` (the ``fleet_*`` series);
+  ``/metrics.json`` (the ``fleet_*`` series; ``--slo-*`` flags add the
+  SLO plane's burn-rate gauges, ISSUE 14);
 * ``nm03-fleet restart --replicas URL,URL,...`` — rolling-restart
   orchestration: drain → relaunch → warm-wait, one replica at a time, so
   a redeploy never drops the fleet below (N−1)/N capacity (pass a shared
-  ``--compile-cache-dir`` to make every warm-wait a PR-9 cache hit).
+  ``--compile-cache-dir`` to make every warm-wait a PR-9 cache hit);
+* ``nm03-fleet flightrec --replicas URL,URL,...`` — remote debug pull
+  (ISSUE 14): fetch every replica's ``GET /debug/flightrec`` (the PR-7
+  flight rings) into one dump per replica — the wedged-fleet post-mortem
+  without SIGUSR2 shell access;
+* ``nm03-fleet profile --replicas URL,URL,... --ms N`` — fan an
+  on-demand ``jax.profiler`` capture (``GET /debug/profile?ms=N``)
+  across the replicas, writing each returned trace archive to disk.
 
 jax-/numpy-free at import by contract (NM301 pins the package): a fleet
 front-end must start in milliseconds and never claim a chip.
@@ -88,6 +96,9 @@ def build_parser() -> argparse.ArgumentParser:
         "as nm03.events.v1 JSONL here",
     )
     s.add_argument("--verbose", action="store_true", help="enable INFO logging")
+    from nm03_capstone_project_tpu.obs.slo import add_slo_args
+
+    add_slo_args(s)  # the fleet-level SLO plane (ISSUE 14)
 
     r = sub.add_parser(
         "restart", help="rolling-restart the replicas, one at a time",
@@ -122,6 +133,35 @@ def build_parser() -> argparse.ArgumentParser:
         "--format", choices=["text", "json"], default="text",
         help="report format (json = the machine/CI interface)",
     )
+
+    for name, desc in (
+        ("flightrec",
+         "pull every replica's flight-recorder rings (GET /debug/flightrec) "
+         "— the wedged-fleet post-mortem without SIGUSR2 shell access"),
+        ("profile",
+         "fan an on-demand jax.profiler capture (GET /debug/profile?ms=N) "
+         "across every replica and write each trace archive to disk"),
+    ):
+        d = sub.add_parser(name, help=desc.split(" — ")[0], description=desc)
+        d.add_argument(
+            "--replicas", required=True, metavar="URL[,URL...]",
+            help="comma list of replica base URLs to pull from",
+        )
+        d.add_argument(
+            "--out-dir", default=".", metavar="DIR",
+            help="where the per-replica dumps land (created if missing)",
+        )
+        d.add_argument(
+            "--timeout-s", type=float, default=30.0,
+            help="per-replica HTTP timeout (profile pulls add the capture "
+            "duration on top)",
+        )
+        if name == "profile":
+            d.add_argument(
+                "--ms", type=int, default=500, metavar="N",
+                help="capture duration per replica in milliseconds "
+                "(the server rejects values outside [10, 10000])",
+            )
     return p
 
 
@@ -138,6 +178,7 @@ def _serve(args) -> int:
         make_http_server,
     )
     from nm03_capstone_project_tpu.obs import RunContext
+    from nm03_capstone_project_tpu.obs.slo import objective_from_args
     from nm03_capstone_project_tpu.resilience import FaultPlan
     from nm03_capstone_project_tpu.utils.reporter import configure_reporting
 
@@ -159,6 +200,7 @@ def _serve(args) -> int:
         proxy_timeout_s=args.proxy_timeout_s,
         canary_hw=args.canary_hw,
         fault_plan=plan,
+        slo=objective_from_args(args),
     )
     httpd = make_http_server(app, args.host, args.port)
     port = httpd.server_address[1]
@@ -234,10 +276,119 @@ def _restart(args) -> int:
     return 0
 
 
+def _debug_pull(args, command: str) -> int:
+    """Fan one ``/debug/*`` pull across every replica, concurrently.
+
+    One thread per target (a profile pull BLOCKS for the capture
+    duration server-side — serial pulls would stretch an N-replica
+    post-mortem N×); every reachable replica's evidence is written even
+    when others are wedged — exit 1 reports the partial pull, it never
+    discards it.
+    """
+    import os
+    import threading
+    import urllib.request
+
+    from nm03_capstone_project_tpu.fleet.replicas import (
+        normalize_target,
+        target_label,
+    )
+    from nm03_capstone_project_tpu.utils.atomicio import atomic_write_text
+
+    targets = [normalize_target(t) for t in _split_targets(args.replicas)]
+    os.makedirs(args.out_dir, exist_ok=True)
+    if command == "profile":
+        path, timeout = f"/debug/profile?ms={args.ms}", (
+            args.timeout_s + args.ms / 1e3
+        )
+    else:
+        path, timeout = "/debug/flightrec", args.timeout_s
+    results = {}
+    lock = threading.Lock()
+
+    def pull(target: str) -> None:
+        label = target_label(target)
+        safe = label.replace(":", "_")
+        try:
+            with urllib.request.urlopen(
+                f"{target}{path}", timeout=timeout
+            ) as resp:
+                payload = json.loads(resp.read())
+        except Exception as e:  # noqa: BLE001 — a dead replica is a row
+            with lock:
+                results[label] = {"ok": False, "error": str(e)}
+            return
+        out = {"ok": True}
+        if command == "profile":
+            zip_b64 = payload.pop("zip_b64", None)
+            json_path = os.path.join(args.out_dir, f"profile_{safe}.json")
+            atomic_write_text(json_path, json.dumps(payload, indent=1) + "\n")
+            out["json"] = json_path
+            out["files"] = len(payload.get("files") or [])
+            if zip_b64 is not None:
+                import base64
+
+                from nm03_capstone_project_tpu.utils.atomicio import (
+                    atomic_write_bytes,
+                )
+
+                zip_path = os.path.join(args.out_dir, f"profile_{safe}.zip")
+                atomic_write_bytes(zip_path, base64.b64decode(zip_b64))
+                out["zip"] = zip_path
+            elif payload.get("zip_dropped"):
+                # archive over the wire cap: it survives ON the replica —
+                # the row names where to fetch it out of band
+                out["zip"] = None
+                out["remote_zip"] = payload.get("zip_path")
+        else:
+            dump_path = os.path.join(args.out_dir, f"flightrec_{safe}.json")
+            atomic_write_text(dump_path, json.dumps(payload, indent=1) + "\n")
+            out["json"] = dump_path
+            out["threads"] = len(payload.get("threads") or {})
+            out["records"] = payload.get("records_total")
+        with lock:
+            results[label] = out
+
+    threads = [
+        threading.Thread(target=pull, args=(t,), daemon=True) for t in targets
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout + 30)
+    failed = 0
+    for target in targets:
+        label = target_label(target)
+        r = results.get(label, {"ok": False, "error": "pull thread hung"})
+        if r.get("ok"):
+            detail = " ".join(
+                f"{k}={v}" for k, v in sorted(r.items()) if k != "ok"
+            )
+            print(f"{label:<22} ok  {detail}")
+        else:
+            failed += 1
+            print(f"{label:<22} FAILED  {r.get('error')}", file=sys.stderr)
+    print(
+        f"nm03-fleet {command}: {len(targets) - failed}/{len(targets)} "
+        f"replica(s) pulled -> {args.out_dir}",
+        flush=True,
+    )
+    return 1 if failed else 0
+
+
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
     if args.command == "serve":
+        from nm03_capstone_project_tpu.obs.slo import objective_from_args
+
+        try:
+            objective_from_args(args)  # a bad --slo-* is a usage error,
+        except ValueError as e:        # not a traceback mid-startup
+            parser.error(str(e))
         return _serve(args)
+    if args.command in ("flightrec", "profile"):
+        return _debug_pull(args, args.command)
     return _restart(args)
 
 

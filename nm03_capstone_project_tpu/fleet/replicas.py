@@ -210,6 +210,7 @@ class ReplicaStates:
                     "queue_depth": self._signals[t].get("queue_depth"),
                     "queue_capacity": self._signals[t].get("queue_capacity"),
                     "identity": self._signals[t].get("identity"),
+                    "clock_offset_s": self._signals[t].get("clock_offset_s"),
                 }
                 for t in self._targets
             ]
@@ -304,11 +305,16 @@ class ReplicaStates:
         identity: Optional[dict] = None,
         canvas: Optional[int] = None,
         min_dim: Optional[int] = None,
+        clock_offset_s: Optional[float] = None,
     ) -> None:
         """Record one health poll's routing signals for ``target``.
 
         ``canvas``/``min_dim`` are the replica's request-size guards —
         the probation canary sizes itself inside them.
+        ``clock_offset_s`` is the replica's monotonic→wall offset from
+        the /readyz clock handshake (ISSUE 14): published in the router
+        table so cross-replica skew is triageable from one screen (the
+        nm03-trace merge derives the same offset from each log itself).
         """
         sig = {
             "capacity": capacity,
@@ -317,6 +323,7 @@ class ReplicaStates:
             "identity": identity,
             "canvas": canvas,
             "min_dim": min_dim,
+            "clock_offset_s": clock_offset_s,
         }
         with self._lock:
             if target not in self._signals:

@@ -55,13 +55,22 @@ from nm03_capstone_project_tpu.fleet.replicas import (
     target_label,
 )
 from nm03_capstone_project_tpu.obs.metrics import (
+    DEFAULT_LATENCY_BUCKETS,
     FLEET_FAILOVERS_TOTAL,
     FLEET_PROBES_TOTAL,
     FLEET_REPLICAS_EJECTED,
     FLEET_REPLICAS_READY,
     FLEET_REQUESTS_ROUTED_TOTAL,
+    FLEET_REQUESTS_TOTAL,
+    FLEET_REQUEST_SECONDS,
     FLEET_ROUTED_CAPACITY,
     FLEET_SHED_TOTAL,
+)
+from nm03_capstone_project_tpu.obs.trace import (
+    FLEET_TRACE_EVENT,
+    TraceContext,
+    new_trace_id,
+    sanitize_trace_id,
 )
 from nm03_capstone_project_tpu.utils.reporter import get_logger
 
@@ -90,6 +99,7 @@ class FleetApp:
         canary_hw: int = 32,
         canary_timeout_s: float = 30.0,
         fault_plan=None,
+        slo=None,
     ):
         if obs is None:
             from nm03_capstone_project_tpu.obs import RunContext
@@ -128,6 +138,32 @@ class FleetApp:
             help="requests answered 503 by the fleet (every replica shed "
             "or unhealthy); carries the replica's own Retry-After through",
         )
+        # the SLO layer's status classes exist at 0 from startup, so a
+        # clean run's snapshot proves "zero errors/sheds" exactly and the
+        # SLO monitor's first sample has series to read
+        for cls in ("ok", "error", "shed"):
+            self.registry.counter(
+                FLEET_REQUESTS_TOTAL, help=self._REQ_HELP, status=cls
+            )
+        # the SLO plane (ISSUE 14): burn rates/budget over the fleet's own
+        # request accounting, pull-refreshed by publish_gauges()
+        self.slo = None
+        if slo is not None:
+            from nm03_capstone_project_tpu.obs.slo import SLOMonitor
+
+            self.slo = SLOMonitor(
+                self.registry, slo, FLEET_REQUESTS_TOTAL,
+                FLEET_REQUEST_SECONDS,
+                # the fleet's bad set: propagated replica 5xx verdicts and
+                # fleet-wide sheds; `invalid` (4xx) is the client's fault
+                bad_statuses=("error", "shed"),
+            )
+
+    _REQ_HELP = (
+        "terminal proxied-request outcomes by status class (ok = 2xx, "
+        "invalid = 4xx application verdicts, error = 5xx, shed = the "
+        "fleet-wide 503) — the fleet SLO layer's availability input"
+    )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -245,6 +281,18 @@ class FleetApp:
         if capacity is not None and float(capacity) <= 0.0:
             self._handle_unhealthy(target, "zero_capacity")
             return False
+        # the clock handshake (ISSUE 14): the replica echoes its own
+        # (mono_s, ts_unix) pair on /readyz, so the router can publish
+        # each replica's monotonic→wall offset for skew triage (the
+        # nm03-trace merge derives the same offset from each log itself)
+        clock = st.get("clock") or {}
+        clock_offset_s = None
+        if isinstance(clock.get("ts_unix"), (int, float)) and isinstance(
+            clock.get("mono_s"), (int, float)
+        ):
+            clock_offset_s = round(
+                float(clock["ts_unix"]) - float(clock["mono_s"]), 6
+            )
         self.replicas.update_signals(
             target,
             capacity=capacity,
@@ -253,6 +301,7 @@ class FleetApp:
             identity=st.get("replica"),
             canvas=st.get("canvas"),
             min_dim=st.get("min_dim"),
+            clock_offset_s=clock_offset_s,
         )
         return True
 
@@ -299,13 +348,23 @@ class FleetApp:
         if sig.get("canvas"):
             hw = min(hw, int(sig["canvas"]))
         body = bytes(hw * hw * 4)  # a zero float32 slice — the warmup input
+        label = target_label(target)
+        probe_id = f"fleet-probe-{label}-{n}"
         headers = {
             "Content-Type": "application/octet-stream",
             "X-Nm03-Height": str(hw),
             "X-Nm03-Width": str(hw),
-            "X-Nm03-Request-Id": f"fleet-probe-{target_label(target)}-{n}",
+            "X-Nm03-Request-Id": probe_id,
+            # the probe tag (ISSUE 14 satellite): the replica still serves
+            # and traces the canary but keeps it OUT of its request
+            # metrics and SLO accounting — a probe every interval against
+            # an otherwise-idle replica must not pollute the very series
+            # the SLO layer reads
+            "X-Nm03-Probe": "1",
         }
         outcome = "failed"
+        ctx = TraceContext(probe_id)
+        t0 = time.monotonic()
         try:
             req = urllib.request.Request(
                 f"{target}/v1/segment?output=mask", data=body,
@@ -323,12 +382,28 @@ class FleetApp:
             self.replicas.reinstate(target)
         else:
             self.replicas.fail_probation(target)
+        ctx.add_span(
+            "canary_probe", t0, time.monotonic(), replica=label,
+            outcome=outcome, probe=True,
+        )
         try:
             self.registry.counter(
                 FLEET_PROBES_TOTAL,
                 help="probation canary requests by replica and outcome",
-                replica=target_label(target), outcome=outcome,
+                replica=label, outcome=outcome,
             ).inc()
+            # probes are traced (probe=true) but never counted in
+            # fleet_requests_total — the fleet-side half of the satellite
+            self.obs.events.emit(
+                FLEET_TRACE_EVENT,
+                trace_id=probe_id,
+                request_id=f"probe-{n:06d}",
+                replica=label,
+                replica_hops=0,
+                status=200 if ok else None,
+                probe=True,
+                spans=ctx.snapshot(),
+            )
         except Exception:  # noqa: BLE001
             pass
 
@@ -385,7 +460,8 @@ class FleetApp:
         ).inc()
 
     def proxy_segment(
-        self, body: bytes, headers: dict, query: str = ""
+        self, body: bytes, headers: dict, query: str = "",
+        trace_id: Optional[str] = None,
     ) -> Tuple[int, bytes, List[Tuple[str, str]]]:
         """Route one ``POST /v1/segment``; (status, body, response headers).
 
@@ -394,84 +470,198 @@ class FleetApp:
         tries an alternative; each replica is tried at most once, and the
         budget is bounded by the fleet size — no infinite ping-pong even
         against a racing reinstatement.
+
+        Every request is traced (ISSUE 14): ``trace_id`` is the handler's
+        minted-or-honored ``X-Nm03-Request-Id`` (minted here for direct
+        callers), forwarded replica-ward so the replica's ``serve_trace``
+        tree shares it, and the router records its own span chain —
+        ``route_pick`` → ``proxy_hop`` per attempt (→ ``failover`` on a
+        transport death or shed) — emitted as one ``fleet_trace`` event.
         """
         seq = self._next_seq()
+        t_req = time.monotonic()
+        ctx = TraceContext(trace_id or new_trace_id())
+        # the canonical trace header rides to the replica (replacing any
+        # case variant of the client's), so the replica-side span tree
+        # shares this request's id — the multi-log merge's join key. The
+        # probe tag is STRIPPED from client traffic: only the router's
+        # own canary path (_probe_one) may set it — a client smuggling
+        # X-Nm03-Probe through the fleet would otherwise have its real
+        # requests silently excluded from the replica's request metrics
+        # and SLO accounting while the fleet counts them
+        headers = {
+            k: v for k, v in headers.items()
+            if k.lower() not in ("x-nm03-request-id", "x-nm03-probe")
+        }
+        headers["X-Nm03-Request-Id"] = ctx.trace_id
         plan = self.fault_plan
         tried: set = set()
         hops = 0
         shed: Optional[Tuple[int, bytes, List[Tuple[str, str]]]] = None
+        status: int = 503
+        data: bytes = b""
+        final: Optional[str] = None
+        resp_headers: List[Tuple[str, str]] = []
         while True:
+            t_pick = time.monotonic()
             target = self.pick(exclude=frozenset(tried))
+            ctx.add_span(
+                "route_pick", t_pick, time.monotonic(),
+                replica=target_label(target) if target else None,
+                attempt=hops + 1,
+            )
             if target is None:
                 break
             tried.add(target)
+            label = target_label(target)
             if plan is not None and plan.has_site("fleet"):
                 rule = plan.fire(
-                    "fleet", obs=self.obs, stem=target_label(target),
+                    "fleet", obs=self.obs, stem=label,
                     index=seq, kinds=("proxy_io_error",),
                 )
                 if rule is not None:
                     # the drill's deterministic mid-body abort: same path
                     # a real connection reset takes
+                    t0 = time.monotonic()
                     self.replicas.eject(target, "proxy_error")
                     self._count_failover(target, "io_error")
+                    now = time.monotonic()
+                    ctx.add_span(
+                        "proxy_hop", t0, now, replica=label,
+                        outcome="io_error", attempt=hops + 1,
+                    )
+                    ctx.add_span(
+                        "failover", now, time.monotonic(), replica=label,
+                        cause="io_error",
+                    )
                     hops += 1
                     continue
+            t0 = time.monotonic()
             try:
                 status, data, resp_headers = self._forward(
                     target, body, headers, query
                 )
             except Exception as e:  # noqa: BLE001 — transport death → failover
                 log.warning(
-                    "proxy to %s failed (%s); failing over",
-                    target_label(target), e,
+                    "proxy to %s failed (%s); failing over", label, e,
+                )
+                now = time.monotonic()
+                ctx.add_span(
+                    "proxy_hop", t0, now, replica=label,
+                    outcome="io_error", attempt=hops + 1,
                 )
                 self.replicas.eject(target, "proxy_error")
                 self._count_failover(target, "io_error")
+                ctx.add_span(
+                    "failover", now, time.monotonic(), replica=label,
+                    cause="io_error",
+                )
                 hops += 1
                 continue
             if status == 503:
                 # backpressure: reroute while an alternative exists,
                 # propagate the replica's own Retry-After when none does
+                now = time.monotonic()
+                ctx.add_span(
+                    "proxy_hop", t0, now, replica=label,
+                    outcome="shed", attempt=hops + 1,
+                )
                 shed = (status, data, resp_headers)
                 self._count_failover(target, "shed")
+                ctx.add_span(
+                    "failover", now, time.monotonic(), replica=label,
+                    cause="shed",
+                )
                 hops += 1
                 continue
+            ctx.add_span(
+                "proxy_hop", t0, time.monotonic(), replica=label,
+                outcome="ok" if status == 200 else f"http_{status}",
+                attempt=hops + 1,
+            )
+            final = target
+            break
+        if final is not None:
             # a routed verdict (200 or an application error) returns as-is
             self.registry.counter(
                 FLEET_REQUESTS_ROUTED_TOTAL,
                 help="requests served to completion by each replica "
                 "(non-503 responses returned to the client)",
-                replica=target_label(target),
+                replica=target_label(final),
             ).inc()
-            out_headers = self._response_headers(resp_headers, target, hops)
+            out_headers = self._response_headers(resp_headers, final, hops)
             if status == 200:
-                data = self._augment_payload(data, target, hops)
-            return status, data, out_headers
-        # no healthy replica left (or every one shed / died)
-        self.registry.counter(
-            FLEET_SHED_TOTAL,
-            help="requests answered 503 by the fleet (every replica shed "
-            "or unhealthy); carries the replica's own Retry-After through",
-        ).inc()
-        if shed is not None:
-            status, data, resp_headers = shed
-            retry_after = next(
-                (v for k, v in resp_headers if k.lower() == "retry-after"),
-                str(RETRY_AFTER_S),
-            )
+                data = self._augment_payload(data, final, hops)
         else:
-            retry_after = str(RETRY_AFTER_S)
-            data = json.dumps({
-                "error": "no healthy replica "
-                f"({self.replicas.ejected_count()} of "
-                f"{len(self.replicas)} ejected)",
-                "replica_hops": hops,
-            }).encode()
-        return 503, data, [
-            ("Content-Type", "application/json"),
-            ("Retry-After", retry_after),
-        ]
+            # no healthy replica left (or every one shed / died)
+            self.registry.counter(
+                FLEET_SHED_TOTAL,
+                help="requests answered 503 by the fleet (every replica "
+                "shed or unhealthy); carries the replica's own Retry-After "
+                "through",
+            ).inc()
+            if shed is not None:
+                status, data, resp_headers = shed
+                retry_after = next(
+                    (v for k, v in resp_headers if k.lower() == "retry-after"),
+                    str(RETRY_AFTER_S),
+                )
+            else:
+                retry_after = str(RETRY_AFTER_S)
+                data = json.dumps({
+                    "error": "no healthy replica "
+                    f"({self.replicas.ejected_count()} of "
+                    f"{len(self.replicas)} ejected)",
+                    "replica_hops": hops,
+                }).encode()
+            status = 503
+            out_headers = [
+                ("Content-Type", "application/json"),
+                ("Retry-After", retry_after),
+                # the echo contract holds on the shed path too: the
+                # replica would have echoed it, so the fleet must
+                ("X-Nm03-Request-Id", ctx.trace_id),
+            ]
+        self._finish_request(ctx, seq, t_req, status, final, hops)
+        return status, data, out_headers
+
+    def _finish_request(
+        self, ctx: TraceContext, seq: int, t_req: float, status: int,
+        final: Optional[str], hops: int,
+    ) -> None:
+        """One proxied request's terminal accounting: the SLO layer's
+        status class + latency observation, and the ``fleet_trace``
+        event carrying the router's span chain."""
+        if 200 <= status < 300:
+            cls = "ok"
+        elif status == 503:
+            cls = "shed"
+        elif status >= 500:
+            cls = "error"
+        else:
+            cls = "invalid"
+        try:
+            self.registry.counter(
+                FLEET_REQUESTS_TOTAL, help=self._REQ_HELP, status=cls
+            ).inc()
+            self.registry.histogram(
+                FLEET_REQUEST_SECONDS,
+                help="client-observed proxy latency per request (front-end "
+                "admission to the final verdict, failover hops included) — "
+                "the fleet SLO layer's latency input",
+                buckets=DEFAULT_LATENCY_BUCKETS,
+            ).observe(time.monotonic() - t_req)
+            self.obs.events.emit(
+                FLEET_TRACE_EVENT,
+                trace_id=ctx.trace_id,
+                request_id=f"fl-{seq:06d}",
+                replica=target_label(final) if final else None,
+                replica_hops=hops,
+                status=status,
+                spans=ctx.snapshot(),
+            )
+        except Exception as e:  # noqa: BLE001 — telemetry never fails a request
+            log.warning("fleet trace emit failed: %s", e)
 
     def _response_headers(
         self, resp_headers: List[Tuple[str, str]], target: str, hops: int
@@ -509,6 +699,11 @@ class FleetApp:
 
     def publish_gauges(self) -> None:
         """Refresh the fleet-level gauges from the current state table."""
+        if self.slo is not None:
+            try:
+                self.slo.publish()  # pull-refresh the burn-rate windows
+            except Exception as e:  # noqa: BLE001 — telemetry never blocks
+                log.warning("fleet SLO publish failed: %s", e)
         healthy = self.replicas.healthy_count()
         self.registry.gauge(
             FLEET_REPLICAS_READY,
@@ -537,6 +732,12 @@ class FleetApp:
             "ready": self.ready,
             "draining": self.draining,
             "fleet": True,
+            # the SLO verdict rides /readyz like the replica's saturation
+            # block: burn rates + budget against the declared objective
+            # (null when no objective was declared). last_block, not
+            # publish: the /readyz handler already published via
+            # publish_gauges() — one probe must sample once
+            "slo": self.slo.last_block() if self.slo is not None else None,
             "capacity": round(self.replicas.capacity_fraction(), 6),
             "replicas": {
                 "count": len(self.replicas),
@@ -602,26 +803,48 @@ def make_handler(app: FleetApp):
                     json.dumps(app.obs.metrics_snapshot(), indent=1).encode(),
                     [("Content-Type", "application/json")],
                 )
+            elif path == "/debug/flightrec":
+                # the remote debug pull (ISSUE 14): the router's own
+                # flight rings over HTTP — `nm03-fleet flightrec` fans
+                # the same endpoint across the replicas
+                from nm03_capstone_project_tpu.obs import flightrec
+
+                snap = flightrec.get_recorder().snapshot(reason="debug_pull")
+                self._reply(
+                    200, json.dumps(snap, default=str).encode(),
+                    [("Content-Type", "application/json")],
+                )
             else:
                 self._reply_json(404, {"error": f"unknown path {path}"})
 
         def do_POST(self):  # noqa: N802
             split = urlsplit(self.path)
+            # the fleet mints-or-honors the trace identity EXPLICITLY
+            # (ISSUE 14): the id is decided here, echoed on every reply
+            # (errors included) and forwarded replica-ward, so the whole
+            # fleet timeline of this request shares one id
+            trace_id = sanitize_trace_id(
+                self.headers.get("X-Nm03-Request-Id")
+            ) or new_trace_id()
+            echo = [("X-Nm03-Request-Id", trace_id)]
             if split.path != "/v1/segment":
-                self._reply_json(404, {"error": f"unknown path {split.path}"})
+                self._reply_json(
+                    404, {"error": f"unknown path {split.path}"}, echo
+                )
                 return
             try:
                 length = int(self.headers.get("Content-Length", 0))
             except ValueError:
-                self._reply_json(400, {"error": "bad Content-Length"})
+                self._reply_json(400, {"error": "bad Content-Length"}, echo)
                 return
             if length <= 0:
-                self._reply_json(400, {"error": "empty body"})
+                self._reply_json(400, {"error": "empty body"}, echo)
                 return
             if length > _MAX_BODY_BYTES:
                 self._reply_json(
                     413,
                     {"error": f"body of {length} bytes exceeds the fleet cap"},
+                    echo,
                 )
                 return
             body = self.rfile.read(length)
@@ -632,12 +855,13 @@ def make_handler(app: FleetApp):
             }
             try:
                 status, data, resp_headers = app.proxy_segment(
-                    body, headers, split.query
+                    body, headers, split.query, trace_id=trace_id
                 )
             except Exception as e:  # noqa: BLE001 — per-request containment
                 log.warning("fleet request failed: %s", e)
                 self._reply_json(
-                    500, {"error": str(e), "error_class": type(e).__name__}
+                    500, {"error": str(e), "error_class": type(e).__name__},
+                    echo,
                 )
                 return
             self._reply(status, data, resp_headers)

@@ -88,6 +88,21 @@ FLEET_SHED_TOTAL = "fleet_shed_total"
 FLEET_REPLICA_EJECTIONS_TOTAL = "fleet_replica_ejections_total"
 FLEET_REPLICA_REINSTATED_TOTAL = "fleet_replica_reinstated_total"
 FLEET_PROBES_TOTAL = "fleet_probes_total"
+# fleet request accounting (ISSUE 14): the SLO layer's inputs on the
+# router side — terminal proxied-request outcomes by status class and the
+# client-observed proxy latency (admission at the front-end to the final
+# verdict, failover hops included)
+FLEET_REQUESTS_TOTAL = "fleet_requests_total"
+FLEET_REQUEST_SECONDS = "fleet_request_seconds"
+# SLO plane (obs.slo, ISSUE 14): multi-window burn rates and the error
+# budget, computed from the request counters/histograms above (replica:
+# serving_requests_total + serving_request_seconds; fleet:
+# fleet_requests_total + fleet_request_seconds). Published on both
+# replica and fleet /metrics whenever an objective is declared.
+SLO_ERROR_BUDGET_REMAINING = "slo_error_budget_remaining"
+SLO_BURN_RATE_FAST = "slo_burn_rate_fast"
+SLO_BURN_RATE_SLOW = "slo_burn_rate_slow"
+SLO_OBJECTIVE_INFO = "slo_objective_info"
 SERVING_BUSY_FRACTION = "serving_busy_fraction"
 SERVING_LANE_IDLE_GAP_SECONDS = "serving_lane_idle_gap_seconds"
 SERVING_LANE_MFU = "serving_lane_mfu"

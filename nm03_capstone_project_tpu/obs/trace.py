@@ -48,6 +48,10 @@ from nm03_capstone_project_tpu.obs import flightrec
 SCHEMA_TRACE = "nm03.trace.v1"
 # the JSONL event (one per completed request) carrying the span tree
 SERVE_TRACE_EVENT = "serve_trace"
+# the router-side twin (ISSUE 14): one per proxied request (and one per
+# probation canary, flagged probe=true) in the fleet front-end's stream,
+# carrying the router's own span tree under the same schema
+FLEET_TRACE_EVENT = "fleet_trace"
 
 # the serving span vocabulary (docs/OBSERVABILITY.md trace schema). The
 # exporter and validator are deliberately name-agnostic (every B event
@@ -64,6 +68,17 @@ SERVE_SPAN_NAMES = (
     "probe",            # probation canary on a quarantined lane (off-path)
     "cpu_fallback",     # degraded-path recompute
     "encode",           # host render + JPEG encode on the handler thread
+)
+
+# the fleet section of the span vocabulary (ISSUE 14): the router's own
+# spans, riding `fleet_trace` events in the front-end's stream. Same
+# lockstep contract as SERVE_SPAN_NAMES — a new router span must be
+# added here AND to the docs/OBSERVABILITY.md trace table.
+FLEET_SPAN_NAMES = (
+    "route_pick",       # one smooth-WRR pick over the healthy set
+    "proxy_hop",        # one forward attempt to one replica (`replica` field)
+    "failover",         # the rider moved off a dying/shedding replica
+    "canary_probe",     # one probation canary POST (off-path, probe=true)
 )
 
 # client-supplied trace ids: bounded charset/length so a hostile header
@@ -253,7 +268,22 @@ def chrome_trace_events(serve_traces: Iterable[dict]) -> List[dict]:
     appear in every rider's record). Metadata (``ph: "M"``) events name
     the process and tracks; B/E events are globally ts-sorted.
     """
-    recs = [r for r in serve_traces]
+    meta, be = _process_events(list(serve_traces), 1, "nm03-serve")
+    be.sort(key=lambda e: (e["ts"], 0 if e["ph"] == "E" else 1))
+    return meta + be
+
+
+def _process_events(
+    recs: List[dict], pid: int, process_name: str, shift_s: float = 0.0
+) -> tuple:
+    """One process's trace records -> (metadata events, unsorted B/E list).
+
+    The single-process exporter and the multi-log merge share this body:
+    ``pid`` scopes the track table, ``shift_s`` is added to every span
+    time BEFORE the µs conversion (the merge passes each stream's
+    monotonic→merged-timeline offset; adding after the conversion would
+    put the values past float's 0.1 µs resolution).
+    """
     # trace ids are client-controlled and nothing enforces uniqueness: a
     # client retrying with the same X-Nm03-Request-Id while the original
     # is in flight yields two span trees under one id. Disambiguate those
@@ -280,19 +310,19 @@ def chrome_trace_events(serve_traces: Iterable[dict]) -> List[dict]:
             spans.append((sp, req_track))
 
     tids: Dict[str, int] = {}
-    events: List[dict] = [
+    meta: List[dict] = [
         {
-            "ph": "M", "pid": 1, "tid": 0, "name": "process_name",
-            "args": {"name": "nm03-serve"},
+            "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": process_name},
         }
     ]
 
     def tid_for(track: str) -> int:
         if track not in tids:
             tids[track] = len(tids) + 1
-            events.append(
+            meta.append(
                 {
-                    "ph": "M", "pid": 1, "tid": tids[track],
+                    "ph": "M", "pid": pid, "tid": tids[track],
                     "name": "thread_name", "args": {"name": track},
                 }
             )
@@ -328,12 +358,9 @@ def chrome_trace_events(serve_traces: Iterable[dict]) -> List[dict]:
         subtracks: List[list] = []  # [tid, cursor_ts] per sibling track
         for sp in sorted(track_spans, key=lambda s: float(s.get("t0_s", 0.0))):
             lane = sp.get("lane")
-            b_ts = round(float(sp.get("t0_s", 0.0)) * 1e6, 1)
-            e_ts = round(
-                (float(sp.get("t0_s", 0.0)) + float(sp.get("dur_s", 0.0)))
-                * 1e6,
-                1,
-            )
+            t0 = float(sp.get("t0_s", 0.0)) + shift_s
+            b_ts = round(t0 * 1e6, 1)
+            e_ts = round((t0 + float(sp.get("dur_s", 0.0))) * 1e6, 1)
             slot = next(
                 (s for s in subtracks if b_ts >= s[1] - _TEAR_EPS_US), None
             )
@@ -357,20 +384,47 @@ def chrome_trace_events(serve_traces: Iterable[dict]) -> List[dict]:
                 args["lane"] = lane
             if "attempt" in sp:
                 args["attempt"] = sp["attempt"]
-            common = {"name": sp.get("name", "?"), "pid": 1, "tid": slot[0],
+            # fleet-span attribution (ISSUE 14): which replica a proxy_hop
+            # went to and how it ended — the fields --expect-fleet-trace
+            # joins on — plus failover causes and the probe flag
+            for k in ("replica", "outcome", "cause", "probe"):
+                if k in sp:
+                    args[k] = sp[k]
+            common = {"name": sp.get("name", "?"), "pid": pid, "tid": slot[0],
                       "cat": "serving"}
             be.append({**common, "ph": "B", "ts": b_ts, "args": args})
             be.append({**common, "ph": "E", "ts": e_ts})
-    # stable global ts order; an E at the same ts as its track's next B
-    # must come first so the per-track stack stays balanced at every prefix
-    be.sort(key=lambda e: (e["ts"], 0 if e["ph"] == "E" else 1))
-    events.extend(be)
-    return events
+    # the caller sorts B/E globally (an E at the same ts as its track's
+    # next B must come first so every per-track stack prefix balances)
+    return meta, be
 
 
 def load_serve_traces(events_path: str) -> List[dict]:
     """The ``serve_trace`` records of one JSONL event stream (in order)."""
-    out: List[dict] = []
+    return load_stream(events_path)["serve"]
+
+
+def load_stream(events_path: str) -> dict:
+    """Parse one JSONL event stream for the exporter.
+
+    Returns ``{path, serve, fleet, offset_s, run_id}``: the
+    ``serve_trace`` and ``fleet_trace`` records in order, plus the
+    stream's monotonic→wall clock offset. Span times are
+    ``time.monotonic()`` seconds of the WRITING process — meaningless
+    across processes — but every event record carries both ``ts_unix``
+    and ``mono_s``, so ``median(ts_unix - mono_s)`` recovers the
+    process's monotonic epoch on the shared wall clock: the offset the
+    multi-log merge aligns each process's spans with. (The replica
+    ``/readyz`` handshake echoes the same clock pair live, so the router
+    can publish per-replica offsets for skew triage; the merge derives
+    its offsets from each log itself and needs no side channel.)
+    Unparsable lines are skipped — a SIGKILLed replica's torn tail is
+    exactly the post-mortem input this tool exists for.
+    """
+    serve: List[dict] = []
+    fleet: List[dict] = []
+    offsets: List[float] = []
+    run_id = None
     with open(events_path) as f:
         for line in f:
             line = line.strip()
@@ -380,27 +434,143 @@ def load_serve_traces(events_path: str) -> List[dict]:
                 rec = json.loads(line)
             except json.JSONDecodeError:
                 continue  # torn tail: a crash mid-write is exactly our use case
-            if isinstance(rec, dict) and rec.get("event") == SERVE_TRACE_EVENT:
-                out.append(rec)
-    return out
+            if not isinstance(rec, dict):
+                continue
+            ts, mono = rec.get("ts_unix"), rec.get("mono_s")
+            if isinstance(ts, (int, float)) and isinstance(mono, (int, float)):
+                offsets.append(float(ts) - float(mono))
+            if run_id is None and rec.get("run_id"):
+                run_id = rec["run_id"]
+            if rec.get("event") == SERVE_TRACE_EVENT:
+                serve.append(rec)
+            elif rec.get("event") == FLEET_TRACE_EVENT:
+                fleet.append(rec)
+    offsets.sort()
+    offset_s = offsets[len(offsets) // 2] if offsets else 0.0
+    return {
+        "path": str(events_path),
+        "serve": serve,
+        "fleet": fleet,
+        "offset_s": offset_s,
+        "run_id": run_id,
+    }
 
 
-def export_chrome_trace(events_path: str, out_path: str) -> int:
-    """Write the Perfetto-loadable export; returns the request count."""
+def _replica_process_name(stream: dict, trace_to_replica: Dict[str, str]) -> str:
+    """Name one replica stream's process track.
+
+    The replica's own log does not know its host:port — the ROUTER does
+    (every ``fleet_trace`` names the answering replica) — so the join is
+    by trace id: the label that answered the majority of this stream's
+    trace ids names the process. Streams the router never routed to
+    (direct traffic, or a replica that died before completing anything)
+    fall back to the run id.
+    """
+    votes: Dict[str, int] = {}
+    for rec in stream["serve"]:
+        label = trace_to_replica.get(rec.get("trace_id"))
+        if label:
+            votes[label] = votes.get(label, 0) + 1
+    if votes:
+        return f"replica {max(votes, key=votes.get)}"
+    suffix = stream["run_id"] or os.path.basename(stream["path"])
+    return f"replica {suffix}"
+
+
+def merged_chrome_trace_events(streams: List[dict]) -> List[dict]:
+    """N event streams -> ONE multi-process Perfetto timeline (ISSUE 14).
+
+    Each stream becomes its own process (router streams — those carrying
+    ``fleet_trace`` records — first, then replicas), with every span's
+    monotonic time normalized onto one shared timeline via the stream's
+    own wall-clock offset (see :func:`load_stream`). The result answers
+    "where did request X's 400 ms go, across which replicas" from one
+    screen: the router's ``route_pick → proxy_hop → failover →
+    proxy_hop`` chain sits above each replica's full span tree under the
+    same trace id.
+    """
+    routers = [s for s in streams if s["fleet"]]
+    replicas = [s for s in streams if not s["fleet"]]
+    # trace id -> answering replica label, from the router's own records
+    trace_to_replica: Dict[str, str] = {}
+    for s in routers:
+        for rec in s["fleet"]:
+            if rec.get("trace_id") and rec.get("replica"):
+                trace_to_replica[rec["trace_id"]] = rec["replica"]
+
+    # one shared zero point: the earliest wall-aligned span start across
+    # every stream, so ts values stay small enough for 0.1 µs arithmetic
+    base = None
+    for s in streams:
+        for rec in s["fleet"] + s["serve"]:
+            for sp in rec.get("spans") or []:
+                try:
+                    t = float(sp.get("t0_s", 0.0)) + s["offset_s"]
+                except (TypeError, ValueError):
+                    continue
+                base = t if base is None else min(base, t)
+    base = base or 0.0
+
+    events: List[dict] = []
+    be_all: List[dict] = []
+    pid = 0
+    for i, s in enumerate(routers):
+        pid += 1
+        name = "nm03-fleet" if len(routers) == 1 else f"nm03-fleet {i}"
+        meta, be = _process_events(
+            s["fleet"] + s["serve"], pid, name, shift_s=s["offset_s"] - base
+        )
+        events.extend(meta)
+        be_all.extend(be)
+    for s in replicas:
+        pid += 1
+        meta, be = _process_events(
+            s["serve"], pid, _replica_process_name(s, trace_to_replica),
+            shift_s=s["offset_s"] - base,
+        )
+        events.extend(meta)
+        be_all.extend(be)
+    be_all.sort(key=lambda e: (e["ts"], 0 if e["ph"] == "E" else 1))
+    events.extend(be_all)
+    return events
+
+
+def export_chrome_trace(events_paths, out_path: str) -> int:
+    """Write the Perfetto-loadable export; returns the request-tree count.
+
+    ``events_paths`` is one stream path or a list of them: a single
+    replica-only stream keeps the original single-process export byte
+    layout; multiple streams (or any stream carrying ``fleet_trace``
+    records) produce the merged multi-process timeline.
+    """
     from nm03_capstone_project_tpu.utils.atomicio import atomic_write_text
 
-    traces = load_serve_traces(events_path)
+    paths = (
+        [events_paths] if isinstance(events_paths, (str, os.PathLike))
+        else list(events_paths)
+    )
+    streams = [load_stream(p) for p in paths]
+    n_serve = sum(len(s["serve"]) for s in streams)
+    n_fleet = sum(len(s["fleet"]) for s in streams)
+    if len(streams) == 1 and not n_fleet:
+        trace_events = chrome_trace_events(streams[0]["serve"])
+        metadata = {"source": streams[0]["path"], "requests": n_serve}
+    else:
+        trace_events = merged_chrome_trace_events(streams)
+        metadata = {
+            "sources": [s["path"] for s in streams],
+            "requests": n_serve,
+            "fleet_requests": n_fleet,
+            "processes": len(streams),
+        }
     payload = {
         "schema": SCHEMA_TRACE,
         "displayTimeUnit": "ms",
-        "traceEvents": chrome_trace_events(traces),
-        "metadata": {
-            "source": events_path,
-            "requests": len(traces),
-        },
+        "traceEvents": trace_events,
+        "metadata": metadata,
     }
     atomic_write_text(out_path, json.dumps(payload, indent=1) + "\n")
-    return len(traces)
+    return n_serve + n_fleet
 
 
 def main(argv=None) -> int:
@@ -412,23 +582,35 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="nm03-trace", description=main.__doc__.strip().splitlines()[0]
     )
-    p.add_argument("events", help="JSONL event stream (--log-json output)")
+    p.add_argument(
+        "events", nargs="+",
+        help="JSONL event stream(s) (--log-json output). One replica "
+        "stream exports the classic single-process timeline; several — "
+        "the fleet router's log plus N replica logs — are stitched into "
+        "ONE multi-process timeline with per-replica tracks and "
+        "clock-offset-normalized times (ISSUE 14)",
+    )
     p.add_argument(
         "-o", "--out", default=None,
-        help="trace JSON output path (default: <events>.trace.json)",
+        help="trace JSON output path (default: <first events file>"
+        ".trace.json)",
     )
     args = p.parse_args(argv)
-    out = args.out or f"{args.events}.trace.json"
+    out = args.out or f"{args.events[0]}.trace.json"
     try:
         n = export_chrome_trace(args.events, out)
     except OSError as e:
         print(f"nm03-trace: {e}", file=sys.stderr)
         return 2
-    print(f"nm03-trace: {n} request trace(s) -> {out}")
+    merged = f" (merged from {len(args.events)} streams)" if len(
+        args.events
+    ) > 1 else ""
+    print(f"nm03-trace: {n} request trace(s){merged} -> {out}")
     if n == 0:
         print(
-            "nm03-trace: no serve_trace records found — was the stream "
-            "written by nm03-serve --log-json with traffic served?",
+            "nm03-trace: no serve_trace records (nor fleet_trace) found — "
+            "was the stream written by nm03-serve/nm03-fleet --log-json "
+            "with traffic served?",
             file=sys.stderr,
         )
         return 1

@@ -429,7 +429,10 @@ class DynamicBatcher:
                 buckets=LATENCY_BUCKETS,
             )
             for r in reqs:
-                wait_h.observe(r.queue_wait_s)
+                # probe riders (fleet canaries, ISSUE 14) are served and
+                # traced but never observed into the request metrics
+                if not getattr(r, "probe", False):
+                    wait_h.observe(r.queue_wait_s)
             reg.histogram(
                 SERVING_BATCH_SIZE,
                 help="coalesced (pre-padding) batch sizes",

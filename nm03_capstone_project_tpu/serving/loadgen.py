@@ -57,6 +57,73 @@ from nm03_capstone_project_tpu.serving.metrics import (
 )
 
 
+def parse_slo_spec(spec: str) -> dict:
+    """``availability=99.5,p99_ms=500`` -> {availability, p99_ms} (ISSUE 14).
+
+    Either key may be omitted (at least one required); values are floats.
+    Raises ValueError on malformed input (the CLI maps it to a usage
+    error).
+    """
+    out: dict = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, eq, v = part.partition("=")
+        k = k.strip()
+        if not eq or k not in ("availability", "p99_ms"):
+            raise ValueError(
+                f"--expect-slo wants availability=PCT and/or p99_ms=MS, "
+                f"got {part!r}"
+            )
+        try:
+            out[k] = float(v.strip())
+        except ValueError:
+            raise ValueError(
+                f"--expect-slo value for {k} must be a number, got "
+                f"{v.strip()!r}"
+            ) from None
+    if not out:
+        raise ValueError("--expect-slo needs at least one objective")
+    if "availability" in out and not 0.0 < out["availability"] <= 100.0:
+        raise ValueError(
+            f"--expect-slo availability must be in (0, 100], got "
+            f"{out['availability']}"
+        )
+    return out
+
+
+def evaluate_slo(summary: dict, expect: dict) -> dict:
+    """The client-side SLO gate verdict over one run's summary.
+
+    Availability is judged on the CLIENT's view — ok requests over total
+    — and p99 on the client-observed end-to-end latency, so the gate
+    measures what users saw, not what any one process published. Returns
+    ``{pass, checks: {...}}`` (each check: expected/observed/pass).
+    """
+    checks: dict = {}
+    if "availability" in expect:
+        total = summary.get("requests_total") or 0
+        ok = summary.get("requests_ok") or 0
+        observed = (ok / total * 100.0) if total else 0.0
+        checks["availability"] = {
+            "expected_pct": expect["availability"],
+            "observed_pct": round(observed, 4),
+            "pass": observed >= expect["availability"],
+        }
+    if "p99_ms" in expect:
+        observed = (summary.get("latency_ms") or {}).get("p99")
+        checks["p99_ms"] = {
+            "expected_ms": expect["p99_ms"],
+            "observed_ms": observed,
+            "pass": observed is not None and observed <= expect["p99_ms"],
+        }
+    return {
+        "pass": all(c["pass"] for c in checks.values()),
+        "checks": checks,
+    }
+
+
 def _percentile(sorted_vals: List[float], p: float) -> float:
     """Nearest-rank percentile on an already-sorted list."""
     if not sorted_vals:
@@ -587,11 +654,26 @@ def build_parser() -> argparse.ArgumentParser:
         help="extra nm03-serve flags for --self-serve, space-separated "
         '(e.g. "--canvas 128 --max-wait-ms 25")',
     )
+    p.add_argument(
+        "--expect-slo", default=None, metavar="SPEC",
+        help="gate the run against a client-side SLO (ISSUE 14): "
+        "'availability=99.5,p99_ms=500' (either key optional) — exit "
+        "non-zero when the observed ok-fraction falls below the "
+        "availability or the client p99 exceeds the target; the verdict "
+        "rides the summary as `slo_gate`",
+    )
     return p
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    expect_slo = None
+    if args.expect_slo:
+        try:
+            expect_slo = parse_slo_spec(args.expect_slo)
+        except ValueError as e:
+            parser.error(str(e))
     httpd = app = None
     url = args.url
     if args.self_serve:
@@ -663,6 +745,10 @@ def main(argv=None) -> int:
     summary["replicas_ejected_max_observed"] = watch.max_replicas_ejected
     summary["replicas"] = topo["replicas"]
     summary["replicas_ready"] = topo["replicas_ready"]
+    # the client-side SLO gate (ISSUE 14): judged on what clients SAW —
+    # the verdict rides the artifact whether or not it passes
+    if expect_slo is not None:
+        summary["slo_gate"] = evaluate_slo(summary, expect_slo)
     if args.self_serve and app is not None:
         app.begin_drain(reason="loadgen_done")
         httpd.shutdown()
@@ -711,6 +797,21 @@ def main(argv=None) -> int:
         f"echo_mismatch={summary['trace_echo_mismatches']}",
         flush=True,
     )
+    if expect_slo is not None:
+        gate = summary["slo_gate"]
+        detail = "  ".join(
+            f"{k}: {'ok' if c['pass'] else 'FAIL'} "
+            f"(want {c.get('expected_pct', c.get('expected_ms'))}, "
+            f"got {c.get('observed_pct', c.get('observed_ms'))})"
+            for k, c in sorted(gate["checks"].items())
+        )
+        print(
+            f"loadgen: --expect-slo "
+            f"{'PASSED' if gate['pass'] else 'FAILED'}  {detail}",
+            flush=True,
+        )
+        if not gate["pass"]:
+            return 1
     # exit non-zero when nothing succeeded: a load test that measured no
     # requests is a failed measurement, whatever the server said
     return 0 if summary["requests_ok"] > 0 else 1

@@ -25,6 +25,10 @@ from nm03_capstone_project_tpu.obs.metrics import (  # noqa: F401
     SERVING_MFU,
     SERVING_PADDING_WASTE_RATIO,
     SERVING_WINDOW_OCCUPANCY_RATIO,
+    SLO_BURN_RATE_FAST,
+    SLO_BURN_RATE_SLOW,
+    SLO_ERROR_BUDGET_REMAINING,
+    SLO_OBJECTIVE_INFO,
 )
 
 # -- counters ---------------------------------------------------------------
@@ -97,4 +101,7 @@ LATENCY_BUCKETS = (
 # Coalesced batch sizes; bucketed at the warm-executable sizes.
 BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
 
-REQUEST_STATUSES = ("ok", "error", "shed", "invalid", "timeout")
+# `probe` (ISSUE 14): every terminal status of a fleet probation canary
+# (X-Nm03-Probe) lands here — visible, and excluded from SLO accounting
+# (neither the good nor the bad status set contains it)
+REQUEST_STATUSES = ("ok", "error", "shed", "invalid", "timeout", "probe")

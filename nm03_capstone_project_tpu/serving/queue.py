@@ -63,6 +63,10 @@ class ServeRequest:
     # lane quarantined mid-flight (ISSUE 8): 0 on the happy path; >0 means
     # the rider outlived a sick chip without ever seeing an error
     requeues: int = 0
+    # a fleet probation canary (X-Nm03-Probe, ISSUE 14): served and traced
+    # normally, but excluded from request metrics and SLO accounting —
+    # the canary cadence must not pollute the series the SLO layer reads
+    probe: bool = False
     error: Optional[BaseException] = None
     done: threading.Event = field(default_factory=threading.Event)
 

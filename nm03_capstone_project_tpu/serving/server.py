@@ -133,6 +133,7 @@ class ServingApp:
         lanes: Optional[int] = None,
         lane_probe_interval_s: Optional[float] = None,
         compile_cache_dir: Optional[str] = None,
+        slo=None,
     ):
         from nm03_capstone_project_tpu.obs import RunContext
         from nm03_capstone_project_tpu.serving.executor import (
@@ -161,6 +162,18 @@ class ServingApp:
         from nm03_capstone_project_tpu.obs.saturation import SaturationMonitor
 
         self.saturation = SaturationMonitor(registry=self.obs.registry)
+        # the SLO plane (ISSUE 14): burn rates/budget computed from the
+        # request histogram/counters this app already maintains; created
+        # only when an objective was declared, pull-refreshed on every
+        # scrape like the saturation monitor
+        self.slo = None
+        if slo is not None:
+            from nm03_capstone_project_tpu.obs.slo import SLOMonitor
+
+            self.slo = SLOMonitor(
+                self.obs.registry, slo,
+                SERVING_REQUESTS_TOTAL, SERVING_REQUEST_SECONDS,
+            )
         self.executor = WarmExecutor(
             self.cfg,
             buckets=tuple(buckets),
@@ -378,6 +391,18 @@ class ServingApp:
             # refreshes the serving_* saturation gauges, so a /readyz
             # probe and a /metrics scrape can never disagree
             "saturation": self.saturation.publish(),
+            # the SLO verdict (ISSUE 14): burn rates + budget against the
+            # declared objective (null when none was declared)
+            "slo": self.slo.publish() if self.slo is not None else None,
+            # the clock handshake (ISSUE 14): this process's monotonic and
+            # wall clocks at answer time, so the fleet router (and any
+            # cross-process tooling) can recover this replica's
+            # monotonic→wall offset — the datum the multi-log trace merge
+            # normalizes span times with
+            "clock": {
+                "mono_s": round(time.monotonic(), 6),
+                "ts_unix": round(time.time(), 6),
+            },
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }
 
@@ -407,6 +432,11 @@ class ServingApp:
             self.saturation.publish()
         except Exception as e:  # noqa: BLE001 — telemetry never blocks a drain
             log.warning("drain: saturation publish failed: %s", e)
+        if self.slo is not None:
+            try:
+                self.slo.publish()  # the final SLO verdict rides the snapshot
+            except Exception as e:  # noqa: BLE001 — never blocks a drain
+                log.warning("drain: SLO publish failed: %s", e)
         if not drained:
             # a wedged drain still must answer whoever is parked on wait():
             # fail the un-popped tail so handler threads return 500, not 504
@@ -503,14 +533,17 @@ class ServingApp:
         return h, w
 
     def submit(
-        self, pixels: np.ndarray, trace_id: Optional[str] = None
+        self, pixels: np.ndarray, trace_id: Optional[str] = None,
+        probe: bool = False,
     ) -> ServeRequest:
         """Admit one decoded slice; QueueFull/QueueClosed shed at the door.
 
         ``trace_id`` is the request-scoped trace identity (an honored
         inbound ``X-Nm03-Request-Id``, or minted here): the request's
         :class:`TraceContext` carries it through every hop and it is
-        echoed back on the response.
+        echoed back on the response. ``probe`` marks a fleet probation
+        canary (``X-Nm03-Probe``): served and traced like any request,
+        excluded from the request metrics (ISSUE 14).
         """
         h, w = self.guard_pixels(pixels)
         req = ServeRequest(
@@ -518,6 +551,7 @@ class ServingApp:
             pixels=pixels,
             dims=(h, w),
             trace=TraceContext(trace_id or new_trace_id()),
+            probe=bool(probe),
         )
         self.queue.put(req)  # raises QueueFull / QueueClosed
         self.registry.gauge(
@@ -530,35 +564,49 @@ class ServingApp:
         pixels: np.ndarray,
         render: bool = True,
         trace_id: Optional[str] = None,
+        probe: bool = False,
     ) -> dict:
         """The full request path minus HTTP: admit, wait, build the payload.
 
         Raises RequestRejected (guards), QueueFull/QueueClosed (shed), or
         TimeoutError; any executor error raises as-is. Always settles the
         inflight gauge and the status counter.
+
+        A ``probe`` request (a fleet probation canary, ISSUE 14) takes
+        the same path but every terminal status lands under
+        ``status="probe"`` and the latency histogram is never observed —
+        the canary cadence is excluded from the series the SLO layer
+        reads, while the request stays fully traced (``serve_trace``
+        carries ``probe: true``).
         """
+
+        def status_class(s: str) -> str:
+            return "probe" if probe else s
+
         t_start = time.monotonic()
         try:
-            req = self.submit(pixels, trace_id=trace_id)
+            req = self.submit(pixels, trace_id=trace_id, probe=probe)
         except (QueueFull, QueueClosed):
-            self.registry.counter(
-                SERVING_SHED_TOTAL,
-                help="admissions refused by backpressure (full or draining)",
-            ).inc()
-            self._count_request("shed")
+            if not probe:
+                self.registry.counter(
+                    SERVING_SHED_TOTAL,
+                    help="admissions refused by backpressure (full or "
+                    "draining)",
+                ).inc()
+            self._count_request(status_class("shed"))
             raise
         except RequestRejected:
-            self._count_request("invalid")  # guard failure at admission
+            self._count_request(status_class("invalid"))  # admission guard
             raise
         try:
             if not req.wait(self.request_timeout_s):
-                self._count_request("timeout")
+                self._count_request(status_class("timeout"))
                 raise TimeoutError(
                     f"request {req.request_id} timed out after "
                     f"{self.request_timeout_s:.0f}s"
                 )
             if req.error is not None:
-                self._count_request("error")
+                self._count_request(status_class("error"))
                 raise req.error
         finally:
             self.registry.gauge(
@@ -591,7 +639,8 @@ class ServingApp:
                     encode_jpeg_bytes(seg, self.jpeg_quality)
                 ).decode("ascii")
         # one serve_trace event per completed request: the span tree the
-        # nm03-trace exporter turns into a Perfetto timeline
+        # nm03-trace exporter turns into a Perfetto timeline (probes stay
+        # traced — labeled, never dropped)
         self.obs.events.emit(
             SERVE_TRACE_EVENT,
             trace_id=req.trace_id,
@@ -599,14 +648,17 @@ class ServingApp:
             lane=req.lane,
             batch_size=req.batch_size,
             queue_wait_s=round(req.queue_wait_s, 6),
+            probe=probe,
             spans=req.trace.snapshot(),
         )
-        self.registry.histogram(
-            SERVING_REQUEST_SECONDS,
-            help="end-to-end request latency (admission to payload built)",
-            buckets=LATENCY_BUCKETS,
-        ).observe(time.monotonic() - t_start)
-        self._count_request("ok")
+        if not probe:
+            self.registry.histogram(
+                SERVING_REQUEST_SECONDS,
+                help="end-to-end request latency (admission to payload "
+                "built)",
+                buckets=LATENCY_BUCKETS,
+            ).observe(time.monotonic() - t_start)
+        self._count_request(status_class("ok"))
         self.registry.gauge(
             SERVING_DEGRADED, help="1 = one-way CPU degradation tripped"
         ).set(1 if self.executor.degraded else 0)
@@ -657,16 +709,64 @@ def make_handler(app: ServingApp):
                 self._reply(200 if st["ready"] else 503, st)
             elif path == "/metrics":
                 app.saturation.publish()  # pull-refresh the sliding window
+                if app.slo is not None:
+                    app.slo.publish()  # pull-refresh the burn-rate windows
                 self._reply_text(
                     200, app.registry.to_prometheus(), "text/plain; version=0.0.4"
                 )
             elif path == "/metrics.json":
                 app.saturation.publish()  # pull-refresh the sliding window
+                if app.slo is not None:
+                    app.slo.publish()  # pull-refresh the burn-rate windows
                 self._reply_text(
                     200,
                     json.dumps(app.obs.metrics_snapshot(), indent=1),
                     "application/json",
                 )
+            elif path == "/debug/flightrec":
+                # remote debug pull (ISSUE 14): the PR-7 flight rings over
+                # HTTP, so a wedged fleet can be post-mortemed without
+                # SIGUSR2 shell access (`nm03-fleet flightrec` fans this
+                # across every replica)
+                from nm03_capstone_project_tpu.obs import flightrec
+
+                snap = flightrec.get_recorder().snapshot(reason="debug_pull")
+                self._reply_text(
+                    200, json.dumps(snap, default=str), "application/json"
+                )
+            elif path == "/debug/profile":
+                # remote debug pull (ISSUE 14): an on-demand jax.profiler
+                # capture (?ms=N, 400 outside [10, 10000]), returned as a
+                # zipped trace directory — the TensorBoard/Perfetto
+                # post-mortem without shell access
+                from nm03_capstone_project_tpu.utils.profiling import (
+                    ProfileBusy,
+                    capture_profile,
+                )
+
+                query = parse_qs(urlsplit(self.path).query)
+                try:
+                    ms = int(query.get("ms", ["500"])[0])
+                except ValueError:
+                    self._reply(400, {"error": "ms must be an integer"})
+                    return
+                try:
+                    result = capture_profile(ms)
+                except ProfileBusy as e:
+                    self._reply(
+                        409, {"error": str(e)},
+                        headers=[("Retry-After", "1")],
+                    )
+                except ValueError as e:
+                    self._reply(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001 — profiler unavailable
+                    log.warning("debug profile capture failed: %s", e)
+                    self._reply(
+                        500,
+                        {"error": str(e), "error_class": type(e).__name__},
+                    )
+                else:
+                    self._reply(200, result)
             else:
                 self._reply(404, {"error": f"unknown path {path}"})
 
@@ -684,6 +784,9 @@ def make_handler(app: ServingApp):
                 self.headers.get("X-Nm03-Request-Id")
             ) or new_trace_id()
             echo = [("X-Nm03-Request-Id", trace_id)]
+            # a fleet probation canary (ISSUE 14): served and traced like
+            # any request, excluded from request metrics/SLO accounting
+            is_probe = self.headers.get("X-Nm03-Probe") == "1"
             # decode phase: every rejection here is counted "invalid" ONCE
             # (segment() owns counting from admission onward)
             try:
@@ -705,15 +808,17 @@ def make_handler(app: ServingApp):
                         body, self.headers.get("Content-Type", "")
                     )
             except RequestRejected as e:
-                app._count_request("invalid")
+                app._count_request("probe" if is_probe else "invalid")
                 self._reply(e.http_status, {"error": str(e)}, headers=echo)
                 return
             except (ValueError, OverflowError) as e:  # bad int headers etc.
-                app._count_request("invalid")
+                app._count_request("probe" if is_probe else "invalid")
                 self._reply(400, {"error": str(e)}, headers=echo)
                 return
             try:
-                payload = app.segment(pixels, render=render, trace_id=trace_id)
+                payload = app.segment(
+                    pixels, render=render, trace_id=trace_id, probe=is_probe
+                )
             except RequestRejected as e:  # guard failures (counted inside)
                 self._reply(e.http_status, {"error": str(e)}, headers=echo)
             except (QueueFull, QueueClosed) as e:
@@ -856,6 +961,9 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument(
         "--jpeg-quality", type=int, default=90, help="JPEG encoder quality"
     )
+    from nm03_capstone_project_tpu.obs.slo import add_slo_args
+
+    add_slo_args(g)  # --slo-availability/--slo-p99-ms/window flags (ISSUE 14)
     g.add_argument(
         "--flight-dir",
         default=None,
@@ -880,6 +988,7 @@ def build_parser() -> argparse.ArgumentParser:
 def app_from_args(args: argparse.Namespace, obs=None) -> ServingApp:
     from nm03_capstone_project_tpu.cli import common
     from nm03_capstone_project_tpu.compilehub.persist import cache_dir_from_env
+    from nm03_capstone_project_tpu.obs.slo import objective_from_args
     from nm03_capstone_project_tpu.resilience import FaultPlan
 
     cfg = common.pipeline_config_from_args(args)
@@ -899,6 +1008,7 @@ def app_from_args(args: argparse.Namespace, obs=None) -> ServingApp:
         lanes=args.lanes or None,
         lane_probe_interval_s=args.lane_probe_interval_s,
         compile_cache_dir=args.compile_cache_dir or cache_dir_from_env(),
+        slo=objective_from_args(args),
     )
 
 
@@ -947,9 +1057,16 @@ def _write_port_file(path: str, port: int) -> None:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
     from nm03_capstone_project_tpu.cli import common
+    from nm03_capstone_project_tpu.obs.slo import objective_from_args
     from nm03_capstone_project_tpu.utils.reporter import configure_reporting
+
+    try:
+        objective_from_args(args)  # a bad --slo-* is a usage error, not
+    except ValueError as e:        # a traceback mid-startup
+        parser.error(str(e))
 
     common.apply_device_env(args.device)
     configure_reporting(verbose=args.verbose)

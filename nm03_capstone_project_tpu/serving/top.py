@@ -37,6 +37,9 @@ from nm03_capstone_project_tpu.obs.metrics import (
     INGEST_DECODE_QUEUE_DEPTH,
     INGEST_RING_OCCUPANCY_RATIO,
     INGEST_UPLOAD_OVERLAP_RATIO,
+    SLO_BURN_RATE_FAST,
+    SLO_BURN_RATE_SLOW,
+    SLO_ERROR_BUDGET_REMAINING,
 )
 from nm03_capstone_project_tpu.serving.metrics import (
     SERVING_BUSY_FRACTION,
@@ -78,6 +81,34 @@ class Sample:
 
     def gauge(self, name: str, **labels) -> Optional[float]:
         return self.gauges.get((name, tuple(sorted(labels.items()))))
+
+
+def _slo_block(cur: "Sample") -> Optional[dict]:
+    """The SLO row's numbers (ISSUE 14), or None when no objective was
+    declared on the scraped process — top shows the gauges, it never
+    recomputes (or invents) an objective."""
+    budget = cur.gauge(SLO_ERROR_BUDGET_REMAINING)
+    if budget is None:
+        return None
+    return {
+        "error_budget_remaining": budget,
+        "burn_rate_fast": cur.gauge(SLO_BURN_RATE_FAST),
+        "burn_rate_slow": cur.gauge(SLO_BURN_RATE_SLOW),
+    }
+
+
+def _slo_line(slo: Optional[dict]) -> Optional[str]:
+    if slo is None:
+        return None
+
+    def _n(v):
+        return "-" if v is None else f"{v:.3g}"
+
+    return (
+        f"slo burn fast {_n(slo['burn_rate_fast'])}   "
+        f"slow {_n(slo['burn_rate_slow'])}   "
+        f"budget {_fmt(slo['error_budget_remaining'], pct=True).strip()} left"
+    )
 
 
 def fetch_sample(url: str, timeout_s: float) -> Sample:
@@ -167,6 +198,9 @@ def build_view(cur: Sample, prev: Optional[Sample] = None) -> dict:
             if cur.gauge(INGEST_RING_OCCUPANCY_RATIO) is not None
             else None
         ),
+        # the SLO row (ISSUE 14): burn rates + budget when the scraped
+        # process declared an objective, null otherwise
+        "slo": _slo_block(cur),
         # rates from counter deltas between polls (null on the first poll
         # and in --once mode: one sample has no delta)
         "rates_per_s": {
@@ -229,6 +263,9 @@ def render_text(view: dict, url: str) -> str:
                 f"{_fmt(ing['upload_overlap_ratio'], pct=True).strip()}"
             ),
         )
+    slo_line = _slo_line(view.get("slo"))
+    if slo_line is not None:
+        lines.insert(3, slo_line)
     for row in view["lanes"]:
         lines.append(
             f"{str(row['lane']):>4} {str(row['state']):<12} "
@@ -317,6 +354,9 @@ def build_fleet_view(
         "uptime_s": st.get("uptime_s"),
         "replicas_ready": (st.get("replicas") or {}).get("ready"),
         "replicas_ejected": (st.get("replicas") or {}).get("ejected"),
+        # the fleet-level SLO row (ISSUE 14): the ROUTER's own burn
+        # gauges — the whole-fleet verdict, not any one replica's
+        "slo": _slo_block(fleet),
         "replicas": rows,
         "rates_per_s": {
             "routed": _rate(fleet, prev_fleet, FLEET_REQUESTS_ROUTED_TOTAL),
@@ -352,6 +392,9 @@ def render_fleet_text(view: dict, url: str) -> str:
         f"{'replica':<22} {'state':<10} {'cap':>6} {'lanes':>5} "
         f"{'queue':>5} {'busy':>8} {'mfu':>8} {'req/s':>7} {'eject':>5}",
     ]
+    slo_line = _slo_line(view.get("slo"))
+    if slo_line is not None:
+        lines.insert(2, slo_line)
     for row in view["replicas"]:
         lines.append(
             f"{str(row['replica']):<22} {str(row['state']):<10} "

@@ -14,6 +14,7 @@ the profiler timeline are the same names by construction.
 from __future__ import annotations
 
 import contextlib
+import threading
 from pathlib import Path
 
 from nm03_capstone_project_tpu.utils.reporter import get_logger
@@ -45,3 +46,90 @@ def annotate(name: str):
     import jax
 
     return jax.profiler.TraceAnnotation(name)
+
+
+class ProfileBusy(RuntimeError):
+    """A capture is already in flight (the jax profiler is process-global)."""
+
+
+# one capture at a time: jax.profiler.start_trace raises on a concurrent
+# start, and two HTTP pulls racing would turn a debug aid into a crash
+_CAPTURE_LOCK = threading.Lock()
+
+MAX_CAPTURE_MS = 10_000
+# past this, the zip is kept SERVER-SIDE (the response names its path and
+# carries the file listing) instead of riding the wire — a remote pull
+# must not OOM the replica it is debugging, but a post-mortem capture
+# must never be destroyed either
+MAX_ZIP_BYTES = 32 << 20
+
+
+def capture_profile(duration_ms: int, zip_cap_bytes: int = MAX_ZIP_BYTES) -> dict:
+    """On-demand ``jax.profiler`` capture for the remote debug pull.
+
+    Runs a trace for ``duration_ms`` (REJECTED outside [10, 10000] ms —
+    a capture is a live-process intrusion, bounded by construction),
+    zips the trace directory in memory and returns a JSON-able dict:
+    ``{duration_ms, files: [{name, bytes}], zip_b64, zip_bytes}``. When
+    the archive exceeds ``zip_cap_bytes`` the base64 payload is dropped
+    from the response (``zip_dropped: true``) but the archive itself is
+    saved server-side and ``zip_path`` names it — an operator's capture
+    is never destroyed, only kept off the wire. Raises
+    :class:`ProfileBusy` when a capture is already running (the HTTP
+    layer maps it to 409), ``ValueError`` on an out-of-bounds duration.
+    """
+    ms = int(duration_ms)
+    if not 10 <= ms <= MAX_CAPTURE_MS:
+        raise ValueError(
+            f"profile duration must be in [10, {MAX_CAPTURE_MS}] ms, got {ms}"
+        )
+    if not _CAPTURE_LOCK.acquire(blocking=False):
+        raise ProfileBusy("a profiler capture is already in flight")
+    try:
+        import base64
+        import io
+        import os
+        import shutil
+        import tempfile
+        import time
+        import zipfile
+
+        import jax
+
+        tmp = tempfile.mkdtemp(prefix="nm03_profile_")
+        try:
+            jax.profiler.start_trace(tmp)
+            time.sleep(ms / 1e3)
+            jax.profiler.stop_trace()
+            files = []
+            buf = io.BytesIO()
+            with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+                for root, _dirs, names in os.walk(tmp):
+                    for name in sorted(names):
+                        full = os.path.join(root, name)
+                        rel = os.path.relpath(full, tmp)
+                        files.append(
+                            {"name": rel, "bytes": os.path.getsize(full)}
+                        )
+                        zf.write(full, rel)
+            out = {"duration_ms": ms, "files": files}
+            data = buf.getvalue()
+            out["zip_bytes"] = len(data)
+            if len(data) <= zip_cap_bytes:
+                out["zip_b64"] = base64.b64encode(data).decode("ascii")
+            else:
+                # too big for the wire: keep the archive on the replica
+                # (named in the response) — the listing alone would name
+                # files that no longer exist anywhere
+                fd, zip_path = tempfile.mkstemp(
+                    prefix="nm03_profile_", suffix=".zip"
+                )
+                with os.fdopen(fd, "wb") as f:
+                    f.write(data)
+                out["zip_dropped"] = True
+                out["zip_path"] = zip_path
+            return out
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    finally:
+        _CAPTURE_LOCK.release()

@@ -86,6 +86,17 @@ trace (``--expect-trace FILE``)
     (``trace_ids`` non-empty or ``trace_id``) — the request attribution
     the export exists for.
 
+fleet trace (``--expect-fleet-trace FILE``)
+  * FILE is a MERGED multi-log ``nm03-trace`` export (router + replica
+    streams, ISSUE 14); everything ``--expect-trace`` checks holds, PLUS:
+  * at least two processes carry B events (the router and >=1 replica);
+  * at least one ``proxy_hop`` span exists (the router really forwarded);
+  * every trace id with a successful (outcome ``ok``) ``proxy_hop``
+    resolves to a replica-side span tree — a B event on a DIFFERENT pid
+    carrying the same id (a failed-over request resolves through the
+    replica that finally answered; requests that completed nowhere are
+    exempt — replicas only emit span trees for completed requests).
+
 cross
   * when both artifacts are given, their run_id and git_sha must match.
 """
@@ -599,6 +610,90 @@ def check_trace(path: str, chk: Checker) -> None:
         chk.fail(path, "no duration (B/E) events — an empty timeline")
 
 
+def check_fleet_trace(path: str, chk: Checker) -> None:
+    """Validate a MERGED fleet timeline (multi-log ``nm03-trace`` output).
+
+    On top of the ordinary trace contract (run :func:`check_trace` too),
+    a merged fleet export must show the cross-process attribution the
+    merge exists for (ISSUE 14):
+
+    * at least two processes (distinct pids carrying B events) — a
+      router log merged with nothing proves nothing;
+    * at least one ``proxy_hop`` span (the router really forwarded);
+    * **every trace id with a successful (outcome ``ok``) ``proxy_hop``
+      resolves to a replica-side span tree**: some B event on a
+      DIFFERENT pid carries the same trace id. A failed-over request's
+      dead-replica hop resolves through the replica that finally
+      answered — the same id, another pid. Requests that never
+      completed anywhere (every hop shed/io_error, or a pre-admission
+      4xx) are exempt: replicas emit ``serve_trace`` for COMPLETED
+      requests only, so demanding resolution there would fail correct
+      artifacts from exactly the overload/chaos drills the fleet exists
+      for. Probe hops never ride ``proxy_hop`` (canaries span
+      ``canary_probe``).
+    """
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        chk.fail(path, f"unreadable or not JSON: {e}")
+        return
+    events = data.get("traceEvents") if isinstance(data, dict) else None
+    if not isinstance(events, list) or not events:
+        chk.fail(path, "traceEvents missing or empty")
+        return
+
+    def ids_of(ev) -> list:
+        args = ev.get("args")
+        if not isinstance(args, dict):
+            return []
+        ids = args.get("trace_ids")
+        if isinstance(ids, list) and ids:
+            return [str(i) for i in ids]
+        return [str(args["trace_id"])] if args.get("trace_id") else []
+
+    pids_with_spans: set = set()
+    hop_ids: dict[str, tuple] = {}  # trace id -> (pid, event index)
+    completed: set = set()  # trace ids with >=1 outcome=ok hop
+    any_hops = False
+    ids_by_pid: dict[object, set] = {}
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict) or ev.get("ph") != "B":
+            continue
+        pid = ev.get("pid")
+        pids_with_spans.add(pid)
+        for tid_ in ids_of(ev):
+            ids_by_pid.setdefault(pid, set()).add(tid_)
+        if ev.get("name") == "proxy_hop":
+            any_hops = True
+            args = ev.get("args") if isinstance(ev.get("args"), dict) else {}
+            for tid_ in ids_of(ev):
+                hop_ids.setdefault(tid_, (pid, i))
+                if args.get("outcome") == "ok":
+                    completed.add(tid_)
+    hop_ids = {t: v for t, v in hop_ids.items() if t in completed}
+    if len(pids_with_spans) < 2:
+        chk.fail(
+            path,
+            f"merged fleet trace has {len(pids_with_spans)} process(es) "
+            "with spans — want the router AND at least one replica",
+        )
+    if not any_hops:
+        chk.fail(path, "no proxy_hop span — the router never forwarded "
+                       "(is this really a fleet log?)")
+    for tid_, (pid, i) in sorted(hop_ids.items()):
+        resolved = any(
+            tid_ in ids and other != pid
+            for other, ids in ids_by_pid.items()
+        )
+        if not resolved:
+            chk.fail(
+                f"{path}: traceEvents[{i}]",
+                f"proxy_hop trace id {tid_!r} resolves to no replica-side "
+                "span tree (no B event on another pid carries it)",
+            )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--events", default=None, help="JSONL event stream to validate")
@@ -644,10 +739,22 @@ def main(argv=None) -> int:
         "output): non-empty, monotonic ts, matched B/E pairs, every "
         "serving span carrying a trace id (repeatable)",
     )
+    ap.add_argument(
+        "--expect-fleet-trace", action="append", default=[], metavar="FILE",
+        help="validate a MERGED fleet timeline (multi-log nm03-trace "
+        "output): everything --expect-trace checks PLUS >=2 processes, "
+        ">=1 proxy_hop span, and every SUCCESSFUL proxy_hop trace id "
+        "resolving to a replica-side span tree on another pid "
+        "(repeatable)",
+    )
     args = ap.parse_args(argv)
-    if not args.events and not args.metrics and not args.expect_trace:
+    if (
+        not args.events and not args.metrics and not args.expect_trace
+        and not args.expect_fleet_trace
+    ):
         ap.error(
-            "nothing to check: pass --events, --metrics and/or --expect-trace"
+            "nothing to check: pass --events, --metrics, --expect-trace "
+            "and/or --expect-fleet-trace"
         )
 
     def parse_expectations(
@@ -712,6 +819,9 @@ def main(argv=None) -> int:
         )
     for trace_path in args.expect_trace:
         check_trace(trace_path, chk)
+    for trace_path in args.expect_fleet_trace:
+        check_trace(trace_path, chk)  # the base contract holds merged too
+        check_fleet_trace(trace_path, chk)
     if ev_ident and mt_ident:
         if mt_ident[0] != ev_ident[0]:
             chk.fail("cross", f"metrics run_id {mt_ident[0]!r} != "
@@ -726,7 +836,10 @@ def main(argv=None) -> int:
         print(f"check_telemetry: {len(chk.problems)} violation(s)", file=sys.stderr)
         return 1
     checked = " and ".join(
-        p for p in (args.events, args.metrics, *args.expect_trace) if p
+        p for p in (
+            args.events, args.metrics, *args.expect_trace,
+            *args.expect_fleet_trace,
+        ) if p
     )
     print(f"check_telemetry: OK ({checked})")
     return 0

@@ -1404,6 +1404,25 @@ class TestMetricsDocs:
         )
         assert rules_of(fs) == ["NM392"]
         assert "serving_new_thing_total" in fs[0].message
+        # drill 3 (ISSUE 14): the fleet/SLO names are INSIDE the
+        # contract — dropping the slo_burn_rate_fast row (or the
+        # fleet_request_seconds row) must fail at the obs/metrics.py
+        # constant, exactly like any serving name
+        for name in ("slo_burn_rate_fast", "fleet_request_seconds"):
+            row = next(
+                line for line in doc_src.splitlines()
+                if line.startswith(f"| `{name}` |")
+            )
+            d = tmp_path / f"drill3_{name}"
+            d.mkdir()
+            fs = lint_tree(
+                d,
+                {**tree, "docs/OBSERVABILITY.md": doc_src.replace(row, "", 1)},
+                rules=(check_metrics_docs,),
+            )
+            assert rules_of(fs) == ["NM392"]
+            assert name in fs[0].message
+            assert fs[0].path.endswith("obs/metrics.py")
 
 
 class TestBaseline:

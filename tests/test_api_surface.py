@@ -141,9 +141,20 @@ SURFACE = {
         "EventLog",
         "RunContext",
     ],
+    # the SLO plane (ISSUE 14): objectives + burn-rate monitor — what
+    # docs/OBSERVABILITY.md's "SLO plane" section names
+    "nm03_capstone_project_tpu.obs.slo": [
+        "SLOObjective",
+        "SLOMonitor",
+        "objective_from_args",
+        "add_slo_args",
+    ],
     "nm03_capstone_project_tpu.utils.manifest": ["Manifest"],
     "nm03_capstone_project_tpu.utils.timing": ["Timer", "write_results_json"],
-    "nm03_capstone_project_tpu.utils.profiling": ["profile_trace"],
+    "nm03_capstone_project_tpu.utils.profiling": [
+        "profile_trace",
+        "capture_profile",  # the remote /debug/profile pull (ISSUE 14)
+    ],
     "nm03_capstone_project_tpu.utils.reporter": ["configure_reporting", "get_logger"],
     "nm03_capstone_project_tpu.native": ["available", "load_batch_native"],
 }

@@ -301,6 +301,9 @@ class FakeReplica:
                         "queue_depth": 0, "queue_capacity": 64,
                         "canvas": fake.canvas, "min_dim": fake.min_dim,
                         "replica": {"id": fake.name, "pid": os.getpid()},
+                        # the ISSUE 14 clock handshake: a fixed fake pair
+                        # whose implied offset the router must record
+                        "clock": {"mono_s": 5.0, "ts_unix": 1005.0},
                     })
                 else:
                     self._j(200, {"status": "alive"})
@@ -312,6 +315,7 @@ class FakeReplica:
                     fake.requests.append({
                         "path": self.path, "bytes": len(body),
                         "id": self.headers.get("X-Nm03-Request-Id"),
+                        "probe": self.headers.get("X-Nm03-Probe"),
                     })
                 if fake.drop:
                     # die mid-response: the transport failure the
@@ -623,6 +627,329 @@ def _fresh_fake_on_port(name: str, port: int) -> FakeReplica:
     fake.url = f"http://127.0.0.1:{port}"
     threading.Thread(target=fake.httpd.serve_forever, daemon=True).start()
     return fake
+
+
+# -- router-side tracing + probe tagging + fleet SLO (ISSUE 14) --------------
+
+
+class TestRouterTracing:
+    def _app(self, fakes, obs=None, **kw):
+        kw.setdefault("health_interval_s", 3600)
+        app = FleetApp([f.url for f in fakes], obs=obs or _Obs(), **kw)
+        app._sweep()
+        return app
+
+    def test_minted_id_forwarded_and_fleet_trace_emitted(self, two_fakes):
+        a, b = two_fakes
+        obs = _Obs()
+        app = self._app([a, b], obs)
+        body, hdrs = _segment_body()
+        status, data, headers = app.proxy_segment(body, hdrs)
+        assert status == 200
+        recs = obs.events.of("fleet_trace")
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["status"] == 200 and rec["replica_hops"] == 0
+        assert rec["request_id"].startswith("fl-")
+        names = [s["name"] for s in rec["spans"]]
+        assert names == ["route_pick", "proxy_hop"]
+        hop = rec["spans"][1]
+        assert hop["outcome"] == "ok" and hop["replica"] == rec["replica"]
+        # the minted id went replica-ward: the serving fake saw it
+        served = a.requests + b.requests
+        assert served and served[-1]["id"] == rec["trace_id"]
+        # the SLO status class landed
+        assert obs.registry.get(
+            "fleet_requests_total", status="ok"
+        ).value == 1
+        assert obs.registry.get("fleet_request_seconds").count == 1
+
+    def test_client_probe_header_is_stripped(self, two_fakes):
+        """A client smuggling X-Nm03-Probe through the fleet must NOT get
+        its traffic excluded from the replica's request metrics — only
+        the router's own canary path may set the tag (review fix)."""
+        a, b = two_fakes
+        app = self._app([a, b])
+        body, hdrs = _segment_body()
+        status, _, _ = app.proxy_segment(
+            body, {**hdrs, "X-Nm03-Probe": "1"}
+        )
+        assert status == 200
+        served = (a.requests + b.requests)[-1]
+        assert served["probe"] is None  # stripped before the forward
+
+    def test_honored_client_id_shared_with_replica(self, two_fakes):
+        a, b = two_fakes
+        obs = _Obs()
+        app = self._app([a, b], obs)
+        body, hdrs = _segment_body()
+        status, _, _ = app.proxy_segment(
+            body, {**hdrs, "x-nm03-request-id": "ignored-case-variant"},
+            trace_id="client-42",
+        )
+        assert status == 200
+        rec = obs.events.of("fleet_trace")[0]
+        assert rec["trace_id"] == "client-42"
+        served = a.requests + b.requests
+        # the canonical id replaced any case variant of the client's
+        assert served[-1]["id"] == "client-42"
+
+    def test_failover_chain_in_spans(self, two_fakes):
+        a, b = two_fakes
+        obs = _Obs()
+        app = self._app([a, b], obs)
+        a.drop = True
+        body, hdrs = _segment_body()
+        status, data, _ = app.proxy_segment(body, hdrs)
+        assert status == 200
+        rec = obs.events.of("fleet_trace")[0]
+        names = [s["name"] for s in rec["spans"]]
+        # the acceptance chain: pick -> hop(A, died) -> failover -> pick
+        # -> hop(B, ok), one trace id throughout
+        assert names == [
+            "route_pick", "proxy_hop", "failover", "route_pick", "proxy_hop",
+        ]
+        hops = [s for s in rec["spans"] if s["name"] == "proxy_hop"]
+        assert hops[0]["outcome"] == "io_error"
+        assert hops[0]["replica"] == a.label
+        assert hops[1]["outcome"] == "ok" and hops[1]["replica"] == b.label
+        assert {s["trace_ids"][0] for s in rec["spans"]} == {rec["trace_id"]}
+        fail = next(s for s in rec["spans"] if s["name"] == "failover")
+        assert fail["cause"] == "io_error" and fail["replica"] == a.label
+        assert rec["replica_hops"] == 1 and rec["replica"] == b.label
+
+    def test_fleet_wide_shed_is_traced_and_echoed(self, two_fakes):
+        a, b = two_fakes
+        obs = _Obs()
+        app = self._app([a, b], obs)
+        a.shed = b.shed = True
+        body, hdrs = _segment_body()
+        status, _, headers = app.proxy_segment(
+            body, hdrs, trace_id="shed-1"
+        )
+        assert status == 503
+        assert dict(headers)["X-Nm03-Request-Id"] == "shed-1"
+        rec = obs.events.of("fleet_trace")[0]
+        assert rec["status"] == 503 and rec["replica"] is None
+        hops = [s for s in rec["spans"] if s["name"] == "proxy_hop"]
+        assert len(hops) == 2
+        assert {h["outcome"] for h in hops} == {"shed"}
+        assert obs.registry.get(
+            "fleet_requests_total", status="shed"
+        ).value == 1
+
+    def test_application_4xx_counts_invalid(self, two_fakes):
+        a, b = two_fakes
+        obs = _Obs()
+        app = self._app([a, b], obs)
+        body = b"\xff" + bytes(1023)  # the fakes 400 this
+        status, _, _ = app.proxy_segment(body, _segment_body()[1])
+        assert status == 400
+        assert obs.registry.get(
+            "fleet_requests_total", status="invalid"
+        ).value == 1
+        rec = obs.events.of("fleet_trace")[0]
+        assert rec["spans"][-1]["outcome"] == "http_400"
+
+    def test_request_classes_exist_at_zero_from_startup(self, two_fakes):
+        obs = _Obs()
+        self._app(list(two_fakes), obs)
+        for cls in ("ok", "error", "shed"):
+            m = obs.registry.get("fleet_requests_total", status=cls)
+            assert m is not None and m.value == 0
+
+    def test_canary_probe_tagged_and_traced(self, two_fakes):
+        a, b = two_fakes
+        obs = _Obs()
+        app = self._app([a, b], obs)
+        app.replicas.eject(b.url, "refused")
+        app._probe_one(b.url, 7)  # the canary, synchronously
+        assert app.replicas.state(b.url) == HEALTHY
+        # the replica saw the probe TAG — the metrics-exclusion satellite
+        probe_req = b.requests[-1]
+        assert probe_req["probe"] == "1"
+        assert probe_req["id"].startswith("fleet-probe-")
+        recs = [r for r in obs.events.of("fleet_trace") if r.get("probe")]
+        assert len(recs) == 1
+        span = recs[0]["spans"][0]
+        assert span["name"] == "canary_probe"
+        assert span["outcome"] == "passed" and span["replica"] == b.label
+        # probes never count as fleet requests
+        assert obs.registry.get(
+            "fleet_requests_total", status="ok"
+        ).value == 0
+
+    def test_clock_offset_recorded_from_handshake(self, two_fakes):
+        a, b = two_fakes
+        app = self._app([a, b])
+        # the fakes publish clock {mono_s: 5, ts_unix: 1005} -> offset 1000
+        assert app.replicas.signals(a.url)["clock_offset_s"] == 1000.0
+        snap = app.status()["replicas"]["per_replica"]
+        assert all(r["clock_offset_s"] == 1000.0 for r in snap)
+
+
+class TestFleetSLO:
+    def test_burn_gauges_and_readyz_block(self, two_fakes):
+        from nm03_capstone_project_tpu.obs.slo import SLOObjective
+
+        a, b = two_fakes
+        obs = _Obs()
+        app = FleetApp(
+            [a.url, b.url], obs=obs, health_interval_s=3600,
+            slo=SLOObjective(99.0, latency_target_s=30.0,
+                             window_fast_s=30.0, window_slow_s=600.0),
+        )
+        app._sweep()
+        body, hdrs = _segment_body()
+        for _ in range(4):
+            assert app.proxy_segment(body, hdrs)[0] == 200
+        app.publish_gauges()
+        assert obs.registry.get("slo_burn_rate_fast").value == 0.0
+        assert obs.registry.get("slo_error_budget_remaining").value == 1.0
+        st = app.status()
+        assert st["slo"]["objective"]["availability_pct"] == 99.0
+        assert st["slo"]["error_budget_remaining"] == 1.0
+        # now burn: every replica sheds -> fleet-wide 503s are bad
+        a.shed = b.shed = True
+        for _ in range(4):
+            assert app.proxy_segment(body, hdrs)[0] == 503
+        block = app.slo.publish()
+        assert block["burn_rate_fast"] > 1.0
+        assert block["error_budget_remaining"] < 1.0
+
+    def test_no_objective_no_gauges(self, two_fakes):
+        obs = _Obs()
+        app = FleetApp(
+            [f.url for f in two_fakes], obs=obs, health_interval_s=3600,
+        )
+        app._sweep()
+        app.publish_gauges()
+        assert obs.registry.get("slo_burn_rate_fast") is None
+        assert app.status()["slo"] is None
+
+
+# -- nm03-top --fleet rendering (canned payloads, ISSUE 14 satellite) --------
+
+
+class TestFleetTopRender:
+    """The ISSUE 13 console path had no direct render test: canned fleet
+    /metrics.json + /readyz payloads -> build_fleet_view/render_fleet_text,
+    including the SLO row."""
+
+    def _fleet_sample(self, ts=100.0, routed=40.0, with_slo=True):
+        from nm03_capstone_project_tpu.serving.top import Sample
+
+        metrics = [
+            {"name": "fleet_requests_routed_total", "type": "counter",
+             "labels": {"replica": "127.0.0.1:8081"}, "value": routed},
+            {"name": "fleet_failovers_total", "type": "counter",
+             "labels": {"replica": "127.0.0.1:8082", "cause": "io_error"},
+             "value": 2.0},
+            {"name": "fleet_shed_total", "type": "counter", "labels": {},
+             "value": 0.0},
+        ]
+        if with_slo:
+            metrics += [
+                {"name": "slo_burn_rate_fast", "type": "gauge",
+                 "labels": {}, "value": 0.25},
+                {"name": "slo_burn_rate_slow", "type": "gauge",
+                 "labels": {}, "value": 0.1},
+                {"name": "slo_error_budget_remaining", "type": "gauge",
+                 "labels": {}, "value": 0.9},
+            ]
+        readyz = {
+            "ready": True, "draining": False, "capacity": 0.833,
+            "uptime_s": 12.5,
+            "replicas": {
+                "count": 2, "ready": 2, "ejected": 0,
+                "per_replica": [
+                    {"target": "http://127.0.0.1:8081",
+                     "replica": "127.0.0.1:8081", "state": "healthy",
+                     "cause": None, "ejections": 0, "capacity": 1.0,
+                     "identity": {"id": "aaa", "pid": 11}},
+                    {"target": "http://127.0.0.1:8082",
+                     "replica": "127.0.0.1:8082", "state": "ejected",
+                     "cause": "refused", "ejections": 2, "capacity": 0.667,
+                     "identity": {"id": "bbb", "pid": 22}},
+                ],
+            },
+        }
+        return Sample({"metrics": metrics}, readyz, ts)
+
+    def _replica_sample(self, ts=100.0, requests=10.0):
+        from nm03_capstone_project_tpu.serving.top import Sample
+
+        metrics = [
+            {"name": "serving_busy_fraction", "type": "gauge", "labels": {},
+             "value": 0.42},
+            {"name": "serving_mfu", "type": "gauge", "labels": {},
+             "value": 0.001},
+            {"name": "serving_requests_total", "type": "counter",
+             "labels": {"status": "ok"}, "value": requests},
+        ]
+        readyz = {"queue_depth": 3, "lanes": {"ready": 4}}
+        return Sample({"metrics": metrics}, readyz, ts)
+
+    def test_build_fleet_view_rows_and_slo(self):
+        from nm03_capstone_project_tpu.serving.top import build_fleet_view
+
+        fleet = self._fleet_sample()
+        per = {
+            "http://127.0.0.1:8081": self._replica_sample(),
+            "http://127.0.0.1:8082": None,  # dead replica -> null row
+        }
+        view = build_fleet_view(fleet, per)
+        assert view["schema"] == "nm03.fleettop.v1"
+        assert view["replicas_ready"] == 2 and len(view["replicas"]) == 2
+        live, dead = view["replicas"]
+        assert live["replica"] == "127.0.0.1:8081"
+        assert live["busy_fraction"] == 0.42
+        assert live["lanes_ready"] == 4 and live["queue_depth"] == 3
+        assert dead["state"] == "ejected" and dead["busy_fraction"] is None
+        assert view["slo"] == {
+            "error_budget_remaining": 0.9,
+            "burn_rate_fast": 0.25,
+            "burn_rate_slow": 0.1,
+        }
+
+    def test_rates_from_counter_deltas(self):
+        from nm03_capstone_project_tpu.serving.top import build_fleet_view
+
+        prev_fleet = self._fleet_sample(ts=100.0, routed=40.0)
+        cur_fleet = self._fleet_sample(ts=110.0, routed=60.0)
+        prev_per = {"http://127.0.0.1:8081": self._replica_sample(100.0, 10)}
+        cur_per = {"http://127.0.0.1:8081": self._replica_sample(110.0, 30)}
+        view = build_fleet_view(cur_fleet, cur_per, prev_fleet, prev_per)
+        assert view["rates_per_s"]["routed"] == pytest.approx(2.0)
+        assert view["replicas"][0]["requests_per_s"] == pytest.approx(2.0)
+
+    def test_render_text_carries_rows_and_slo_line(self):
+        from nm03_capstone_project_tpu.serving.top import (
+            build_fleet_view,
+            render_fleet_text,
+        )
+
+        view = build_fleet_view(
+            self._fleet_sample(),
+            {"http://127.0.0.1:8081": self._replica_sample()},
+        )
+        screen = render_fleet_text(view, "http://fleet:8070")
+        assert "127.0.0.1:8081" in screen and "127.0.0.1:8082" in screen
+        assert "ejected" in screen
+        assert "slo burn fast 0.25" in screen
+        assert "slow 0.1" in screen and "budget 90% left" in screen
+        # the replica row carries its live busy fraction
+        assert "42%" in screen
+
+    def test_no_slo_no_row(self):
+        from nm03_capstone_project_tpu.serving.top import (
+            build_fleet_view,
+            render_fleet_text,
+        )
+
+        view = build_fleet_view(self._fleet_sample(with_slo=False), {})
+        assert view["slo"] is None
+        assert "slo burn" not in render_fleet_text(view, "u")
 
 
 # -- the fleet fault site ----------------------------------------------------
@@ -1166,8 +1493,15 @@ class TestFleetChaosAcceptanceDrill:
             "site": "dispatch", "kind": "hang", "count": 1, "hang_s": 120.0,
         }]})
         replicas = []
+        replica_logs = []
         for i, port in enumerate(ports[:3]):
-            extra = ["--request-timeout-s", "300"]
+            # every replica writes its own event stream (ISSUE 14): the
+            # multi-log merge stitches them — the victim's torn,
+            # SIGKILLed log included — into one fleet timeline
+            log_path = tmp_path / f"r{i}_events.jsonl"
+            replica_logs.append(log_path)
+            extra = ["--request-timeout-s", "300",
+                     "--log-json", str(log_path)]
             if port == victim_port:
                 extra += ["--fault-plan", hang_plan,
                           "--dispatch-timeout-s", "240"]
@@ -1193,6 +1527,13 @@ class TestFleetChaosAcceptanceDrill:
                     "--canary-hw", "32",
                     "--metrics-out", str(fleet_metrics),
                     "--log-json", str(fleet_events),
+                    # the declared SLO (ISSUE 14): zero failed client
+                    # requests is the drill's bar, so the budget must
+                    # survive intact — gated below on the snapshot
+                    "--slo-availability", "99.0",
+                    "--slo-p99-ms", "300000",
+                    "--slo-fast-window-s", "60",
+                    "--slo-slow-window-s", "600",
                 ],
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
                 env=env, cwd=REPO,
@@ -1224,6 +1565,9 @@ class TestFleetChaosAcceptanceDrill:
                     "--timeout-s", "240", "--warmup", "0",
                     "--height", str(CANVAS), "--width", str(CANVAS),
                     "--results-json", str(results_json),
+                    # the client-side SLO gate (ISSUE 14): the kill must
+                    # not cost availability; failing it fails main()
+                    "--expect-slo", "availability=99.0,p99_ms=240000",
                 ]))
 
             lg = threading.Thread(target=run_loadgen, daemon=True)
@@ -1254,6 +1598,8 @@ class TestFleetChaosAcceptanceDrill:
             summary = json.loads(results_json.read_text())
             # THE bar: zero failed client requests through the kill
             assert summary["statuses"] == {"ok": 32}, summary["statuses"]
+            # the client-side SLO verdict rides the artifact (ISSUE 14)
+            assert summary["slo_gate"]["pass"] is True, summary["slo_gate"]
             assert summary["failovers_observed"] >= 1, summary
             assert set(summary["replicas_observed"]) <= {
                 f"127.0.0.1:{p}" for p in ports[:3]
@@ -1336,10 +1682,63 @@ class TestFleetChaosAcceptanceDrill:
                     "--expect-counter", "fleet_failovers_total=1",
                     "--expect-counter", "fleet_shed_total==0",
                     "--expect-gauge-range", "fleet_routed_capacity=(0..1]",
+                    # the SLO plane's verdict on the same run (ISSUE 14):
+                    # zero failed requests = nothing burned, budget intact
+                    "--expect-gauge-range", "slo_burn_rate_fast=[0..1)",
+                    "--expect-gauge-range", "slo_burn_rate_slow=[0..1)",
+                    "--expect-gauge-range",
+                    "slo_error_budget_remaining=(0.5..1]",
+                    "--expect-counter", "fleet_requests_total{status=ok}=32",
                 ],
                 capture_output=True, text=True, timeout=60,
             )
             assert res.returncode == 0, res.stderr
+            # ONE merged timeline across the whole fleet (ISSUE 14): the
+            # router's log plus every replica's — the SIGKILLed victim's
+            # torn stream included — validated by --expect-fleet-trace:
+            # every proxy_hop trace id resolves to a replica-side span
+            # tree, and the failed-over request's chain is visible
+            merged = tmp_path / "fleet.trace.json"
+            res = subprocess.run(
+                [
+                    sys.executable, "-m",
+                    "nm03_capstone_project_tpu.obs.trace",
+                    str(fleet_events), *[str(p) for p in replica_logs],
+                    "-o", str(merged),
+                ],
+                capture_output=True, text=True, timeout=120, cwd=REPO,
+            )
+            assert res.returncode == 0, res.stderr + res.stdout
+            res = subprocess.run(
+                [sys.executable, CHECKER,
+                 "--expect-fleet-trace", str(merged)],
+                capture_output=True, text=True, timeout=60,
+            )
+            assert res.returncode == 0, res.stderr
+            events = json.loads(merged.read_text())["traceEvents"]
+            b_events = [e for e in events if e.get("ph") == "B"]
+            # the acceptance chain is in the artifact: a proxy_hop that
+            # DIED on the victim, a failover span, and the same trace id
+            # answered by a surviving replica's span tree
+            died = [
+                e for e in b_events
+                if e["name"] == "proxy_hop"
+                and e["args"].get("replica") == victim_label
+                and e["args"].get("outcome") == "io_error"
+            ]
+            assert died, "no io_error proxy_hop on the killed replica"
+            assert any(e["name"] == "failover" for e in b_events)
+            failed_over_ids = set(died[0]["args"]["trace_ids"])
+            router_pid = died[0]["pid"]
+            assert any(
+                e["pid"] != router_pid
+                and failed_over_ids & set(e["args"].get("trace_ids") or [])
+                for e in b_events
+            ), "the failed-over trace id never resolved on a replica track"
+            # >= 3 processes merged: the router + the two survivors (the
+            # victim's stream may carry no completed span trees)
+            pids = {e["pid"] for e in b_events}
+            assert len(pids) >= 3, pids
         finally:
             if poller is not None:
                 poller.stop()

@@ -1,0 +1,567 @@
+"""The SLO plane + remote debug pulls (ISSUE 14).
+
+Four layers, cheapest first:
+
+* jax-free units: :class:`SLOObjective` validation and the
+  :class:`SLOMonitor` burn-rate/budget math against a hand-built
+  registry with an injectable clock (window forgetting, latency bucket
+  rounding, probe exclusion, gauges-exist-at-construction);
+* the ``nm03-loadgen --expect-slo`` client-side gate (spec parsing +
+  verdict math, red and green);
+* ``utils.profiling.capture_profile`` (a real ``jax.profiler`` capture
+  on CPU: zip round-trip, duration clamps, one-at-a-time);
+* an in-process warmed ``nm03-serve`` replica with a declared SLO: the
+  ``/readyz`` ``slo``/``clock`` blocks, the ``slo_*`` gauges on
+  ``/metrics.json``, probe-request exclusion end to end
+  (``X-Nm03-Probe`` → ``status="probe"``, histograms untouched, trace
+  kept), the ``/debug/flightrec`` + ``/debug/profile`` pulls, and the
+  ``nm03-fleet flightrec``/``profile`` fan-out CLI against it.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import os
+import urllib.error
+import urllib.request
+import zipfile
+
+import pytest
+
+from nm03_capstone_project_tpu.obs.metrics import (
+    SLO_BURN_RATE_FAST,
+    SLO_BURN_RATE_SLOW,
+    SLO_ERROR_BUDGET_REMAINING,
+    SLO_OBJECTIVE_INFO,
+    MetricsRegistry,
+)
+from nm03_capstone_project_tpu.obs.slo import (
+    SLOMonitor,
+    SLOObjective,
+    objective_from_args,
+)
+
+CANVAS = 128
+
+
+# -- the objective -----------------------------------------------------------
+
+
+class TestSLOObjective:
+    def test_budgets(self):
+        obj = SLOObjective(99.5, latency_target_s=0.5)
+        assert obj.availability_budget == pytest.approx(0.005)
+        assert obj.latency_budget == pytest.approx(0.01)
+        d = obj.describe()
+        assert d["availability_pct"] == 99.5
+        assert d["latency_target_ms"] == 500.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SLOObjective(0.0)
+        with pytest.raises(ValueError):
+            SLOObjective(100.0)
+        with pytest.raises(ValueError):
+            SLOObjective(99.0, latency_target_s=-1)
+        with pytest.raises(ValueError):
+            SLOObjective(99.0, latency_pct=100.0)
+        with pytest.raises(ValueError):
+            SLOObjective(99.0, window_fast_s=600, window_slow_s=60)
+
+    def test_objective_from_args(self):
+        from types import SimpleNamespace
+
+        assert objective_from_args(SimpleNamespace()) is None
+        obj = objective_from_args(
+            SimpleNamespace(slo_availability=None, slo_p99_ms=250.0)
+        )
+        assert obj.availability_pct == 99.0  # the default rides along
+        assert obj.latency_target_s == pytest.approx(0.25)
+        obj = objective_from_args(
+            SimpleNamespace(
+                slo_availability=99.9, slo_p99_ms=None,
+                slo_fast_window_s=30.0, slo_slow_window_s=600.0,
+            )
+        )
+        assert obj.latency_target_s is None
+        assert obj.window_fast_s == 30.0 and obj.window_slow_s == 600.0
+
+
+# -- the monitor -------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _mk_monitor(
+    reg, clock, availability=99.0, latency_s=0.5, fast=30.0, slow=600.0
+):
+    return SLOMonitor(
+        reg,
+        SLOObjective(
+            availability, latency_target_s=latency_s,
+            window_fast_s=fast, window_slow_s=slow,
+        ),
+        "serving_requests_total",
+        "serving_request_seconds",
+        clock=clock,
+    )
+
+
+def _traffic(reg, ok=0, error=0, probe=0, latencies=()):
+    if ok:
+        reg.counter("serving_requests_total", status="ok").inc(ok)
+    if error:
+        reg.counter("serving_requests_total", status="error").inc(error)
+    if probe:
+        reg.counter("serving_requests_total", status="probe").inc(probe)
+    h = reg.histogram("serving_request_seconds", buckets=(0.1, 0.5, 1.0))
+    for v in latencies:
+        h.observe(v)
+
+
+class TestSLOMonitor:
+    def test_gauges_exist_at_construction(self):
+        reg = MetricsRegistry()
+        _mk_monitor(reg, _Clock())
+        assert reg.get(SLO_ERROR_BUDGET_REMAINING).value == 1.0
+        assert reg.get(SLO_BURN_RATE_FAST).value == 0.0
+        assert reg.get(SLO_BURN_RATE_SLOW).value == 0.0
+        info = [m for m in reg.series() if m.name == SLO_OBJECTIVE_INFO]
+        assert len(info) == 1 and info[0].value == 1.0
+        assert info[0].labels["availability_pct"] == "99.0"
+        assert info[0].labels["latency_target_ms"] == "500.0"
+
+    def test_no_traffic_burn_zero_budget_full(self):
+        reg = MetricsRegistry()
+        clock = _Clock()
+        mon = _mk_monitor(reg, clock)
+        clock.t = 10.0
+        block = mon.publish()
+        assert block["burn_rate_fast"] == 0.0
+        assert block["burn_rate_slow"] == 0.0
+        assert block["error_budget_remaining"] == 1.0
+
+    def test_availability_and_latency_burn_math(self):
+        reg = MetricsRegistry()
+        clock = _Clock()
+        mon = _mk_monitor(reg, clock)
+        # 1% errors against a 1% budget = availability burn exactly 1.0;
+        # 2% slow (> 0.5s) against a 1% latency budget = burn 2.0 — the
+        # combined burn is the max of the two SLIs
+        _traffic(reg, ok=99, error=1, latencies=[0.05] * 98 + [0.9, 0.9])
+        clock.t = 10.0
+        block = mon.publish()
+        assert block["burn_rate_fast"] == pytest.approx(2.0)
+        assert block["burn_rate_slow"] == pytest.approx(2.0)
+        # budget: latency consumed 2/(0.01*100) = 2 -> remaining -1
+        assert block["error_budget_remaining"] == pytest.approx(-1.0)
+
+    def test_latency_target_rounds_up_to_bucket_bound(self):
+        reg = MetricsRegistry()
+        clock = _Clock()
+        # target 0.3 sits between the 0.1 and 0.5 bounds: slow = above
+        # 0.5 (rounded UP), so a 0.4s request is not counted slow
+        mon = _mk_monitor(reg, clock, latency_s=0.3)
+        _traffic(reg, ok=100, latencies=[0.4] * 99 + [0.9])
+        clock.t = 5.0
+        block = mon.publish()
+        assert block["burn_rate_fast"] == pytest.approx(1.0)  # 1% > 0.5s
+
+    def test_fast_window_forgets_old_badness_slow_remembers(self):
+        reg = MetricsRegistry()
+        clock = _Clock()
+        mon = _mk_monitor(reg, clock, fast=30.0, slow=600.0)
+        _traffic(reg, ok=90, error=10, latencies=[0.05] * 100)
+        clock.t = 10.0
+        assert mon.publish()["burn_rate_fast"] == pytest.approx(10.0)
+        # a quiet hour later, fresh clean traffic: the fast window only
+        # sees the clean delta, the slow window still holds the incident
+        clock.t = 200.0
+        mon.publish()  # a baseline sample inside the coming fast window
+        _traffic(reg, ok=100, latencies=[0.05] * 100)
+        clock.t = 220.0
+        block = mon.publish()
+        assert block["burn_rate_fast"] == pytest.approx(0.0)
+        assert block["burn_rate_slow"] == pytest.approx(5.0)  # 10/200 req
+        # the budget is lifetime: 10 errors / (1% of 200) = 5 consumed
+        assert block["error_budget_remaining"] == pytest.approx(-4.0)
+
+    def test_probe_status_is_excluded(self):
+        reg = MetricsRegistry()
+        clock = _Clock()
+        mon = _mk_monitor(reg, clock)
+        # 100 probes and nothing else: no traffic as far as the SLO is
+        # concerned — probes are in neither the good nor the bad set
+        _traffic(reg, probe=100)
+        clock.t = 10.0
+        block = mon.publish()
+        assert block["burn_rate_fast"] == 0.0
+        assert block["error_budget_remaining"] == 1.0
+
+    def test_availability_only_objective_ignores_latency(self):
+        reg = MetricsRegistry()
+        clock = _Clock()
+        mon = _mk_monitor(reg, clock, latency_s=None)
+        _traffic(reg, ok=100, latencies=[0.9] * 100)  # all "slow" — no SLI
+        clock.t = 10.0
+        block = mon.publish()
+        assert block["burn_rate_fast"] == 0.0
+        assert block["error_budget_remaining"] == 1.0
+
+
+# -- the loadgen gate --------------------------------------------------------
+
+
+class TestLoadgenSLOGate:
+    def test_parse_spec(self):
+        from nm03_capstone_project_tpu.serving.loadgen import parse_slo_spec
+
+        assert parse_slo_spec("availability=99.5,p99_ms=500") == {
+            "availability": 99.5, "p99_ms": 500.0,
+        }
+        assert parse_slo_spec("p99_ms=250") == {"p99_ms": 250.0}
+        for bad in ("", "latency=1", "availability=abc", "availability=0",
+                    "availability=101"):
+            with pytest.raises(ValueError):
+                parse_slo_spec(bad)
+
+    def test_evaluate_green_and_red(self):
+        from nm03_capstone_project_tpu.serving.loadgen import evaluate_slo
+
+        summary = {
+            "requests_total": 100, "requests_ok": 99,
+            "latency_ms": {"p99": 450.0},
+        }
+        gate = evaluate_slo(
+            summary, {"availability": 99.0, "p99_ms": 500.0}
+        )
+        assert gate["pass"] is True
+        assert gate["checks"]["availability"]["observed_pct"] == 99.0
+        # red: availability floor missed
+        gate = evaluate_slo(summary, {"availability": 99.5})
+        assert gate["pass"] is False
+        # red: p99 target exceeded
+        gate = evaluate_slo(summary, {"p99_ms": 400.0})
+        assert gate["pass"] is False
+        # red: no latency measured at all cannot pass a latency gate
+        gate = evaluate_slo(
+            {"requests_total": 0, "requests_ok": 0, "latency_ms": {}},
+            {"p99_ms": 400.0},
+        )
+        assert gate["pass"] is False
+
+    def test_cli_rejects_malformed_spec(self):
+        from nm03_capstone_project_tpu.serving import loadgen
+
+        with pytest.raises(SystemExit):
+            loadgen.main(["--expect-slo", "nonsense", "--requests", "1"])
+
+    def test_serve_clis_reject_bad_slo_flags_as_usage_errors(self, capsys):
+        """A bad --slo-* value is an argparse usage error (exit 2), never
+        a mid-startup traceback or a silently-swallowed default (review
+        fix)."""
+        from nm03_capstone_project_tpu.fleet import cli as fleet_cli
+        from nm03_capstone_project_tpu.serving import server
+
+        for argv in (["--slo-availability", "100"],
+                     ["--slo-availability", "99", "--slo-fast-window-s", "0"]):
+            with pytest.raises(SystemExit) as exc:
+                server.main(argv)
+            assert exc.value.code == 2
+        with pytest.raises(SystemExit) as exc:
+            fleet_cli.main([
+                "serve", "--replicas", "h:1", "--slo-availability", "0",
+            ])
+        assert exc.value.code == 2
+        capsys.readouterr()  # swallow the usage chatter
+
+    def test_last_block_reuses_the_published_verdict(self):
+        reg = MetricsRegistry()
+        clock = _Clock()
+        mon = _mk_monitor(reg, clock)
+        clock.t = 5.0
+        block = mon.publish()
+        n_samples = len(mon._samples)
+        assert mon.last_block() is block  # no re-sampling
+        assert len(mon._samples) == n_samples
+        # a never-published monitor publishes once on demand
+        mon2 = _mk_monitor(MetricsRegistry(), clock)
+        assert mon2.last_block()["error_budget_remaining"] == 1.0
+
+
+# -- the profiler capture ----------------------------------------------------
+
+
+class TestCaptureProfile:
+    def test_capture_round_trip(self):
+        from nm03_capstone_project_tpu.utils.profiling import capture_profile
+
+        out = capture_profile(60)
+        assert out["duration_ms"] == 60
+        assert isinstance(out["files"], list) and out["files"]
+        data = base64.b64decode(out["zip_b64"])
+        assert out["zip_bytes"] == len(data)
+        with zipfile.ZipFile(io.BytesIO(data)) as zf:
+            assert sorted(zf.namelist()) == sorted(
+                f["name"] for f in out["files"]
+            )
+
+    def test_duration_clamps(self):
+        from nm03_capstone_project_tpu.utils.profiling import capture_profile
+
+        for bad in (0, 5, 10_001):
+            with pytest.raises(ValueError):
+                capture_profile(bad)
+
+    def test_one_capture_at_a_time(self):
+        from nm03_capstone_project_tpu.utils import profiling
+
+        assert profiling._CAPTURE_LOCK.acquire(blocking=False)
+        try:
+            with pytest.raises(profiling.ProfileBusy):
+                profiling.capture_profile(60)
+        finally:
+            profiling._CAPTURE_LOCK.release()
+
+    def test_oversized_zip_kept_server_side(self):
+        from nm03_capstone_project_tpu.utils.profiling import capture_profile
+
+        out = capture_profile(60, zip_cap_bytes=1)
+        assert out.get("zip_dropped") is True
+        assert "zip_b64" not in out
+        assert out["files"]  # the listing survives the wire cap
+        # the archive itself is NOT destroyed: it lands server-side and
+        # the response names it
+        try:
+            assert os.path.getsize(out["zip_path"]) == out["zip_bytes"]
+            with zipfile.ZipFile(out["zip_path"]) as zf:
+                assert zf.namelist()
+        finally:
+            os.unlink(out["zip_path"])
+
+
+# -- the in-process replica: SLO + probe + debug endpoints -------------------
+
+
+def _get(url, timeout=30.0):
+    req = urllib.request.Request(url, method="GET")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _post(url, body, headers, timeout=60.0):
+    req = urllib.request.Request(url, data=body, headers=headers, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def _counter_value(app, name, **labels):
+    m = app.obs.registry.get(name, **labels)
+    return m.value if m is not None else None
+
+
+@pytest.fixture(scope="module")
+def slo_served():
+    """One warmed loopback replica with a declared SLO (1 compile)."""
+    from nm03_capstone_project_tpu.config import PipelineConfig
+    from nm03_capstone_project_tpu.serving.server import (
+        ServingApp,
+        serve_in_thread,
+    )
+
+    app = ServingApp(
+        cfg=PipelineConfig(canvas=CANVAS),
+        queue_capacity=16,
+        buckets=(1,),
+        max_wait_s=0.01,
+        request_timeout_s=60.0,
+        lanes=1,
+        slo=SLOObjective(99.0, latency_target_s=30.0, window_fast_s=30.0,
+                         window_slow_s=600.0),
+    )
+    httpd, _, port = serve_in_thread(app)
+    yield app, f"http://127.0.0.1:{port}"
+    app.begin_drain(reason="test_teardown")
+    httpd.shutdown()
+    httpd.server_close()
+    app.close()
+
+
+def _phantom_body(h=CANVAS, w=CANVAS, seed=0):
+    from nm03_capstone_project_tpu.data.synthetic import phantom_slice
+
+    return phantom_slice(h, w, seed=seed).astype("<f4").tobytes()
+
+
+def _raw_headers(h, w, **extra):
+    return {
+        "Content-Type": "application/octet-stream",
+        "X-Nm03-Height": str(h), "X-Nm03-Width": str(w),
+        **extra,
+    }
+
+
+class TestServingSLOAndDebug:
+    def test_readyz_carries_slo_and_clock(self, slo_served):
+        app, base = slo_served
+        status, body = _get(base + "/readyz")
+        st = json.loads(body)
+        assert status == 200
+        slo = st["slo"]
+        assert slo["objective"]["availability_pct"] == 99.0
+        assert 0.0 <= slo["error_budget_remaining"] <= 1.0
+        clock = st["clock"]
+        # the handshake pair is this process's clocks: the offset it
+        # implies must match ours to well under a second (same host)
+        import time as _time
+
+        offset = clock["ts_unix"] - clock["mono_s"]
+        assert offset == pytest.approx(
+            _time.time() - _time.monotonic(), abs=5.0
+        )
+
+    def test_probe_requests_excluded_but_traced(self, slo_served):
+        app, base = slo_served
+        url = base + "/v1/segment?output=mask"
+        body = _phantom_body()
+        # settle the baseline with one REAL request first
+        status, payload, _ = _post(url, body, _raw_headers(CANVAS, CANVAS))
+        assert status == 200
+        ok_before = _counter_value(app, "serving_requests_total", status="ok")
+        hist = app.obs.registry.get("serving_request_seconds")
+        hist_before = hist.count
+        qwait = app.obs.registry.get("serving_queue_wait_seconds")
+        qwait_before = qwait.count
+        status, payload, headers = _post(
+            url, body,
+            _raw_headers(CANVAS, CANVAS, **{
+                "X-Nm03-Probe": "1",
+                "X-Nm03-Request-Id": "fleet-probe-test-1",
+            }),
+        )
+        assert status == 200 and payload["mask_pixels"] >= 0
+        assert headers["X-Nm03-Request-Id"] == "fleet-probe-test-1"
+        # counted as a probe, not ok; latency histograms untouched
+        assert _counter_value(
+            app, "serving_requests_total", status="probe"
+        ) == 1
+        assert _counter_value(
+            app, "serving_requests_total", status="ok"
+        ) == ok_before
+        assert hist.count == hist_before
+        assert qwait.count == qwait_before
+        # still fully traced: the serve_trace event exists, probe-flagged
+        probes = [
+            r for r in app.obs.events.tail
+            if r["event"] == "serve_trace"
+            and r.get("trace_id") == "fleet-probe-test-1"
+        ]
+        assert len(probes) == 1 and probes[0]["probe"] is True
+        assert probes[0]["spans"]
+
+    def test_slo_gauges_on_metrics_json(self, slo_served):
+        app, base = slo_served
+        status, body = _get(base + "/metrics.json")
+        assert status == 200
+        names = {
+            m["name"]: m for m in json.loads(body)["metrics"]
+            if m["name"].startswith("slo_")
+        }
+        assert SLO_BURN_RATE_FAST in names
+        assert SLO_BURN_RATE_SLOW in names
+        assert SLO_ERROR_BUDGET_REMAINING in names
+        assert names[SLO_ERROR_BUDGET_REMAINING]["value"] == 1.0
+        assert names[SLO_BURN_RATE_FAST]["value"] == 0.0
+
+    def test_debug_flightrec_pull(self, slo_served):
+        app, base = slo_served
+        status, body = _get(base + "/debug/flightrec")
+        assert status == 200
+        snap = json.loads(body)
+        assert snap["schema"] == "nm03.flightrec.v1"
+        assert snap["reason"] == "debug_pull"
+        assert snap["threads"]  # the serving threads' rings are in there
+
+    def test_debug_profile_pull(self, slo_served):
+        app, base = slo_served
+        status, body = _get(base + "/debug/profile?ms=60")
+        assert status == 200
+        out = json.loads(body)
+        assert out["duration_ms"] == 60 and out["files"]
+        zipfile.ZipFile(io.BytesIO(base64.b64decode(out["zip_b64"])))
+        # guards: malformed + out-of-clamp durations are 400s
+        assert _get(base + "/debug/profile?ms=abc")[0] == 400
+        assert _get(base + "/debug/profile?ms=1")[0] == 400
+
+    def test_fleet_debug_pull_cli_fans_out(self, slo_served, tmp_path):
+        """`nm03-fleet flightrec|profile` against a real replica plus one
+        dead target: the live pull lands on disk, the dead one is a
+        FAILED row, exit 1 reports the partial pull without discarding
+        it."""
+        from nm03_capstone_project_tpu.fleet import cli as fleet_cli
+
+        app, base = slo_served
+        out_dir = tmp_path / "pulls"
+        rc = fleet_cli.main([
+            "flightrec", "--replicas", base, "--out-dir", str(out_dir),
+        ])
+        assert rc == 0
+        label = base.split("://", 1)[1].replace(":", "_")
+        dump = json.loads((out_dir / f"flightrec_{label}.json").read_text())
+        assert dump["schema"] == "nm03.flightrec.v1"
+        rc = fleet_cli.main([
+            "profile", "--replicas", base, "--ms", "60",
+            "--out-dir", str(out_dir),
+        ])
+        assert rc == 0
+        meta = json.loads((out_dir / f"profile_{label}.json").read_text())
+        assert meta["duration_ms"] == 60
+        assert zipfile.ZipFile(out_dir / f"profile_{label}.zip").namelist()
+        # partial pull: one live + one dead target -> exit 1, live kept
+        (out_dir2 := tmp_path / "partial").mkdir()
+        rc = fleet_cli.main([
+            "flightrec",
+            "--replicas", f"{base},127.0.0.1:1",
+            "--out-dir", str(out_dir2), "--timeout-s", "3",
+        ])
+        assert rc == 1
+        assert (out_dir2 / f"flightrec_{label}.json").exists()
+
+    def test_loadgen_expect_slo_green_and_red(self, slo_served, tmp_path):
+        """The client-side gate against real traffic: a generous
+        objective passes (exit 0, slo_gate in the artifact), an
+        impossible p99 fails (exit 1)."""
+        from nm03_capstone_project_tpu.serving import loadgen
+
+        app, base = slo_served
+        results = tmp_path / "lg.json"
+        rc = loadgen.main([
+            "--url", base, "--requests", "6", "--concurrency", "2",
+            "--warmup", "0", "--height", str(CANVAS), "--width", str(CANVAS),
+            "--expect-slo", "availability=99.0,p99_ms=60000",
+            "--results-json", str(results),
+        ])
+        assert rc == 0
+        gate = json.loads(results.read_text())["slo_gate"]
+        assert gate["pass"] is True
+        assert gate["checks"]["availability"]["observed_pct"] == 100.0
+        rc = loadgen.main([
+            "--url", base, "--requests", "4", "--concurrency", "2",
+            "--warmup", "0", "--height", str(CANVAS), "--width", str(CANVAS),
+            "--expect-slo", "p99_ms=0.001",
+        ])
+        assert rc == 1

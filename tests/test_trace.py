@@ -406,6 +406,223 @@ class TestExpectTraceGate:
         assert "no serve_trace records" in res.stderr
 
 
+# -- the multi-log fleet merge (ISSUE 14) ------------------------------------
+
+
+WALL0 = 1_700_000_000.0  # a fixed wall epoch shared by every fake process
+
+
+def _jsonl(path, records):
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+def _span(name, wall_t0, dur, mono_epoch, **fields):
+    """One span whose process booted at wall ``WALL0 - mono_epoch``... i.e.
+    whose monotonic clock reads ``wall - (WALL0 - mono_epoch)``."""
+    t0 = wall_t0 - WALL0 + mono_epoch
+    return trace.make_span(name, t0, t0 + dur, fields.pop("trace_ids", ["t"]),
+                           **fields)
+
+
+def _envelope(event, mono_epoch, run_id="run", **fields):
+    # ts_unix - mono_s must recover the process's wall offset: pick an
+    # arbitrary emit moment consistent with the epoch mapping
+    return {
+        "event": event, "run_id": run_id,
+        "ts_unix": WALL0 + 9.0, "mono_s": 9.0 + mono_epoch,
+        **fields,
+    }
+
+
+class TestMultiLogMerge:
+    """Router + N replica logs -> ONE clock-aligned multi-process export."""
+
+    def _router_stream(self, path, replica_label, trace_id="t1",
+                       mono_epoch=5000.0):
+        spans = [
+            _span("route_pick", WALL0 + 1.0, 0.01, mono_epoch,
+                  trace_ids=[trace_id], replica=replica_label, attempt=1),
+            _span("proxy_hop", WALL0 + 1.01, 0.4, mono_epoch,
+                  trace_ids=[trace_id], replica=replica_label, outcome="ok",
+                  attempt=1),
+        ]
+        _jsonl(path, [
+            _envelope("run_started", mono_epoch, run_id="router"),
+            _envelope("fleet_trace", mono_epoch, run_id="router",
+                      trace_id=trace_id, request_id="fl-000001",
+                      replica=replica_label, replica_hops=0, status=200,
+                      spans=spans),
+        ])
+
+    def _replica_stream(self, path, trace_id="t1", mono_epoch=100.0,
+                        run_id="replica-run"):
+        spans = [
+            _span("queue_wait", WALL0 + 1.02, 0.05, mono_epoch,
+                  trace_ids=[trace_id]),
+            _span("device_dispatch", WALL0 + 1.1, 0.2, mono_epoch,
+                  trace_ids=[trace_id], lane=0),
+        ]
+        _jsonl(path, [
+            _envelope("run_started", mono_epoch, run_id=run_id),
+            _envelope("serve_trace", mono_epoch, run_id=run_id,
+                      trace_id=trace_id, request_id="r1", spans=spans),
+        ])
+
+    def test_single_stream_keeps_the_classic_export(self, tmp_path):
+        events = tmp_path / "solo.jsonl"
+        self._replica_stream(events)
+        out = tmp_path / "solo.json"
+        assert trace.export_chrome_trace(str(events), str(out)) == 1
+        data = json.loads(out.read_text())
+        assert data["metadata"] == {"source": str(events), "requests": 1}
+        assert {e["pid"] for e in data["traceEvents"]} == {1}
+        proc = [e for e in data["traceEvents"]
+                if e.get("name") == "process_name"]
+        assert proc[0]["args"]["name"] == "nm03-serve"
+
+    def test_merge_aligns_clocks_and_names_processes(self, tmp_path):
+        """Two processes whose monotonic epochs differ by ~5000s but whose
+        spans happened at the SAME wall moment land adjacent on one
+        timeline, each on its own pid — the replica named by the
+        trace-id join against the router's fleet_trace records."""
+        router, replica = tmp_path / "router.jsonl", tmp_path / "r1.jsonl"
+        self._router_stream(router, "127.0.0.1:8081")
+        self._replica_stream(replica)
+        out = tmp_path / "merged.json"
+        n = trace.export_chrome_trace([str(router), str(replica)], str(out))
+        assert n == 2
+        data = json.loads(out.read_text())
+        assert data["metadata"]["processes"] == 2
+        names = {
+            e["pid"]: e["args"]["name"] for e in data["traceEvents"]
+            if e.get("name") == "process_name"
+        }
+        assert set(names.values()) == {"nm03-fleet", "replica 127.0.0.1:8081"}
+        by_name = {}
+        for e in data["traceEvents"]:
+            if e.get("ph") == "B":
+                by_name[e["name"]] = e
+        # wall alignment: route_pick began at WALL0+1.0, queue_wait at
+        # WALL0+1.02 — 20ms apart on the merged timeline, despite the
+        # ~4900s monotonic skew between the two processes
+        dt_us = by_name["queue_wait"]["ts"] - by_name["route_pick"]["ts"]
+        assert dt_us == pytest.approx(20_000, abs=200)
+        # distinct processes, same trace id
+        assert by_name["proxy_hop"]["pid"] != by_name["device_dispatch"]["pid"]
+        assert by_name["proxy_hop"]["args"]["trace_ids"] == ["t1"]
+        assert by_name["proxy_hop"]["args"]["replica"] == "127.0.0.1:8081"
+        # the merged stream still satisfies the base trace contract AND
+        # the fleet one (proxy_hop resolves across pids)
+        res = run_checker("--expect-fleet-trace", out)
+        assert res.returncode == 0, res.stderr
+
+    def test_unjoinable_replica_falls_back_to_run_id(self, tmp_path):
+        router, replica = tmp_path / "router.jsonl", tmp_path / "r1.jsonl"
+        self._router_stream(router, "127.0.0.1:8081", trace_id="t1")
+        # the replica's traces never went through the router
+        self._replica_stream(replica, trace_id="direct-9", run_id="abc123")
+        out = tmp_path / "merged.json"
+        trace.export_chrome_trace([str(router), str(replica)], str(out))
+        data = json.loads(out.read_text())
+        names = {
+            e["args"]["name"] for e in data["traceEvents"]
+            if e.get("name") == "process_name"
+        }
+        assert "replica abc123" in names
+
+    def test_never_completed_requests_exempt_from_resolution(self, tmp_path):
+        """A fleet-wide shed leaves proxy_hop spans whose trace id no
+        replica ever completed — the gate must not fail a correct
+        overload artifact (review fix): only ids with an outcome=ok hop
+        must resolve."""
+        router, replica = tmp_path / "router.jsonl", tmp_path / "r1.jsonl"
+        mono = 5000.0
+        shed_spans = [
+            _span("proxy_hop", WALL0 + 2.0, 0.01, mono,
+                  trace_ids=["t-shed"], replica="127.0.0.1:8081",
+                  outcome="shed", attempt=1),
+            _span("proxy_hop", WALL0 + 2.02, 0.01, mono,
+                  trace_ids=["t-shed"], replica="127.0.0.1:8082",
+                  outcome="shed", attempt=2),
+        ]
+        ok_spans = [
+            _span("proxy_hop", WALL0 + 1.0, 0.1, mono,
+                  trace_ids=["t1"], replica="127.0.0.1:8081",
+                  outcome="ok", attempt=1),
+        ]
+        _jsonl(router, [
+            _envelope("run_started", mono, run_id="router"),
+            _envelope("fleet_trace", mono, run_id="router", trace_id="t1",
+                      request_id="fl-000001", replica="127.0.0.1:8081",
+                      replica_hops=0, status=200, spans=ok_spans),
+            _envelope("fleet_trace", mono, run_id="router",
+                      trace_id="t-shed", request_id="fl-000002",
+                      replica=None, replica_hops=2, status=503,
+                      spans=shed_spans),
+        ])
+        self._replica_stream(replica, trace_id="t1")
+        out = tmp_path / "merged.json"
+        trace.export_chrome_trace([str(router), str(replica)], str(out))
+        res = run_checker("--expect-fleet-trace", out)
+        assert res.returncode == 0, res.stderr
+
+    def test_expect_fleet_trace_red_without_replica_stream(self, tmp_path):
+        router = tmp_path / "router.jsonl"
+        self._router_stream(router, "127.0.0.1:8081")
+        out = tmp_path / "router_only.json"
+        trace.export_chrome_trace([str(router)], str(out))
+        res = run_checker("--expect-fleet-trace", out)
+        assert res.returncode == 1
+        assert "resolves to no replica-side span tree" in res.stderr
+
+    def test_expect_fleet_trace_red_on_plain_serve_export(self, tmp_path):
+        events = tmp_path / "solo.jsonl"
+        self._replica_stream(events)
+        out = tmp_path / "solo.json"
+        trace.export_chrome_trace(str(events), str(out))
+        res = run_checker("--expect-fleet-trace", out)
+        assert res.returncode == 1
+        assert "no proxy_hop span" in res.stderr
+
+    def test_torn_tail_stream_still_merges(self, tmp_path):
+        """A SIGKILLed replica's log (torn final line, no run_finished) is
+        exactly the post-mortem input — the merge skips the tear."""
+        router, replica = tmp_path / "router.jsonl", tmp_path / "r1.jsonl"
+        self._router_stream(router, "127.0.0.1:8081")
+        self._replica_stream(replica)
+        with open(replica, "a") as f:
+            f.write('{"event": "serve_trace", "trace_id": "t2", "spa')
+        out = tmp_path / "merged.json"
+        assert trace.export_chrome_trace(
+            [str(router), str(replica)], str(out)
+        ) == 2
+        res = run_checker("--expect-fleet-trace", out)
+        assert res.returncode == 0, res.stderr
+
+    def test_cli_accepts_multiple_streams(self, tmp_path):
+        router, replica = tmp_path / "router.jsonl", tmp_path / "r1.jsonl"
+        self._router_stream(router, "127.0.0.1:8081")
+        self._replica_stream(replica)
+        out = tmp_path / "cli_merged.json"
+        res = subprocess.run(
+            [sys.executable, "-m", "nm03_capstone_project_tpu.obs.trace",
+             str(router), str(replica), "-o", str(out)],
+            capture_output=True, text=True, timeout=60, cwd=REPO,
+        )
+        assert res.returncode == 0, res.stderr
+        assert "merged from 2 streams" in res.stdout
+        assert json.loads(out.read_text())["metadata"]["processes"] == 2
+
+    def test_fleet_span_vocabulary_pinned(self):
+        # the docs-table lockstep contract, fleet section (ISSUE 14)
+        assert trace.FLEET_SPAN_NAMES == (
+            "route_pick", "proxy_hop", "failover", "canary_probe",
+        )
+        assert trace.FLEET_TRACE_EVENT == "fleet_trace"
+
+
 # -- batcher/executor span plumbing (fake executor, no jax) ------------------
 
 
