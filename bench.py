@@ -583,6 +583,19 @@ def zshard_scaling() -> None:
         _log(f"serve lanes {lanes}: {tput:.1f} slices/s (checksums {checks})")
     all_checks = set().union(*lane_checks.values()) if lane_checks else set()
     out["serve_lane_checksum_ok"] = len(all_checks) == 1
+
+    # The whole-volume SERVING number (ISSUE 15) — the budget-reserved
+    # zshard slot's missing record: one study through the gang lane
+    # (POST /v1/segment-volume's in-process path — gang acquire, mesh
+    # staging, AOT z-sharded dispatch, gather) vs the same study driven
+    # directly the way nm03-volume --z-shard dispatches it. Checksum-
+    # gated like the Pallas/cold-start legs: the throughput claims are
+    # null unless the served mask is BIT-IDENTICAL to the direct one.
+    try:
+        out["volume_serve"] = _volume_serve_record(vol, dims)
+    except Exception as e:  # noqa: BLE001 — the section's other legs stand
+        out["volume_serve_error"] = f"{e!r:.500}"
+        _log(f"volume_serve leg failed: {e!r:.500}")
     # the fleet's compile-cost columns (ISSUE 7): what warming every
     # per-lane serve_mask executable cost, with the XLA cost/memory
     # analysis where exposed — the denominators the serve_lane_tput
@@ -595,6 +608,98 @@ def zshard_scaling() -> None:
         "specs": [e for e in hub.cost_report() if e["name"] == "serve_mask"],
     }
     print(_SENTINEL + json.dumps(out), flush=True)
+
+
+def _volume_serve_record(vol, dims) -> dict:
+    """Served-volume vs direct z-shard throughput (ISSUE 15), one record.
+
+    An in-process ServingApp (4 lanes, one slice bucket, one volume depth
+    bucket) serves the synthetic study through the FULL gang path; the
+    direct leg dispatches the same study through
+    ``process_volume_zsharded`` on an identical mesh. ``slices_per_s``
+    fields are null unless every served mask equalled the direct mask
+    byte-for-byte. CPU-container honesty (PERF.md): 4 virtual devices
+    share the host cores, so the record proves serve-path overhead and
+    correctness, not multi-chip speedup — the TPU window re-measures.
+    """
+    import base64
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from nm03_capstone_project_tpu.config import PipelineConfig
+    from nm03_capstone_project_tpu.parallel.mesh import make_mesh
+    from nm03_capstone_project_tpu.parallel.zshard import (
+        process_volume_zsharded,
+    )
+    from nm03_capstone_project_tpu.serving.server import ServingApp
+
+    lanes = min(4, len(jax.devices()))
+    depth = int(vol.shape[0])
+    canvas = int(vol.shape[1])
+    cfg = PipelineConfig(canvas=canvas)
+    app = ServingApp(
+        cfg=cfg, buckets=(1,), lanes=lanes,
+        volume_serving=True, volume_depth_buckets=(depth,),
+    )
+    t0 = time.perf_counter()
+    app.start()
+    warm_s = time.perf_counter() - t0
+    rec: dict = {
+        "depth": depth, "canvas": canvas, "z_shards": lanes,
+        "warmup_s": round(warm_s, 2),
+        "note": (
+            "virtual CPU mesh on a shared-core host: serve-path overhead "
+            "+ bit-identity evidence, not a scaling claim"
+        ),
+    }
+    try:
+        vol_np = np.asarray(vol, np.float32)
+        dims_np = np.asarray(dims, np.int32)
+        reps = 3
+        payloads = []
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            payloads.append(app.segment_volume(vol_np))
+        served_s = (time.perf_counter() - t0) / reps
+        rec["gang_wait_s_max"] = max(p["gang_wait_s"] for p in payloads)
+        served_masks = [
+            np.frombuffer(base64.b64decode(p["mask_b64"]), np.uint8).reshape(
+                depth, canvas, canvas
+            )
+            for p in payloads
+        ]
+        # the direct leg: the driver's own dispatch on an identical mesh
+        mesh = make_mesh(lanes, axis_names=("z",), devices=jax.devices()[:lanes])
+        dfn = lambda: process_volume_zsharded(  # noqa: E731
+            jnp.asarray(vol_np), jnp.asarray(dims_np), cfg, mesh
+        )["mask"]
+        direct = np.asarray(dfn())  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            last = dfn()
+        np.asarray(last)
+        direct_s = (time.perf_counter() - t0) / reps
+        checksum_ok = all(np.array_equal(m, direct) for m in served_masks)
+        rec["checksum_ok"] = bool(checksum_ok)
+        if checksum_ok:
+            rec["served_slices_per_s"] = round(depth / served_s, 2)
+            rec["direct_slices_per_s"] = round(depth / direct_s, 2)
+            rec["serve_overhead_ratio"] = round(served_s / direct_s, 3)
+        else:
+            rec["served_slices_per_s"] = None
+            rec["direct_slices_per_s"] = None
+            rec["serve_overhead_ratio"] = None
+        _log(
+            f"volume_serve: served {rec['served_slices_per_s']} vs direct "
+            f"{rec['direct_slices_per_s']} slices/s (checksum {checksum_ok})"
+        )
+    finally:
+        app.begin_drain(reason="bench_done")
+        app.close()
+    return rec
 
 
 def _time_stage(fn, args, reps):

@@ -37,6 +37,7 @@ __all__ = [
     "batch_pipeline",
     "lane_devices",
     "serve_mask",
+    "serve_volume",
     "slice_pipeline",
     "volume_pipeline",
 ]
@@ -249,5 +250,53 @@ def serve_mask(cfg, bucket: Optional[int] = None, device=None):
         device=device,
         lane=getattr(device, "id", None) if device is not None else None,
         variant="pinned" if device is not None else "",
+    )
+    return get_hub().get(spec, build)
+
+
+# -- serving: the whole-volume gang program ----------------------------------
+
+
+def serve_volume(cfg, depth: int, mesh):
+    """The volume gang's z-sharded program (ISSUE 15), one per depth bucket.
+
+    The SAME shard_map'd halo-exchanged region-growing program
+    ``nm03-volume --z-shard`` dispatches
+    (:func:`~nm03_capstone_project_tpu.parallel.zshard.zshard_volume_callable`),
+    AOT lowered+compiled at ``(depth, canvas, canvas)`` over ``mesh`` so a
+    volume request never pays trace+compile online, and shape-pinned so
+    the persistent cache (PR 9) keeps the mesh executable warm across
+    restarts. ``depth`` must divide the mesh's ``z`` axis evenly (the
+    gang pads the study's stack to the bucket before dispatch). Returns
+    the executable computing ``{'original', 'mask', 'grow_converged'}``.
+    """
+
+    def build(spec: CompileSpec):
+        import jax
+        import jax.numpy as jnp
+
+        from nm03_capstone_project_tpu.parallel.zshard import (
+            zshard_volume_callable,
+        )
+
+        fn = hub_jit(zshard_volume_callable(spec.mesh, spec.cfg))
+        d = spec.shape[0]
+        c = spec.cfg.canvas
+        return aot_compile(
+            fn,
+            jax.ShapeDtypeStruct((d, c, c), jnp.float32),
+            jax.ShapeDtypeStruct((2,), jnp.int32),
+        )
+
+    if depth % mesh.shape["z"] != 0:
+        raise ValueError(
+            f"volume depth bucket {depth} not divisible by z-axis size "
+            f"{mesh.shape['z']}"
+        )
+    spec = CompileSpec(
+        name="serve_volume",
+        cfg=cfg,
+        shape=(int(depth), cfg.canvas, cfg.canvas),
+        mesh=mesh,
     )
     return get_hub().get(spec, build)

@@ -306,6 +306,7 @@ class ReplicaStates:
         canvas: Optional[int] = None,
         min_dim: Optional[int] = None,
         clock_offset_s: Optional[float] = None,
+        volume_cost: Optional[int] = None,
     ) -> None:
         """Record one health poll's routing signals for ``target``.
 
@@ -315,6 +316,10 @@ class ReplicaStates:
         the /readyz clock handshake (ISSUE 14): published in the router
         table so cross-replica skew is triageable from one screen (the
         nm03-trace merge derives the same offset from each log itself).
+        ``volume_cost`` is the replica's published default slice-
+        equivalent cost of one whole-volume request (ISSUE 15): what the
+        WRR debits an unsized ``/v1/segment-volume`` proxy by, so a
+        volume never weighs like one slice.
         """
         sig = {
             "capacity": capacity,
@@ -324,6 +329,7 @@ class ReplicaStates:
             "canvas": canvas,
             "min_dim": min_dim,
             "clock_offset_s": clock_offset_s,
+            "volume_cost": volume_cost,
         }
         with self._lock:
             if target not in self._signals:

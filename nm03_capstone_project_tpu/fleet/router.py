@@ -293,6 +293,13 @@ class FleetApp:
             clock_offset_s = round(
                 float(clock["ts_unix"]) - float(clock["mono_s"]), 6
             )
+        # the replica's published volume cost (ISSUE 15): the default
+        # slice-equivalent weight of one whole-volume request (its
+        # smallest depth bucket) — None on slice-only replicas
+        volumes = st.get("volumes") or {}
+        volume_cost = (
+            volumes.get("default_cost") if volumes.get("enabled") else None
+        )
         self.replicas.update_signals(
             target,
             capacity=capacity,
@@ -302,6 +309,7 @@ class FleetApp:
             canvas=st.get("canvas"),
             min_dim=st.get("min_dim"),
             clock_offset_s=clock_offset_s,
+            volume_cost=volume_cost,
         )
         return True
 
@@ -409,8 +417,17 @@ class FleetApp:
 
     # -- routing -----------------------------------------------------------
 
-    def pick(self, exclude: FrozenSet[str] = frozenset()) -> Optional[str]:
-        """Smooth weighted round-robin over healthy, non-excluded targets."""
+    def pick(
+        self, exclude: FrozenSet[str] = frozenset(), cost: float = 1.0
+    ) -> Optional[str]:
+        """Smooth weighted round-robin over healthy, non-excluded targets.
+
+        ``cost`` is the request's slice-equivalent weight (ISSUE 15): the
+        picked replica is debited ``cost`` rounds' worth instead of one,
+        so a 32-plane volume request "spends" that replica's turn 32
+        times over and the next 31 slice picks land elsewhere — WRR never
+        mistakes a whole study for one slice.
+        """
         healthy = [
             t for t in self.replicas.healthy_targets() if t not in exclude
         ]
@@ -424,7 +441,7 @@ class FleetApp:
             for t, w in weights.items():
                 self._wrr[t] = self._wrr.get(t, 0.0) + w
             best = max(healthy, key=lambda t: self._wrr[t])
-            self._wrr[best] -= total
+            self._wrr[best] -= total * max(float(cost), 1.0)
         return best
 
     def _next_seq(self) -> int:
@@ -433,11 +450,12 @@ class FleetApp:
             return self._seq
 
     def _forward(
-        self, target: str, body: bytes, headers: dict, query: str
+        self, target: str, body: bytes, headers: dict, query: str,
+        path: str = "/v1/segment",
     ) -> Tuple[int, bytes, List[Tuple[str, str]]]:
         """One proxied POST to ``target``; HTTP errors return, transport
         errors raise (the caller's failover trigger)."""
-        url = f"{target}/v1/segment" + (f"?{query}" if query else "")
+        url = f"{target}{path}" + (f"?{query}" if query else "")
         req = urllib.request.Request(
             url, data=body, headers=headers, method="POST"
         )
@@ -459,11 +477,41 @@ class FleetApp:
             replica=target_label(target), cause=cause,
         ).inc()
 
+    def volume_request_cost(self, headers: dict) -> float:
+        """The slice-equivalent WRR cost of one volume request (ISSUE 15).
+
+        The request's own declared depth (``X-Nm03-Depth``, the raw
+        stacked format) when present; otherwise the largest volume cost
+        any replica published on ``/readyz`` (its smallest depth bucket —
+        an undeclared DICOM study is at least that deep once padded);
+        floor 1.0 so a missing signal degrades to slice weighting, never
+        a zero-cost pick.
+        """
+        for k, v in headers.items():
+            if k.lower() == "x-nm03-depth":
+                try:
+                    return max(float(int(v)), 1.0)
+                except (TypeError, ValueError):
+                    break
+        published = [
+            self.replicas.signals(t).get("volume_cost")
+            for t in self.replicas.targets
+        ]
+        costs = [float(c) for c in published if c]
+        return max(costs) if costs else 1.0
+
     def proxy_segment(
         self, body: bytes, headers: dict, query: str = "",
-        trace_id: Optional[str] = None,
+        trace_id: Optional[str] = None, path: str = "/v1/segment",
+        cost: float = 1.0,
     ) -> Tuple[int, bytes, List[Tuple[str, str]]]:
-        """Route one ``POST /v1/segment``; (status, body, response headers).
+        """Route one ``POST /v1/segment[-volume]``; (status, body, headers).
+
+        ``path`` selects the replica endpoint (``/v1/segment-volume``
+        proxies through the same failover/shed ladder — a volume request
+        that dies on a dying replica moves on like any rider); ``cost``
+        is the request's slice-equivalent WRR debit
+        (:meth:`volume_request_cost`).
 
         The failover ladder: transport death ejects the replica and moves
         the request on; a 503 remembers the replica's Retry-After and
@@ -504,7 +552,7 @@ class FleetApp:
         resp_headers: List[Tuple[str, str]] = []
         while True:
             t_pick = time.monotonic()
-            target = self.pick(exclude=frozenset(tried))
+            target = self.pick(exclude=frozenset(tried), cost=cost)
             ctx.add_span(
                 "route_pick", t_pick, time.monotonic(),
                 replica=target_label(target) if target else None,
@@ -539,7 +587,7 @@ class FleetApp:
             t0 = time.monotonic()
             try:
                 status, data, resp_headers = self._forward(
-                    target, body, headers, query
+                    target, body, headers, query, path=path
                 )
             except Exception as e:  # noqa: BLE001 — transport death → failover
                 log.warning(
@@ -827,7 +875,7 @@ def make_handler(app: FleetApp):
                 self.headers.get("X-Nm03-Request-Id")
             ) or new_trace_id()
             echo = [("X-Nm03-Request-Id", trace_id)]
-            if split.path != "/v1/segment":
+            if split.path not in ("/v1/segment", "/v1/segment-volume"):
                 self._reply_json(
                     404, {"error": f"unknown path {split.path}"}, echo
                 )
@@ -853,9 +901,18 @@ def make_handler(app: FleetApp):
                 if k.lower().startswith(_FORWARD_PREFIX)
                 or k.lower() in _FORWARD_HEADERS
             }
+            # a whole-volume request weighs its declared depth in the WRR
+            # (ISSUE 15) — the router must not treat a 32-plane study as
+            # one slice when spreading load
+            cost = (
+                app.volume_request_cost(headers)
+                if split.path == "/v1/segment-volume"
+                else 1.0
+            )
             try:
                 status, data, resp_headers = app.proxy_segment(
-                    body, headers, split.query, trace_id=trace_id
+                    body, headers, split.query, trace_id=trace_id,
+                    path=split.path, cost=cost,
                 )
             except Exception as e:  # noqa: BLE001 — per-request containment
                 log.warning("fleet request failed: %s", e)

@@ -30,4 +30,5 @@ from nm03_capstone_project_tpu.ingest.staging import (  # noqa: F401
     prefetch_to_device,
     stage_arrays,
     stage_batch,
+    stage_volume,
 )

@@ -78,6 +78,28 @@ def stage_arrays(arrays: Iterable[Any], placement: Optional[Any] = None) -> list
     return [jax.device_put(a, placement) for a in arrays]
 
 
+def stage_volume(volume: Any, dims: Any, mesh: Any) -> tuple:
+    """Stage one (D, H, W) study onto a z-sharded mesh; ``(vol, dims)``.
+
+    The volume gang's upload home (ISSUE 15): the stack lands
+    ``NamedSharding(mesh, P('z', None, None))`` — each chip receives only
+    its z-shard's planes over one H2D enqueue — and ``dims`` replicates.
+    Lives here, not in serving/, because host→HBM placement is this
+    module's contract (NM401): the whole-volume request path must be as
+    visible to the transfer guard and the staging telemetry as the batch
+    drivers' feed.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    vol_sh = NamedSharding(mesh, P("z", None, None))
+    rep_sh = NamedSharding(mesh, P())
+    return (
+        jax.device_put(volume, vol_sh),
+        jax.device_put(dims, rep_sh),
+    )
+
+
 def prefetch_to_device(
     iterator: Iterable[T],
     depth: int = 2,

@@ -68,6 +68,11 @@ SERVE_SPAN_NAMES = (
     "probe",            # probation canary on a quarantined lane (off-path)
     "cpu_fallback",     # degraded-path recompute
     "encode",           # host render + JPEG encode on the handler thread
+    # whole-volume serving (ISSUE 15): the gang lane's span chain
+    "volume_gang_acquire",  # waiting for the slice batcher to park
+    "volume_dispatch",      # one supervised mesh-wide execute attempt
+    "volume_gather",        # mesh -> host mask-volume fetch
+    "volume_requeue",       # the gang re-meshed onto surviving lanes
 )
 
 # the fleet section of the span vocabulary (ISSUE 14): the router's own

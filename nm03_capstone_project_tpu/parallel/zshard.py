@@ -160,6 +160,44 @@ def _post_mask(
     return mask * valid.astype(mask.dtype)
 
 
+def zshard_volume_callable(mesh: Mesh, cfg: PipelineConfig):
+    """The shard_map'd z-sharded volume program, un-jitted.
+
+    The single shared definition of the halo-exchanged region-growing
+    program: :func:`_compiled_zsharded` wraps it in a deferred ``hub_jit``
+    (the batch driver's path) and the serving volume gang AOT-compiles it
+    per depth bucket through :func:`compilehub.programs.serve_volume`
+    (ISSUE 15) — one program text, so the served mask is bit-identical to
+    a directly-driven ``nm03-volume --z-shard`` run by construction.
+    """
+    n_shards = mesh.shape[AXIS]
+    spec_v = P(AXIS, None, None)
+
+    def run(vol_local: jax.Array, dims: jax.Array) -> Dict[str, jax.Array]:
+        pre, seeds, valid, band = _pre_and_band(vol_local, dims, cfg)
+        region, converged = _region_grow_local(
+            pre, seeds, band, n_shards,
+            cfg.grow_block_iters, cfg.grow_max_iters,
+        )
+        return {
+            "original": vol_local,
+            "mask": _post_mask(region, valid, cfg, n_shards),
+            "grow_converged": converged,
+        }
+
+    return shard_map(
+        run,
+        mesh=mesh,
+        in_specs=(spec_v, P()),
+        out_specs={
+            "original": spec_v,
+            "mask": spec_v,
+            "grow_converged": P(),
+        },
+        check_vma=False,
+    )
+
+
 def _compiled_zsharded(mesh: Mesh, cfg: PipelineConfig):
     """The z-sharded volume program, compiled and cached by the hub.
 
@@ -170,33 +208,7 @@ def _compiled_zsharded(mesh: Mesh, cfg: PipelineConfig):
     """
 
     def build(spec: CompileSpec):
-        n_shards = spec.mesh.shape[AXIS]
-        spec_v = P(AXIS, None, None)
-
-        def run(vol_local: jax.Array, dims: jax.Array) -> Dict[str, jax.Array]:
-            pre, seeds, valid, band = _pre_and_band(vol_local, dims, spec.cfg)
-            region, converged = _region_grow_local(
-                pre, seeds, band, n_shards,
-                spec.cfg.grow_block_iters, spec.cfg.grow_max_iters,
-            )
-            return {
-                "original": vol_local,
-                "mask": _post_mask(region, valid, spec.cfg, n_shards),
-                "grow_converged": converged,
-            }
-
-        sharded = shard_map(
-            run,
-            mesh=spec.mesh,
-            in_specs=(spec_v, P()),
-            out_specs={
-                "original": spec_v,
-                "mask": spec_v,
-                "grow_converged": P(),
-            },
-            check_vma=False,
-        )
-        return hub_jit(sharded)
+        return hub_jit(zshard_volume_callable(spec.mesh, spec.cfg))
 
     return get_hub().get(
         CompileSpec(name="zshard_volume", cfg=cfg, mesh=mesh), build

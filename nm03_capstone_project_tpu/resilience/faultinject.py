@@ -77,7 +77,7 @@ from nm03_capstone_project_tpu.resilience.policy import TransientDeviceError
 
 ENV_VAR = "NM03_FAULT_PLAN"
 
-SITES = ("decode", "dispatch", "export", "cache", "ingest", "fleet")
+SITES = ("decode", "dispatch", "export", "cache", "ingest", "fleet", "volume")
 KINDS_BY_SITE = {
     "decode": ("error", "corrupt"),
     "dispatch": ("transient", "hang"),
@@ -105,6 +105,15 @@ KINDS_BY_SITE = {
     # with fire()'s `kinds` filter, so one kind's rules never consume the
     # other's after/count budget.
     "fleet": ("replica_unreachable", "proxy_io_error"),
+    # the whole-volume gang lane (serving/volumes.py, ISSUE 15):
+    # `dispatch_error` fails one supervised mesh-wide dispatch as a
+    # retryable device error — with a `lane` selector the gang treats it
+    # as that lane's death, quarantines it, and re-meshes the retry onto
+    # the survivors (the lane-death-mid-volume drill); without `lane` the
+    # failure is unattributable and the gang sheds honestly with
+    # Retry-After rather than guess. `index` selects the volume-request
+    # ordinal.
+    "volume": ("dispatch_error",),
 }
 
 

@@ -59,3 +59,9 @@ from nm03_capstone_project_tpu.serving.server import (  # noqa: F401
     make_http_server,
     serve_in_thread,
 )
+from nm03_capstone_project_tpu.serving.volumes import (  # noqa: F401
+    DEFAULT_VOLUME_DEPTH_BUCKETS,
+    GangUnavailable,
+    VolumeGang,
+    VolumeRequest,
+)

@@ -32,6 +32,7 @@ hard failure with ``--no-fallback-cpu``).
 from __future__ import annotations
 
 import concurrent.futures as cf
+import contextlib
 import itertools
 import math
 import threading
@@ -100,6 +101,12 @@ class DynamicBatcher:
             "max_coalesced": 0,
             "lane_batches": {},
         }
+        # the gang gate (ISSUE 15): the batcher holds this around every
+        # window's dispatch, and the volume gang holds it for a whole
+        # mesh-wide program — so "park the slice lanes" is one lock
+        # acquisition that naturally waits for the in-flight window's
+        # slowest chunk and blocks the next window from dispatching
+        self._gang_lock = threading.Lock()
         # nm03-lint: disable=NM331 written by the owner thread before _thread.start() and read only from that same thread in join(); the Thread.start() fence orders it for the batcher thread
         self._started = False
 
@@ -154,6 +161,21 @@ class DynamicBatcher:
     @property
     def alive(self) -> bool:
         return self._thread.is_alive()
+
+    @contextlib.contextmanager
+    def gang_parked(self):
+        """Park the per-lane slice fleet for one mesh-wide program.
+
+        Acquiring waits for the in-flight coalescing window's slowest
+        chunk (the batcher holds the same lock around every window's
+        dispatch) and holds new windows back until release — the volume
+        gang's "drain the lanes, run the mesh, return the lanes"
+        construct (ISSUE 15). Admissions keep flowing into the bounded
+        queue throughout, so slice traffic sheds only on the queue's own
+        capacity contract, never because a volume was in flight.
+        """
+        with self._gang_lock:
+            yield
 
     def lanes(self) -> int:
         """The lane count dispatch fans out over (1 until lanes resolve)."""
@@ -210,7 +232,14 @@ class DynamicBatcher:
             if not batch:  # closed and empty: drain complete
                 return
             try:
-                self.execute(batch)
+                # the gang gate: slice windows dispatch under the lock a
+                # volume request parks the fleet with (gang_parked). While
+                # a mesh program runs, popped riders wait HERE — inside
+                # their existing request deadline — and the admission
+                # queue keeps coalescing behind them, so slice traffic
+                # resumes at full fan-out the moment the lanes return.
+                with self._gang_lock:
+                    self.execute(batch)
             except BaseException as e:  # noqa: BLE001 — the loop must survive
                 # execute() already failed the requests; a raise escaping it
                 # is a batcher bug — log, answer anything still waiting, and

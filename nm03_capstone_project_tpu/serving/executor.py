@@ -240,6 +240,41 @@ class WarmExecutor:
             return None
         return fleet.healthy_lanes()
 
+    def healthy_lane_devices(self) -> List[Tuple[int, object]]:
+        """``[(lane, device)]`` for the lanes currently taking traffic.
+
+        The volume gang's mesh pool (ISSUE 15): a whole-volume request
+        spans every healthy lane's chip, so the gang builds its z-mesh
+        from exactly this set — a quarantined lane is out of the mesh the
+        same way it is out of the slice fan-out. Resolves lanes (and so
+        the backend) on first use, like every dispatch path.
+        """
+        devs = self._resolve_lanes()
+        healthy = self.healthy_lanes()
+        ids = healthy if healthy is not None else range(len(devs))
+        return [(i, devs[i]) for i in ids]
+
+    def quarantine_lane(self, lane: int, cause: str) -> None:
+        """Quarantine one lane from OUTSIDE the dispatch path.
+
+        The volume gang's lane-death attribution hook (ISSUE 15): when a
+        mesh-wide dispatch failure is attributable to one lane, the gang
+        books it through the same state machine a slice dispatch failure
+        uses — probation, telemetry, and the process-wide degradation
+        (last healthy lane) all behave identically.
+        """
+        self._resolve_lanes()
+        self._quarantine_lane(lane, cause, NULL_TRACE)
+
+    def new_supervisor(self) -> DispatchSupervisor:
+        """A fresh quiet-mode supervisor with this executor's policy.
+
+        Public for the volume gang: supervisors degrade one-way, so every
+        caller that can outlive a failure (probation probes, gang
+        retries) takes a fresh one per attempt.
+        """
+        return self._new_supervisor()
+
     @property
     def quarantined_count(self) -> int:
         with self._lock:

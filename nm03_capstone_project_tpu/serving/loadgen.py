@@ -155,13 +155,19 @@ class LoadResult:
         self.requests: List[dict] = []
         self.echo_mismatches = 0
         self.errors: List[str] = []
+        # whole-volume attribution (ISSUE 15): per-request z-shard counts
+        # and gang-waits from the /v1/segment-volume response payload
+        self.zshards: collections.Counter = collections.Counter()
+        self.gang_waits_s: List[float] = []
 
     def record(self, status: str, latency_s: float, batch_size: int = 0,
                error: str = "", sent_id: str = "", echoed_id: str = "",
                queue_wait_s: Optional[float] = None,
                lane: Optional[int] = None,
                replica: Optional[str] = None,
-               replica_hops: Optional[int] = None) -> None:
+               replica_hops: Optional[int] = None,
+               z_shards: Optional[int] = None,
+               gang_wait_s: Optional[float] = None) -> None:
         with self._lock:
             self.statuses[status] += 1
             if status == "ok":
@@ -176,6 +182,10 @@ class LoadResult:
                     self.replicas[replica] += 1
                 if replica_hops:
                     self.failovers += 1
+                if z_shards is not None:
+                    self.zshards[int(z_shards)] += 1
+                if gang_wait_s is not None:
+                    self.gang_waits_s.append(gang_wait_s)
             elif error and len(self.errors) < 20:
                 self.errors.append(error)
             if sent_id and echoed_id and sent_id != echoed_id:
@@ -197,6 +207,10 @@ class LoadResult:
                     rec["replica"] = replica
                 if replica_hops is not None:
                     rec["replica_hops"] = replica_hops
+                if z_shards is not None:
+                    rec["z_shards"] = int(z_shards)
+                if gang_wait_s is not None:
+                    rec["gang_wait_ms"] = round(gang_wait_s * 1e3, 3)
                 self.requests.append(rec)
             else:
                 # counted, not silent: a soak past the cap must say so in
@@ -246,6 +260,22 @@ class LoadResult:
             str(k): v for k, v in sorted(self.replicas.items())
         }
         out["failovers_observed"] = self.failovers
+        # whole-volume evidence (ISSUE 15): which mesh widths served the
+        # volumes and the gang-wait distribution — the request-level view
+        # of the serving_volume_* gauges the acceptance drill gates
+        if self.zshards:
+            gw = sorted(self.gang_waits_s)
+            out["volume"] = {
+                "zshards_observed": {
+                    str(k): v for k, v in sorted(self.zshards.items())
+                },
+                "gang_wait_ms": {
+                    "p50": round(_percentile(gw, 50) * 1e3, 3),
+                    "p95": round(_percentile(gw, 95) * 1e3, 3),
+                    "max": round(gw[-1] * 1e3, 3) if gw else 0.0,
+                    "mean": round(sum(gw) / len(gw) * 1e3, 3) if gw else 0.0,
+                },
+            }
         out["trace_echo_mismatches"] = self.echo_mismatches
         if self.requests_dropped:
             out["requests_record_cap"] = self.MAX_REQUEST_RECORDS
@@ -294,6 +324,58 @@ def _make_payloads(height: int, width: int, n_distinct: int, dicom: bool):
     return payloads
 
 
+def _make_volume_payloads(
+    depth: int, height: int, width: int, n_distinct: int, dicom: bool
+):
+    """Pre-build whole-study request bodies (``--volume`` mode, ISSUE 15).
+
+    Raw mode stacks ``depth`` phantom slices as little-endian float32
+    with the dims in X-Nm03-Depth/Height/Width; DICOM mode writes one
+    Part-10 file per plane and concatenates them under the length-
+    prefixed ``application/x-nm03-dicom-parts`` framing the server
+    decodes (docs/API.md).
+    """
+    from nm03_capstone_project_tpu.data.synthetic import phantom_volume
+
+    payloads = []
+    for i in range(n_distinct):
+        vol = np.asarray(
+            phantom_volume(n_slices=depth, height=height, width=width, seed=i),
+            np.float32,
+        )
+        if dicom:
+            import os
+            import tempfile
+
+            from nm03_capstone_project_tpu.data.dicomlite import write_dicom
+
+            parts = []
+            fd, path = tempfile.mkstemp(suffix=".dcm")
+            os.close(fd)
+            try:
+                for plane in vol:
+                    write_dicom(
+                        path, np.clip(plane, 0, 65535).astype(np.uint16)
+                    )
+                    with open(path, "rb") as f:
+                        raw = f.read()
+                    parts.append(len(raw).to_bytes(4, "little") + raw)
+            finally:
+                os.unlink(path)
+            body = b"".join(parts)
+            headers = {"Content-Type": "application/x-nm03-dicom-parts"}
+        else:
+            body = vol.astype("<f4").tobytes()
+            headers = {
+                "Content-Type": "application/octet-stream",
+                "X-Nm03-Depth": str(depth),
+                "X-Nm03-Height": str(height),
+                "X-Nm03-Width": str(width),
+            }
+        payloads.append((body, headers))
+    return payloads
+
+
 def _one_request(url: str, body: bytes, headers: dict, timeout_s: float,
                  result: LoadResult, req_id: str = "") -> None:
     t0 = time.monotonic()
@@ -325,17 +407,23 @@ def _one_request(url: str, body: bytes, headers: dict, timeout_s: float,
                 or urllib.parse.urlsplit(url).netloc
             )
             hops = None
+            z_shards = gang_wait = None
             try:
                 payload = json.loads(data)
                 if isinstance(payload, dict):
                     replica = payload.get("replica") or replica
                     hops = payload.get("replica_hops")
+                    # whole-volume truth fields (ISSUE 15): present only
+                    # on /v1/segment-volume responses
+                    z_shards = payload.get("z_shards")
+                    gang_wait = payload.get("gang_wait_s")
             except (json.JSONDecodeError, UnicodeDecodeError):
                 pass
             result.record(
                 "ok", time.monotonic() - t0, batch_size=bs, sent_id=req_id,
                 echoed_id=echoed, queue_wait_s=qw, lane=lane,
                 replica=replica, replica_hops=hops,
+                z_shards=z_shards, gang_wait_s=gang_wait,
             )
     except urllib.error.HTTPError as e:
         echoed = e.headers.get("X-Nm03-Request-Id", "") if e.headers else ""
@@ -627,6 +715,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--height", type=int, default=128, help="phantom slice height")
     p.add_argument("--width", type=int, default=128, help="phantom slice width")
     p.add_argument(
+        "--volume", action="store_true",
+        help="whole-study mode (ISSUE 15): POST synthetic multi-slice "
+        "studies to /v1/segment-volume instead of slices to /v1/segment; "
+        "the summary gains a `volume` block (per-request z-shard counts "
+        "and the gang-wait distribution from the response payload)",
+    )
+    p.add_argument(
+        "--volume-depth", type=int, default=8, metavar="D",
+        help="planes per synthetic study in --volume mode (must fit the "
+        "server's --volume-depth-buckets)",
+    )
+    p.add_argument(
         "--dicom", action="store_true",
         help="send real Part-10 DICOM bytes (full parser path) instead of "
         "raw float32 arrays",
@@ -702,9 +802,20 @@ def main(argv=None) -> int:
         url = bases[0]
     else:
         bases = [url]
-    endpoints = [f"{b}/v1/segment?output={args.mode}" for b in bases]
+    if args.volume:
+        # whole-study mode: the summary payload (no mask bytes) keeps the
+        # wire cheap — the gates read z_shards/gang_wait_s, not the mask
+        endpoints = [f"{b}/v1/segment-volume?output=summary" for b in bases]
+        payloads = _make_volume_payloads(
+            args.volume_depth, args.height, args.width, args.distinct,
+            args.dicom,
+        )
+    else:
+        endpoints = [f"{b}/v1/segment?output={args.mode}" for b in bases]
+        payloads = _make_payloads(
+            args.height, args.width, args.distinct, args.dicom
+        )
     endpoint = endpoints[0]
-    payloads = _make_payloads(args.height, args.width, args.distinct, args.dicom)
     if args.warmup > 0:
         warm = LoadResult()  # discarded: compile/cache effects stay out
         run_load(endpoints, payloads, args.warmup, min(args.warmup, 4), 0.0,
@@ -774,6 +885,13 @@ def main(argv=None) -> int:
         return "?" if v is None else f"{v * 100:.3g}%"
 
     fleet_cap = summary["fleet_capacity_min_observed"]
+    vol_cols = ""
+    if summary.get("volume"):
+        vb = summary["volume"]
+        vol_cols = (
+            f"zshards={vb['zshards_observed']} "
+            f"gang_wait_p95={vb['gang_wait_ms']['p95']}ms "
+        )
     fleet_cols = ""
     if summary.get("targets") or summary["replicas"] is not None:
         # the fleet columns (ISSUE 13): printed on --targets runs and
@@ -793,6 +911,7 @@ def main(argv=None) -> int:
         f"busy_min={_pct(summary['busy_fraction_min_observed'])} "
         f"padding_max={_pct(summary['padding_waste_max_observed'])} "
         f"mfu_max={_pct(summary['mfu_max_observed'])} "
+        f"{vol_cols}"
         f"{fleet_cols}"
         f"echo_mismatch={summary['trace_echo_mismatches']}",
         flush=True,

@@ -61,6 +61,11 @@ COMPILE_CACHE_MISSES_TOTAL = "compile_cache_misses_total"
 # chunks re-dispatched off a quarantined lane (ISSUE 8's requeue span,
 # counted so nm03-top can show a requeue RATE from scrape deltas)
 SERVING_REQUEUES_TOTAL = "serving_requeues_total"
+# whole-volume serving (ISSUE 15): terminal POST /v1/segment-volume
+# outcomes by status (ok | error | shed | invalid | timeout) — the gang
+# lane's request accounting, separate from the per-slice series because
+# one volume request is a whole-mesh dispatch, not one slice
+SERVING_VOLUME_REQUESTS_TOTAL = "serving_volume_requests_total"
 
 # -- gauges -----------------------------------------------------------------
 # compile-cost accounting (ISSUE 7; labels: spec = CompileSpec.label()):
@@ -86,6 +91,14 @@ SERVING_LANE_STATE = "serving_lane_state"
 LANE_STATE_VALUES = {"healthy": 0, "probation": 1, "quarantined": 2}
 # startup compile+first-execute per lane and bucket (set by warmup)
 SERVING_WARMUP_SECONDS = "serving_warmup_seconds"
+# whole-volume serving gauges (ISSUE 15): z-shards the LAST served volume
+# actually spanned (the gang's mesh width — shrinks when the gang fails
+# over onto a surviving mesh) and the last request's gang-wait: how long
+# the volume waited for the per-lane slice batcher to park (the
+# scheduling cost of borrowing the whole mesh; gauge, not histogram, so
+# check_telemetry's --expect-gauge-range can gate it directly)
+SERVING_VOLUME_ZSHARDS = "serving_volume_zshards"
+SERVING_VOLUME_GANG_WAIT_SECONDS = "serving_volume_gang_wait_seconds"
 
 # -- histograms -------------------------------------------------------------
 SERVING_QUEUE_WAIT_SECONDS = "serving_queue_wait_seconds"

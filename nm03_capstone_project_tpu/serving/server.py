@@ -134,6 +134,11 @@ class ServingApp:
         lane_probe_interval_s: Optional[float] = None,
         compile_cache_dir: Optional[str] = None,
         slo=None,
+        volume_serving: bool = False,
+        volume_depth_buckets=None,
+        volume_queue_capacity: int = 4,
+        volume_timeout_s: float = 300.0,
+        distributed_init: bool = False,
     ):
         from nm03_capstone_project_tpu.obs import RunContext
         from nm03_capstone_project_tpu.serving.executor import (
@@ -195,6 +200,34 @@ class ServingApp:
             max_batch=max_batch,
             obs=self.obs,
         )
+        # whole-volume serving (ISSUE 15): the gang lane behind
+        # POST /v1/segment-volume — its own bounded admission queue, the
+        # batcher's gang gate, the z-sharded mesh program per depth
+        # bucket. Opt-in (--volume-serving): warmup compiles one mesh
+        # executable per depth bucket, which a slice-only replica must
+        # not pay.
+        self.volumes = None
+        self.volume_timeout_s = float(volume_timeout_s)
+        if volume_serving:
+            from nm03_capstone_project_tpu.serving.volumes import (
+                DEFAULT_VOLUME_DEPTH_BUCKETS,
+                VolumeGang,
+            )
+
+            self.volumes = VolumeGang(
+                self.cfg,
+                self.executor,
+                self.batcher,
+                obs=self.obs,
+                queue_capacity=volume_queue_capacity,
+                depth_buckets=(
+                    tuple(volume_depth_buckets)
+                    if volume_depth_buckets
+                    else DEFAULT_VOLUME_DEPTH_BUCKETS
+                ),
+                fault_plan=fault_plan,
+                distributed=distributed_init,
+            )
         self.request_timeout_s = float(request_timeout_s)
         self.jpeg_quality = int(jpeg_quality)
         self.draining = False
@@ -236,6 +269,21 @@ class ServingApp:
     def start(self) -> dict:
         """Warm every bucket, start the batcher; {bucket: warmup seconds}."""
         timings = self.executor.warmup()
+        if self.volumes is not None:
+            # after the executor's warmup (lanes resolved), before /readyz
+            # flips: the first volume request must find warm mesh
+            # executables, never a trace+compile stall
+            timings["volume"] = self.volumes.warmup()
+            self.volumes.start()
+            from nm03_capstone_project_tpu.serving.metrics import (
+                SERVING_VOLUME_ZSHARDS,
+            )
+
+            self.registry.gauge(
+                SERVING_VOLUME_ZSHARDS,
+                help="z-shards the last served volume spanned (the gang's "
+                "mesh width; full fleet width from warmup)",
+            ).set(self.volumes.z_shards)
         self.batcher.start()
         self.registry.gauge(
             SERVING_READY, help="1 = warmed and admitting, 0 otherwise"
@@ -380,6 +428,15 @@ class ServingApp:
             # capacity-weighted balancer feeds on while ready stays 200
             "capacity": self.executor.capacity,
             "mesh_shape": [lane_count] if lane_count else None,
+            # whole-volume serving (ISSUE 15): the gang lane's shape —
+            # depth buckets, mesh width, its own queue, and the
+            # default_cost the fleet router weighs unsized volume
+            # requests by. {enabled: false} when not serving volumes.
+            "volumes": (
+                self.volumes.status()
+                if self.volumes is not None
+                else {"enabled": False}
+            ),
             # stats() carries the total_compile_seconds rollup; the per-spec
             # map makes warmup cost visible without grepping logs (ISSUE 7)
             "compile_hub": {
@@ -424,7 +481,21 @@ class ServingApp:
             queue_depth=len(self.queue),
         )
         self.queue.close()
+        if self.volumes is not None:
+            # same close-the-door-finish-the-room contract as the slice
+            # queue: admitted volumes complete, later ones shed
+            self.volumes.queue.close()
         drained = self.batcher.join(timeout_s=timeout_s)
+        if self.volumes is not None:
+            gang_drained = self.volumes.join(timeout_s=timeout_s)
+            if not gang_drained:
+                for r in self.volumes.queue.drain_pending():
+                    r.fail(RuntimeError("server drain timed out"))
+                log.warning(
+                    "drain: volume gang did not finish inside %.0fs",
+                    timeout_s,
+                )
+            drained = drained and gang_drained
         # final gauge refresh BEFORE the snapshot flush: the --metrics-out
         # artifact must carry the run's last efficiency window (the
         # subprocess drills gate on these gauges post-drain)
@@ -664,6 +735,248 @@ class ServingApp:
         ).set(1 if self.executor.degraded else 0)
         return payload
 
+    # -- whole-volume request plumbing (ISSUE 15, HTTP-free) ---------------
+
+    def _count_volume_request(self, status: str) -> None:
+        from nm03_capstone_project_tpu.serving.metrics import (
+            SERVING_VOLUME_REQUESTS_TOTAL,
+        )
+
+        self.registry.counter(
+            SERVING_VOLUME_REQUESTS_TOTAL,
+            help="terminal whole-volume request outcomes by status "
+            "(POST /v1/segment-volume)",
+            status=status,
+        ).inc()
+
+    def decode_volume_raw(
+        self, body: bytes, depth: int, height: int, width: int
+    ) -> np.ndarray:
+        """Raw stacked study: little-endian float32 (depth, height, width)."""
+        if depth < 1:
+            raise RequestRejected(400, f"depth must be >= 1, got {depth}")
+        expected = depth * height * width * 4
+        if len(body) != expected:
+            raise RequestRejected(
+                400,
+                f"raw volume body is {len(body)} bytes; "
+                f"{depth}x{height}x{width} float32 needs {expected}",
+            )
+        return (
+            np.frombuffer(body, dtype="<f4")
+            .reshape(depth, height, width)
+            .astype(np.float32)
+        )
+
+    def decode_volume_dicom(self, body: bytes, content_type: str) -> np.ndarray:
+        """DICOM study body -> (depth, h, w) float32 stack.
+
+        ``application/dicom`` is ONE Part-10 file whose frames are the
+        z-planes (multi-frame series — the format
+        ``data.dicomlite.read_dicom_frames`` already decodes for the
+        drivers); ``application/x-nm03-dicom-parts`` is the concatenated
+        form: each part is a 4-byte little-endian length prefix followed
+        by one Part-10 file (explicit framing — scanning raw
+        concatenation for the DICM magic could split inside pixel data).
+        Every plane must decode and share one in-plane size: a partial
+        volume is never silently served.
+        """
+        import tempfile
+
+        from nm03_capstone_project_tpu.data.dicomlite import (
+            read_dicom_bytes,
+            read_dicom_frames,
+        )
+
+        ct = (content_type or "").split(";")[0].strip().lower()
+        planes: list = []
+        try:
+            if ct == "application/x-nm03-dicom-parts":
+                off = 0
+                while off < len(body):
+                    if off + 4 > len(body):
+                        raise ValueError("truncated part length prefix")
+                    n = int.from_bytes(body[off:off + 4], "little")
+                    off += 4
+                    if n <= 0 or off + n > len(body):
+                        raise ValueError(f"part length {n} overruns the body")
+                    planes.append(
+                        np.asarray(
+                            read_dicom_bytes(body[off:off + n]).pixels,
+                            np.float32,
+                        )
+                    )
+                    off += n
+                if not planes:
+                    raise ValueError("no DICOM parts in body")
+            else:  # application/dicom: one (possibly multi-frame) file
+                with tempfile.NamedTemporaryFile(suffix=".dcm") as f:
+                    f.write(body)
+                    f.flush()
+                    slices = read_dicom_frames(f.name, strict=True)
+                planes = [np.asarray(s.pixels, np.float32) for s in slices]
+        except RequestRejected:
+            raise
+        except Exception as e:  # noqa: BLE001 — parser rejection -> 400
+            raise RequestRejected(400, f"DICOM study parse failed: {e}") from e
+        if not planes:
+            # a parseable file with zero frames is still an empty study —
+            # a 400, never an unhandled IndexError below
+            raise RequestRejected(400, "DICOM study contains no image planes")
+        hw = planes[0].shape
+        if any(p.shape != hw for p in planes):
+            raise RequestRejected(
+                400,
+                "study planes disagree on in-plane size "
+                f"({sorted({p.shape for p in planes})})",
+            )
+        return np.stack(planes)
+
+    def guard_volume(self, volume: np.ndarray) -> Tuple[int, int, int]:
+        """Admission guards for one decoded study; (depth, h, w)."""
+        if self.volumes is None:
+            raise RequestRejected(
+                404,
+                "volume serving is not enabled on this replica "
+                "(start nm03-serve with --volume-serving)",
+                status_label="invalid",
+            )
+        d = int(volume.shape[0])
+        h, w = self.guard_pixels(volume[0])
+        if d > self.volumes.max_depth:
+            raise RequestRejected(
+                413,
+                f"study of {d} planes exceeds the largest volume depth "
+                f"bucket {self.volumes.max_depth} (start the server with "
+                "deeper --volume-depth-buckets)",
+            )
+        return d, h, w
+
+    def segment_volume(
+        self,
+        volume: np.ndarray,
+        trace_id: Optional[str] = None,
+        mhd: bool = False,
+        mhd_compressed: bool = False,
+        include_mask: bool = True,
+    ) -> dict:
+        """The whole-volume request path minus HTTP (ISSUE 15).
+
+        Admit to the gang's own queue, wait for the mesh-wide dispatch,
+        build the payload carrying the full mask volume (base64 raw
+        uint8, C-order) plus — with ``mhd`` — the MetaImage pair the
+        driver's ``--export-mhd`` writes. Raises RequestRejected
+        (guards), QueueFull/QueueClosed (volume-queue shed),
+        GangUnavailable (no servable mesh — the honest shed), or
+        TimeoutError. Counts every terminal outcome under
+        ``serving_volume_requests_total`` and publishes the gang-wait /
+        z-shard gauges.
+        """
+        from nm03_capstone_project_tpu.serving.metrics import (
+            SERVING_VOLUME_GANG_WAIT_SECONDS,
+            SERVING_VOLUME_ZSHARDS,
+        )
+        from nm03_capstone_project_tpu.serving.volumes import GangUnavailable
+
+        try:
+            d, h, w = self.guard_volume(volume)
+        except RequestRejected:
+            self._count_volume_request("invalid")  # admission guard
+            raise
+        try:
+            req = self.volumes.submit(volume, (h, w), trace_id=trace_id)
+        except (QueueFull, QueueClosed):
+            self.registry.counter(
+                SERVING_SHED_TOTAL,
+                help="admissions refused by backpressure (full or "
+                "draining)",
+            ).inc()
+            self._count_volume_request("shed")
+            raise
+        except ValueError as e:  # depth guard inside the gang
+            self._count_volume_request("invalid")
+            raise RequestRejected(413, str(e)) from e
+        self.registry.gauge(
+            SERVING_INFLIGHT, help="admitted requests not yet responded"
+        ).inc()
+        try:
+            if not req.wait(self.volume_timeout_s):
+                self._count_volume_request("timeout")
+                raise TimeoutError(
+                    f"volume request {req.request_id} timed out after "
+                    f"{self.volume_timeout_s:.0f}s"
+                )
+            if req.error is not None:
+                self._count_volume_request(
+                    "shed" if isinstance(req.error, GangUnavailable)
+                    else "error"
+                )
+                raise req.error
+        finally:
+            self.registry.gauge(
+                SERVING_INFLIGHT, help="admitted requests not yet responded"
+            ).dec()
+        payload = {
+            "request_id": req.request_id,
+            "trace_id": req.trace_id,
+            "shape": [d, h, w],
+            "z_shards": req.z_shards,
+            "gang_wait_s": round(req.gang_wait_s, 6),
+            "queue_wait_s": round(req.queue_wait_s, 6),
+            # >0: the gang re-meshed onto surviving lanes mid-volume
+            "requeues": req.requeues,
+            "grow_converged": req.converged,
+            "mask_voxels": int(np.count_nonzero(req.mask)),
+        }
+        if include_mask:
+            payload["mask_b64"] = base64.b64encode(
+                np.ascontiguousarray(req.mask).tobytes()
+            ).decode("ascii")
+        if mhd:
+            payload.update(self._mhd_payload(req.mask, mhd_compressed))
+        self.obs.events.emit(
+            SERVE_TRACE_EVENT,
+            trace_id=req.trace_id,
+            request_id=req.request_id,
+            lane=None,
+            batch_size=1,
+            queue_wait_s=round(req.queue_wait_s, 6),
+            probe=False,
+            volume=True,
+            z_shards=req.z_shards,
+            spans=req.trace.snapshot(),
+        )
+        self.registry.gauge(
+            SERVING_VOLUME_GANG_WAIT_SECONDS,
+            help="gang-wait of the last served volume: how long it waited "
+            "for the slice batcher to park the lanes",
+        ).set(round(req.gang_wait_s, 6))
+        self.registry.gauge(
+            SERVING_VOLUME_ZSHARDS,
+            help="z-shards the last served volume spanned (the gang's "
+            "mesh width; full fleet width from warmup)",
+        ).set(req.z_shards)
+        self._count_volume_request("ok")
+        return payload
+
+    def _mhd_payload(self, mask: np.ndarray, compressed: bool) -> dict:
+        """The driver's ``--export-mhd`` artifact pair, base64 over the wire."""
+        import tempfile
+        from pathlib import Path
+
+        from nm03_capstone_project_tpu.data.imageio import write_metaimage
+
+        with tempfile.TemporaryDirectory() as td:
+            write_metaimage(mask, Path(td) / "mask.mhd", compressed=compressed)
+            header = (Path(td) / "mask.mhd").read_bytes()
+            data_name = "mask.zraw" if compressed else "mask.raw"
+            data = (Path(td) / data_name).read_bytes()
+        return {
+            "mhd_header_b64": base64.b64encode(header).decode("ascii"),
+            "mhd_data_b64": base64.b64encode(data).decode("ascii"),
+            "mhd_data_file": data_name,
+        }
+
 
 # -- the HTTP layer ---------------------------------------------------------
 
@@ -772,6 +1085,9 @@ def make_handler(app: ServingApp):
 
         def do_POST(self):  # noqa: N802
             split = urlsplit(self.path)
+            if split.path == "/v1/segment-volume":
+                self._post_volume(split)
+                return
             if split.path != "/v1/segment":
                 self._reply(404, {"error": f"unknown path {split.path}"})
                 return
@@ -849,6 +1165,93 @@ def make_handler(app: ServingApp):
                         (
                             "X-Nm03-Queue-Wait-Ms",
                             f"{payload['queue_wait_s'] * 1e3:.3f}",
+                        ),
+                    ],
+                )
+
+        def _post_volume(self, split):
+            """``POST /v1/segment-volume`` (ISSUE 15): one whole study in,
+            the full mask volume out — the gang-lane request path."""
+            query = parse_qs(split.query)
+            output = query.get("output", ["mask"])[0]
+            trace_id = sanitize_trace_id(
+                self.headers.get("X-Nm03-Request-Id")
+            ) or new_trace_id()
+            echo = [("X-Nm03-Request-Id", trace_id)]
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                max_depth = (
+                    app.volumes.max_depth if app.volumes is not None else 1
+                )
+                cap = max_depth * app.cfg.canvas * app.cfg.canvas * 4 + 65536
+                if length <= 0:
+                    raise RequestRejected(400, "empty body")
+                if length > cap:
+                    raise RequestRejected(
+                        413,
+                        f"body of {length} bytes exceeds the {cap} volume cap",
+                    )
+                body = self.rfile.read(length)
+                d_hdr = self.headers.get("X-Nm03-Depth")
+                h_hdr = self.headers.get("X-Nm03-Height")
+                w_hdr = self.headers.get("X-Nm03-Width")
+                if d_hdr is not None and h_hdr is not None and w_hdr is not None:
+                    volume = app.decode_volume_raw(
+                        body, int(d_hdr), int(h_hdr), int(w_hdr)
+                    )
+                else:
+                    volume = app.decode_volume_dicom(
+                        body, self.headers.get("Content-Type", "")
+                    )
+            except RequestRejected as e:
+                app._count_volume_request("invalid")
+                self._reply(e.http_status, {"error": str(e)}, headers=echo)
+                return
+            except (ValueError, OverflowError) as e:  # bad int headers etc.
+                app._count_volume_request("invalid")
+                self._reply(400, {"error": str(e)}, headers=echo)
+                return
+            from nm03_capstone_project_tpu.serving.volumes import (
+                GangUnavailable,
+            )
+
+            try:
+                payload = app.segment_volume(
+                    volume,
+                    trace_id=trace_id,
+                    mhd=output == "mhd",
+                    mhd_compressed=query.get("compressed", ["0"])[0] == "1",
+                    include_mask=output != "summary",
+                )
+            except RequestRejected as e:  # guards (counted inside)
+                self._reply(e.http_status, {"error": str(e)}, headers=echo)
+            except (QueueFull, QueueClosed, GangUnavailable) as e:
+                # volume-queue backpressure AND the gang's honest no-mesh
+                # shed: the client retries, the mask is never guessed
+                self._reply(
+                    503,
+                    {"error": str(e), "draining": app.draining},
+                    headers=[("Retry-After", str(RETRY_AFTER_S)), *echo],
+                )
+            except TimeoutError as e:
+                self._reply(504, {"error": str(e)}, headers=echo)
+            except Exception as e:  # noqa: BLE001 — per-request containment
+                log.warning("volume request failed: %s", e)
+                self._reply(
+                    500,
+                    {"error": str(e), "error_class": type(e).__name__},
+                    headers=echo,
+                )
+            else:
+                self._reply(
+                    200,
+                    payload,
+                    headers=[
+                        ("X-Nm03-Request-Id", payload["trace_id"]),
+                        ("X-Nm03-Z-Shards", str(payload["z_shards"])),
+                        (
+                            "X-Nm03-Gang-Wait-Ms",
+                            f"{payload['gang_wait_s'] * 1e3:.3f}",
                         ),
                     ],
                 )
@@ -961,6 +1364,49 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument(
         "--jpeg-quality", type=int, default=90, help="JPEG encoder quality"
     )
+    g.add_argument(
+        "--volume-serving",
+        action="store_true",
+        help="serve POST /v1/segment-volume (ISSUE 15): whole studies in "
+        "one request through a gang lane spanning every healthy lane's "
+        "chip — warmup additionally compiles one z-sharded mesh executable "
+        "per depth bucket (persisted by --compile-cache-dir); "
+        "docs/OPERATIONS.md 'Serving whole studies'",
+    )
+    g.add_argument(
+        "--volume-depth-buckets",
+        default=None,
+        metavar="D1,D2,...",
+        help="comma list of warm volume depth buckets (each is one "
+        "AOT-compiled mesh executable; a study pads to the smallest that "
+        "fits; default 8,16,32). The largest bucket is the served depth "
+        "cap",
+    )
+    g.add_argument(
+        "--volume-queue-capacity",
+        type=int,
+        default=4,
+        help="bounded volume admission queue — separate from the slice "
+        "queue by design, so bulk volumes shed on their own capacity and "
+        "never occupy slice-admission slots",
+    )
+    g.add_argument(
+        "--volume-timeout-s",
+        type=float,
+        default=300.0,
+        help="per-volume wall budget from admission to response (a "
+        "mesh-wide study is minutes of work where a slice is "
+        "milliseconds)",
+    )
+    g.add_argument(
+        "--distributed-init",
+        action="store_true",
+        help="join this replica into a jax.distributed job before warmup "
+        "(compat.ensure_cpu_multiprocess_collectives + "
+        "jax.distributed autodetection) so the volume gang's mesh spans "
+        "the GLOBAL device set — a replica whose mesh crosses processes "
+        "(ROADMAP item 3)",
+    )
     from nm03_capstone_project_tpu.obs.slo import add_slo_args
 
     add_slo_args(g)  # --slo-availability/--slo-p99-ms/window flags (ISSUE 14)
@@ -995,6 +1441,24 @@ def app_from_args(args: argparse.Namespace, obs=None) -> ServingApp:
     res = common.resilience_config_from_args(args)
     plan = res.fault_plan if res.fault_plan is not None else FaultPlan.from_env()
     buckets = tuple(int(b) for b in str(args.buckets).split(",") if b.strip())
+    volume_buckets = None
+    if getattr(args, "volume_depth_buckets", None):
+        volume_buckets = tuple(
+            int(b) for b in str(args.volume_depth_buckets).split(",")
+            if b.strip()
+        )
+    if getattr(args, "distributed_init", False):
+        # join the jax.distributed job BEFORE any backend work (the
+        # ROADMAP item-3 leftover, minimal form): gloo collectives for a
+        # CPU-backend mesh, then jax's own cluster autodetection; a
+        # single-process start is a documented no-op
+        from nm03_capstone_project_tpu.compilehub import (
+            ensure_cpu_multiprocess_collectives,
+        )
+        from nm03_capstone_project_tpu.parallel import distributed
+
+        ensure_cpu_multiprocess_collectives()
+        distributed.initialize()
     return ServingApp(
         cfg=cfg,
         queue_capacity=args.queue_capacity,
@@ -1009,6 +1473,11 @@ def app_from_args(args: argparse.Namespace, obs=None) -> ServingApp:
         lane_probe_interval_s=args.lane_probe_interval_s,
         compile_cache_dir=args.compile_cache_dir or cache_dir_from_env(),
         slo=objective_from_args(args),
+        volume_serving=getattr(args, "volume_serving", False),
+        volume_depth_buckets=volume_buckets,
+        volume_queue_capacity=getattr(args, "volume_queue_capacity", 4),
+        volume_timeout_s=getattr(args, "volume_timeout_s", 300.0),
+        distributed_init=getattr(args, "distributed_init", False),
     )
 
 

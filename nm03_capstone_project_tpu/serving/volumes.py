@@ -1,0 +1,495 @@
+"""Whole-volume multi-chip serving: one study, one mesh-wide request.
+
+``POST /v1/segment-volume`` (ISSUE 15) makes "segment this entire study
+in one online request" a served scenario instead of N client-stitched
+slice calls — the OpenCLIPER thesis (PAPERS.md, arXiv:1807.11830) applied
+to the request path: keep the study device-resident and amortize every
+host round-trip over the whole volume. The compute is EXACTLY the batch
+driver's z-sharded program (``nm03-volume --z-shard``): the same
+shard_map'd halo-exchanged region-growing fixpoint
+(:func:`~nm03_capstone_project_tpu.parallel.zshard.zshard_volume_callable`),
+AOT-compiled per depth bucket through the compile hub — so the served
+mask volume is bit-identical to a directly-driven run by construction,
+and the persistent cache (PR 9) keeps the mesh executables warm across
+restarts.
+
+The scheduling construct this forces is the **gang lane**
+(:class:`VolumeGang`): slice requests ride per-lane executables, but a
+volume request needs EVERY healthy lane's chip at once. The gang owns
+
+* its **own bounded admission queue** — volume traffic sheds on its own
+  capacity, and bulk volumes can never occupy slice-admission slots (the
+  admission-separation down-payment on ROADMAP item 4);
+* the batcher's **gang gate**
+  (:meth:`~nm03_capstone_project_tpu.serving.batcher.DynamicBatcher.gang_parked`):
+  acquiring waits for the in-flight slice window and parks the lanes;
+  the wait is the published ``serving_volume_gang_wait_seconds``;
+* **fault-domain integration**: the mesh is built from the executor's
+  *currently healthy* lanes, a mid-volume lane death re-meshes the retry
+  onto the survivors (span ``volume_requeue``, the lane booked through
+  the same quarantine machine slice traffic uses), and when no usable
+  mesh remains the request sheds honestly with ``Retry-After`` — a wrong
+  mask is never an outcome.
+
+Depth buckets mirror the batch buckets: a study pads (with zero planes,
+which segment empty — the same filler the driver uses for shard
+divisibility) up to the smallest warm bucket, so the compile-shape set is
+fixed at startup and online traffic never triggers a mesh recompile.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from nm03_capstone_project_tpu.config import PipelineConfig
+from nm03_capstone_project_tpu.serving.queue import AdmissionQueue
+from nm03_capstone_project_tpu.utils.reporter import get_logger
+
+log = get_logger("serving")
+
+# depth buckets a study pads up into (one AOT mesh executable each);
+# mirrors DEFAULT_BUCKETS' role for slices. 8 keeps the smallest volume
+# cheap; 64 bounds the compile-shape set and the request body cap.
+DEFAULT_VOLUME_DEPTH_BUCKETS: Tuple[int, ...] = (8, 16, 32)
+
+
+class GangUnavailable(RuntimeError):
+    """No usable mesh can serve this volume right now; shed with 503 +
+    ``Retry-After`` (the server maps it). Raised instead of EVER returning
+    a mask the gang cannot vouch for."""
+
+
+@dataclass
+class VolumeRequest:
+    """One in-flight whole-volume request, admission to response.
+
+    ``volume`` is the decoded host-side (depth, h, w) float32 stack,
+    ``dims`` the true in-plane (h, w). The gang fills ``mask`` (cropped
+    uint8 (depth, h, w)), ``converged``, ``z_shards``, ``gang_wait_s``
+    (or ``error``) and sets ``done``.
+    """
+
+    request_id: str
+    volume: object  # np.ndarray (depth, h, w) float32, raw intensities
+    dims: tuple  # (h, w)
+    depth: int
+    t_admitted: float = field(default_factory=time.monotonic)
+    trace: object = None  # obs.trace.TraceContext
+    t_popped: float = 0.0  # stamped by AdmissionQueue.get_batch
+    # filled by the gang
+    mask: object = None  # np.ndarray (depth, h, w) uint8
+    converged: bool = True
+    z_shards: int = 0
+    gang_wait_s: float = 0.0
+    queue_wait_s: float = 0.0
+    requeues: int = 0  # mesh rebuilds after a mid-volume lane death
+    error: Optional[BaseException] = None
+    done: threading.Event = field(default_factory=threading.Event)
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        return self.trace.trace_id if self.trace is not None else None
+
+    def fail(self, exc: BaseException) -> None:
+        # nm03-lint: disable=NM331 release ordering via the Event (ServeRequest.fail's contract)
+        self.error = exc
+        self.done.set()
+
+    def wait(self, timeout_s: Optional[float] = None) -> bool:
+        return self.done.wait(timeout_s)
+
+
+class VolumeGang:
+    """The gang lane: one thread serving whole-volume requests mesh-wide.
+
+    One consumer thread pops volume requests (strictly one at a time — a
+    gang IS the whole mesh), parks the slice batcher through its gang
+    gate, dispatches the z-sharded program over the healthy lanes'
+    devices, and returns the lanes between volumes so interleaved slice
+    traffic always gets a turn. Construction is backend-free; lanes
+    resolve at :meth:`warmup` (call after the executor's own warmup).
+    """
+
+    def __init__(
+        self,
+        cfg: PipelineConfig,
+        executor,
+        batcher,
+        obs=None,
+        queue_capacity: int = 4,
+        depth_buckets: Tuple[int, ...] = DEFAULT_VOLUME_DEPTH_BUCKETS,
+        fault_plan=None,
+        distributed: bool = False,
+    ):
+        buckets = tuple(int(b) for b in depth_buckets)
+        if not buckets or list(buckets) != sorted(set(buckets)):
+            raise ValueError(
+                f"volume depth buckets must be strictly increasing, got "
+                f"{depth_buckets}"
+            )
+        if any(b < 1 for b in buckets):
+            raise ValueError(f"volume depth buckets must be >= 1, got {buckets}")
+        self.cfg = cfg
+        self.executor = executor
+        self.batcher = batcher
+        self.obs = obs
+        self.depth_buckets = buckets
+        self.fault_plan = fault_plan
+        # --distributed-init (ROADMAP item 3 leftover): when this process
+        # joined a jax.distributed job, the gang's mesh spans the GLOBAL
+        # device set — a replica's volume mesh can cross processes the way
+        # nm03-volume --z-shard --distributed does
+        self.distributed = bool(distributed)
+        self.queue = AdmissionQueue(queue_capacity)
+        self._seq = itertools.count()
+        self._warm_width = 0  # full-mesh z width pinned at warmup
+        self._thread = threading.Thread(
+            target=self._run, name="nm03-serve-gang", daemon=True
+        )
+        # nm03-lint: disable=NM331 owner-thread write before _thread.start(); the start() fence orders it
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def max_depth(self) -> int:
+        """The deepest study one request may carry (the largest bucket)."""
+        return self.depth_buckets[-1]
+
+    @property
+    def z_shards(self) -> int:
+        """The full-mesh z width (0 before warmup)."""
+        return self._warm_width
+
+    @property
+    def default_cost(self) -> int:
+        """The slice-equivalent cost the fleet router weighs an
+        unsized volume request by (the smallest depth bucket)."""
+        return self.depth_buckets[0]
+
+    def _device_pool(self) -> List[Tuple[Optional[int], object]]:
+        """``[(lane, device)]`` the next mesh is built from.
+
+        Healthy local lanes normally; the GLOBAL device set when this
+        replica joined a ``jax.distributed`` job (``--distributed-init``)
+        — global devices carry no local lane id, so lane-death
+        attribution is local-mode only.
+        """
+        if self.distributed:
+            from nm03_capstone_project_tpu.compilehub import (
+                distributed_is_initialized,
+            )
+
+            if distributed_is_initialized():
+                import jax
+
+                return [(None, d) for d in jax.devices()]
+        return self.executor.healthy_lane_devices()
+
+    def padded_depth(self, depth: int, n_shards: int) -> int:
+        """The dispatch depth for a ``depth``-plane study on ``n_shards``.
+
+        Smallest warm bucket that fits, rounded up to the next multiple
+        of ``n_shards`` (shard_map needs even division; the extra planes
+        are zero filler that segments empty — the driver's own
+        divisibility pad, so bucketing preserves bit-identity). Raises
+        ValueError past the largest bucket.
+        """
+        for b in self.depth_buckets:
+            if depth <= b:
+                return -(-b // n_shards) * n_shards
+        raise ValueError(
+            f"study of {depth} planes exceeds the largest volume depth "
+            f"bucket {self.max_depth}"
+        )
+
+    def _usable_shards(self, pool_size: int, depth: int) -> int:
+        """Largest mesh width <= pool_size the halo contract allows."""
+        halo = self.cfg.morph_size // 2
+        n = max(pool_size, 1)
+        while n > 1 and self.padded_depth(depth, n) // n < max(halo, 1):
+            n -= 1
+        return n
+
+    def _compiled(self, depth: int, devices: List):
+        """(executable, padded_depth, mesh) for a study over ``devices``."""
+        from nm03_capstone_project_tpu.compilehub import programs
+        from nm03_capstone_project_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(len(devices), axis_names=("z",), devices=devices)
+        padded = self.padded_depth(depth, len(devices))
+        return programs.serve_volume(self.cfg, padded, mesh), padded, mesh
+
+    def warmup(self) -> dict:
+        """Compile + execute every depth bucket on its full mesh once.
+
+        Call after the executor's warmup (lanes resolved). Returns
+        ``{bucket: seconds}``. Each bucket warms at the SAME mesh width
+        dispatch will compute for a study of that bucket's depth
+        (``_usable_shards`` is bucket-dependent when the dilation halo
+        constrains shallow buckets — e.g. ``morph_size=5`` caps an
+        8-plane bucket at fewer shards than a 32-plane one), so the
+        first volume request of ANY admissible depth finds its warm
+        executable and never pays a trace+compile while holding the
+        gang; the hub persists the executables when a compile cache is
+        attached.
+        """
+        pool = self._device_pool()
+        devices = [d for _, d in pool]
+        timings = {}
+        c = self.cfg.canvas
+        width = 0
+        for b in self.depth_buckets:
+            n = self._usable_shards(len(devices), b)
+            width = max(width, n)
+            t0 = time.perf_counter()
+            fn, padded, mesh = self._compiled(b, devices[:n])
+            vol, dims = self._stage(
+                np.zeros((padded, c, c), np.float32),
+                np.asarray([self.cfg.min_dim, self.cfg.min_dim], np.int32),
+                mesh,
+            )
+            out = fn(vol, dims)
+            np.asarray(out["mask"])  # block until executed
+            timings[b] = round(time.perf_counter() - t0, 3)
+        # nm03-lint: disable=NM331 single writer: warmup() runs once on the startup thread before start(); concurrent /readyz readers see either 0 (warming) or the final width — an atomic int either way
+        self._warm_width = width
+        return timings
+
+    @staticmethod
+    def _stage(volume: np.ndarray, dims: np.ndarray, mesh):
+        """Host -> mesh staging, through the ingest home (NM401)."""
+        from nm03_capstone_project_tpu.ingest import stage_volume
+
+        return stage_volume(volume, dims, mesh)
+
+    def start(self) -> "VolumeGang":
+        # nm03-lint: disable=NM331 owner-thread write before _thread.start(); see __init__
+        self._started = True
+        self._thread.start()
+        return self
+
+    def join(self, timeout_s: Optional[float] = None) -> bool:
+        """Wait for the gang to drain (queue must be closed first)."""
+        if not self._started:
+            return True
+        self._thread.join(timeout=timeout_s)
+        return not self._thread.is_alive()
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(
+        self, volume: np.ndarray, dims: Tuple[int, int],
+        trace_id: Optional[str] = None,
+    ) -> VolumeRequest:
+        """Admit one decoded study; QueueFull/QueueClosed shed at the door.
+
+        Depth guards are the CALLER's job (the server rejects before
+        admission so a too-deep study is a 413, never a wasted gang
+        turn); this validates only what the gang itself depends on.
+        """
+        from nm03_capstone_project_tpu.obs.trace import (
+            TraceContext,
+            new_trace_id,
+        )
+
+        depth = int(volume.shape[0])
+        self.padded_depth(depth, 1)  # raises past the largest bucket
+        req = VolumeRequest(
+            request_id=uuid.uuid4().hex[:12],
+            volume=volume,
+            dims=(int(dims[0]), int(dims[1])),
+            depth=depth,
+            trace=TraceContext(trace_id or new_trace_id()),
+        )
+        self.queue.put(req)  # raises QueueFull / QueueClosed
+        return req
+
+    # -- the gang loop -----------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            batch = self.queue.get_batch(1, 0.0)
+            if not batch:  # closed and empty: drain complete
+                return
+            req = batch[0]
+            try:
+                self._execute(req)
+            except BaseException as e:  # noqa: BLE001 — the loop must survive
+                if not req.done.is_set():
+                    req.fail(e)
+
+    def _fire_fault(self, seq: int, lanes: List[Optional[int]]):
+        """Consult the ``volume`` fault site; ``(blamed_lane, rule)`` or None.
+
+        One check per mesh lane so a ``lane``-selected rule fires exactly
+        when its lane is part of the dispatching mesh — the deterministic
+        "lane k dies mid-volume" drill. A rule with no lane selector
+        fires on the first check and reports no blame (an unattributable
+        mesh failure: the gang sheds rather than guess).
+        """
+        plan = self.fault_plan
+        if plan is None or not plan.has_site("volume"):
+            return None
+        for ln in lanes:
+            rule = plan.fire("volume", obs=self.obs, index=seq, lane=ln)
+            if rule is not None:
+                return (rule.lane, rule)
+        return None
+
+    def _execute(self, req: VolumeRequest) -> None:
+        now = time.monotonic()
+        req.queue_wait_s = max(now - req.t_admitted, 0.0)
+        if req.trace is not None:
+            popped = req.t_popped or now
+            req.trace.add_span("queue_wait", req.t_admitted, popped)
+        seq = next(self._seq)
+        t_gang0 = time.monotonic()
+        with self.batcher.gang_parked():
+            t_acquired = time.monotonic()
+            req.gang_wait_s = t_acquired - t_gang0
+            if req.trace is not None:
+                req.trace.add_span("volume_gang_acquire", t_gang0, t_acquired)
+            try:
+                self._dispatch_volume(req, seq)
+            except BaseException as e:  # noqa: BLE001 — per-request containment
+                req.fail(e)
+                return
+        req.done.set()
+
+    def _dispatch_volume(self, req: VolumeRequest, seq: int) -> None:
+        """Run the mesh program, re-meshing onto survivors on lane death."""
+        c = self.cfg.canvas
+        h, w = req.dims
+        excluded: set = set()
+        # one hop per lane the mesh started with, plus one: bounded even
+        # against pathological flapping
+        hops_left = len(self._device_pool()) + 1
+        while True:
+            hops_left -= 1
+            if hops_left < 0:
+                raise GangUnavailable(
+                    "volume request exhausted its re-mesh budget (lanes "
+                    "are flapping; see serving_lane_quarantines_total)"
+                )
+            full_pool = [
+                (ln, d) for ln, d in self._device_pool()
+                if ln not in excluded
+            ]
+            if not full_pool:
+                raise GangUnavailable(
+                    "no healthy lane left to build a volume mesh on"
+                )
+            full_lanes = [ln for ln, _ in full_pool]
+            n = self._usable_shards(len(full_pool), req.depth)
+            pool = full_pool[:n]
+            lanes = [ln for ln, _ in pool]
+            devices = [d for _, d in pool]
+            fn, padded, mesh = self._compiled(req.depth, devices)
+            # zero filler planes segment empty (normalize(0) lands outside
+            # the grow band) — the driver's own divisibility pad, extended
+            # to the bucket, so cropping [:depth] recovers the exact
+            # directly-driven mask
+            stack = np.zeros((padded, c, c), np.float32)
+            stack[: req.depth, :h, :w] = req.volume
+            injected = self._fire_fault(seq, lanes)
+            if injected is not None:
+                blamed, _rule = injected
+                if blamed is None or blamed not in lanes:
+                    raise GangUnavailable(
+                        "injected unattributable mesh failure "
+                        "(volume dispatch_error)"
+                    )
+                # the drill's deterministic lane death: book it through
+                # the real quarantine machine and re-mesh on the survivors
+                log.warning(
+                    "volume %s: injected death of lane %d mid-volume; "
+                    "re-meshing onto survivors", req.request_id, blamed,
+                )
+                self.executor.quarantine_lane(blamed, "device_lost")
+                excluded.add(blamed)
+                self._note_requeue(req, blamed, "injected_device_lost")
+                continue
+            vol_dev, dims_dev = self._stage(
+                stack, np.asarray([h, w], np.int32), mesh
+            )
+            sup = self.executor.new_supervisor()
+            trace = req.trace
+
+            def primary():
+                with trace.span("volume_dispatch", z_shards=len(devices)):
+                    out = fn(vol_dev, dims_dev)
+                with trace.span("volume_gather"):
+                    mask = np.asarray(out["mask"])  # nm03-lint: disable=NM321 the gather span MEASURES this mesh->host sync — that is its purpose
+                    conv = np.asarray(out["grow_converged"])  # nm03-lint: disable=NM321 same deliberate sync, see above
+
+                return mask, conv
+
+            try:
+                mask, conv = sup.run(
+                    primary, fallback=None, label="volume_dispatch"
+                )
+            except BaseException as e:  # noqa: BLE001 — classified below
+                cause = self._failure_cause(e)
+                if cause is None:
+                    raise  # deterministic failure: the requester's problem
+                survivors = [
+                    ln for ln, _ in self._device_pool()
+                    if ln not in excluded
+                ]
+                if survivors != full_lanes:
+                    # the fleet already booked a lane death (slice traffic
+                    # or the probe loop saw it): retry on the survivors
+                    log.warning(
+                        "volume %s: mesh dispatch failed (%s); re-meshing "
+                        "onto the surviving lanes", req.request_id, cause,
+                    )
+                    self._note_requeue(req, None, cause)
+                    continue
+                # unattributable with an unchanged fleet: shedding beats
+                # guessing which chip to blame — the client retries
+                raise GangUnavailable(
+                    f"mesh-wide volume dispatch failed ({cause}) with no "
+                    "attributable lane; retry after the fleet settles"
+                ) from e
+            req.mask = np.ascontiguousarray(mask[: req.depth, :h, :w])
+            req.converged = bool(np.asarray(conv))
+            req.z_shards = len(devices)
+            return
+
+    def _note_requeue(self, req: VolumeRequest, lane, cause: str) -> None:
+        req.requeues += 1
+        if req.trace is not None:
+            t = time.monotonic()
+            req.trace.add_span("volume_requeue", t, t, lane=lane, cause=cause)
+
+    @staticmethod
+    def _failure_cause(exc: BaseException) -> Optional[str]:
+        """Lane-fault classification, shared with the slice executor."""
+        from nm03_capstone_project_tpu.serving.executor import WarmExecutor
+
+        return WarmExecutor._quarantine_cause(exc)
+
+    # -- status ------------------------------------------------------------
+
+    def status(self) -> dict:
+        """The ``/readyz`` ``volumes`` block."""
+        return {
+            "enabled": True,
+            "depth_buckets": list(self.depth_buckets),
+            "max_depth": self.max_depth,
+            "z_shards": self.z_shards,
+            "queue_depth": len(self.queue),
+            "queue_capacity": self.queue.capacity,
+            "distributed": self.distributed,
+            # the published routing cost (ISSUE 15): what the fleet
+            # front-end weighs an unsized volume request by
+            "default_cost": self.default_cost,
+        }

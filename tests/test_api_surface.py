@@ -77,6 +77,24 @@ SURFACE = {
         "serve_in_thread",
         "RestartError",
     ],
+    # online serving incl. whole-volume gang serving (ISSUE 15): what
+    # docs/API.md's serving sections name
+    "nm03_capstone_project_tpu.serving": [
+        "ServingApp",
+        "AdmissionQueue",
+        "DynamicBatcher",
+        "WarmExecutor",
+        "VolumeGang",
+        "VolumeRequest",
+        "GangUnavailable",
+        "serve_in_thread",
+    ],
+    "nm03_capstone_project_tpu.serving.volumes": [
+        "VolumeGang",
+        "VolumeRequest",
+        "GangUnavailable",
+        "DEFAULT_VOLUME_DEPTH_BUCKETS",
+    ],
     "nm03_capstone_project_tpu.data.codecs": [
         "rle_encode_frame",
         "rle_decode_frame",

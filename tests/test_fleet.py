@@ -274,6 +274,7 @@ class FakeReplica:
         self.drop = False  # abort POST connections without a response
         self.canvas = None  # published request-size guards (None = omit)
         self.min_dim = None
+        self.volumes = None  # the ISSUE 15 volumes block (None = omit)
         self.requests = []
         self._lock = threading.Lock()
         fake = self
@@ -300,6 +301,7 @@ class FakeReplica:
                         "ready": True, "capacity": fake.capacity,
                         "queue_depth": 0, "queue_capacity": 64,
                         "canvas": fake.canvas, "min_dim": fake.min_dim,
+                        "volumes": fake.volumes,
                         "replica": {"id": fake.name, "pid": os.getpid()},
                         # the ISSUE 14 clock handshake: a fixed fake pair
                         # whose implied offset the router must record
@@ -443,6 +445,58 @@ class TestRouterProxy:
         # a shed is a reroute, not an ejection: backpressure != sickness
         assert app.replicas.state(a.url) == HEALTHY
         assert obs.registry.get("fleet_shed_total").value == 0
+
+    def test_volume_request_weighs_its_depth_in_wrr(self, two_fakes):
+        """ISSUE 15: a /v1/segment-volume proxy debits the picked replica
+        its declared depth's worth of WRR rounds — the following slice
+        picks all land on the OTHER replica until the debt amortizes."""
+        a, b = two_fakes
+        obs = _Obs()
+        app = self._app([a, b], obs)
+        vol_body = bytes(4 * 16 * 16 * 4)
+        vol_hdrs = {
+            "Content-Type": "application/octet-stream",
+            "X-Nm03-Depth": "4", "X-Nm03-Height": "16",
+            "X-Nm03-Width": "16",
+        }
+        assert app.volume_request_cost(vol_hdrs) == 4.0
+        status, data, _ = app.proxy_segment(
+            vol_body, vol_hdrs, path="/v1/segment-volume", cost=4.0
+        )
+        assert status == 200
+        volume_replica = json.loads(data)["replica"]
+        fakes = {a.label: a, b.label: b}
+        served_by = fakes[volume_replica]
+        other = b if served_by is a else a
+        # the volume reached the replica on the VOLUME endpoint
+        assert any(
+            r["path"].startswith("/v1/segment-volume")
+            for r in served_by.requests
+        )
+        # cost 4: the next 3 slice picks amortize the debt elsewhere
+        body, hdrs = _segment_body()
+        for _ in range(3):
+            _s, d2, _h = app.proxy_segment(body, hdrs)
+            assert json.loads(d2)["replica"] == other.label
+        # debt paid: traffic spreads again
+        picked = {
+            json.loads(app.proxy_segment(body, hdrs)[1])["replica"]
+            for _ in range(4)
+        }
+        assert volume_replica in picked
+
+    def test_unsized_volume_uses_published_cost(self, two_fakes):
+        """No X-Nm03-Depth: the WRR weighs the request by the largest
+        volume cost any replica published on /readyz (its smallest depth
+        bucket), floor 1.0 when nobody serves volumes."""
+        a, b = two_fakes
+        app = self._app([a, b])
+        assert app.volume_request_cost({}) == 1.0  # nobody publishes
+        a.volumes = {"enabled": True, "default_cost": 16,
+                     "depth_buckets": [16, 32]}
+        app._sweep()
+        assert app.volume_request_cost({}) == 16.0
+        assert app.volume_request_cost({"X-Nm03-Depth": "nonsense"}) == 16.0
 
     def test_fleet_wide_shed_propagates_retry_after(self, two_fakes):
         a, b = two_fakes
