@@ -81,6 +81,19 @@ STAGE_REPS = 48
 PROBE_TIMEOUT_S = 90
 PROBE_ATTEMPTS = 6
 PROBE_BACKOFF_S = 45
+
+# bench stage names -> the serving ledger's stage vocabulary
+# (obs.ledger.STAGES): the device_time_pie record and the perf baseline
+# speak the SAME stage names as serving_device_time_share, so check_perf
+# can compare a drill's pie against a bench-derived baseline key-by-key
+BENCH_STAGE_TO_LEDGER = {
+    "normalize_clip": "normalize",
+    "median7": "median7",
+    "sharpen": "sharpen",
+    "region_grow": "grow",
+    "cast_dilate": "morph",
+    "render": "render",
+}
 # Vigil probe backoff (r05 lesson: vigil probe 4 burned its full 90 s
 # timeout and the zshard section was then skipped for budget): each
 # consecutive vigil-probe TIMEOUT halves the next probe's timeout down to
@@ -887,6 +900,152 @@ def _stage_times(device, reps):
     }
 
 
+def _device_time_pie(prof: dict) -> dict:
+    """The serving ledger's pie, bench-side (ISSUE 16).
+
+    Normalizes each stage's batch-linear ``device_ms`` from the stage
+    matrix into a share under the ledger's stage names
+    (:data:`BENCH_STAGE_TO_LEDGER`) — the record-side twin of
+    ``serving_device_time_share``, so a round's artifact carries the same
+    pie nm03-top renders and check_perf gates. Checksum-gated like every
+    derived leg: the shares only count when the staged composition's mask
+    is bit-identical to the fused pipeline's mask on the same inputs (an
+    attribution of a *different* program is no attribution). Gated fields
+    are null on mismatch; ``checksum_ok`` is always present.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nm03_capstone_project_tpu.config import PipelineConfig
+    from nm03_capstone_project_tpu.core.image import valid_mask
+    from nm03_capstone_project_tpu.ops.elementwise import (
+        cast_uint8,
+        clip_intensity,
+        normalize,
+    )
+    from nm03_capstone_project_tpu.ops.morphology import dilate
+    from nm03_capstone_project_tpu.ops.neighborhood import extend_edges
+    from nm03_capstone_project_tpu.ops.pallas_median import median_filter
+    from nm03_capstone_project_tpu.ops.sharpen import sharpen
+    from nm03_capstone_project_tpu.pipeline.slice_pipeline import (
+        process_batch,
+        segment,
+    )
+
+    cfg = PipelineConfig()
+    pixels, dims = _make_batch(STAGE_SMALL_BATCH)
+
+    def staged(px, dm):
+        # the stage matrix's exact per-stage compositions, chained — what
+        # the pie attributes must be the program the pipeline serves
+        normed = jax.vmap(
+            lambda p, d: clip_intensity(
+                normalize(
+                    extend_edges(p, d),
+                    cfg.norm_low,
+                    cfg.norm_high,
+                    cfg.norm_intensity_min,
+                    cfg.norm_intensity_max,
+                ),
+                cfg.clip_low,
+                cfg.clip_high,
+            )
+        )(px, dm)
+        med = jax.vmap(
+            lambda p: median_filter(p, cfg.median_window, impl=cfg.median_impl)
+        )(normed)
+        pre = jax.vmap(
+            lambda p: sharpen(
+                p, cfg.sharpen_gain, cfg.sharpen_sigma, cfg.sharpen_kernel
+            )
+        )(med)
+        seg = jax.vmap(lambda p, d: segment(p, d, cfg)[0])(pre, dm)
+        return jax.vmap(
+            lambda s, d: dilate(cast_uint8(s), cfg.morph_size)
+            * valid_mask(d, s.shape[-2:]).astype(jnp.uint8)
+        )(seg, dm)
+
+    staged_sum = int(
+        np.asarray(_hub_jit(staged)(pixels, dims)).astype(np.int64).sum()
+    )
+    fused_sum = int(
+        np.asarray(
+            _hub_jit(lambda px, dm: process_batch(px, dm, cfg)["mask"])(
+                pixels, dims
+            )
+        ).astype(np.int64).sum()
+    )
+    checksum_ok = staged_sum == fused_sum
+
+    device_ms = {
+        name: float((prof["stages"].get(name) or {}).get("device_ms") or 0.0)
+        for name in BENCH_STAGE_TO_LEDGER
+    }
+    total = sum(device_ms.values())
+    shares = (
+        {
+            BENCH_STAGE_TO_LEDGER[k]: round(v / total, 4)
+            for k, v in device_ms.items()
+        }
+        if total > 0
+        else None
+    )
+    return {
+        "batch": BATCH,
+        "checksum_ok": checksum_ok,
+        "stage_share": shares if checksum_ok else None,
+        "device_seconds_per_slice": (
+            round(total / 1e3 / BATCH, 9)
+            if checksum_ok and total > 0
+            else None
+        ),
+    }
+
+
+def write_perf_baseline(
+    path: str, platform: str | None = None, reps: int = STAGE_REPS
+) -> int:
+    """Measure the stage matrix in-process and write a perf baseline.
+
+    The ``--write-perf-baseline`` mode: produces the committed
+    ``PERF_BASELINE.json`` that ``scripts/check_perf.py`` gates serving
+    drills against (schema ``nm03.perf_baseline.v1``). The bands are
+    deliberately wide — the tripwire exists to catch a stage silently
+    doubling or the per-request cost jumping an order of magnitude, not
+    to flake on run-to-run jitter of a shared CI host.
+    """
+    _pin_platform(platform)
+    import jax
+
+    dev = jax.devices()[0]
+    prof = _stage_times(dev, reps)
+    pie = _device_time_pie(prof)
+    if not pie["checksum_ok"]:
+        print(
+            "write-perf-baseline: staged/fused checksum MISMATCH — a "
+            "baseline of the wrong program gates nothing; refusing to write",
+            file=sys.stderr,
+        )
+        return 1
+    baseline = {
+        "schema": "nm03.perf_baseline.v1",
+        "device_kind": prof["device_kind"],
+        "batch": pie["batch"],
+        "device_seconds_per_slice": pie["device_seconds_per_slice"],
+        "stage_shares": pie["stage_share"],
+        "tolerance": {"device_seconds_rel": 4.0, "stage_share_abs": 0.25},
+        "min_share": 0.05,
+    }
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(baseline, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    print(f"wrote {path}", file=sys.stderr)
+    return 0
+
+
 def _pin_platform(platform: str | None):
     """Pin the backend before jax initializes (belt and braces: env is set by
     the parent, but a PJRT plugin loaded via sitecustomize may have re-pinned
@@ -1457,6 +1616,22 @@ def worker(
                     "hbm_peak_gbps": prof["hbm_peak_gbps"],
                 }
             )
+            # the ledger pie (ISSUE 16): the stage matrix renormalized
+            # under the serving stage names, checksum-gated — its OWN
+            # containment so a failed gate leg cannot mislabel the
+            # already-emitted stage matrix as skipped
+            try:
+                pie = _device_time_pie(prof)
+                emit({"device_time_pie": pie})
+                _log(
+                    f"device-time pie: {pie['stage_share']} "
+                    f"({pie['device_seconds_per_slice']} device-s/slice, "
+                    f"checksum "
+                    f"{'matches' if pie['checksum_ok'] else 'MISMATCH'})"
+                )
+            except Exception as e:  # noqa: BLE001
+                emit({"device_time_pie_error": f"{e!r:.500}"})
+                _log(f"device-time pie leg failed: {e!r:.500}")
         except Exception as e:  # noqa: BLE001 — never lose the headline number
             emit({"stages_error": f"{e!r:.500}"})
             _log(f"stage timing failed: {e!r:.500}")
@@ -1854,7 +2029,8 @@ def _copy_optional(out: dict, rec: dict) -> None:
                 "volume", "xla_scan_tput", "scan_chunk",
                 "scan_checksum_ok", "batch_note", "compile_cost",
                 "cold_start", "feed_stall", "feed_streamed",
-                "feed_streamed_by_batch", "streamed_batch_note"):
+                "feed_streamed_by_batch", "streamed_batch_note",
+                "device_time_pie"):
         if key in rec:
             out[key] = rec[key]
 
@@ -2080,7 +2256,11 @@ _SANITIZE = False
 # shed the evidence that a number was NOT measured on the chip)
 _SLIM_REQUIRED = ("metric", "value", "unit", "vs_baseline", "backend",
                   "backend_requested", "backend_actual", "wedge_observed",
-                  "mesh_shape", "lanes", "error", "detail")
+                  "mesh_shape", "lanes", "error", "detail",
+                  # the ledger pie rides the slim line (ISSUE 16): small
+                  # (~6 shares + one scalar), checksum-gated, and the
+                  # record-side anchor check_perf baselines come from
+                  "device_time_pie")
 
 
 def _slim_record(record: dict) -> dict:
@@ -2373,6 +2553,13 @@ if __name__ == "__main__":
         "(schema nm03.metrics.v1, docs/OBSERVABILITY.md)",
     )
     parser.add_argument(
+        "--write-perf-baseline", default=None, metavar="PATH",
+        help="measure the stage matrix in-process and write the perf "
+        "baseline scripts/check_perf.py gates against (schema "
+        "nm03.perf_baseline.v1; refuses to write on a staged/fused "
+        "checksum mismatch); standalone mode — no orchestrator run",
+    )
+    parser.add_argument(
         "--log-json", default=None,
         help="write structured orchestrator events here (bench phases, "
         "60 s heartbeat through the vigil; schema nm03.events.v1; one run "
@@ -2381,6 +2568,12 @@ if __name__ == "__main__":
     ns = parser.parse_args()
     _AS_SCRIPT = True
     _SANITIZE = ns.sanitize
+    if ns.write_perf_baseline:
+        raise SystemExit(
+            write_perf_baseline(
+                ns.write_perf_baseline, ns.platform, ns.reps
+            )
+        )
     if ns.probe:
         probe(ns.platform)
     elif ns.zshard_scaling:

@@ -1,0 +1,531 @@
+"""Device-time ledger: what every served request COSTS (ISSUE 16).
+
+The saturation layer (ISSUE 10) answers "how busy are the chips" and the
+SLO plane (ISSUE 14) answers "are we meeting objectives"; neither can say
+*where the device time goes* or *what one request costs*. This module is
+that missing attribution layer, three coupled planes over evidence the
+serving stack already produces:
+
+* **Per-request cost attribution** — the executor's per-dispatch busy
+  intervals, prorated across the riders of each padded chunk: real rows
+  charged to the ``request`` account, dead rows to ``padding``, fleet
+  probation canaries to ``probe`` (visible but excluded from the
+  per-request histogram, the PR 14 contract). The three accounts sum to
+  the executor's recorded busy time *exactly* — proration conserves.
+* **Live stage shares** — a cadence-driven sampler takes short
+  ``jax.profiler`` captures (through the one-at-a-time lock
+  ``utils.profiling`` already owns), reduces the device timeline into
+  per-stage self-time using the optimized HLO's ``source_file`` metadata
+  (fusions attributed by majority vote over their fused computation), and
+  publishes the r05 bench pie as ``serving_device_time_share{stage}`` —
+  live, on ``/metrics``.
+* **HBM ledger** — per-bucket executable memory analysis from the compile
+  hub's ``executable_cost``, published as
+  ``serving_executable_hbm_bytes{bucket,kind}`` at warmup.
+
+jax-free AND numpy-free at import by the obs package contract (NM301):
+the HLO text and the Chrome-trace JSON are both parsed with stdlib only,
+and the profiler capture function is injected (the serving layer hands in
+``utils.profiling.capture_profile``; tests hand in fakes). Thread-shared
+state is lock-guarded (NM331). Metric names live in :mod:`.metrics` so
+the NM392 metrics<->docs gate covers them.
+"""
+
+from __future__ import annotations
+
+import base64
+import collections
+import gzip
+import io
+import json
+import logging
+import re
+import threading
+import time
+import zipfile
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from nm03_capstone_project_tpu.obs.metrics import (
+    LEDGER_PROFILE_SKIPPED_TOTAL,
+    SERVING_DEVICE_SECONDS_PER_REQUEST,
+    SERVING_DEVICE_SECONDS_PER_REQUEST_MEAN,
+    SERVING_DEVICE_SECONDS_TOTAL,
+    SERVING_DEVICE_TIME_SHARE,
+    SERVING_EXECUTABLE_HBM_BYTES,
+)
+
+_log = logging.getLogger("nm03.ledger")
+
+# the three cost accounts every dispatched row lands in (and sums across)
+ACCOUNTS = ("request", "padding", "probe")
+
+# the serving pipeline's stage vocabulary — the same names the r05 bench
+# pie uses, plus "other" for device time no stage claims (infeed, copies,
+# glue the compiler didn't tag with a pipeline source file)
+STAGES = ("normalize", "median7", "sharpen", "grow", "morph", "render")
+
+# pipeline source-file basename fragments -> stage. The optimized HLO
+# carries ``source_file`` metadata per instruction; the fragment match is
+# on the basename so a refactor that moves ops/ around does not silently
+# retag the pie. Order matters only for documentation — fragments are
+# disjoint.
+STAGE_BY_FILE: Tuple[Tuple[str, str], ...] = (
+    ("median", "median7"),
+    ("sharpen", "sharpen"),
+    ("region_growing", "grow"),
+    ("seeds", "grow"),
+    ("morphology", "morph"),
+    ("elementwise", "normalize"),
+    ("neighborhood", "normalize"),
+    ("render", "render"),
+)
+
+# executable_cost() keys -> the {kind} label of serving_executable_hbm_bytes
+HBM_KINDS: Tuple[Tuple[str, str], ...] = (
+    ("argument_bytes", "argument"),
+    ("output_bytes", "output"),
+    ("temp_bytes", "temp"),
+    ("alias_bytes", "alias"),
+    ("code_bytes", "code"),
+    ("peak_hbm_bytes", "peak"),
+)
+
+# per-request device-seconds: sub-ms TPU rows up to tens of seconds of a
+# degraded CPU lane — much finer at the bottom than the latency buckets,
+# because a row's device share is latency divided by the batch size
+DEVICE_SECONDS_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+def stage_for_source(path: str) -> str:
+    """Stage owning one HLO ``source_file`` path ("other" if none does)."""
+    base = (path or "").replace("\\", "/").rsplit("/", 1)[-1]
+    for fragment, stage in STAGE_BY_FILE:
+        if fragment in base:
+            return stage
+    return "other"
+
+
+# computation headers start at column 0: "%fused_computation.1 (p: ...) ->"
+# or "ENTRY %main.42 (...) ->"
+_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?([A-Za-z0-9_.\-]+)\s*\(.*\)\s*->")
+_INST_RE = re.compile(r"%([A-Za-z0-9_.\-]+) = .*?source_file=\"([^\"]+)\"")
+_FUSION_RE = re.compile(
+    r"%([A-Za-z0-9_.\-]+) = .*? fusion\(.*?calls=%([A-Za-z0-9_.\-]+)"
+)
+
+
+def stage_map_from_hlo(hlo_text: str) -> Dict[str, str]:
+    """instruction name -> stage, from optimized HLO text.
+
+    Plain instructions are attributed by their own ``source_file``
+    metadata; ``fusion`` instructions by majority vote over the
+    instructions of the computation they call (a fused region spans ops
+    from several source lines — the vote picks the stage that contributed
+    most of its body, preferring any real stage over "other"). The map is
+    what the trace reducer joins device events against: profiler events
+    carry ``hlo_op`` names, not source files.
+    """
+    comp_counts: Dict[str, collections.Counter] = {}
+    fusions: List[Tuple[str, str]] = []
+    out: Dict[str, str] = {}
+    current: Optional[str] = None
+    for line in (hlo_text or "").splitlines():
+        if line and not line[0].isspace():
+            m = _COMP_RE.match(line)
+            if m:
+                current = m.group(1)
+                comp_counts.setdefault(current, collections.Counter())
+                continue
+        fm = _FUSION_RE.search(line)
+        im = _INST_RE.search(line)
+        if im:
+            name, src = im.group(1), im.group(2)
+            stage = stage_for_source(src)
+            if current is not None:
+                comp_counts[current][stage] += 1
+            if fm is None:
+                out[name] = stage
+        if fm:
+            fusions.append((fm.group(1), fm.group(2)))
+    for instr, called in fusions:
+        counts = comp_counts.get(called) or collections.Counter()
+        ranked = {s: c for s, c in counts.items() if s != "other"}
+        out[instr] = max(ranked, key=ranked.get) if ranked else "other"
+    return out
+
+
+def reduce_trace_events(
+    events: Iterable[dict], stage_of: Dict[str, str]
+) -> Dict[str, float]:
+    """Per-stage device SELF-time (seconds) from Chrome-trace events.
+
+    Considers only complete (``ph == "X"``) events carrying an ``hlo_op``
+    arg — the device op lanes; host-side thunk/executor events carry no
+    ``hlo_op`` and are excluded. Events nest on each (pid, tid) timeline
+    (a fusion's region contains its constituent ops), so durations are
+    reduced to self-time with an interval stack: a child's duration is
+    subtracted from its enclosing parent's stage, never double-counted.
+    """
+    per_thread: Dict[Tuple, List[Tuple[float, float, str]]] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args") or {}
+        op = args.get("hlo_op")
+        if not op:
+            continue
+        try:
+            ts = float(ev["ts"])
+            dur = float(ev.get("dur", 0.0))
+        except (KeyError, TypeError, ValueError):
+            continue
+        if dur <= 0:
+            continue
+        key = (ev.get("pid"), ev.get("tid"))
+        per_thread.setdefault(key, []).append((ts, dur, str(op).lstrip("%")))
+    stage_us: Dict[str, float] = collections.defaultdict(float)
+    for rows in per_thread.values():
+        # at equal start times the LONGER event is the parent: sort it first
+        rows.sort(key=lambda r: (r[0], -r[1]))
+        stack: List[Tuple[str, float]] = []  # (stage, end_ts)
+        for ts, dur, op in rows:
+            while stack and stack[-1][1] <= ts:
+                stack.pop()
+            stage = stage_of.get(op, "other")
+            stage_us[stage] += dur
+            if stack:
+                stage_us[stack[-1][0]] -= dur
+            stack.append((stage, ts + dur))
+    return {s: us / 1e6 for s, us in stage_us.items() if us > 1e-9}
+
+
+def trace_events_from_capture(capture: dict) -> List[dict]:
+    """Extract Chrome-trace events from a ``capture_profile`` result.
+
+    The capture zips the whole profiler directory; the ``*.trace.json.gz``
+    member inside is gzipped Chrome-trace JSON (stdlib all the way down).
+    Oversized captures kept server-side (``zip_dropped``) are read back
+    from ``zip_path``. Returns ``[]`` when no trace rode the capture.
+    """
+    data = None
+    if capture.get("zip_b64"):
+        data = base64.b64decode(capture["zip_b64"])
+    elif capture.get("zip_path"):
+        with open(capture["zip_path"], "rb") as f:
+            data = f.read()
+    if not data:
+        return []
+    events: List[dict] = []
+    with zipfile.ZipFile(io.BytesIO(data)) as zf:
+        for name in zf.namelist():
+            if name.endswith(".trace.json.gz"):
+                doc = json.loads(gzip.decompress(zf.read(name)))
+            elif name.endswith(".trace.json"):
+                doc = json.loads(zf.read(name))
+            else:
+                continue
+            events.extend(doc.get("traceEvents") or [])
+    return events
+
+
+class DeviceTimeLedger:
+    """Per-request device-time accounting + live stage shares + HBM ledger.
+
+    Fed by the executor (accumulated chunk busy seconds, warmup HLO text
+    and memory analysis), charged by the batcher per dispatched chunk
+    (:meth:`charge_chunk` prorates; :meth:`observe_request` lands each
+    non-probe rider's total in the histogram), sampled by a
+    :class:`ProfileSampler`, and read by :meth:`publish`/:meth:`snapshot`
+    on every scrape and once at drain — the same pull-refresh contract as
+    the SaturationMonitor. All shared state is lock-guarded (NM331).
+    """
+
+    def __init__(
+        self,
+        registry=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.registry = registry
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._accounts: Dict[str, float] = {a: 0.0 for a in ACCOUNTS}
+        self._request_count = 0
+        self._request_seconds = 0.0
+        self._stage_map: Dict[str, str] = {}
+        # cumulative reduced device seconds per stage across every sample:
+        # shares smooth over sampling jitter instead of flapping per trace
+        self._stage_seconds: Dict[str, float] = {}
+        self._samples_taken = 0
+        self._samples_skipped = 0
+        self._hbm: Dict[int, Dict[str, int]] = {}
+
+    # -- feeding (executor / batcher side) ---------------------------------
+
+    def charge_chunk(
+        self,
+        busy_s: float,
+        bucket_rows: int,
+        real_rows: int,
+        probe_rows: int = 0,
+    ) -> float:
+        """Prorate one chunk's device-busy seconds across its canvas rows.
+
+        ``bucket_rows`` is the padded canvas height the device actually
+        ran; ``real_rows`` the non-probe riders, ``probe_rows`` the fleet
+        probation canaries aboard. Every row costs the same share
+        (``busy_s / bucket_rows`` — the device computes padding as hard as
+        payload), so request + probe + padding always sums back to
+        ``busy_s`` exactly. Returns the per-row share the caller stamps on
+        each rider.
+        """
+        busy = max(float(busy_s), 0.0)
+        rows = max(int(bucket_rows), 1)
+        real = max(int(real_rows), 0)
+        probe = max(int(probe_rows), 0)
+        pad = max(rows - real - probe, 0)
+        share = busy / rows
+        with self._lock:
+            self._accounts["request"] += share * real
+            self._accounts["probe"] += share * probe
+            self._accounts["padding"] += share * pad
+        if self.registry is not None and busy > 0:
+            for account, amount in (
+                ("request", share * real),
+                ("probe", share * probe),
+                ("padding", share * pad),
+            ):
+                if amount > 0:
+                    self.registry.counter(
+                        SERVING_DEVICE_SECONDS_TOTAL,
+                        help="device-busy seconds by cost account: request "
+                        "(real riders), padding (dead canvas rows), probe "
+                        "(fleet probation canaries) — the three sum to the "
+                        "executor's recorded busy time",
+                        account=account,
+                    ).inc(amount)
+        return share
+
+    def observe_request(self, seconds: float) -> None:
+        """One finished NON-probe request's total device-seconds (its
+        prorated share, summed over every dispatch attempt it rode)."""
+        s = max(float(seconds), 0.0)
+        with self._lock:
+            self._request_count += 1
+            self._request_seconds += s
+        if self.registry is not None:
+            self.registry.histogram(
+                SERVING_DEVICE_SECONDS_PER_REQUEST,
+                help="prorated device-seconds each served request cost "
+                "(probe canaries excluded)",
+                buckets=DEVICE_SECONDS_BUCKETS,
+            ).observe(s)
+
+    def note_profile_skipped(self) -> None:
+        """The sampler yielded to a client capture (busy lock) — counted,
+        never queued (ISSUE 16 bugfix: a queued sample would stack behind
+        an operator's pull and fire at an arbitrary later moment)."""
+        with self._lock:
+            self._samples_skipped += 1
+        if self.registry is not None:
+            self.registry.counter(
+                LEDGER_PROFILE_SKIPPED_TOTAL,
+                help="ledger profile samples skipped because a client "
+                "GET /debug/profile capture held the profiler lock",
+            ).inc()
+
+    def ingest_hlo(self, hlo_text: str) -> int:
+        """Merge one executable's optimized-HLO stage map (warmup feed;
+        instruction names are unique enough across buckets that last-wins
+        merging is safe — colliding names map to the same stage)."""
+        mapping = stage_map_from_hlo(hlo_text)
+        with self._lock:
+            self._stage_map.update(mapping)
+        return len(mapping)
+
+    def set_bucket_hbm(self, bucket: int, cost: Optional[dict]) -> None:
+        """One bucket's executable memory analysis (``executable_cost``
+        output; best-effort — absent kinds are simply not published)."""
+        if not cost:
+            return
+        kinds = {
+            label: int(cost[key])
+            for key, label in HBM_KINDS
+            if isinstance(cost.get(key), (int, float))
+        }
+        if not kinds:
+            return
+        with self._lock:
+            self._hbm[int(bucket)] = kinds
+
+    def ingest_trace_events(self, events: Iterable[dict]) -> Dict[str, float]:
+        """Reduce one capture's events into stage self-time and fold it
+        into the cumulative shares; returns this sample's stage seconds."""
+        with self._lock:
+            stage_of = dict(self._stage_map)
+        sample = reduce_trace_events(events, stage_of)
+        with self._lock:
+            self._samples_taken += 1
+            for stage, s in sample.items():
+                self._stage_seconds[stage] = (
+                    self._stage_seconds.get(stage, 0.0) + s
+                )
+        return sample
+
+    def ingest_capture(self, capture: dict) -> Dict[str, float]:
+        """Full path for one ``capture_profile`` result: unzip, parse the
+        Chrome trace, reduce, accumulate."""
+        return self.ingest_trace_events(trace_events_from_capture(capture))
+
+    # -- reading (scrape / drain side) -------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            accounts = {a: round(v, 9) for a, v in self._accounts.items()}
+            total_stage = sum(self._stage_seconds.values())
+            shares = {
+                s: round(v / total_stage, 4)
+                for s, v in sorted(self._stage_seconds.items())
+                if total_stage > 0
+            }
+            # per-share rounding can overshoot the pie (sum 1.0001); the
+            # "shares sum to <= 1" contract is load-bearing (the
+            # --expect-gauge-sum-range gate), so shave the excess off the
+            # largest slice
+            excess = round(sum(shares.values()) - 1.0, 9)
+            if excess > 0:
+                top = max(shares, key=shares.get)
+                shares[top] = round(shares[top] - excess, 9)
+            stage_seconds = {
+                s: round(v, 6) for s, v in sorted(self._stage_seconds.items())
+            }
+            count, seconds = self._request_count, self._request_seconds
+            hbm = {b: dict(k) for b, k in sorted(self._hbm.items())}
+            taken, skipped = self._samples_taken, self._samples_skipped
+        return {
+            "accounts": accounts,
+            "device_seconds_total": round(sum(accounts.values()), 9),
+            "requests": {
+                "count": count,
+                "device_seconds_sum": round(seconds, 9),
+                "device_seconds_mean": (
+                    round(seconds / count, 9) if count else None
+                ),
+            },
+            "stage_shares": shares,
+            "stage_seconds": stage_seconds,
+            "profile_samples": {"taken": taken, "skipped": skipped},
+            "hbm_bytes": hbm,
+        }
+
+    def publish(self) -> dict:
+        """Refresh the ledger gauges from :meth:`snapshot`; returns it.
+
+        Counters and histograms land at feed time; this pushes the
+        derived gauges (stage shares, the per-request mean, the HBM
+        table) so every scrape and the drain snapshot carry them.
+        """
+        snap = self.snapshot()
+        reg = self.registry
+        if reg is None:
+            return snap
+        for stage, share in snap["stage_shares"].items():
+            reg.gauge(
+                SERVING_DEVICE_TIME_SHARE,
+                help="fraction of sampled device self-time spent in one "
+                "pipeline stage (profiler-sampled; shares sum to <= 1)",
+                stage=stage,
+            ).set(share)
+        mean = snap["requests"]["device_seconds_mean"]
+        if mean is not None:
+            reg.gauge(
+                SERVING_DEVICE_SECONDS_PER_REQUEST_MEAN,
+                help="mean prorated device-seconds per served request "
+                "(probe canaries excluded) — the gauge twin of the "
+                "histogram, for nm03-top and gauge-range gates",
+            ).set(mean)
+        for bucket, kinds in snap["hbm_bytes"].items():
+            for kind, nbytes in kinds.items():
+                reg.gauge(
+                    SERVING_EXECUTABLE_HBM_BYTES,
+                    help="per-bucket executable memory analysis from the "
+                    "compile hub: argument/output/temp/alias/code/peak "
+                    "bytes of each warm serving executable",
+                    bucket=str(bucket),
+                    kind=kind,
+                ).set(nbytes)
+        return snap
+
+
+class ProfileSampler:
+    """Cadence-driven stage-share sampler for one :class:`DeviceTimeLedger`.
+
+    Every ``interval_s`` it takes a short profiler capture through the
+    injected ``capture`` callable (the serving layer passes
+    ``utils.profiling.capture_profile``, which owns the process-global
+    one-at-a-time lock) and feeds the reduced trace to the ledger. When a
+    client ``GET /debug/profile`` pull holds the lock the sample is
+    SKIPPED and counted — never queued — so an operator's capture is
+    never contended and the sampler can never stack behind one
+    (the ISSUE 16 bugfix contract). Capture or reduction failures are
+    logged and swallowed: sampling must never take serving down.
+    """
+
+    def __init__(
+        self,
+        ledger: DeviceTimeLedger,
+        interval_s: float = 30.0,
+        duration_ms: int = 200,
+        capture: Optional[Callable[[int], dict]] = None,
+    ):
+        self.ledger = ledger
+        self.interval_s = float(interval_s)
+        self.duration_ms = int(duration_ms)
+        self._capture = capture
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sample_once(self) -> bool:
+        """One sample attempt; True when a trace landed in the ledger."""
+        capture = self._capture
+        if capture is None:
+            from nm03_capstone_project_tpu.utils.profiling import (
+                capture_profile as capture,
+            )
+        try:
+            result = capture(self.duration_ms)
+        except Exception as exc:
+            from nm03_capstone_project_tpu.utils.profiling import ProfileBusy
+
+            if isinstance(exc, ProfileBusy):
+                self.ledger.note_profile_skipped()
+            else:
+                _log.warning("ledger profile capture failed: %s", exc)
+            return False
+        try:
+            self.ledger.ingest_capture(result)
+        except Exception as exc:
+            _log.warning("ledger trace reduction failed: %s", exc)
+            return False
+        return True
+
+    def start(self) -> None:
+        if self.interval_s <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="ledger-sampler", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.sample_once()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            self._thread = None

@@ -112,6 +112,20 @@ SERVING_PADDING_WASTE_RATIO = "serving_padding_waste_ratio"
 SERVING_WINDOW_OCCUPANCY_RATIO = "serving_window_occupancy_ratio"
 SERVING_BATCH_ROWS_TOTAL = "serving_batch_rows_total"
 SERVING_BUCKET_FILL_RATIO = "serving_bucket_fill_ratio"
+# device-time ledger (obs.ledger, ISSUE 16): what each served request
+# COSTS — per-dispatch busy seconds prorated across chunk riders by cost
+# account, the profiler-sampled per-stage device-time pie, and the
+# per-bucket executable memory table. Defined HERE (not in
+# serving/metrics.py) for the same reason as the saturation names: the
+# ledger lives in jax-/numpy-free obs/ and obs must not import serving.
+SERVING_DEVICE_SECONDS_TOTAL = "serving_device_seconds_total"
+SERVING_DEVICE_SECONDS_PER_REQUEST = "serving_device_seconds_per_request"
+SERVING_DEVICE_SECONDS_PER_REQUEST_MEAN = (
+    "serving_device_seconds_per_request_mean"
+)
+SERVING_DEVICE_TIME_SHARE = "serving_device_time_share"
+SERVING_EXECUTABLE_HBM_BYTES = "serving_executable_hbm_bytes"
+LEDGER_PROFILE_SKIPPED_TOTAL = "ledger_profile_skipped_total"
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")

@@ -201,7 +201,10 @@ class ChunkTrace:
     ``riders`` requests on the lane's track.
     """
 
-    __slots__ = ("contexts", "lane", "trace_ids", "served_by_fallback")
+    __slots__ = (
+        "contexts", "lane", "trace_ids", "served_by_fallback",
+        "device_busy_s",
+    )
 
     def __init__(self, contexts: Iterable, lane: Optional[int] = None):
         self.contexts = [c for c in contexts if c is not None]
@@ -211,6 +214,11 @@ class ChunkTrace:
         # by the process-wide CPU fallback, on no lane — the batcher's
         # per-lane accounting must skip it
         self.served_by_fallback = False
+        # accumulated device-busy seconds across every dispatch ATTEMPT of
+        # this chunk (requeues included): WarmExecutor.run_batch adds each
+        # interval; the batcher's success path prorates the total across
+        # the chunk's riders into the device-time ledger (ISSUE 16)
+        self.device_busy_s = 0.0
 
     def mark(self, name: str, **fields) -> None:
         """Flight-recorder-only marker (no span): the in-flight evidence a

@@ -411,6 +411,22 @@ class DynamicBatcher:
                 self._stats["lane_batches"][lane_key] = (
                     self._stats["lane_batches"].get(lane_key, 0) + 1
                 )
+        # device-time ledger (ISSUE 16): prorate the chunk's accumulated
+        # busy seconds (every attempt, requeues included) across its canvas
+        # rows — real riders to the `request` account, fleet probation
+        # canaries to `probe`, dead rows to `padding`. The per-row share is
+        # each rider's cost; a fallback-served chunk accumulated no busy
+        # (it ran on no device lane), so its share is an honest 0.0.
+        ledger = getattr(self.executor, "ledger", None)
+        share = 0.0
+        if ledger is not None:
+            probes = sum(1 for r in reqs if getattr(r, "probe", False))
+            share = ledger.charge_chunk(
+                getattr(trace, "device_busy_s", 0.0),
+                int(pixels.shape[0]),
+                len(reqs) - probes,
+                probe_rows=probes,
+            )
         for i, r in enumerate(reqs):
             h, w = r.dims
             # run_batch already fetched host-side arrays inside the
@@ -422,6 +438,12 @@ class DynamicBatcher:
             # a fallback-served chunk ran on NO lane: the payload/header
             # report null, matching the lane accounting both series skip
             r.lane = lane if served_on_lane else None
+            # the rider's prorated device cost (echoed in the payload);
+            # probe canaries carry it too but are excluded from the
+            # per-request histogram below, the PR 14 contract
+            r.device_seconds = share
+            if ledger is not None and not getattr(r, "probe", False):
+                ledger.observe_request(share)
             r.done.set()
 
     def execute(self, reqs: List[ServeRequest]) -> None:

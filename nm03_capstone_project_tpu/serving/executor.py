@@ -116,6 +116,7 @@ class WarmExecutor:
         lanes: Optional[int] = None,
         lane_probe_interval_s: float = DEFAULT_LANE_PROBE_INTERVAL_S,
         saturation=None,
+        ledger=None,
     ):
         if not buckets or list(buckets) != sorted(set(int(b) for b in buckets)):
             raise ValueError(
@@ -139,6 +140,11 @@ class WarmExecutor:
         # 10): every supervised dispatch records its busy interval (+ the
         # executable's flops for MFU); None = no accounting (tests' fakes)
         self.saturation = saturation
+        # device-time ledger (obs.ledger.DeviceTimeLedger, ISSUE 16): fed
+        # the per-bucket HLO stage map + memory analysis at warmup; the
+        # batcher charges it per chunk from the busy seconds run_batch
+        # accumulates on the ChunkTrace. None = no attribution (fakes)
+        self.ledger = ledger
         self._fallback_fn = None
         self._lock = threading.Lock()
         self._dispatch_seq = itertools.count()
@@ -420,6 +426,22 @@ class WarmExecutor:
                     self.saturation.set_lane_bucket_flops(
                         lane, b, executable_cost(fn).get("flops")
                     )
+                if self.ledger is not None and lane == 0:
+                    # lane 0 only: every lane compiles the same program per
+                    # bucket, so one HLO parse / memory analysis per bucket
+                    # feeds the ledger's stage map and HBM table — N more
+                    # would be identical work
+                    try:
+                        from nm03_capstone_project_tpu.compilehub import (
+                            executable_cost,
+                        )
+
+                        self.ledger.set_bucket_hbm(b, executable_cost(fn))
+                        self.ledger.ingest_hlo(fn.as_text())
+                    except Exception:
+                        # attribution is best-effort evidence; a jaxlib
+                        # without as_text()/analysis must not fail warmup
+                        pass
             timings[f"lane{lane}"] = lane_t
             with self._lock:
                 self._lane_warm[lane] = True
@@ -771,6 +793,13 @@ class WarmExecutor:
                     lane, t_busy0, time.monotonic(), bucket=bucket,
                     counted=dispatched_ok,
                 )
+            if hasattr(trace, "device_busy_s"):
+                # accumulate onto the chunk's OWN trace (requeued attempts
+                # add up): the batcher's success path prorates the total
+                # into the device-time ledger. hasattr-gated like
+                # served_by_fallback — NULL_TRACE/TraceContext callers
+                # must be neither written nor crashed on
+                trace.device_busy_s += time.monotonic() - t_busy0
             if reg is not None:
                 inflight_g.dec()
             with self._lock:

@@ -159,6 +159,10 @@ class LoadResult:
         # and gang-waits from the /v1/segment-volume response payload
         self.zshards: collections.Counter = collections.Counter()
         self.gang_waits_s: List[float] = []
+        # device-cost attribution (ISSUE 16): each ok response's prorated
+        # `device_seconds` payload field — the client-side view of the
+        # serving_device_seconds_per_request histogram
+        self.device_seconds: List[float] = []
 
     def record(self, status: str, latency_s: float, batch_size: int = 0,
                error: str = "", sent_id: str = "", echoed_id: str = "",
@@ -167,7 +171,8 @@ class LoadResult:
                replica: Optional[str] = None,
                replica_hops: Optional[int] = None,
                z_shards: Optional[int] = None,
-               gang_wait_s: Optional[float] = None) -> None:
+               gang_wait_s: Optional[float] = None,
+               device_s: Optional[float] = None) -> None:
         with self._lock:
             self.statuses[status] += 1
             if status == "ok":
@@ -186,6 +191,8 @@ class LoadResult:
                     self.zshards[int(z_shards)] += 1
                 if gang_wait_s is not None:
                     self.gang_waits_s.append(gang_wait_s)
+                if device_s is not None:
+                    self.device_seconds.append(device_s)
             elif error and len(self.errors) < 20:
                 self.errors.append(error)
             if sent_id and echoed_id and sent_id != echoed_id:
@@ -211,6 +218,8 @@ class LoadResult:
                     rec["z_shards"] = int(z_shards)
                 if gang_wait_s is not None:
                     rec["gang_wait_ms"] = round(gang_wait_s * 1e3, 3)
+                if device_s is not None:
+                    rec["device_seconds"] = round(device_s, 9)
                 self.requests.append(rec)
             else:
                 # counted, not silent: a soak past the cap must say so in
@@ -275,6 +284,19 @@ class LoadResult:
                     "max": round(gw[-1] * 1e3, 3) if gw else 0.0,
                     "mean": round(sum(gw) / len(gw) * 1e3, 3) if gw else 0.0,
                 },
+            }
+        # device-cost evidence (ISSUE 16): the prorated device-seconds
+        # distribution clients were billed — the request-level view of
+        # serving_device_seconds_total{account="request"}. Milliseconds,
+        # like every other latency block in this summary.
+        if self.device_seconds:
+            ds = sorted(self.device_seconds)
+            out["device_seconds_ms"] = {
+                "p50": round(_percentile(ds, 50) * 1e3, 3),
+                "p95": round(_percentile(ds, 95) * 1e3, 3),
+                "mean": round(sum(ds) / len(ds) * 1e3, 3),
+                "max": round(ds[-1] * 1e3, 3),
+                "sum_s": round(sum(ds), 6),
             }
         out["trace_echo_mismatches"] = self.echo_mismatches
         if self.requests_dropped:
@@ -407,7 +429,7 @@ def _one_request(url: str, body: bytes, headers: dict, timeout_s: float,
                 or urllib.parse.urlsplit(url).netloc
             )
             hops = None
-            z_shards = gang_wait = None
+            z_shards = gang_wait = device_s = None
             try:
                 payload = json.loads(data)
                 if isinstance(payload, dict):
@@ -417,6 +439,8 @@ def _one_request(url: str, body: bytes, headers: dict, timeout_s: float,
                     # on /v1/segment-volume responses
                     z_shards = payload.get("z_shards")
                     gang_wait = payload.get("gang_wait_s")
+                    # prorated device cost (ISSUE 16)
+                    device_s = payload.get("device_seconds")
             except (json.JSONDecodeError, UnicodeDecodeError):
                 pass
             result.record(
@@ -424,6 +448,7 @@ def _one_request(url: str, body: bytes, headers: dict, timeout_s: float,
                 echoed_id=echoed, queue_wait_s=qw, lane=lane,
                 replica=replica, replica_hops=hops,
                 z_shards=z_shards, gang_wait_s=gang_wait,
+                device_s=device_s,
             )
     except urllib.error.HTTPError as e:
         echoed = e.headers.get("X-Nm03-Request-Id", "") if e.headers else ""
@@ -892,6 +917,13 @@ def main(argv=None) -> int:
             f"zshards={vb['zshards_observed']} "
             f"gang_wait_p95={vb['gang_wait_ms']['p95']}ms "
         )
+    ds_cols = ""
+    if summary.get("device_seconds_ms"):
+        db = summary["device_seconds_ms"]
+        ds_cols = (
+            f"device_seconds_p50={db['p50']}ms "
+            f"device_seconds_p95={db['p95']}ms "
+        )
     fleet_cols = ""
     if summary.get("targets") or summary["replicas"] is not None:
         # the fleet columns (ISSUE 13): printed on --targets runs and
@@ -911,6 +943,7 @@ def main(argv=None) -> int:
         f"busy_min={_pct(summary['busy_fraction_min_observed'])} "
         f"padding_max={_pct(summary['padding_waste_max_observed'])} "
         f"mfu_max={_pct(summary['mfu_max_observed'])} "
+        f"{ds_cols}"
         f"{vol_cols}"
         f"{fleet_cols}"
         f"echo_mismatch={summary['trace_echo_mismatches']}",

@@ -15,9 +15,15 @@ from __future__ import annotations
 # package — and re-exported here so serving-side callers keep one import
 # home for every serving series name (NM392 counts the definition site).
 from nm03_capstone_project_tpu.obs.metrics import (  # noqa: F401
+    LEDGER_PROFILE_SKIPPED_TOTAL,
     SERVING_BATCH_ROWS_TOTAL,
     SERVING_BUCKET_FILL_RATIO,
     SERVING_BUSY_FRACTION,
+    SERVING_DEVICE_SECONDS_PER_REQUEST,
+    SERVING_DEVICE_SECONDS_PER_REQUEST_MEAN,
+    SERVING_DEVICE_SECONDS_TOTAL,
+    SERVING_DEVICE_TIME_SHARE,
+    SERVING_EXECUTABLE_HBM_BYTES,
     SERVING_LANE_BUSY_FRACTION,
     SERVING_LANE_IDLE_GAP_SECONDS,
     SERVING_LANE_MFU,

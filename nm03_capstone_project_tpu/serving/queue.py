@@ -67,6 +67,10 @@ class ServeRequest:
     # normally, but excluded from request metrics and SLO accounting —
     # the canary cadence must not pollute the series the SLO layer reads
     probe: bool = False
+    # the rider's prorated device cost (ISSUE 16): its row's share of the
+    # chunk's accumulated device-busy seconds, stamped by the batcher and
+    # echoed in the response payload as `device_seconds`
+    device_seconds: float = 0.0
     error: Optional[BaseException] = None
     done: threading.Event = field(default_factory=threading.Event)
 

@@ -139,6 +139,8 @@ class ServingApp:
         volume_queue_capacity: int = 4,
         volume_timeout_s: float = 300.0,
         distributed_init: bool = False,
+        ledger_profile_interval_s: float = 0.0,
+        ledger_profile_ms: int = 200,
     ):
         from nm03_capstone_project_tpu.obs import RunContext
         from nm03_capstone_project_tpu.serving.executor import (
@@ -167,6 +169,24 @@ class ServingApp:
         from nm03_capstone_project_tpu.obs.saturation import SaturationMonitor
 
         self.saturation = SaturationMonitor(registry=self.obs.registry)
+        # device-time ledger (obs.ledger, ISSUE 16): per-request cost
+        # attribution, the live stage-share pie, and the per-bucket HBM
+        # table — fed by the executor/batcher, pull-refreshed on every
+        # scrape like the saturation monitor. The sampler thread takes
+        # short profiler captures on a cadence (0 = disabled, the
+        # in-process/test default; the CLI turns it on) and NEVER queues
+        # behind a client GET /debug/profile pull — it skips and counts.
+        from nm03_capstone_project_tpu.obs.ledger import (
+            DeviceTimeLedger,
+            ProfileSampler,
+        )
+
+        self.ledger = DeviceTimeLedger(registry=self.obs.registry)
+        self._ledger_sampler = ProfileSampler(
+            self.ledger,
+            interval_s=float(ledger_profile_interval_s),
+            duration_ms=int(ledger_profile_ms),
+        )
         # the SLO plane (ISSUE 14): burn rates/budget computed from the
         # request histogram/counters this app already maintains; created
         # only when an objective was declared, pull-refreshed on every
@@ -192,6 +212,7 @@ class ServingApp:
                 else DEFAULT_LANE_PROBE_INTERVAL_S
             ),
             saturation=self.saturation,
+            ledger=self.ledger,
         )
         self.batcher = DynamicBatcher(
             self.queue,
@@ -289,6 +310,11 @@ class ServingApp:
             SERVING_READY, help="1 = warmed and admitting, 0 otherwise"
         ).set(1)
         self._publish_compile_cost()
+        # warmup filled the ledger's HBM table and stage map: publish the
+        # per-bucket serving_executable_hbm_bytes gauges now, then start
+        # the cadence sampler (no-op at interval 0)
+        self.ledger.publish()
+        self._ledger_sampler.start()
         self.obs.events.emit(
             "serving_ready",
             buckets=list(self.executor.buckets),
@@ -448,6 +474,11 @@ class ServingApp:
             # refreshes the serving_* saturation gauges, so a /readyz
             # probe and a /metrics scrape can never disagree
             "saturation": self.saturation.publish(),
+            # the cost view (ISSUE 16): device-seconds by account, the
+            # sampled stage-share pie, per-bucket executable HBM —
+            # publish() refreshes the ledger gauges for the same
+            # never-disagree contract as the saturation block
+            "ledger": self.ledger.publish(),
             # the SLO verdict (ISSUE 14): burn rates + budget against the
             # declared objective (null when none was declared)
             "slo": self.slo.publish() if self.slo is not None else None,
@@ -503,6 +534,14 @@ class ServingApp:
             self.saturation.publish()
         except Exception as e:  # noqa: BLE001 — telemetry never blocks a drain
             log.warning("drain: saturation publish failed: %s", e)
+        # stop the ledger sampler first (a capture mid-drain would race
+        # the profiler teardown), then refresh the ledger gauges so the
+        # snapshot carries the run's final accounts/pie/HBM table
+        try:
+            self._ledger_sampler.stop()
+            self.ledger.publish()
+        except Exception as e:  # noqa: BLE001 — telemetry never blocks a drain
+            log.warning("drain: ledger publish failed: %s", e)
         if self.slo is not None:
             try:
                 self.slo.publish()  # the final SLO verdict rides the snapshot
@@ -693,6 +732,10 @@ class ServingApp:
             "lane": req.lane,
             # >0: the rider's chunk outlived a lane quarantine (re-dispatch)
             "requeues": req.requeues,
+            # what THIS request cost the device (ISSUE 16): its prorated
+            # row share of the chunk's busy seconds — 0.0 when the chunk
+            # was served by the CPU fallback (it ran on no device lane)
+            "device_seconds": round(req.device_seconds, 9),
             "degraded": self.executor.degraded,
             "mask_pixels": int(np.count_nonzero(req.mask)),
         }
@@ -1022,6 +1065,7 @@ def make_handler(app: ServingApp):
                 self._reply(200 if st["ready"] else 503, st)
             elif path == "/metrics":
                 app.saturation.publish()  # pull-refresh the sliding window
+                app.ledger.publish()  # pull-refresh the cost/pie gauges
                 if app.slo is not None:
                     app.slo.publish()  # pull-refresh the burn-rate windows
                 self._reply_text(
@@ -1029,6 +1073,7 @@ def make_handler(app: ServingApp):
                 )
             elif path == "/metrics.json":
                 app.saturation.publish()  # pull-refresh the sliding window
+                app.ledger.publish()  # pull-refresh the cost/pie gauges
                 if app.slo is not None:
                     app.slo.publish()  # pull-refresh the burn-rate windows
                 self._reply_text(
@@ -1419,6 +1464,26 @@ def build_parser() -> argparse.ArgumentParser:
         "and on an unhandled crash — docs/OPERATIONS.md post-mortem triage",
     )
     g.add_argument(
+        "--ledger-profile-interval-s",
+        type=float,
+        default=15.0,
+        metavar="S",
+        help="device-time ledger sampling cadence (ISSUE 16): every S "
+        "seconds a short on-device profile is captured and reduced into "
+        "the serving_device_time_share{stage} pie; 0 disables the "
+        "sampler (per-request device-seconds attribution still runs — "
+        "it costs nothing and needs no profiler)",
+    )
+    g.add_argument(
+        "--ledger-profile-ms",
+        type=int,
+        default=200,
+        metavar="MS",
+        help="duration of each ledger profile capture (short by design: "
+        "the sampler shares utils.profiling's one-capture-at-a-time lock "
+        "with GET /debug/profile and must never hold it long)",
+    )
+    g.add_argument(
         "--device",
         choices=["auto", "tpu", "cpu"],
         default="auto",
@@ -1478,6 +1543,10 @@ def app_from_args(args: argparse.Namespace, obs=None) -> ServingApp:
         volume_queue_capacity=getattr(args, "volume_queue_capacity", 4),
         volume_timeout_s=getattr(args, "volume_timeout_s", 300.0),
         distributed_init=getattr(args, "distributed_init", False),
+        ledger_profile_interval_s=getattr(
+            args, "ledger_profile_interval_s", 0.0
+        ),
+        ledger_profile_ms=getattr(args, "ledger_profile_ms", 200),
     )
 
 

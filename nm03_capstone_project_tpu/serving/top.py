@@ -43,6 +43,8 @@ from nm03_capstone_project_tpu.obs.metrics import (
 )
 from nm03_capstone_project_tpu.serving.metrics import (
     SERVING_BUSY_FRACTION,
+    SERVING_DEVICE_SECONDS_PER_REQUEST_MEAN,
+    SERVING_DEVICE_TIME_SHARE,
     SERVING_LANE_BUSY_FRACTION,
     SERVING_LANE_MFU,
     SERVING_MFU,
@@ -81,6 +83,35 @@ class Sample:
 
     def gauge(self, name: str, **labels) -> Optional[float]:
         return self.gauges.get((name, tuple(sorted(labels.items()))))
+
+
+def _pie_block(cur: "Sample") -> Optional[dict]:
+    """The device-time pie (ISSUE 16), or None when the scraped process
+    hasn't taken a profile sample yet (sampler off, or first cadence tick
+    still pending) — top shows the ledger's gauges, it never profiles."""
+    shares = {
+        labels[0][1]: v
+        for (name, labels), v in cur.gauges.items()
+        if name == SERVING_DEVICE_TIME_SHARE and labels
+    }
+    if not shares:
+        return None
+    return {k: round(v, 4) for k, v in shares.items()}
+
+
+def _pie_line(
+    shares: Optional[dict], ds_per_req: Optional[float]
+) -> Optional[str]:
+    if shares is None and ds_per_req is None:
+        return None
+    parts = ["device pie"]
+    for stage, v in sorted(
+        (shares or {}).items(), key=lambda kv: -kv[1]
+    ):
+        parts.append(f"{stage} {_fmt(v, pct=True).strip()}")
+    if ds_per_req is not None:
+        parts.append(f"ds/req {ds_per_req * 1000:.3g}ms")
+    return "   ".join(parts)
 
 
 def _slo_block(cur: "Sample") -> Optional[dict]:
@@ -201,6 +232,13 @@ def build_view(cur: Sample, prev: Optional[Sample] = None) -> dict:
         # the SLO row (ISSUE 14): burn rates + budget when the scraped
         # process declared an objective, null otherwise
         "slo": _slo_block(cur),
+        # the device-time pie (ISSUE 16): per-stage shares of sampled
+        # device time + mean prorated device-seconds per request — null
+        # until the ledger's profile sampler has reduced a capture
+        "device_time_share": _pie_block(cur),
+        "device_seconds_per_request": cur.gauge(
+            SERVING_DEVICE_SECONDS_PER_REQUEST_MEAN
+        ),
         # rates from counter deltas between polls (null on the first poll
         # and in --once mode: one sample has no delta)
         "rates_per_s": {
@@ -263,6 +301,11 @@ def render_text(view: dict, url: str) -> str:
                 f"{_fmt(ing['upload_overlap_ratio'], pct=True).strip()}"
             ),
         )
+    pie_line = _pie_line(
+        view.get("device_time_share"), view.get("device_seconds_per_request")
+    )
+    if pie_line is not None:
+        lines.insert(3, pie_line)
     slo_line = _slo_line(view.get("slo"))
     if slo_line is not None:
         lines.insert(3, slo_line)
@@ -339,6 +382,10 @@ def build_fleet_view(
                 s.gauge(SERVING_BUSY_FRACTION) if s is not None else None
             ),
             "mfu": s.gauge(SERVING_MFU) if s is not None else None,
+            "device_seconds_per_request": (
+                s.gauge(SERVING_DEVICE_SECONDS_PER_REQUEST_MEAN)
+                if s is not None else None
+            ),
             "requests_per_s": (
                 _rate(s, ps, SERVING_REQUESTS_TOTAL)
                 if s is not None and ps is not None else None
@@ -346,6 +393,21 @@ def build_fleet_view(
             "id": (entry.get("identity") or {}).get("id"),
             "pid": (entry.get("identity") or {}).get("pid"),
         })
+    # the fleet pie (ISSUE 16): each stage's share averaged across the
+    # replicas that have sampled one — the fleet-wide "where do the
+    # device-seconds go" answer, null until any replica has a pie
+    pies = [p for p in (
+        _pie_block(s) for s in per.values() if s is not None
+    ) if p]
+    fleet_pie: Optional[dict] = None
+    if pies:
+        stages = sorted({k for p in pies for k in p})
+        fleet_pie = {
+            st: round(
+                sum(p.get(st, 0.0) for p in pies) / len(pies), 4
+            )
+            for st in stages
+        }
     return {
         "schema": "nm03.fleettop.v1",
         "ready": st.get("ready"),
@@ -357,6 +419,7 @@ def build_fleet_view(
         # the fleet-level SLO row (ISSUE 14): the ROUTER's own burn
         # gauges — the whole-fleet verdict, not any one replica's
         "slo": _slo_block(fleet),
+        "device_time_share": fleet_pie,
         "replicas": rows,
         "rates_per_s": {
             "routed": _rate(fleet, prev_fleet, FLEET_REQUESTS_ROUTED_TOTAL),
@@ -390,12 +453,18 @@ def render_fleet_text(view: dict, url: str) -> str:
         ),
         "",
         f"{'replica':<22} {'state':<10} {'cap':>6} {'lanes':>5} "
-        f"{'queue':>5} {'busy':>8} {'mfu':>8} {'req/s':>7} {'eject':>5}",
+        f"{'queue':>5} {'busy':>8} {'mfu':>8} {'req/s':>7} "
+        f"{'ds/req':>8} {'eject':>5}",
     ]
+    pie_line = _pie_line(view.get("device_time_share"), None)
+    if pie_line is not None:
+        lines.insert(2, pie_line)
     slo_line = _slo_line(view.get("slo"))
     if slo_line is not None:
         lines.insert(2, slo_line)
     for row in view["replicas"]:
+        dsr = row["device_seconds_per_request"]
+        dsr_s = "-" if dsr is None else f"{dsr * 1000:.3g}ms"
         lines.append(
             f"{str(row['replica']):<22} {str(row['state']):<10} "
             f"{_fmt(row['capacity'], pct=True, width=6)} "
@@ -404,6 +473,7 @@ def render_fleet_text(view: dict, url: str) -> str:
             f"{_fmt(row['busy_fraction'], pct=True, width=8)} "
             f"{_fmt(row['mfu'], pct=True, width=8)} "
             f"{str(row['requests_per_s'] if row['requests_per_s'] is not None else '-'):>7} "
+            f"{dsr_s:>8} "
             f"{str(row['ejections']):>5}"
         )
     if not view["replicas"]:

@@ -1,0 +1,279 @@
+#!/usr/bin/env python
+"""Perf-regression tripwires over a serving metrics snapshot (ISSUE 16).
+
+Joins a replica's post-drain metrics snapshot (``--metrics``, schema
+``nm03.metrics.v1``) against a committed perf baseline (``--baseline``,
+schema ``nm03.perf_baseline.v1``, written by ``bench.py
+--write-perf-baseline`` or ``--write-baseline`` below) and exits non-zero
+when the run's device-time ledger drifted outside the baseline's tolerance
+bands. The last mile of the ledger: the per-request device-seconds
+histogram and the stage-share pie are live observability; this script is
+what makes them a GATE — a stage that silently doubled, or a per-request
+cost that jumped an order of magnitude, fails the drill instead of
+scrolling past on a dashboard.
+
+Usage:
+    python scripts/check_perf.py --metrics m.json --baseline PERF_BASELINE.json
+    python scripts/check_perf.py --metrics m.json --write-baseline PERF_BASELINE.json
+
+Checked tripwires (each prints ``PERF DRIFT <where>: <msg>`` on failure):
+
+* **per-request device cost** — the observed mean of the
+  ``serving_device_seconds_per_request`` histogram (sum/count) against the
+  baseline's ``device_seconds_per_slice``, as a RATIO band: fail when
+  observed > baseline * (1 + device_seconds_rel) or observed <
+  baseline / (1 + device_seconds_rel). Relative and symmetric in
+  log-space, because device-seconds swing with host load — the band is
+  wide by design (an order-of-magnitude tripwire, not a jitter alarm),
+  and "suspiciously fast" trips too: a 10x drop means the ledger stopped
+  measuring, not that the code got 10x faster.
+* **stage shares** — each ``serving_device_time_share{stage}`` gauge
+  against the baseline's ``stage_shares[stage]``, as an ABSOLUTE band:
+  fail when |observed - baseline| > stage_share_abs. Only stages whose
+  baseline share >= ``min_share`` are gated — a 0.4% stage's share is
+  noise, and gating it would flake; shares are already normalized so the
+  absolute band is scale-free.
+* **presence** — a baseline with stage shares requires the snapshot to
+  carry the share gauges at all (a run whose sampler never fired gates
+  nothing, and must say so rather than pass vacuously). The histogram
+  tripwire is likewise only vacuous when the baseline carries no
+  ``device_seconds_per_slice``.
+
+``--write-baseline PATH`` derives a fresh baseline FROM the snapshot
+instead of checking it (observed mean + observed shares + default bands)
+— the re-pin workflow after an intentional perf change, from the same
+artifact the failing gate read.
+
+Exit codes: 0 ok, 1 perf drift, 2 usage/unreadable artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+SCHEMA_METRICS = "nm03.metrics.v1"
+SCHEMA_BASELINE = "nm03.perf_baseline.v1"
+
+DEVICE_SECONDS_HIST = "serving_device_seconds_per_request"
+STAGE_SHARE_GAUGE = "serving_device_time_share"
+
+# bands a --write-baseline re-pin starts from (wide by design: tripwire,
+# not jitter alarm — see the module docstring)
+DEFAULT_DEVICE_SECONDS_REL = 4.0
+DEFAULT_STAGE_SHARE_ABS = 0.25
+DEFAULT_MIN_SHARE = 0.05
+
+
+def _load_json(path: str, what: str):
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"check_perf: {what} {path} unreadable: {e}", file=sys.stderr)
+        return None
+    if not isinstance(doc, dict):
+        print(f"check_perf: {what} {path} is not a JSON object",
+              file=sys.stderr)
+        return None
+    return doc
+
+
+def observed_from_snapshot(snap: dict) -> dict:
+    """The ledger evidence inside one metrics snapshot.
+
+    Returns ``{"device_seconds_mean": float|None, "request_count": int,
+    "stage_shares": {stage: value}}`` — the mean from the per-request
+    histogram's sum/count (None until any request was observed), the
+    shares from the pie gauges (empty until the sampler reduced a
+    capture).
+    """
+    mean = None
+    count = 0
+    shares: dict = {}
+    for rec in snap.get("metrics") or []:
+        if not isinstance(rec, dict):
+            continue
+        name, kind = rec.get("name"), rec.get("type")
+        if name == DEVICE_SECONDS_HIST and kind == "histogram":
+            c = rec.get("count")
+            s = rec.get("sum")
+            if isinstance(c, (int, float)) and isinstance(s, (int, float)):
+                count += int(c)
+                if c:
+                    mean = (0.0 if mean is None else mean) + float(s)
+        elif name == STAGE_SHARE_GAUGE and kind == "gauge":
+            stage = (rec.get("labels") or {}).get("stage")
+            v = rec.get("value")
+            if stage and isinstance(v, (int, float)):
+                shares[str(stage)] = float(v)
+    if mean is not None and count:
+        mean = mean / count
+    return {
+        "device_seconds_mean": mean,
+        "request_count": count,
+        "stage_shares": shares,
+    }
+
+
+def check(baseline: dict, observed: dict) -> list:
+    """The tripwire verdicts; returns the list of drift messages."""
+    problems: list = []
+    tol = baseline.get("tolerance") or {}
+    rel = float(tol.get("device_seconds_rel", DEFAULT_DEVICE_SECONDS_REL))
+    abs_band = float(tol.get("stage_share_abs", DEFAULT_STAGE_SHARE_ABS))
+    min_share = float(baseline.get("min_share", DEFAULT_MIN_SHARE))
+
+    base_ds = baseline.get("device_seconds_per_slice")
+    obs_ds = observed.get("device_seconds_mean")
+    if isinstance(base_ds, (int, float)) and base_ds > 0:
+        if obs_ds is None:
+            problems.append(
+                "device_seconds: no serving_device_seconds_per_request "
+                "observations in the snapshot — the ledger never charged a "
+                "request, nothing to gate (did the drill serve traffic?)"
+            )
+        else:
+            ratio = obs_ds / float(base_ds)
+            hi = 1.0 + rel
+            lo = 1.0 / (1.0 + rel)
+            if ratio > hi or ratio < lo:
+                problems.append(
+                    f"device_seconds: observed mean {obs_ds:.6g}s/request is "
+                    f"{ratio:.3g}x the baseline {base_ds:.6g}s/slice, "
+                    f"outside [{lo:.3g}x..{hi:.3g}x] "
+                    f"(device_seconds_rel={rel:g})"
+                )
+
+    base_shares = baseline.get("stage_shares") or {}
+    gated = {
+        st: float(v) for st, v in base_shares.items()
+        if isinstance(v, (int, float)) and v >= min_share
+    }
+    obs_shares = observed.get("stage_shares") or {}
+    if gated and not obs_shares:
+        problems.append(
+            f"stage_shares: baseline gates {sorted(gated)} but the snapshot "
+            f"carries no {STAGE_SHARE_GAUGE} series — the profile sampler "
+            "never reduced a capture (sampler off, or the drill outpaced "
+            "its first cadence tick)"
+        )
+    elif obs_shares:
+        for st, want in sorted(gated.items()):
+            got = obs_shares.get(st, 0.0)
+            if abs(got - want) > abs_band:
+                problems.append(
+                    f"stage_shares[{st}]: observed {got:.4f} vs baseline "
+                    f"{want:.4f}, |delta| {abs(got - want):.4f} > "
+                    f"stage_share_abs {abs_band:g}"
+                )
+    return problems
+
+
+def write_baseline(path: str, observed: dict, device_kind: str) -> int:
+    """Derive and atomically write a fresh baseline from a snapshot."""
+    if observed["device_seconds_mean"] is None and not observed["stage_shares"]:
+        print(
+            "check_perf: snapshot carries neither per-request histogram "
+            "observations nor stage-share gauges — nothing to baseline",
+            file=sys.stderr,
+        )
+        return 2
+    baseline = {
+        "schema": SCHEMA_BASELINE,
+        "device_kind": device_kind,
+        "device_seconds_per_slice": (
+            None if observed["device_seconds_mean"] is None
+            else round(observed["device_seconds_mean"], 9)
+        ),
+        "stage_shares": {
+            st: round(v, 4)
+            for st, v in sorted(observed["stage_shares"].items())
+        },
+        "tolerance": {
+            "device_seconds_rel": DEFAULT_DEVICE_SECONDS_REL,
+            "stage_share_abs": DEFAULT_STAGE_SHARE_ABS,
+        },
+        "min_share": DEFAULT_MIN_SHARE,
+    }
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(baseline, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    print(f"check_perf: wrote {path}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--metrics", required=True,
+        help="metrics snapshot JSON (nm03.metrics.v1) to gate — a serving "
+        "drill's post-drain --metrics-out artifact",
+    )
+    ap.add_argument(
+        "--baseline", default=None,
+        help="committed perf baseline (nm03.perf_baseline.v1) to gate "
+        "against",
+    )
+    ap.add_argument(
+        "--write-baseline", default=None, metavar="PATH",
+        help="derive a fresh baseline FROM the snapshot and write it here "
+        "instead of checking (the re-pin workflow after an intentional "
+        "perf change)",
+    )
+    ap.add_argument(
+        "--device-kind", default="unknown",
+        help="device_kind stamped into a --write-baseline output "
+        "(snapshots don't carry it; bench-derived baselines do)",
+    )
+    args = ap.parse_args(argv)
+    if bool(args.baseline) == bool(args.write_baseline):
+        ap.error("pass exactly one of --baseline / --write-baseline")
+
+    snap = _load_json(args.metrics, "metrics snapshot")
+    if snap is None:
+        return 2
+    if snap.get("schema") != SCHEMA_METRICS:
+        print(
+            f"check_perf: {args.metrics} schema {snap.get('schema')!r} != "
+            f"{SCHEMA_METRICS!r}",
+            file=sys.stderr,
+        )
+        return 2
+    observed = observed_from_snapshot(snap)
+
+    if args.write_baseline:
+        return write_baseline(args.write_baseline, observed, args.device_kind)
+
+    baseline = _load_json(args.baseline, "baseline")
+    if baseline is None:
+        return 2
+    if baseline.get("schema") != SCHEMA_BASELINE:
+        print(
+            f"check_perf: {args.baseline} schema {baseline.get('schema')!r} "
+            f"!= {SCHEMA_BASELINE!r}",
+            file=sys.stderr,
+        )
+        return 2
+
+    problems = check(baseline, observed)
+    for p in problems:
+        print(f"PERF DRIFT {p}", file=sys.stderr)
+    if problems:
+        print(f"check_perf: {len(problems)} drift(s) vs {args.baseline}",
+              file=sys.stderr)
+        return 1
+    print(
+        f"check_perf: OK ({args.metrics} vs {args.baseline}: "
+        f"{observed['request_count']} requests, "
+        f"{len(observed['stage_shares'])} stage shares)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

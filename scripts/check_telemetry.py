@@ -72,7 +72,13 @@ metrics
     ``'serving_lane_busy_fraction=(0..1]'`` asserts every lane did real
     work (one idle lane fails), ``'serving_padding_waste_ratio=[0..1)'``
     that padding stayed sane — property assertions that cannot flake on
-    exact values.
+    exact values;
+  * ``--expect-gauge-sum-range NAME=LO..HI`` (repeatable) requires the
+    SUM of every matching gauge series to lie in the range — the
+    partition-of-a-whole complement of the per-series form. The ledger
+    hook (ISSUE 16): ``'serving_device_time_share=(0..1]'`` asserts the
+    stage shares form a pie (each share alone says nothing about the
+    total).
 
 trace (``--expect-trace FILE``)
   * FILE is a Chrome/Perfetto ``trace_event`` export (``nm03-trace``
@@ -353,7 +359,7 @@ def _check_histogram(where: str, rec: dict, chk: Checker) -> None:
 
 def check_metrics(path: str, chk: Checker, expect_counters=None,
                   expect_histograms=None, expect_gauges=None,
-                  expect_gauge_ranges=None):
+                  expect_gauge_ranges=None, expect_gauge_sum_ranges=None):
     """Validate one metrics snapshot; returns (run_id, git_sha) or None.
 
     ``expect_counters``: {name: min_total | (value, exact)} — the summed
@@ -371,6 +377,12 @@ def check_metrics(path: str, chk: Checker, expect_counters=None,
     series must match. ``serving_lane_busy_fraction=(0..1]`` therefore
     asserts every lane worked — one idle lane fails the gate
     (saturation-drill assertions, ISSUE 10).
+    ``expect_gauge_sum_ranges``: {selector: range} — the SUM of every gauge
+    series matching the selector must lie in the range (at least one
+    series must match). The complement of the per-series form for gauges
+    that partition a whole: ``serving_device_time_share=(0..1]`` asserts
+    the stage shares are a pie — each share alone says nothing about the
+    total (ledger assertions, ISSUE 16).
     """
     try:
         with open(path) as f:
@@ -529,6 +541,38 @@ def check_metrics(path: str, chk: Checker, expect_counters=None,
                     f"gauge {name}{lbls or ''} = {v}, expected in "
                     f"{_render_range(rng)}",
                 )
+    for spec, rng in sorted((expect_gauge_sum_ranges or {}).items()):
+        try:
+            name, sel = parse_selector(spec)
+        except ValueError as e:
+            chk.fail(path, str(e))
+            continue
+        if name not in gauge_series:
+            kind = kind_by_name.get(name)
+            if kind is not None and kind != "gauge":
+                chk.fail(path, f"{name} is a {kind}, not a gauge")
+            else:
+                chk.fail(
+                    path,
+                    f"gauge {spec} absent, expected sum in "
+                    f"{_render_range(rng)}",
+                )
+            continue
+        matched = _select(gauge_series[name], sel)
+        if not matched:
+            chk.fail(
+                path,
+                f"gauge {spec}: no series matches, expected sum in "
+                f"{_render_range(rng)}",
+            )
+            continue
+        got = sum(matched)
+        if not _in_range(got, rng):
+            chk.fail(
+                path,
+                f"gauge {spec} sums to {got:g} over {len(matched)} "
+                f"series, expected in {_render_range(rng)}",
+            )
     for name, want in sorted((expect_histograms or {}).items()):
         if name not in histogram_counts and kind_by_name.get(name) is not None:
             chk.fail(path, f"{name} is a {kind_by_name[name]}, not a histogram")
@@ -734,6 +778,15 @@ def main(argv=None) -> int:
         "'serving_padding_waste_ratio=[0..1)')",
     )
     ap.add_argument(
+        "--expect-gauge-sum-range", action="append", default=[],
+        metavar="NAME=LO..HI",
+        help="require the SUM of every gauge series matching NAME to lie "
+        "in the range — the partition-of-a-whole complement of "
+        "--expect-gauge-range (repeatable; ledger assertions, e.g. "
+        "'serving_device_time_share=(0..1]' = the stage shares are a "
+        "pie, ISSUE 16)",
+    )
+    ap.add_argument(
         "--expect-trace", action="append", default=[], metavar="FILE",
         help="validate a Perfetto/Chrome trace_event export (nm03-trace "
         "output): non-empty, monotonic ts, matched B/E pairs, every "
@@ -797,16 +850,25 @@ def main(argv=None) -> int:
     expect_gauges = parse_expectations(
         args.expect_gauge, "--expect-gauge", labeled=True
     )
-    expect_gauge_ranges = {}
-    for spec in args.expect_gauge_range:
-        sel, _, val = spec.rpartition("=")
-        try:
-            parse_selector(sel)
-            expect_gauge_ranges[sel] = parse_range(val)
-        except ValueError as e:
-            ap.error(f"--expect-gauge-range: {e}")
-    if expect_gauge_ranges and not args.metrics:
-        ap.error("--expect-gauge-range needs --metrics")
+    def parse_range_expectations(specs: list, flag: str) -> dict:
+        out = {}
+        for spec in specs:
+            sel, _, val = spec.rpartition("=")
+            try:
+                parse_selector(sel)
+                out[sel] = parse_range(val)
+            except ValueError as e:
+                ap.error(f"{flag}: {e}")
+        if out and not args.metrics:
+            ap.error(f"{flag} needs --metrics")
+        return out
+
+    expect_gauge_ranges = parse_range_expectations(
+        args.expect_gauge_range, "--expect-gauge-range"
+    )
+    expect_gauge_sum_ranges = parse_range_expectations(
+        args.expect_gauge_sum_range, "--expect-gauge-sum-range"
+    )
 
     chk = Checker()
     ev_ident = mt_ident = None
@@ -815,7 +877,7 @@ def main(argv=None) -> int:
     if args.metrics:
         mt_ident = check_metrics(
             args.metrics, chk, expect_counters, expect_histograms,
-            expect_gauges, expect_gauge_ranges,
+            expect_gauges, expect_gauge_ranges, expect_gauge_sum_ranges,
         )
     for trace_path in args.expect_trace:
         check_trace(trace_path, chk)

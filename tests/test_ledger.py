@@ -1,0 +1,873 @@
+"""Device-time ledger tests (ISSUE 16).
+
+Unit layer: proration conservation (the three accounts sum to the
+executor's busy time exactly), HLO stage-map extraction with fusion
+majority vote, Chrome-trace self-time reduction, capture round-trip, and
+the ProfileSampler's never-collide-with-a-client-capture contract (the
+bugfix regression: a busy profiler lock SKIPS and counts, never queues).
+
+Integration layer: the batcher's charge site stamps every rider's
+prorated share and excludes probe canaries from the histogram.
+
+CLI layer: scripts/check_perf.py red/green at both the parse layer (bad
+schema/usage -> 2) and the verdict layer (drift -> 1), plus the new
+check_telemetry --expect-gauge-sum-range gate.
+
+Acceptance: a live --lanes 4 drill whose post-drain snapshot passes the
+ledger gates (request account charged, shares a pie, per-request
+histogram observed) and whose artifact check_perf both baselines and
+re-gates green/red.
+"""
+
+from __future__ import annotations
+
+import base64
+import gzip
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+import zipfile
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from nm03_capstone_project_tpu.obs.ledger import (
+    DeviceTimeLedger,
+    ProfileSampler,
+    reduce_trace_events,
+    stage_for_source,
+    stage_map_from_hlo,
+    trace_events_from_capture,
+)
+from nm03_capstone_project_tpu.obs.metrics import (
+    LEDGER_PROFILE_SKIPPED_TOTAL,
+    MetricsRegistry,
+    SERVING_DEVICE_SECONDS_PER_REQUEST,
+    SERVING_DEVICE_SECONDS_PER_REQUEST_MEAN,
+    SERVING_DEVICE_SECONDS_TOTAL,
+    SERVING_DEVICE_TIME_SHARE,
+    SERVING_EXECUTABLE_HBM_BYTES,
+)
+from nm03_capstone_project_tpu.serving.batcher import DynamicBatcher
+from nm03_capstone_project_tpu.serving.queue import AdmissionQueue, ServeRequest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECKER = os.path.join(REPO, "scripts", "check_telemetry.py")
+CHECK_PERF = os.path.join(REPO, "scripts", "check_perf.py")
+CANVAS = 128
+
+
+# -- proration ---------------------------------------------------------------
+
+
+class TestProration:
+    def test_three_accounts_conserve_busy_exactly(self):
+        led = DeviceTimeLedger()
+        charged = 0.0
+        # mixed chunks: full, padded, probe-carrying, empty-busy
+        for busy, rows, real, probes in (
+            (2.0, 4, 4, 0),
+            (1.5, 4, 2, 1),
+            (0.75, 2, 1, 0),
+            (0.0, 4, 3, 0),
+        ):
+            led.charge_chunk(busy, rows, real, probe_rows=probes)
+            charged += busy
+        snap = led.snapshot()
+        assert sum(snap["accounts"].values()) == pytest.approx(
+            charged, rel=1e-9
+        )
+        assert snap["device_seconds_total"] == pytest.approx(
+            charged, rel=1e-9
+        )
+
+    def test_split_by_account(self):
+        led = DeviceTimeLedger()
+        # 4 rows at 4.0s busy -> 1.0s/row: 2 real, 1 probe, 1 dead
+        share = led.charge_chunk(4.0, 4, 2, probe_rows=1)
+        assert share == pytest.approx(1.0)
+        snap = led.snapshot()
+        assert snap["accounts"]["request"] == pytest.approx(2.0)
+        assert snap["accounts"]["probe"] == pytest.approx(1.0)
+        assert snap["accounts"]["padding"] == pytest.approx(1.0)
+
+    def test_counters_mirror_accounts(self):
+        reg = MetricsRegistry()
+        led = DeviceTimeLedger(registry=reg)
+        led.charge_chunk(4.0, 4, 2, probe_rows=1)
+        for account, want in (("request", 2.0), ("probe", 1.0),
+                              ("padding", 1.0)):
+            c = reg.get(SERVING_DEVICE_SECONDS_TOTAL, account=account)
+            assert c is not None and c.value == pytest.approx(want)
+
+    def test_fallback_chunk_is_an_honest_zero(self):
+        # a CPU-fallback chunk accumulated no device busy: share 0.0 and
+        # no counter series materializes (0-valued noise helps nobody)
+        reg = MetricsRegistry()
+        led = DeviceTimeLedger(registry=reg)
+        assert led.charge_chunk(0.0, 4, 4) == 0.0
+        assert reg.get(SERVING_DEVICE_SECONDS_TOTAL, account="request") is None
+
+    def test_histogram_and_mean_gauge(self):
+        reg = MetricsRegistry()
+        led = DeviceTimeLedger(registry=reg)
+        led.observe_request(0.002)
+        led.observe_request(0.004)
+        hist = reg.get(SERVING_DEVICE_SECONDS_PER_REQUEST)
+        assert hist.count == 2
+        assert hist.sum == pytest.approx(0.006)
+        led.publish()
+        mean = reg.get(SERVING_DEVICE_SECONDS_PER_REQUEST_MEAN)
+        assert mean is not None and mean.value == pytest.approx(0.003)
+        snap = led.snapshot()
+        assert snap["requests"]["count"] == 2
+        assert snap["requests"]["device_seconds_mean"] == pytest.approx(
+            0.003
+        )
+
+    def test_requeued_chunk_busy_accumulates_before_one_charge(self):
+        # the contract the executor/batcher pair implements: every dispatch
+        # attempt adds onto the chunk trace's device_busy_s, and the single
+        # charge at success covers them all — conservation over requeues
+        from nm03_capstone_project_tpu.obs.trace import ChunkTrace
+
+        trace = ChunkTrace([], lane=0)
+        assert trace.device_busy_s == 0.0
+        trace.device_busy_s += 0.5  # attempt 1 (lane quarantined mid-run)
+        trace.device_busy_s += 0.3  # attempt 2 (succeeded)
+        led = DeviceTimeLedger()
+        led.charge_chunk(trace.device_busy_s, 2, 2)
+        assert led.snapshot()["accounts"]["request"] == pytest.approx(0.8)
+
+
+# -- HLO stage map -----------------------------------------------------------
+
+
+CANNED_HLO = """\
+HloModule jit_one
+
+%fused_computation.1 (param_0: f32[4]) -> f32[4] {
+  %m1 = f32[4] multiply(%a, %b), metadata={op_name="med" source_file="/x/nm03/ops/pallas_median.py" source_line=1}
+  %m2 = f32[4] add(%m1, %b), metadata={op_name="med" source_file="/x/nm03/ops/pallas_median.py" source_line=2}
+  %m3 = f32[4] add(%m2, %b), metadata={op_name="glue" source_file="/x/nm03/utils/helpers.py" source_line=3}
+}
+
+ENTRY %main.9 (p: f32[4]) -> f32[4] {
+  %norm.1 = f32[4] subtract(%p, %p), metadata={op_name="n" source_file="/x/nm03/ops/elementwise.py" source_line=9}
+  %fusion.1 = f32[4] fusion(%norm.1), kind=kLoop, calls=%fused_computation.1
+  %sharp.2 = f32[4] add(%fusion.1, %p), metadata={op_name="s" source_file="/x/nm03/ops/sharpen.py" source_line=4}
+}
+"""
+
+
+class TestStageMap:
+    def test_stage_for_source(self):
+        assert stage_for_source("/x/ops/pallas_median.py") == "median7"
+        assert stage_for_source("ops\\elementwise.py") == "normalize"
+        assert stage_for_source("/x/ops/region_growing.py") == "grow"
+        assert stage_for_source("/x/ops/morphology.py") == "morph"
+        assert stage_for_source("/x/utils/helpers.py") == "other"
+        assert stage_for_source("") == "other"
+
+    def test_canned_hlo_plain_and_fusion(self):
+        m = stage_map_from_hlo(CANNED_HLO)
+        assert m["norm.1"] == "normalize"
+        assert m["sharp.2"] == "sharpen"
+        # fusion attributed by majority vote over its called computation:
+        # 2 median instructions beat 1 "other"
+        assert m["fusion.1"] == "median7"
+
+    def test_fusion_of_untagged_body_is_other(self):
+        hlo = (
+            "%fused_computation.2 (p: f32[4]) -> f32[4] {\n"
+            '  %g1 = f32[4] add(%a, %b), metadata={source_file="/x/glue.py"'
+            " source_line=1}\n"
+            "}\n"
+            "ENTRY %main.2 (p: f32[4]) -> f32[4] {\n"
+            "  %fusion.2 = f32[4] fusion(%p), kind=kLoop, "
+            "calls=%fused_computation.2\n"
+            "}\n"
+        )
+        assert stage_map_from_hlo(hlo)["fusion.2"] == "other"
+
+    def test_empty_and_garbage_are_safe(self):
+        assert stage_map_from_hlo("") == {}
+        assert stage_map_from_hlo("not hlo at all") == {}
+
+
+# -- trace reduction ---------------------------------------------------------
+
+
+def _ev(op, ts, dur, pid=1, tid=1, ph="X", **extra_args):
+    args = dict(extra_args)
+    if op is not None:
+        args["hlo_op"] = op
+    return {"ph": ph, "ts": ts, "dur": dur, "pid": pid, "tid": tid,
+            "name": op or "host", "args": args}
+
+
+class TestReduceTrace:
+    def test_nested_events_reduce_to_self_time(self):
+        stage_of = {"fusion.1": "median7", "norm.1": "normalize"}
+        events = [
+            _ev("fusion.1", 0.0, 100.0),  # parent
+            _ev("norm.1", 10.0, 30.0),  # nested child
+        ]
+        out = reduce_trace_events(events, stage_of)
+        assert out["median7"] == pytest.approx(70e-6)
+        assert out["normalize"] == pytest.approx(30e-6)
+        assert sum(out.values()) == pytest.approx(100e-6)
+
+    def test_host_and_incomplete_events_excluded(self):
+        out = reduce_trace_events(
+            [
+                _ev(None, 0.0, 50.0),  # host event: no hlo_op
+                _ev("x", 0.0, 40.0, ph="B"),  # not a complete event
+                _ev("x", 0.0, 0.0),  # zero duration
+                _ev("y", 0.0, 10.0),
+            ],
+            {"y": "grow"},
+        )
+        assert out == {"grow": pytest.approx(10e-6)}
+
+    def test_threads_reduce_independently(self):
+        # same timestamps on different tids must NOT nest across lanes
+        events = [
+            _ev("a", 0.0, 100.0, tid=1),
+            _ev("b", 0.0, 100.0, tid=2),
+        ]
+        out = reduce_trace_events(events, {"a": "grow", "b": "render"})
+        assert out["grow"] == pytest.approx(100e-6)
+        assert out["render"] == pytest.approx(100e-6)
+
+    def test_unmapped_ops_land_in_other(self):
+        out = reduce_trace_events([_ev("mystery.7", 0.0, 10.0)], {})
+        assert out == {"other": pytest.approx(10e-6)}
+
+
+# -- capture round-trip ------------------------------------------------------
+
+
+def _canned_capture(events) -> dict:
+    """A capture_profile-shaped dict wrapping a gzipped Chrome trace."""
+    trace = json.dumps({"traceEvents": events}).encode()
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("plugins/profile/run/host.trace.json.gz",
+                    gzip.compress(trace))
+        zf.writestr("plugins/profile/run/unrelated.pb", b"\x00")
+    return {
+        "duration_ms": 100,
+        "zip_b64": base64.b64encode(buf.getvalue()).decode(),
+        "zip_bytes": buf.tell(),
+    }
+
+
+class TestCaptureRoundTrip:
+    def test_zip_b64_round_trip(self):
+        cap = _canned_capture([_ev("a", 0.0, 5.0)])
+        events = trace_events_from_capture(cap)
+        assert len(events) == 1 and events[0]["args"]["hlo_op"] == "a"
+
+    def test_zip_path_round_trip(self, tmp_path):
+        cap = _canned_capture([_ev("a", 0.0, 5.0)])
+        p = tmp_path / "capture.zip"
+        p.write_bytes(base64.b64decode(cap.pop("zip_b64")))
+        cap["zip_path"] = str(p)
+        cap["zip_dropped"] = True
+        assert len(trace_events_from_capture(cap)) == 1
+
+    def test_empty_capture_is_no_events(self):
+        assert trace_events_from_capture({"duration_ms": 50}) == []
+
+    def test_ingest_capture_publishes_share_gauges(self):
+        reg = MetricsRegistry()
+        led = DeviceTimeLedger(registry=reg)
+        led.ingest_hlo(CANNED_HLO)
+        led.ingest_capture(
+            _canned_capture(
+                [_ev("fusion.1", 0.0, 60.0), _ev("sharp.2", 60.0, 40.0)]
+            )
+        )
+        snap = led.publish()
+        assert snap["stage_shares"] == {"median7": 0.6, "sharpen": 0.4}
+        assert snap["profile_samples"]["taken"] == 1
+        g = reg.get(SERVING_DEVICE_TIME_SHARE, stage="median7")
+        assert g is not None and g.value == pytest.approx(0.6)
+        # shares are a pie: sum <= 1 (the sum-range gate's invariant)
+        assert sum(snap["stage_shares"].values()) <= 1.0 + 1e-9
+
+    def test_shares_smooth_across_samples(self):
+        led = DeviceTimeLedger()
+        led.ingest_hlo(CANNED_HLO)
+        led.ingest_capture(_canned_capture([_ev("fusion.1", 0.0, 100.0)]))
+        led.ingest_capture(_canned_capture([_ev("sharp.2", 0.0, 100.0)]))
+        snap = led.snapshot()
+        # cumulative across samples, not last-sample-wins
+        assert snap["stage_shares"] == {"median7": 0.5, "sharpen": 0.5}
+        assert snap["profile_samples"]["taken"] == 2
+
+
+# -- HBM ledger --------------------------------------------------------------
+
+
+class TestHbmLedger:
+    def test_per_bucket_kinds_published(self):
+        reg = MetricsRegistry()
+        led = DeviceTimeLedger(registry=reg)
+        led.set_bucket_hbm(1, {
+            "argument_bytes": 1000, "output_bytes": 500,
+            "peak_hbm_bytes": 4096, "generated_code_size_in_bytes": 7,
+        })
+        led.set_bucket_hbm(8, {"peak_hbm_bytes": 9999})
+        led.set_bucket_hbm(16, None)  # jaxlib without memory_analysis
+        led.set_bucket_hbm(32, {"unrelated": 3})
+        snap = led.publish()
+        assert snap["hbm_bytes"] == {
+            1: {"argument": 1000, "output": 500, "peak": 4096},
+            8: {"peak": 9999},
+        }
+        g = reg.get(SERVING_EXECUTABLE_HBM_BYTES, bucket="1", kind="peak")
+        assert g is not None and g.value == 4096
+        assert reg.get(
+            SERVING_EXECUTABLE_HBM_BYTES, bucket="16", kind="peak"
+        ) is None
+
+
+# -- the sampler's never-collide contract (the ISSUE 16 bugfix) --------------
+
+
+class TestProfileSampler:
+    def test_busy_lock_skips_and_counts_never_queues(self):
+        # the regression: an operator's GET /debug/profile holds the
+        # process-global capture lock; the cadence sampler must skip (and
+        # count) — never block, never queue behind the client's capture
+        from nm03_capstone_project_tpu.utils import profiling
+
+        reg = MetricsRegistry()
+        led = DeviceTimeLedger(registry=reg)
+        sampler = ProfileSampler(led, interval_s=0.0, duration_ms=50)
+        assert profiling._CAPTURE_LOCK.acquire(blocking=False)
+        try:
+            t0 = time.monotonic()
+            assert sampler.sample_once() is False
+            assert sampler.sample_once() is False
+            # skipping is immediate — a sampler that WAITED for the lock
+            # would sit here for the client capture's full duration
+            assert time.monotonic() - t0 < 1.0
+        finally:
+            profiling._CAPTURE_LOCK.release()
+        snap = led.snapshot()
+        assert snap["profile_samples"] == {"taken": 0, "skipped": 2}
+        c = reg.get(LEDGER_PROFILE_SKIPPED_TOTAL)
+        assert c is not None and c.value == 2
+
+    def test_capture_failure_is_swallowed_not_counted_as_skip(self):
+        led = DeviceTimeLedger()
+
+        def broken(_ms):
+            raise RuntimeError("profiler exploded")
+
+        sampler = ProfileSampler(led, interval_s=0.0, capture=broken)
+        assert sampler.sample_once() is False
+        assert led.snapshot()["profile_samples"] == {
+            "taken": 0, "skipped": 0,
+        }
+
+    def test_injected_capture_lands_in_ledger(self):
+        led = DeviceTimeLedger()
+        led.ingest_hlo(CANNED_HLO)
+        sampler = ProfileSampler(
+            led, interval_s=0.0,
+            capture=lambda ms: _canned_capture([_ev("norm.1", 0.0, 10.0)]),
+        )
+        assert sampler.sample_once() is True
+        snap = led.snapshot()
+        assert snap["profile_samples"]["taken"] == 1
+        assert snap["stage_shares"] == {"normalize": 1.0}
+
+    def test_zero_interval_never_starts_a_thread(self):
+        sampler = ProfileSampler(DeviceTimeLedger(), interval_s=0.0)
+        sampler.start()
+        assert sampler._thread is None
+        sampler.stop()
+
+
+# -- batcher integration -----------------------------------------------------
+
+
+class FakeLedgerExecutor:
+    """Lane-aware, trace-aware executor stand-in carrying a real ledger."""
+
+    supports_trace = True
+    BUSY_PER_DISPATCH = 0.5
+
+    def __init__(self, buckets=(4,), lanes=1, canvas=16, min_dim=4):
+        self.cfg = SimpleNamespace(canvas=canvas, min_dim=min_dim)
+        self.buckets = tuple(buckets)
+        self.lane_count = lanes
+        self.registry = MetricsRegistry()
+        self.ledger = DeviceTimeLedger(registry=self.registry)
+
+    @property
+    def max_batch(self):
+        return self.buckets[-1]
+
+    def bucket_for(self, n):
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(n)
+
+    def run_batch(self, pixels, dims, lane=0, trace=None):
+        if trace is not None and hasattr(trace, "device_busy_s"):
+            trace.device_busy_s += self.BUSY_PER_DISPATCH
+        mask = (pixels > 0).astype(np.uint8)
+        return mask, np.ones(pixels.shape[0], bool)
+
+
+def _reqs(n, hw=16, probes=0):
+    return [
+        ServeRequest(
+            request_id=f"r{i}",
+            pixels=np.ones((hw, hw), np.float32),
+            dims=(hw, hw),
+            probe=i < probes,
+        )
+        for i in range(n)
+    ]
+
+
+class TestBatcherLedger:
+    def test_chunk_charge_stamps_riders_and_skips_probe_histogram(self):
+        ex = FakeLedgerExecutor(buckets=(4,), lanes=1)
+        b = DynamicBatcher(AdmissionQueue(8), ex, max_wait_s=0.0)
+        reqs = _reqs(3, probes=1)  # 3 riders pad into bucket 4, one canary
+        b.execute(reqs)
+        # 0.5s busy over 4 rows -> 0.125/row: 2 real, 1 probe, 1 dead
+        snap = ex.ledger.snapshot()
+        assert snap["accounts"]["request"] == pytest.approx(0.25)
+        assert snap["accounts"]["probe"] == pytest.approx(0.125)
+        assert snap["accounts"]["padding"] == pytest.approx(0.125)
+        assert sum(snap["accounts"].values()) == pytest.approx(
+            ex.BUSY_PER_DISPATCH, rel=1e-9
+        )
+        # every rider (canary included) carries its prorated cost...
+        assert all(
+            r.device_seconds == pytest.approx(0.125) for r in reqs
+        )
+        # ...but only non-probes land in the per-request histogram
+        hist = ex.registry.get(SERVING_DEVICE_SECONDS_PER_REQUEST)
+        assert hist.count == 2
+        assert hist.sum == pytest.approx(0.25)
+
+    def test_ledgerless_executor_keeps_working(self):
+        # the ledger is strictly opt-in, like the saturation monitor
+        class Bare:
+            def __init__(self):
+                self.cfg = SimpleNamespace(canvas=16, min_dim=4)
+                self.buckets = (4,)
+                self.max_batch = 4
+
+            def bucket_for(self, n):
+                return 4
+
+            def run_batch(self, pixels, dims):
+                return (pixels > 0).astype(np.uint8), np.ones(
+                    pixels.shape[0], bool
+                )
+
+        b = DynamicBatcher(AdmissionQueue(8), Bare(), max_wait_s=0.0)
+        reqs = _reqs(3)
+        b.execute(reqs)  # must simply not raise
+        assert all(r.device_seconds == 0.0 for r in reqs)
+
+
+# -- check_perf CLI: red/green at parse and verdict layers -------------------
+
+
+def _snapshot(path, metrics):
+    path.write_text(json.dumps({
+        "schema": "nm03.metrics.v1", "created_unix": 1.0,
+        "run_id": "r", "git_sha": "s", "metrics": metrics,
+    }))
+
+
+def _ledger_metrics(mean=0.005, count=10, shares=None):
+    shares = {"median7": 0.6, "normalize": 0.35} if shares is None else shares
+    out = [{
+        "name": "serving_device_seconds_per_request", "type": "histogram",
+        "labels": {}, "count": count, "sum": mean * count,
+        "buckets": [["+Inf", count]],
+    }]
+    for st, v in shares.items():
+        out.append({
+            "name": "serving_device_time_share", "type": "gauge",
+            "labels": {"stage": st}, "value": v,
+        })
+    return out
+
+
+def _run_check_perf(*args):
+    return subprocess.run(
+        [sys.executable, CHECK_PERF, *args],
+        capture_output=True, text=True, timeout=60,
+    )
+
+
+class TestCheckPerfCLI:
+    def test_write_then_gate_green(self, tmp_path):
+        snap = tmp_path / "m.json"
+        base = tmp_path / "base.json"
+        _snapshot(snap, _ledger_metrics())
+        w = _run_check_perf(
+            "--metrics", str(snap), "--write-baseline", str(base)
+        )
+        assert w.returncode == 0, w.stderr
+        doc = json.loads(base.read_text())
+        assert doc["schema"] == "nm03.perf_baseline.v1"
+        assert doc["device_seconds_per_slice"] == pytest.approx(0.005)
+        g = _run_check_perf(
+            "--metrics", str(snap), "--baseline", str(base)
+        )
+        assert g.returncode == 0, g.stderr
+        assert "OK" in g.stdout
+
+    def test_perturbed_share_trips_red(self, tmp_path):
+        snap = tmp_path / "m.json"
+        base = tmp_path / "base.json"
+        _snapshot(snap, _ledger_metrics())
+        _run_check_perf("--metrics", str(snap), "--write-baseline", str(base))
+        doc = json.loads(base.read_text())
+        doc["stage_shares"]["median7"] = 0.1  # "the median used to be 10%"
+        base.write_text(json.dumps(doc))
+        r = _run_check_perf("--metrics", str(snap), "--baseline", str(base))
+        assert r.returncode == 1
+        assert "PERF DRIFT stage_shares[median7]" in r.stderr
+
+    def test_device_seconds_ratio_trips_both_directions(self, tmp_path):
+        snap = tmp_path / "m.json"
+        _snapshot(snap, _ledger_metrics(mean=0.005))
+        for slow_or_fast in (0.0005, 0.05):  # 10x either way vs rel=4.0
+            base = tmp_path / "base.json"
+            base.write_text(json.dumps({
+                "schema": "nm03.perf_baseline.v1",
+                "device_seconds_per_slice": slow_or_fast,
+                "stage_shares": {},
+                "tolerance": {"device_seconds_rel": 4.0,
+                              "stage_share_abs": 0.25},
+                "min_share": 0.05,
+            }))
+            r = _run_check_perf(
+                "--metrics", str(snap), "--baseline", str(base)
+            )
+            assert r.returncode == 1, (slow_or_fast, r.stderr)
+            assert "PERF DRIFT device_seconds" in r.stderr
+
+    def test_tiny_baseline_shares_are_not_gated(self, tmp_path):
+        snap = tmp_path / "m.json"
+        base = tmp_path / "base.json"
+        # observed carries no "grow" at all; baseline's 1% grow is under
+        # the min_share floor, so its absence must not trip
+        _snapshot(snap, _ledger_metrics(shares={"median7": 0.99}))
+        base.write_text(json.dumps({
+            "schema": "nm03.perf_baseline.v1",
+            "device_seconds_per_slice": None,
+            "stage_shares": {"median7": 0.98, "grow": 0.01},
+            "tolerance": {"device_seconds_rel": 4.0,
+                          "stage_share_abs": 0.25},
+            "min_share": 0.05,
+        }))
+        r = _run_check_perf("--metrics", str(snap), "--baseline", str(base))
+        assert r.returncode == 0, r.stderr
+
+    def test_missing_shares_fail_not_vacuously_pass(self, tmp_path):
+        snap = tmp_path / "m.json"
+        base = tmp_path / "base.json"
+        _snapshot(snap, _ledger_metrics(shares={}))
+        base.write_text(json.dumps({
+            "schema": "nm03.perf_baseline.v1",
+            "device_seconds_per_slice": 0.005,
+            "stage_shares": {"median7": 0.6},
+            "tolerance": {"device_seconds_rel": 4.0,
+                          "stage_share_abs": 0.25},
+            "min_share": 0.05,
+        }))
+        r = _run_check_perf("--metrics", str(snap), "--baseline", str(base))
+        assert r.returncode == 1
+        assert "never reduced a capture" in r.stderr
+
+    def test_parse_layer_usage_errors(self, tmp_path):
+        snap = tmp_path / "m.json"
+        _snapshot(snap, [])
+        # exactly one of --baseline/--write-baseline
+        assert _run_check_perf("--metrics", str(snap)).returncode == 2
+        base = tmp_path / "base.json"
+        base.write_text(json.dumps({"schema": "nm03.perf_baseline.v1"}))
+        assert _run_check_perf(
+            "--metrics", str(snap), "--baseline", str(base),
+            "--write-baseline", str(tmp_path / "x.json"),
+        ).returncode == 2
+        # wrong metrics schema
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"schema": "nope", "metrics": []}))
+        assert _run_check_perf(
+            "--metrics", str(bad), "--baseline", str(base)
+        ).returncode == 2
+        # wrong baseline schema
+        badbase = tmp_path / "badbase.json"
+        badbase.write_text(json.dumps({"schema": "nope"}))
+        assert _run_check_perf(
+            "--metrics", str(snap), "--baseline", str(badbase)
+        ).returncode == 2
+        # unreadable artifacts
+        assert _run_check_perf(
+            "--metrics", str(tmp_path / "absent.json"),
+            "--baseline", str(base),
+        ).returncode == 2
+        # nothing to baseline from an empty snapshot
+        assert _run_check_perf(
+            "--metrics", str(snap),
+            "--write-baseline", str(tmp_path / "y.json"),
+        ).returncode == 2
+
+
+class TestCheckTelemetrySumRange:
+    def _run(self, snap, *args):
+        return subprocess.run(
+            [sys.executable, CHECKER, "--metrics", str(snap), *args],
+            capture_output=True, text=True, timeout=60,
+        )
+
+    def test_pie_sum_green_red_and_absent(self, tmp_path):
+        snap = tmp_path / "m.json"
+        _snapshot(snap, [
+            {"name": "serving_device_time_share", "type": "gauge",
+             "labels": {"stage": s}, "value": v}
+            for s, v in (("median7", 0.6), ("normalize", 0.35))
+        ])
+        ok = self._run(snap, "--expect-gauge-sum-range",
+                       "serving_device_time_share=(0..1]")
+        assert ok.returncode == 0, ok.stderr
+        red = self._run(snap, "--expect-gauge-sum-range",
+                        "serving_device_time_share=(0..0.5]")
+        assert red.returncode == 1
+        assert "sums to 0.95" in red.stderr
+        absent = self._run(snap, "--expect-gauge-sum-range",
+                           "not_a_series=(0..1]")
+        assert absent.returncode == 1
+        assert "absent" in absent.stderr
+
+    def test_usage_errors(self, tmp_path):
+        snap = tmp_path / "m.json"
+        _snapshot(snap, [])
+        bad = self._run(snap, "--expect-gauge-sum-range", "name=zz")
+        assert bad.returncode == 2
+        no_metrics = subprocess.run(
+            [sys.executable, CHECKER,
+             "--expect-gauge-sum-range", "name=0..1"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert no_metrics.returncode == 2
+
+
+# -- acceptance: the live drill ----------------------------------------------
+
+
+class TestLedgerAcceptance:
+    def test_drill_charges_profiles_and_gates(self, tmp_path):
+        """The ISSUE 16 acceptance bar: a 4-lane replica under load charges
+        real riders to the ``request`` account, lands every request in the
+        per-request histogram (echoed in the payload and in nm03-loadgen's
+        ``device_seconds_p50/p95``), samples a live stage pie whose shares
+        sum to <= 1, and passes check_perf both ways (fresh baseline
+        green, perturbed share red) on the post-drain snapshot.
+        """
+        port_file = tmp_path / "port"
+        metrics = tmp_path / "metrics.json"
+        results = tmp_path / "loadgen.json"
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        )
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m",
+                "nm03_capstone_project_tpu.serving.server",
+                "--device", "cpu", "--port", "0",
+                "--port-file", str(port_file),
+                "--canvas", str(CANVAS), "--buckets", "1,2", "--lanes", "4",
+                "--max-wait-ms", "60", "--heartbeat-s", "0",
+                "--ledger-profile-interval-s", "0.4",
+                "--ledger-profile-ms", "250",
+                "--metrics-out", str(metrics),
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO,
+        )
+        try:
+            deadline = time.monotonic() + 300
+            while not port_file.exists() and time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    pytest.fail(f"server died: {proc.stdout.read()}")
+                time.sleep(0.2)
+            assert port_file.exists(), "server never became ready"
+            base = f"http://127.0.0.1:{int(port_file.read_text())}"
+
+            def loadgen(n):
+                return subprocess.run(
+                    [
+                        sys.executable, "-m",
+                        "nm03_capstone_project_tpu.serving.loadgen",
+                        "--url", base, "--requests", str(n),
+                        "--concurrency", "8", "--mode", "mask",
+                        "--height", str(CANVAS), "--width", str(CANVAS),
+                        "--warmup", "4", "--results-json", str(results),
+                    ],
+                    capture_output=True, text=True, timeout=300, cwd=REPO,
+                )
+
+            lg = loadgen(32)
+            assert lg.returncode == 0, lg.stdout + lg.stderr
+            summary = json.loads(results.read_text())
+            assert summary["requests_ok"] == 32
+            # the payload echo, client-side: every ok request billed > 0
+            ds = summary.get("device_seconds_ms")
+            assert ds is not None, "no device_seconds in any payload"
+            assert ds["p50"] > 0 and ds["p95"] >= ds["p50"]
+            assert "device_seconds_p50=" in lg.stdout
+            recs = json.loads(results.read_text())["requests"]
+            assert all(
+                r["device_seconds"] > 0
+                for r in recs if r["status"] == "ok"
+            )
+
+            # the pie needs a capture that OVERLAPPED traffic; drive small
+            # bursts until the sampler lands one (bounded — the 0.4 s
+            # cadence makes the first overlapping capture near-certain)
+            def live_shares():
+                with urllib.request.urlopen(
+                    f"{base}/metrics.json", timeout=10
+                ) as resp:
+                    doc = json.loads(resp.read())
+                return {
+                    rec["labels"]["stage"]: rec["value"]
+                    for rec in doc["metrics"]
+                    if rec["name"] == "serving_device_time_share"
+                }
+            shares = live_shares()
+            for _ in range(6):
+                if shares:
+                    break
+                assert loadgen(16).returncode == 0
+                time.sleep(1.0)
+                shares = live_shares()
+            assert shares, "profile sampler never landed a capture"
+            # the acceptance pin: on this container the median network
+            # dominates device time — the pie must say so
+            assert shares.get("median7", 0.0) > 0.0
+            assert sum(shares.values()) <= 1.0 + 1e-6
+
+            # nm03-top renders the pie + ds/req column from the gauges
+            tp = subprocess.run(
+                [
+                    sys.executable, "-m",
+                    "nm03_capstone_project_tpu.serving.top",
+                    "--url", base, "--once", "--format", "json",
+                ],
+                capture_output=True, text=True, timeout=60, cwd=REPO,
+            )
+            assert tp.returncode == 0, tp.stdout + tp.stderr
+            view = json.loads(tp.stdout)
+            assert view["device_time_share"], view
+            assert view["device_time_share"].get("median7", 0) > 0
+            assert view["device_seconds_per_request"] > 0
+            proc.send_signal(signal.SIGTERM)
+            out, _ = proc.communicate(timeout=120)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate(timeout=30)
+        assert proc.returncode == 0, out
+
+        # post-drain gates: request account charged, pie sums to a pie,
+        # every request in the histogram
+        gates = [
+            sys.executable, CHECKER,
+            "--metrics", str(metrics),
+            "--expect-counter",
+            "serving_device_seconds_total{account=request}=0.000001",
+            "--expect-histogram", "serving_device_seconds_per_request=32",
+            "--expect-gauge-sum-range", "serving_device_time_share=(0..1]",
+            "--expect-gauge-range",
+            "serving_device_seconds_per_request_mean=(0..30]",
+        ]
+        snap_doc = json.loads(metrics.read_text())
+        series = {m["name"] for m in snap_doc["metrics"]}
+        if "executable_hbm_bytes" in series:
+            # this jaxlib exposes memory_analysis (the compile-hub series
+            # is present): the ledger's per-bucket twin must be too
+            for bucket in ("1", "2"):
+                gates += [
+                    "--expect-gauge-range",
+                    "serving_executable_hbm_bytes"
+                    f"{{bucket={bucket},kind=peak}}=(0..1e15]",
+                ]
+        res = subprocess.run(
+            gates, capture_output=True, text=True, timeout=60,
+        )
+        assert res.returncode == 0, res.stderr
+
+        # conservation: the histogram's sum (per-rider stamps) must agree
+        # with the request account (per-chunk charges) within 1%
+        req_account = sum(
+            m["value"] for m in snap_doc["metrics"]
+            if m["name"] == "serving_device_seconds_total"
+            and m["labels"].get("account") == "request"
+        )
+        hist_sum = sum(
+            m["sum"] for m in snap_doc["metrics"]
+            if m["name"] == "serving_device_seconds_per_request"
+        )
+        assert req_account > 0
+        assert hist_sum == pytest.approx(req_account, rel=0.01)
+
+        # check_perf joins the drill: fresh baseline green, perturbed red
+        fresh = tmp_path / "fresh_baseline.json"
+        w = _run_check_perf(
+            "--metrics", str(metrics), "--write-baseline", str(fresh)
+        )
+        assert w.returncode == 0, w.stderr
+        g = _run_check_perf(
+            "--metrics", str(metrics), "--baseline", str(fresh)
+        )
+        assert g.returncode == 0, g.stderr
+        doc = json.loads(fresh.read_text())
+        # perturb the dominant stage far outside the band (upward, so the
+        # perturbed share always stays above the min_share gating floor)
+        top_stage = max(doc["stage_shares"], key=doc["stage_shares"].get)
+        doc["stage_shares"][top_stage] += 0.5
+        bad = tmp_path / "bad_baseline.json"
+        bad.write_text(json.dumps(doc))
+        r = _run_check_perf(
+            "--metrics", str(metrics), "--baseline", str(bad)
+        )
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "PERF DRIFT" in r.stderr
+
+        # the committed tripwire baseline gates this very drill
+        committed = os.path.join(REPO, "PERF_BASELINE.json")
+        assert os.path.exists(committed), "PERF_BASELINE.json not committed"
+        c = _run_check_perf(
+            "--metrics", str(metrics), "--baseline", committed
+        )
+        assert c.returncode == 0, c.stderr
