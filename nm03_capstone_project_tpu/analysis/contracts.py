@@ -66,6 +66,12 @@ CONTRACT_REGISTRY: Dict[str, Tuple[str, ...]] = {
     # serving package precisely so no numpy-importing ancestor __init__
     # weakens the contract the way serving.queue's does)
     "nm03_capstone_project_tpu.fleet": ("jax", "numpy"),
+    # the content-addressed result tier (ISSUE 19): keys, the LRU store
+    # and the in-flight coalescing index are pure hashing over bytes —
+    # the router embeds a ResultStore in a process that must never pay a
+    # jax import, so the package is jax- AND numpy-banned like fleet/
+    # (the program-version key half crosses from compilehub over the wire)
+    "nm03_capstone_project_tpu.cache": ("jax", "numpy"),
     # the linter itself runs in pre-backend CI processes; the gate gates
     # itself so a convenience import can never make the gate cost a backend
     "nm03_capstone_project_tpu.analysis": ("jax", "numpy"),

@@ -50,6 +50,11 @@ THREADED_FILES: Tuple[str, ...] = (
     # poller and the drain thread share the replica state table, the
     # routing weights and the signal cache — same discipline
     "nm03_capstone_project_tpu/fleet/",
+    # the result tier (ISSUE 19): the store is written by handler threads
+    # on fill and read/evicted by scrape + admin threads; the in-flight
+    # index is shared between every handler that might coalesce — same
+    # discipline
+    "nm03_capstone_project_tpu/cache/",
 )
 
 _SYNC_TYPE_NAMES = {

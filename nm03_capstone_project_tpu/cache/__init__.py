@@ -1,0 +1,47 @@
+"""Content-addressed result tier (ISSUE 19).
+
+The executable cache (``compilehub/``) amortizes *compiles*; this package
+amortizes *whole results*: a segmentation mask keyed on the sha256 of the
+input bytes, the algorithm, its parameters, and the program version is
+immutable by construction — the key changes whenever anything that could
+change the answer changes, so invalidation is free and a stale result is
+never an outcome (see docs/RESILIENCE.md).
+
+jax- and numpy-free by contract (NM301-registered, like ``fleet/``): the
+router embeds a :class:`ResultStore` in a process that must never pay a
+jax import, and the replica-side store only ever holds opaque payload
+bytes. The program-version half of the key is produced by
+``compilehub.persist.result_version`` on the replica (which may import
+jax) and travels to jax-free consumers over the wire (``/readyz``).
+
+Lock discipline: NM331-scanned. Every class owning a sync primitive takes
+it around all mutation outside ``__init__``.
+"""
+
+from nm03_capstone_project_tpu.cache.inflight import InflightIndex
+from nm03_capstone_project_tpu.cache.keys import (
+    ResultKey,
+    digest_bytes,
+    params_digest,
+    result_key,
+)
+from nm03_capstone_project_tpu.cache.store import (
+    ResultEntry,
+    ResultStore,
+    content_etag,
+    etag_matches,
+    parse_bytes,
+)
+
+__all__ = [
+    "InflightIndex",
+    "ResultEntry",
+    "ResultKey",
+    "ResultStore",
+    "content_etag",
+    "digest_bytes",
+    "etag_matches",
+    "params_digest",
+    "parse_bytes",
+    "result_key",
+]

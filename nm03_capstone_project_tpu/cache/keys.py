@@ -1,0 +1,83 @@
+"""Content-addressed result keys (ISSUE 19).
+
+A result is addressed by *everything that could change it*:
+
+    (input-bytes digest, algo, params digest, program version)
+
+This is the ``compilehub/persist.py`` versioned-key contract extended one
+level up — ``PersistKey`` pins toolchain versions so an executable can
+never satisfy a lookup from a different program; ``ResultKey`` pins the
+program version (which itself folds in the toolchain triple, see
+``compilehub.persist.result_version``) so a cached *mask* can never be
+served back by a different algorithm. Bump the algorithm and every entry
+misses by construction: invalidation without TTLs, flush RPCs, or any
+notion of staleness.
+
+jax- and numpy-free: keys are pure hashing over bytes and JSON.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any, Dict, Optional
+
+__all__ = ["ResultKey", "digest_bytes", "params_digest", "result_key"]
+
+
+def digest_bytes(data: bytes) -> str:
+    """sha256 of the raw input body — the content-address half of the key.
+
+    Full hex: the input digest is the identity clients can precompute and
+    the dedup window compares; truncation buys nothing here.
+    """
+    return hashlib.sha256(data).hexdigest()
+
+
+def params_digest(params: Optional[Dict[str, Any]]) -> str:
+    """Canonical digest of request parameters (mirrors ``config_digest``).
+
+    ``None`` and ``{}`` collapse to the same digest on purpose: "no
+    parameters" is one identity, however the caller spells it.
+    """
+    payload = json.dumps(
+        params or {}, sort_keys=True, default=repr, separators=(",", ":")
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+@dataclasses.dataclass(frozen=True)
+class ResultKey:
+    """The four-tuple identity of one cacheable result.
+
+    Frozen: a key is a value. ``digest()`` is the store/index address —
+    32 hex chars of sha256 over the canonical JSON form, collision-safe
+    at any plausible store size.
+    """
+
+    input_digest: str
+    algo: str  # "segment" | "segment-volume"
+    params_digest: str
+    program_version: str
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+    def digest(self) -> str:
+        return hashlib.sha256(self.to_json().encode()).hexdigest()[:32]
+
+
+def result_key(
+    body: bytes,
+    algo: str,
+    params: Optional[Dict[str, Any]],
+    program_version: str,
+) -> ResultKey:
+    """Build the key for one request: hash the body, digest the params."""
+    return ResultKey(
+        input_digest=digest_bytes(body),
+        algo=algo,
+        params_digest=params_digest(params),
+        program_version=program_version,
+    )

@@ -14,7 +14,11 @@ Subcommands (docs/OPERATIONS.md, "Compile cache management"):
   not fail the check — they report, and ``gc`` reclaims them);
 * ``gc``     — retention: corrupt and stale entries always go (both can
   only ever miss for this toolchain), then anything older than
-  ``--max-age``, then oldest-first until under ``--max-bytes``.
+  ``--max-age``, then oldest-first until under ``--max-bytes``;
+* ``result`` — the RESULT tier's admin surface (ISSUE 19): ``ls`` /
+  ``stats`` / ``evict`` against a live replica's (or fleet front-end's)
+  ``/debug/result-cache`` endpoint — the store lives in serving-process
+  memory, so its admin path is HTTP (``--url``), not ``--dir``.
 
 Diagnostics go to stderr, results to stdout (``--format json`` for
 scripting) — the same discipline as the sibling CLIs. Exit codes:
@@ -140,6 +144,32 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="report what WOULD be removed without touching the directory",
     )
+    res = sub.add_parser(
+        "result",
+        help="administer a live process's content-addressed result store",
+        description="The result tier's admin surface (ISSUE 19): ls/stats "
+        "read GET /debug/result-cache on a replica started with "
+        "--result-cache-bytes (or an nm03-fleet front-end); evict POSTs "
+        "/debug/result-cache/evict — one --digest, or everything without "
+        "it. Invalidation normally needs neither: the program version in "
+        "every key retires stale results by construction.",
+    )
+    res.add_argument(
+        "action", choices=["ls", "stats", "evict"],
+        help="ls = entry rows hot-to-cold; stats = counters + hit ratio; "
+        "evict = drop one --digest (or all entries when omitted)",
+    )
+    res.add_argument(
+        "--url", required=True, metavar="URL",
+        help="base URL of the replica or fleet front-end to administer",
+    )
+    res.add_argument(
+        "--digest", default=None, metavar="D",
+        help="result-key digest to evict (evict only; omit to drop all)",
+    )
+    res.add_argument(
+        "--timeout-s", type=float, default=10.0, help="HTTP timeout",
+    )
     return p
 
 
@@ -232,8 +262,91 @@ def _cmd_gc(root: Path, args: argparse.Namespace, fmt: str) -> int:
     return 0
 
 
+def _cmd_result(args: argparse.Namespace) -> int:
+    """The result tier's admin actions (ISSUE 19) — HTTP, never ``--dir``.
+
+    Exit codes keep the sibling discipline: 0 ok, 2 usage/unreachable —
+    a disabled tier is a usage error (the operator pointed the admin
+    surface at a process that runs no store), never a silent empty list.
+    """
+    import urllib.error
+    import urllib.request
+
+    base = args.url.rstrip("/")
+    try:
+        if args.action == "evict":
+            q = f"?digest={args.digest}" if args.digest else ""
+            req = urllib.request.Request(
+                f"{base}/debug/result-cache/evict{q}", data=b"",
+                method="POST",
+            )
+        else:
+            req = urllib.request.Request(
+                f"{base}/debug/result-cache", method="GET"
+            )
+        with urllib.request.urlopen(req, timeout=args.timeout_s) as resp:
+            payload = json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        detail = (e.read() or b"")[:200].decode(errors="replace")
+        print(
+            f"nm03-cache result: {base} answered HTTP {e.code}: {detail}",
+            file=sys.stderr,
+        )
+        return 2
+    except Exception as e:  # noqa: BLE001 — unreachable is a usage error
+        print(f"nm03-cache result: {base} unreachable: {e}", file=sys.stderr)
+        return 2
+    if payload.get("enabled") is False:
+        print(
+            f"nm03-cache result: the result tier is disabled on {base} "
+            "(start the process with --result-cache-bytes)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.format == "json":
+        print(json.dumps(payload, indent=1))
+        return 0
+    if args.action == "evict":
+        print(
+            f"nm03-cache result: evicted {payload.get('evicted')} entr"
+            f"{'y' if payload.get('evicted') == 1 else 'ies'}"
+        )
+        return 0
+    if args.action == "stats":
+        hr = payload.get("hit_ratio")
+        print(
+            f"entries {payload.get('entries')}  "
+            f"bytes {_fmt_bytes(payload.get('bytes') or 0)} / "
+            f"{_fmt_bytes(payload.get('max_bytes') or 0)}  "
+            f"hits {payload.get('hits')}  misses {payload.get('misses')}  "
+            f"fills {payload.get('fills')}  "
+            f"evictions {payload.get('evictions')} "
+            f"(corrupt {payload.get('corrupt_evictions')})  "
+            f"hit_ratio {'-' if hr is None else round(hr, 4)}  "
+            f"program {payload.get('program_version') or '?'}"
+        )
+        return 0
+    rows = payload.get("ls") or []
+    if not rows:
+        print("(empty result store)")
+        return 0
+    print(f"{'SIZE':>9}  {'AGE':>7}  {'HITS':>5}  {'ALGO':<15}  DIGEST")
+    for r in rows:
+        print(
+            f"{_fmt_bytes(r['bytes']):>9}  {_fmt_age(r['age_s']):>7}  "
+            f"{r['hits']:>5}  {r['algo']:<15}  {r['digest']}"
+        )
+    total = sum(r["bytes"] for r in rows)
+    print(f"{len(rows)} entries, {_fmt_bytes(total)} total")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.command == "result":
+        # the result tier lives in a serving process, not a directory —
+        # no --dir resolution, no filesystem scan
+        return _cmd_result(args)
     root = _resolve_dir(args.dir)
     # one guard around every directory read: an unreadable dir is a usage
     # error (exit 2) on ANY subcommand, never a traceback or a fake

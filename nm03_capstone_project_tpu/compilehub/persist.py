@@ -73,6 +73,7 @@ __all__ = [
     "config_digest",
     "gc_entries",
     "jax_cache_stats",
+    "result_version",
     "scan_entries",
 ]
 
@@ -227,6 +228,28 @@ def _versions() -> Dict[str, str]:
         "jaxlib_version": jaxlib.__version__,
         "nm03_version": str(nm03_version),
     }
+
+
+def result_version(cfg: Any = None) -> str:
+    """The program-identity half of a RESULT-tier cache key (ISSUE 19).
+
+    The executable cache's :class:`PersistKey` pins toolchain versions so
+    an entry can never satisfy a lookup from a different program; the
+    result tier (``nm03_capstone_project_tpu.cache``) extends the same
+    contract one level up: a cached *mask* is only valid for the exact
+    algorithm + toolchain + pipeline config that produced it. This digest
+    — sha256 over the jax/jaxlib/nm03 version triple plus the config
+    digest — is that identity: bump any of them and every stored result
+    misses by construction (invalidation without TTLs or flush RPCs).
+
+    Imports jax (via :func:`_versions`); callers in jax-free packages
+    (fleet/, cache/) receive the string over the wire instead of calling
+    this (the replica publishes it on ``/readyz``).
+    """
+    payload = {"cfg_digest": config_digest(cfg), **_versions()}
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True).encode()
+    ).hexdigest()[:16]
 
 
 @dataclasses.dataclass(frozen=True)

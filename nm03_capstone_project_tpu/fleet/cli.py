@@ -82,6 +82,13 @@ def build_parser() -> argparse.ArgumentParser:
         "floor when the replica publishes neither)",
     )
     s.add_argument(
+        "--result-cache-bytes", default="0", metavar="BYTES",
+        help="router-side content-addressed result tier budget (k/m/g "
+        "suffixes; 0 disables) — a repeated study is answered at the "
+        "front-end without spending a replica pick (docs/OPERATIONS.md, "
+        "'Running the result tier')",
+    )
+    s.add_argument(
         "--fault-plan", default=None, metavar="SPEC",
         help="chaos plan (site 'fleet': replica_unreachable / "
         "proxy_io_error; docs/RESILIENCE.md). Default: $NM03_FAULT_PLAN",
@@ -173,6 +180,7 @@ def _split_targets(spec: str):
 
 
 def _serve(args) -> int:
+    from nm03_capstone_project_tpu.cache import parse_bytes
     from nm03_capstone_project_tpu.fleet.router import (
         FleetApp,
         make_http_server,
@@ -201,6 +209,9 @@ def _serve(args) -> int:
         canary_hw=args.canary_hw,
         fault_plan=plan,
         slo=objective_from_args(args),
+        result_cache_bytes=parse_bytes(
+            getattr(args, "result_cache_bytes", "0") or "0"
+        ),
     )
     httpd = make_http_server(app, args.host, args.port)
     port = httpd.server_address[1]

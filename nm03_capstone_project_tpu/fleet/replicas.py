@@ -211,6 +211,7 @@ class ReplicaStates:
                     "queue_capacity": self._signals[t].get("queue_capacity"),
                     "identity": self._signals[t].get("identity"),
                     "clock_offset_s": self._signals[t].get("clock_offset_s"),
+                    "result_version": self._signals[t].get("result_version"),
                 }
                 for t in self._targets
             ]
@@ -307,6 +308,7 @@ class ReplicaStates:
         min_dim: Optional[int] = None,
         clock_offset_s: Optional[float] = None,
         volume_cost: Optional[int] = None,
+        result_version: Optional[str] = None,
     ) -> None:
         """Record one health poll's routing signals for ``target``.
 
@@ -320,6 +322,11 @@ class ReplicaStates:
         equivalent cost of one whole-volume request (ISSUE 15): what the
         WRR debits an unsized ``/v1/segment-volume`` proxy by, so a
         volume never weighs like one slice.
+        ``result_version`` is the replica's result-key program identity
+        (ISSUE 19, ``/readyz`` ``result_cache.program_version``): the
+        router's own result tier only engages while every healthy
+        replica publishes the SAME value — a mixed fleet mid-rolling-
+        restart bypasses the router cache by construction.
         """
         sig = {
             "capacity": capacity,
@@ -330,6 +337,7 @@ class ReplicaStates:
             "min_dim": min_dim,
             "clock_offset_s": clock_offset_s,
             "volume_cost": volume_cost,
+            "result_version": result_version,
         }
         with self._lock:
             if target not in self._signals:

@@ -46,8 +46,13 @@ import time
 import urllib.error
 import urllib.request
 from typing import Dict, FrozenSet, List, Optional, Tuple
-from urllib.parse import urlsplit
+from urllib.parse import parse_qs, urlsplit
 
+from nm03_capstone_project_tpu.cache import (
+    ResultStore,
+    etag_matches,
+    result_key,
+)
 from nm03_capstone_project_tpu.fleet.replicas import (
     EJECTED,
     ReplicaStates,
@@ -65,6 +70,11 @@ from nm03_capstone_project_tpu.obs.metrics import (
     FLEET_REQUEST_SECONDS,
     FLEET_ROUTED_CAPACITY,
     FLEET_SHED_TOTAL,
+    SERVING_RESULT_CACHE_BYTES,
+    SERVING_RESULT_CACHE_EVICT_TOTAL,
+    SERVING_RESULT_CACHE_FILL_TOTAL,
+    SERVING_RESULT_CACHE_HIT_TOTAL,
+    SERVING_RESULT_CACHE_MISS_TOTAL,
 )
 from nm03_capstone_project_tpu.obs.trace import (
     FLEET_TRACE_EVENT,
@@ -78,8 +88,9 @@ log = get_logger("fleet")
 
 RETRY_AFTER_S = 1  # the fleet-wide shed hint when no replica named one
 # request headers forwarded replica-ward (lowercase); responses echo
-# every X-Nm03-*
-_FORWARD_HEADERS = ("content-type",)
+# every X-Nm03-* plus the bare ETag — the result tier's revalidation
+# token (If-None-Match in, ETag out) must survive the proxy both ways
+_FORWARD_HEADERS = ("content-type", "if-none-match")
 _FORWARD_PREFIX = "x-nm03-"
 _MAX_BODY_BYTES = 64 << 20  # replicas enforce their own canvas-derived cap
 _WEIGHT_FLOOR = 0.01  # a healthy replica with a full queue is still pickable
@@ -100,6 +111,7 @@ class FleetApp:
         canary_timeout_s: float = 30.0,
         fault_plan=None,
         slo=None,
+        result_cache_bytes: int = 0,
     ):
         if obs is None:
             from nm03_capstone_project_tpu.obs import RunContext
@@ -145,6 +157,23 @@ class FleetApp:
             self.registry.counter(
                 FLEET_REQUESTS_TOTAL, help=self._REQ_HELP, status=cls
             )
+        # the router-side result tier (ISSUE 19): a content-addressed hit
+        # is answered HERE — it never spends a WRR round or touches a
+        # replica. The program-version half of every key comes from the
+        # replicas' own /readyz publications (_fleet_result_version), so
+        # the jax-free router never computes it — and a fleet that
+        # disagrees on the version (mid-rolling-restart) bypasses the
+        # tier by construction.
+        self.result_store = (
+            ResultStore(
+                int(result_cache_bytes), on_evict=self._on_result_evict
+            )
+            if int(result_cache_bytes) > 0
+            else None
+        )
+        # the bytes gauge exists (at 0) from startup when the tier is on:
+        # its presence IS nm03-top's tier-enabled signal
+        self._publish_result_bytes()
         # the SLO plane (ISSUE 14): burn rates/budget over the fleet's own
         # request accounting, pull-refreshed by publish_gauges()
         self.slo = None
@@ -310,6 +339,12 @@ class FleetApp:
             min_dim=st.get("min_dim"),
             clock_offset_s=clock_offset_s,
             volume_cost=volume_cost,
+            # the replica's result-tier program version (ISSUE 19): the
+            # key half the router's own content-addressed tier borrows —
+            # published even when the replica's store is disabled
+            result_version=(st.get("result_cache") or {}).get(
+                "program_version"
+            ),
         )
         return True
 
@@ -500,6 +535,145 @@ class FleetApp:
         costs = [float(c) for c in published if c]
         return max(costs) if costs else 1.0
 
+    # -- the result tier (ISSUE 19, router side) ---------------------------
+
+    def _on_result_evict(self, n: int) -> None:
+        # fired from inside the store's lock — a counter bump only (the
+        # bytes gauge refreshes outside the lock, in _result_fill and the
+        # admin evict handler)
+        self.registry.counter(
+            SERVING_RESULT_CACHE_EVICT_TOTAL,
+            help="result-tier entries evicted by tier (LRU pressure, "
+            "explicit evict, or a failed verify-on-read)",
+            tier="router",
+        ).inc(n)
+
+    def _count_result(self, name: str, help_text: str) -> None:
+        self.registry.counter(name, help=help_text, tier="router").inc()
+
+    def _publish_result_bytes(self) -> None:
+        if self.result_store is not None:
+            self.registry.gauge(
+                SERVING_RESULT_CACHE_BYTES,
+                help="resident bytes in the router result store",
+            ).set(self.result_store.bytes)
+
+    def _fleet_result_version(self) -> Optional[str]:
+        """The one program version every healthy replica publishes, or None.
+
+        The router tier only engages while the WHOLE healthy set agrees
+        on a single ``result_version`` (each replica's ``/readyz``
+        ``result_cache.program_version``). During a rolling restart the
+        fleet is mixed, the set has two members, and the tier bypasses by
+        construction — a mask the old algorithm computed can never answer
+        a request the new one would segment differently. Invalidation is
+        the key changing, not a flush.
+        """
+        versions = {
+            self.replicas.signals(t).get("result_version")
+            for t in self.replicas.healthy_targets()
+        }
+        if len(versions) != 1:
+            return None
+        v = versions.pop()
+        return v or None
+
+    def _result_digest(
+        self, body: bytes, query: str, path: str
+    ) -> Optional[str]:
+        """This request's content-addressed key digest, or None (bypass).
+
+        None when the tier is off or the healthy set doesn't currently
+        agree on one program version. Router keys hash the raw query
+        string's sorted parameters: the router never interprets replica
+        semantics (defaults, clamping), so two spellings of one request
+        land on different keys and both simply miss — never wrong, at
+        worst one extra compute.
+        """
+        if self.result_store is None:
+            return None
+        version = self._fleet_result_version()
+        if version is None:
+            return None
+        algo = "segment-volume" if path.endswith("-volume") else "segment"
+        params = dict(
+            sorted(parse_qs(query, keep_blank_values=True).items())
+        )
+        return result_key(body, algo, params, version).digest()
+
+    def _serve_cached(
+        self, entry, headers: dict, ctx: "TraceContext", seq: int,
+        t_req: float,
+    ) -> Tuple[int, bytes, List[Tuple[str, str]]]:
+        """Answer a router-tier hit: 304 on a matching ``If-None-Match``,
+        else the stored payload with this request's own identity fields.
+
+        The HTTP ETag served is the REPLICA's content ETag when one was
+        recorded at fill (entry.meta) — so revalidation works identically
+        whichever tier answers — falling back to the store's own payload
+        digest when the replica tier was off.
+        """
+        etag = entry.meta.get("etag") or entry.etag
+        inm = next(
+            (v for k, v in headers.items() if k.lower() == "if-none-match"),
+            None,
+        )
+        base = [
+            ("ETag", etag),
+            ("X-Nm03-Cache", "hit"),
+            ("X-Nm03-Request-Id", ctx.trace_id),
+            ("X-Nm03-Replica-Hops", "0"),
+        ]
+        if etag_matches(inm, etag):
+            self._finish_request(ctx, seq, t_req, 304, None, 0)
+            return 304, b"", base
+        data = entry.payload
+        try:
+            payload = json.loads(data)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            payload = None
+        if isinstance(payload, dict):
+            # per-execution fields tell THIS request's truth: nothing
+            # ran, nothing waited, nothing hopped (replica/replica_id
+            # stay — they name who computed the stored result)
+            payload["request_id"] = ctx.trace_id
+            payload["cached"] = True
+            payload["device_seconds"] = 0.0
+            payload["queue_wait_s"] = 0.0
+            payload["replica_hops"] = 0
+            data = json.dumps(payload).encode()
+        self._finish_request(ctx, seq, t_req, 200, None, 0)
+        return 200, data, [("Content-Type", "application/json"), *base]
+
+    def _result_fill(
+        self, digest: str, data: bytes, path: str,
+        resp_headers: List[Tuple[str, str]],
+    ) -> None:
+        """Store one routed 200 at the router tier.
+
+        The stored bytes are the AUGMENTED payload (replica identity
+        included) — ``entry.etag`` must stay the digest of exactly those
+        bytes because it doubles as the verify-on-read check — while the
+        replica's own content ETag (when its tier is on) rides in
+        ``entry.meta`` for the HTTP surface.
+        """
+        if self.result_store is None:
+            return
+        algo = "segment-volume" if path.endswith("-volume") else "segment"
+        replica_etag = next(
+            (v for k, v in resp_headers if k.lower() == "etag"), None
+        )
+        entry, created = self.result_store.fill(
+            digest, data, algo,
+            meta={"etag": replica_etag} if replica_etag else None,
+        )
+        if created:
+            self._count_result(
+                SERVING_RESULT_CACHE_FILL_TOTAL,
+                "computed results stored into the tier, by tier",
+            )
+            self._publish_result_bytes()
+
     def proxy_segment(
         self, body: bytes, headers: dict, query: str = "",
         trace_id: Optional[str] = None, path: str = "/v1/segment",
@@ -542,6 +716,22 @@ class FleetApp:
             if k.lower() not in ("x-nm03-request-id", "x-nm03-probe")
         }
         headers["X-Nm03-Request-Id"] = ctx.trace_id
+        # the router-side lookup happens BEFORE admission to the pick
+        # loop (ISSUE 19): a hit never spends a WRR round, never costs a
+        # replica pick, and charges zero device-seconds anywhere
+        cache_digest = self._result_digest(body, query, path)
+        if cache_digest is not None:
+            entry = self.result_store.lookup(cache_digest)
+            if entry is not None:
+                self._count_result(
+                    SERVING_RESULT_CACHE_HIT_TOTAL,
+                    "result-tier lookups served from cache, by tier",
+                )
+                return self._serve_cached(entry, headers, ctx, seq, t_req)
+            self._count_result(
+                SERVING_RESULT_CACHE_MISS_TOTAL,
+                "result-tier lookups that fell through to compute, by tier",
+            )
         plan = self.fault_plan
         tried: set = set()
         hops = 0
@@ -640,6 +830,10 @@ class FleetApp:
             out_headers = self._response_headers(resp_headers, final, hops)
             if status == 200:
                 data = self._augment_payload(data, final, hops)
+                if cache_digest is not None:
+                    # replica-side fill rides home through the router's
+                    # own tier: the next identical study never leaves it
+                    self._result_fill(cache_digest, data, path, resp_headers)
         else:
             # no healthy replica left (or every one shed / died)
             self.registry.counter(
@@ -680,7 +874,9 @@ class FleetApp:
         """One proxied request's terminal accounting: the SLO layer's
         status class + latency observation, and the ``fleet_trace``
         event carrying the router's span chain."""
-        if 200 <= status < 300:
+        if 200 <= status < 400:
+            # 304 Not Modified is a served verdict (the result tier's
+            # revalidation answer), not an error — it burns no budget
             cls = "ok"
         elif status == 503:
             cls = "shed"
@@ -723,7 +919,7 @@ class FleetApp:
         out = [
             (k, v) for k, v in resp_headers
             if k.lower().startswith(_FORWARD_PREFIX)
-            or k.lower() == "content-type"
+            or k.lower() in ("content-type", "etag")
         ]
         out.append(("X-Nm03-Replica", target_label(target)))
         out.append(("X-Nm03-Replica-Hops", str(hops)))
@@ -787,6 +983,17 @@ class FleetApp:
             # publish_gauges() — one probe must sample once
             "slo": self.slo.last_block() if self.slo is not None else None,
             "capacity": round(self.replicas.capacity_fraction(), 6),
+            # the router-side result tier (ISSUE 19): stats + the
+            # fleet-agreed program version (null while the healthy set
+            # disagrees — the rolling-restart bypass window)
+            "result_cache": (
+                {
+                    **self.result_store.stats(),
+                    "program_version": self._fleet_result_version(),
+                }
+                if self.result_store is not None
+                else {"enabled": False}
+            ),
             "replicas": {
                 "count": len(self.replicas),
                 "ready": self.replicas.healthy_count(),
@@ -851,6 +1058,22 @@ def make_handler(app: FleetApp):
                     json.dumps(app.obs.metrics_snapshot(), indent=1).encode(),
                     [("Content-Type", "application/json")],
                 )
+            elif path == "/debug/result-cache":
+                # the result tier's admin surface (ISSUE 19): stats plus
+                # hot-to-cold rows, and the fleet-agreed program version
+                # (null while the healthy set disagrees — the bypass
+                # window an operator sees during a rolling restart)
+                if app.result_store is None:
+                    self._reply_json(200, {"enabled": False})
+                else:
+                    self._reply_json(
+                        200,
+                        {
+                            **app.result_store.stats(),
+                            "program_version": app._fleet_result_version(),
+                            "ls": app.result_store.ls(),
+                        },
+                    )
             elif path == "/debug/flightrec":
                 # the remote debug pull (ISSUE 14): the router's own
                 # flight rings over HTTP — `nm03-fleet flightrec` fans
@@ -875,6 +1098,19 @@ def make_handler(app: FleetApp):
                 self.headers.get("X-Nm03-Request-Id")
             ) or new_trace_id()
             echo = [("X-Nm03-Request-Id", trace_id)]
+            if split.path == "/debug/result-cache/evict":
+                # admin evict (?digest=D for one entry, bare for all)
+                if app.result_store is None:
+                    self._reply_json(
+                        404, {"error": "result tier disabled"}, echo
+                    )
+                    return
+                qs = parse_qs(split.query)
+                digest = (qs.get("digest") or [None])[0]
+                n = app.result_store.evict(digest)
+                app._publish_result_bytes()
+                self._reply_json(200, {"evicted": n}, echo)
+                return
             if split.path not in ("/v1/segment", "/v1/segment-volume"):
                 self._reply_json(
                     404, {"error": f"unknown path {split.path}"}, echo

@@ -126,6 +126,17 @@ SERVING_DEVICE_SECONDS_PER_REQUEST_MEAN = (
 SERVING_DEVICE_TIME_SHARE = "serving_device_time_share"
 SERVING_EXECUTABLE_HBM_BYTES = "serving_executable_hbm_bytes"
 LEDGER_PROFILE_SKIPPED_TOTAL = "ledger_profile_skipped_total"
+# content-addressed result tier (cache/ subsystem, ISSUE 19): lookup
+# outcomes by tier — router (a hit never spends a WRR round), replica
+# (store in front of the batcher) and inflight (the batcher dedup window
+# plus volume-gang coalescing) — and the replica store's resident bytes.
+# Defined HERE for the fleet reason: the cache package is jax-/numpy-free
+# by contract and the router-side store must not import serving.
+SERVING_RESULT_CACHE_HIT_TOTAL = "serving_result_cache_hit_total"
+SERVING_RESULT_CACHE_MISS_TOTAL = "serving_result_cache_miss_total"
+SERVING_RESULT_CACHE_FILL_TOTAL = "serving_result_cache_fill_total"
+SERVING_RESULT_CACHE_EVICT_TOTAL = "serving_result_cache_evict_total"
+SERVING_RESULT_CACHE_BYTES = "serving_result_cache_bytes"
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")

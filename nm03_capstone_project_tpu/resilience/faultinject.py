@@ -82,11 +82,17 @@ KINDS_BY_SITE = {
     "decode": ("error", "corrupt"),
     "dispatch": ("transient", "hang"),
     "export": ("io_error", "sigterm"),
-    # the persistent compile cache's store path (compilehub/persist.py):
-    # io_error aborts the entry write, proving a failed persist degrades
-    # to a plain recompile on the next start — never a torn entry (the
-    # write itself is atomic; `stem` selects the entry filename)
-    "cache": ("io_error",),
+    # the cache site covers both cache tiers and disambiguates with
+    # fire()'s `kinds` filter, like the fleet site's pair. io_error is
+    # the persistent COMPILE cache's store path (compilehub/persist.py):
+    # it aborts the entry write, proving a failed persist degrades to a
+    # plain recompile on the next start — never a torn entry (the write
+    # itself is atomic; `stem` selects the entry filename). corrupt_entry
+    # is the RESULT tier's read path (ISSUE 19, cache/store.py
+    # verify-on-read): the lookup sees one flipped byte, the digest check
+    # evicts the entry and reports a miss — a corrupt entry costs one
+    # recompute, never a wrong mask (`stem` selects the result-key digest)
+    "cache": ("io_error", "corrupt_entry"),
     # the streaming-ingest pipeline (ingest/, ISSUE 11): `decode_error`
     # fails one work item on the decode pool (contained as an
     # IngestFailure record the driver counts); `stall` wedges the stager

@@ -51,6 +51,7 @@ from nm03_capstone_project_tpu.serving.metrics import (
     SERVING_BATCH_SIZE,
     SERVING_QUEUE_WAIT_SECONDS,
     SERVING_REQUEUES_TOTAL,
+    SERVING_RESULT_CACHE_HIT_TOTAL,
 )
 from nm03_capstone_project_tpu.serving.queue import AdmissionQueue, ServeRequest
 from nm03_capstone_project_tpu.utils.reporter import get_logger
@@ -459,19 +460,40 @@ class DynamicBatcher:
                 popped = r.t_popped or now
                 r.trace.add_span("queue_wait", r.t_admitted, popped)
                 r.trace.add_span("coalesce", popped, now)
+        # the in-flight dedup window (ISSUE 19): identical content-
+        # addressed slices in one window ride a SINGLE dispatch — the
+        # first of each digest becomes the leader, the rest become
+        # riders that copy its mask after the barrier. A zipfian replay
+        # that lands 8 copies of one study in a window spends one batch
+        # row on it, not eight.
+        leaders: List[ServeRequest] = []
+        dup_riders: dict = {}
+        leader_by_digest: dict = {}
+        for r in reqs:
+            d = getattr(r, "digest", None)
+            if d is None or getattr(r, "probe", False):
+                leaders.append(r)
+                continue
+            if d in leader_by_digest:
+                dup_riders.setdefault(d, []).append(r)
+            else:
+                leader_by_digest[d] = r
+                leaders.append(r)
         # fan over the lanes that are actually taking traffic: a window
         # coalesced while lane 2 sat in quarantine splits across the other
         # three and never waits on the sick chip
         targets = self.healthy_lanes()
-        chunks = self._chunk(reqs, len(targets))
+        chunks = self._chunk(leaders, len(targets))
         sat = getattr(self.executor, "saturation", None)
         if sat is not None:
             # occupancy: this window's riders against what the HEALTHY
             # fleet could have carried (largest bucket x healthy lanes) —
             # a persistently low ratio means the fleet is oversized for
             # the offered load, not that batching is broken
+            # deduped rows are real capacity headroom: occupancy counts
+            # what was actually dispatched, not the rider count
             sat.record_window(
-                len(reqs), self.executor.max_batch * len(targets)
+                len(leaders), self.executor.max_batch * len(targets)
             )
         if reg is not None:
             wait_h = reg.histogram(
@@ -488,7 +510,7 @@ class DynamicBatcher:
                 SERVING_BATCH_SIZE,
                 help="coalesced (pre-padding) batch sizes",
                 buckets=BATCH_SIZE_BUCKETS,
-            ).observe(len(reqs))
+            ).observe(len(leaders))
             reg.counter(
                 SERVING_BATCHES_TOTAL,
                 help="device batches dispatched by the serving batcher",
@@ -504,19 +526,56 @@ class DynamicBatcher:
             )
         if len(chunks) == 1:
             self._execute_chunk(chunks[0], assign[0])
-            return
-        with self._lock:
-            if self._pool is None:
-                # sized to the FULL fleet: reinstated lanes must not queue
-                # behind a pool sized during a quarantine dip
-                self._pool = cf.ThreadPoolExecutor(
-                    max_workers=self.lanes(),
-                    thread_name_prefix="nm03-serve-lane",
-                )
-            pool = self._pool
-        futures = [
-            pool.submit(self._execute_chunk, chunk, assign[ci])
-            for ci, chunk in enumerate(chunks)
-        ]
-        for f in futures:
-            f.result()  # _execute_chunk never raises; this is the barrier
+        else:
+            with self._lock:
+                if self._pool is None:
+                    # sized to the FULL fleet: reinstated lanes must not
+                    # queue behind a pool sized during a quarantine dip
+                    self._pool = cf.ThreadPoolExecutor(
+                        max_workers=self.lanes(),
+                        thread_name_prefix="nm03-serve-lane",
+                    )
+                pool = self._pool
+            futures = [
+                pool.submit(self._execute_chunk, chunk, assign[ci])
+                for ci, chunk in enumerate(chunks)
+            ]
+            for f in futures:
+                f.result()  # _execute_chunk never raises; the barrier
+        if dup_riders:
+            self._fan_out_duplicates(leader_by_digest, dup_riders, reg)
+
+    def _fan_out_duplicates(self, leader_by_digest, dup_riders, reg) -> None:
+        """Answer dedup riders from their leader's filled result.
+
+        Runs after the window's dispatch barrier, so every leader's
+        ``done`` has fired. Riders share the leader's mask ARRAY (the
+        HTTP layer only reads it), its convergence verdict and — on the
+        sad path — its error; they charge the ledger ZERO device-seconds,
+        which is exactly the dedup win the ledger must show.
+        """
+        hit = None
+        if reg is not None:
+            hit = reg.counter(
+                SERVING_RESULT_CACHE_HIT_TOTAL,
+                help="result-tier lookups served from cache, by tier",
+                tier="inflight",
+            )
+        ledger = getattr(self.executor, "ledger", None)
+        for d, riders in dup_riders.items():
+            leader = leader_by_digest[d]
+            for r in riders:
+                if leader.error is not None:
+                    r.fail(leader.error)
+                    continue
+                r.mask = leader.mask
+                r.converged = leader.converged
+                r.batch_size = leader.batch_size
+                r.lane = leader.lane
+                r.requeues = leader.requeues
+                r.device_seconds = 0.0
+                if ledger is not None and not getattr(r, "probe", False):
+                    ledger.observe_request(0.0)
+                if hit is not None:
+                    hit.inc()
+                r.done.set()

@@ -163,6 +163,15 @@ class LoadResult:
         # `device_seconds` payload field — the client-side view of the
         # serving_device_seconds_per_request histogram
         self.device_seconds: List[float] = []
+        # result-tier attribution (ISSUE 19): each ok response's
+        # X-Nm03-Cache verdict (hit | miss | fill), with the latency and
+        # device-seconds distributions split served-from-cache vs
+        # computed — the zipfian replay's evidence columns
+        self.cache_states: collections.Counter = collections.Counter()
+        self.latencies_hit_s: List[float] = []
+        self.latencies_miss_s: List[float] = []
+        self.device_seconds_hit: List[float] = []
+        self.device_seconds_miss: List[float] = []
 
     def record(self, status: str, latency_s: float, batch_size: int = 0,
                error: str = "", sent_id: str = "", echoed_id: str = "",
@@ -172,7 +181,8 @@ class LoadResult:
                replica_hops: Optional[int] = None,
                z_shards: Optional[int] = None,
                gang_wait_s: Optional[float] = None,
-               device_s: Optional[float] = None) -> None:
+               device_s: Optional[float] = None,
+               cache_state: Optional[str] = None) -> None:
         with self._lock:
             self.statuses[status] += 1
             if status == "ok":
@@ -193,6 +203,16 @@ class LoadResult:
                     self.gang_waits_s.append(gang_wait_s)
                 if device_s is not None:
                     self.device_seconds.append(device_s)
+                if cache_state is not None:
+                    self.cache_states[cache_state] += 1
+                    if cache_state == "hit":
+                        self.latencies_hit_s.append(latency_s)
+                        if device_s is not None:
+                            self.device_seconds_hit.append(device_s)
+                    else:  # miss and fill both computed
+                        self.latencies_miss_s.append(latency_s)
+                        if device_s is not None:
+                            self.device_seconds_miss.append(device_s)
             elif error and len(self.errors) < 20:
                 self.errors.append(error)
             if sent_id and echoed_id and sent_id != echoed_id:
@@ -220,6 +240,8 @@ class LoadResult:
                     rec["gang_wait_ms"] = round(gang_wait_s * 1e3, 3)
                 if device_s is not None:
                     rec["device_seconds"] = round(device_s, 9)
+                if cache_state is not None:
+                    rec["cache"] = cache_state
                 self.requests.append(rec)
             else:
                 # counted, not silent: a soak past the cap must say so in
@@ -297,6 +319,37 @@ class LoadResult:
                 "mean": round(sum(ds) / len(ds) * 1e3, 3),
                 "max": round(ds[-1] * 1e3, 3),
                 "sum_s": round(sum(ds), 6),
+            }
+            # the result-tier split (ISSUE 19): what a hit is worth —
+            # hit_mean must read ~0.0 (a hit charges no device time),
+            # miss_mean is what each cold study actually cost
+            if self.device_seconds_hit or self.device_seconds_miss:
+                dh, dm = self.device_seconds_hit, self.device_seconds_miss
+                out["device_seconds_ms"]["hit_mean"] = (
+                    round(sum(dh) / len(dh) * 1e3, 6) if dh else None
+                )
+                out["device_seconds_ms"]["miss_mean"] = (
+                    round(sum(dm) / len(dm) * 1e3, 6) if dm else None
+                )
+        # result-tier evidence (ISSUE 19): the hit ratio clients saw
+        # (X-Nm03-Cache: hit over every response that carried the
+        # header) and the latency split that prices a repeat study
+        if self.cache_states:
+            total_states = sum(self.cache_states.values())
+            hits = self.cache_states.get("hit", 0)
+            lh = sorted(self.latencies_hit_s)
+            lm = sorted(self.latencies_miss_s)
+            out["cache_hit_ratio"] = round(hits / total_states, 4)
+            out["cache"] = {
+                "states": dict(sorted(self.cache_states.items())),
+                "hit_latency_ms": {
+                    "p50": round(_percentile(lh, 50) * 1e3, 3),
+                    "p95": round(_percentile(lh, 95) * 1e3, 3),
+                },
+                "miss_latency_ms": {
+                    "p50": round(_percentile(lm, 50) * 1e3, 3),
+                    "p95": round(_percentile(lm, 95) * 1e3, 3),
+                },
             }
         out["trace_echo_mismatches"] = self.echo_mismatches
         if self.requests_dropped:
@@ -398,6 +451,28 @@ def _make_volume_payloads(
     return payloads
 
 
+def _zipf_schedule(payloads, n_requests: int, s: float):
+    """Expand ``payloads`` into a per-request zipfian replay (ISSUE 19).
+
+    Request *i* sends ``schedule[i % n]`` — ``run_load``'s round-robin
+    indexing — so pre-drawing the whole schedule turns study REUSE into
+    plain list repetition with zero change to the send path (the entries
+    alias the same body bytes; nothing is copied). Rank *r* is drawn
+    with P(r) ∝ 1/r^s over the keyspace; at s ≈ 1.1 over 32 studies the
+    hottest study is roughly a quarter of all traffic — the skew a
+    hospital's repeat-read workload actually shows, and the one the
+    result tier is priced against. The seed is fixed: two runs replay
+    the identical request stream, so a cold-vs-warm comparison differs
+    only in cache state.
+    """
+    ranks = np.arange(1, len(payloads) + 1, dtype=np.float64)
+    probs = ranks ** -float(s)
+    probs /= probs.sum()
+    rng = np.random.default_rng(20260807)
+    draws = rng.choice(len(payloads), size=max(1, int(n_requests)), p=probs)
+    return [payloads[int(i)] for i in draws]
+
+
 def _one_request(url: str, body: bytes, headers: dict, timeout_s: float,
                  result: LoadResult, req_id: str = "") -> None:
     t0 = time.monotonic()
@@ -428,6 +503,9 @@ def _one_request(url: str, body: bytes, headers: dict, timeout_s: float,
                 resp.headers.get("X-Nm03-Replica")
                 or urllib.parse.urlsplit(url).netloc
             )
+            # result-tier verdict (ISSUE 19): hit | miss | fill, absent
+            # when neither tier is enabled on the serving side
+            cache_state = resp.headers.get("X-Nm03-Cache")
             hops = None
             z_shards = gang_wait = device_s = None
             try:
@@ -448,7 +526,7 @@ def _one_request(url: str, body: bytes, headers: dict, timeout_s: float,
                 echoed_id=echoed, queue_wait_s=qw, lane=lane,
                 replica=replica, replica_hops=hops,
                 z_shards=z_shards, gang_wait_s=gang_wait,
-                device_s=device_s,
+                device_s=device_s, cache_state=cache_state,
             )
     except urllib.error.HTTPError as e:
         echoed = e.headers.get("X-Nm03-Request-Id", "") if e.headers else ""
@@ -759,6 +837,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--distinct", type=int, default=4, help="distinct pre-built payloads"
     )
+    p.add_argument(
+        "--zipf", type=float, default=0.0, metavar="S",
+        help="zipfian study-reuse replay (ISSUE 19): draw each request's "
+        "payload with P(rank r) ∝ 1/r^S over --keyspace distinct studies "
+        "(S≈1.1 is a realistic hot-study skew; 0 disables) — the mode "
+        "that exercises the result tier; the summary gains "
+        "cache_hit_ratio and the hit/miss latency and device-seconds "
+        "split",
+    )
+    p.add_argument(
+        "--keyspace", type=int, default=32, metavar="N",
+        help="distinct synthetic studies the --zipf draw ranges over "
+        "(replaces --distinct in zipf mode)",
+    )
     p.add_argument("--timeout-s", type=float, default=30.0, help="per-request timeout")
     p.add_argument(
         "--warmup", type=int, default=4,
@@ -827,19 +919,25 @@ def main(argv=None) -> int:
         url = bases[0]
     else:
         bases = [url]
+    # zipf replay mode (ISSUE 19): the keyspace replaces --distinct and
+    # the payload list becomes a pre-drawn per-request schedule
+    zipf_on = args.zipf and args.zipf > 0
+    n_distinct = max(1, args.keyspace) if zipf_on else args.distinct
     if args.volume:
         # whole-study mode: the summary payload (no mask bytes) keeps the
         # wire cheap — the gates read z_shards/gang_wait_s, not the mask
         endpoints = [f"{b}/v1/segment-volume?output=summary" for b in bases]
         payloads = _make_volume_payloads(
-            args.volume_depth, args.height, args.width, args.distinct,
+            args.volume_depth, args.height, args.width, n_distinct,
             args.dicom,
         )
     else:
         endpoints = [f"{b}/v1/segment?output={args.mode}" for b in bases]
         payloads = _make_payloads(
-            args.height, args.width, args.distinct, args.dicom
+            args.height, args.width, n_distinct, args.dicom
         )
+    if zipf_on:
+        payloads = _zipf_schedule(payloads, args.requests, args.zipf)
     endpoint = endpoints[0]
     if args.warmup > 0:
         warm = LoadResult()  # discarded: compile/cache effects stay out
@@ -857,6 +955,8 @@ def main(argv=None) -> int:
     summary["endpoint"] = endpoint
     if args.targets:
         summary["targets"] = bases
+    if zipf_on:
+        summary["zipf"] = {"s": args.zipf, "keyspace": n_distinct}
     # serving topology alongside the numbers (mesh_shape/lanes ride next to
     # the drivers' backend_requested/backend_actual honesty pair): probed
     # from the live server so the record describes what actually served
@@ -924,6 +1024,16 @@ def main(argv=None) -> int:
             f"device_seconds_p50={db['p50']}ms "
             f"device_seconds_p95={db['p95']}ms "
         )
+    cache_cols = ""
+    if summary.get("cache_hit_ratio") is not None:
+        # the result-tier columns (ISSUE 19): printed whenever any
+        # response carried an X-Nm03-Cache verdict
+        cb = summary["cache"]
+        cache_cols = (
+            f"cache_hit_ratio={summary['cache_hit_ratio']} "
+            f"hit_p50={cb['hit_latency_ms']['p50']}ms "
+            f"miss_p50={cb['miss_latency_ms']['p50']}ms "
+        )
     fleet_cols = ""
     if summary.get("targets") or summary["replicas"] is not None:
         # the fleet columns (ISSUE 13): printed on --targets runs and
@@ -944,6 +1054,7 @@ def main(argv=None) -> int:
         f"padding_max={_pct(summary['padding_waste_max_observed'])} "
         f"mfu_max={_pct(summary['mfu_max_observed'])} "
         f"{ds_cols}"
+        f"{cache_cols}"
         f"{vol_cols}"
         f"{fleet_cols}"
         f"echo_mismatch={summary['trace_echo_mismatches']}",

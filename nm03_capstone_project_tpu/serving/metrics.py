@@ -30,6 +30,11 @@ from nm03_capstone_project_tpu.obs.metrics import (  # noqa: F401
     SERVING_LANE_PEAK_FLOPS,
     SERVING_MFU,
     SERVING_PADDING_WASTE_RATIO,
+    SERVING_RESULT_CACHE_BYTES,
+    SERVING_RESULT_CACHE_EVICT_TOTAL,
+    SERVING_RESULT_CACHE_FILL_TOTAL,
+    SERVING_RESULT_CACHE_HIT_TOTAL,
+    SERVING_RESULT_CACHE_MISS_TOTAL,
     SERVING_WINDOW_OCCUPANCY_RATIO,
     SLO_BURN_RATE_FAST,
     SLO_BURN_RATE_SLOW,

@@ -71,6 +71,10 @@ class ServeRequest:
     # chunk's accumulated device-busy seconds, stamped by the batcher and
     # echoed in the response payload as `device_seconds`
     device_seconds: float = 0.0
+    # content-addressed result-key digest (ISSUE 19): identical digests in
+    # one batch window ride a single dispatch — the batcher elects a leader
+    # and fans its mask out to the dup riders. None = dedup not in play.
+    digest: Optional[str] = None
     error: Optional[BaseException] = None
     done: threading.Event = field(default_factory=threading.Event)
 

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import argparse
 import base64
+import hashlib
 import json
 import os
 import signal
@@ -47,6 +48,13 @@ from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
+from nm03_capstone_project_tpu.cache import (
+    InflightIndex,
+    ResultStore,
+    etag_matches,
+    parse_bytes,
+    result_key,
+)
 from nm03_capstone_project_tpu.config import PipelineConfig
 from nm03_capstone_project_tpu.obs.trace import (
     SERVE_TRACE_EVENT,
@@ -69,6 +77,11 @@ from nm03_capstone_project_tpu.serving.metrics import (
     SERVING_READY,
     SERVING_REQUESTS_TOTAL,
     SERVING_REQUEST_SECONDS,
+    SERVING_RESULT_CACHE_BYTES,
+    SERVING_RESULT_CACHE_EVICT_TOTAL,
+    SERVING_RESULT_CACHE_FILL_TOTAL,
+    SERVING_RESULT_CACHE_HIT_TOTAL,
+    SERVING_RESULT_CACHE_MISS_TOTAL,
     SERVING_SHED_TOTAL,
 )
 from nm03_capstone_project_tpu.serving.queue import (
@@ -106,13 +119,60 @@ def _cache_fault_hook(fault_plan, obs):
     from nm03_capstone_project_tpu.resilience import InjectedExportError
 
     def hook(entry_name: str) -> None:
-        rule = fault_plan.fire("cache", obs=obs, stem=entry_name)
-        if rule is not None:  # the site's only kind is io_error
+        rule = fault_plan.fire(
+            "cache", obs=obs, stem=entry_name, kinds=("io_error",)
+        )
+        if rule is not None:
             raise InjectedExportError(
                 f"injected compile-cache io error ({entry_name})"
             )
 
     return hook
+
+
+def _result_corrupt_hook(fault_plan, obs):
+    """The result store's chaos hook (site ``cache``/``corrupt_entry``).
+
+    Consulted by ``ResultStore.lookup`` with the result-key digest; a
+    firing rule hands the verifier a payload with one flipped byte — the
+    drill that proves verify-on-read evicts and recomputes, so a corrupt
+    entry is a miss, never a wrong mask (docs/RESILIENCE.md).
+    """
+    if fault_plan is None or not fault_plan.has_site("cache"):
+        return None
+
+    def hook(digest: str) -> bool:
+        return fault_plan.fire(
+            "cache", obs=obs, stem=digest, kinds=("corrupt_entry",)
+        ) is not None
+
+    return hook
+
+
+# the response fields a result entry stores, per algo: everything derived
+# from the INPUT (and so covered by the content-addressed key), nothing
+# per-execution (request ids, queue waits, lane numbers, device seconds —
+# a hit merges fresh values for those). Keeping the stored subset
+# execution-free is what makes the ETag stable across evict/recompute
+# cycles: the bit-identity gate in tests/bench rides on it.
+_CACHEABLE_SEGMENT_FIELDS = (
+    "shape",
+    "grow_converged",
+    "mask_pixels",
+    "mask_sha256",
+    "original_jpeg_b64",
+    "processed_jpeg_b64",
+)
+_CACHEABLE_VOLUME_FIELDS = (
+    "shape",
+    "grow_converged",
+    "mask_voxels",
+    "mask_sha256",
+    "mask_b64",
+    "mhd_header_b64",
+    "mhd_data_b64",
+    "mhd_data_file",
+)
 
 
 class ServingApp:
@@ -141,6 +201,7 @@ class ServingApp:
         distributed_init: bool = False,
         ledger_profile_interval_s: float = 0.0,
         ledger_profile_ms: int = 200,
+        result_cache_bytes: int = 0,
     ):
         from nm03_capstone_project_tpu.obs import RunContext
         from nm03_capstone_project_tpu.serving.executor import (
@@ -249,6 +310,30 @@ class ServingApp:
                 fault_plan=fault_plan,
                 distributed=distributed_init,
             )
+        # the content-addressed result tier (ISSUE 19): replica-side store
+        # in front of the batcher, bounded by bytes (0 = disabled). The
+        # in-flight index exists whenever the tier does — it is what lets
+        # an idempotent volume retry coalesce onto a running gang instead
+        # of dispatching a second mesh-wide program.
+        self.result_store = None
+        self.volume_inflight = None
+        if result_cache_bytes and int(result_cache_bytes) > 0:
+            self.result_store = ResultStore(
+                int(result_cache_bytes),
+                corrupt_hook=_result_corrupt_hook(fault_plan, self.obs),
+                on_evict=self._on_result_evict,
+            )
+            self.volume_inflight = InflightIndex()
+            # the bytes gauge exists (at 0) from startup on any
+            # tier-enabled process: its presence IS nm03-top's
+            # tier-enabled signal, and a clean run's snapshot proves
+            # "nothing resident" instead of saying nothing
+            self._publish_result_bytes()
+        # the program-version half of every result key: resolved lazily
+        # (compilehub.persist imports jax) and then pinned for the
+        # process's lifetime — the key contract, not a per-request cost
+        self._rv_lock = threading.Lock()
+        self._rv_value = None
         self.request_timeout_s = float(request_timeout_s)
         self.jpeg_quality = int(jpeg_quality)
         self.draining = False
@@ -463,6 +548,25 @@ class ServingApp:
                 if self.volumes is not None
                 else {"enabled": False}
             ),
+            # the result tier (ISSUE 19). program_version is published
+            # even with the tier off: it is the replica's result-key
+            # identity, and the FLEET router's store keys on it — the
+            # router only enables its tier when every healthy replica
+            # agrees on one value (a mixed fleet mid-rolling-restart
+            # bypasses the tier by construction, never serves stale).
+            "result_cache": {
+                "program_version": (
+                    self.result_version() if self.executor.warm else None
+                ),
+                **(
+                    {
+                        **self.result_store.stats(),
+                        "inflight": self.volume_inflight.stats(),
+                    }
+                    if self.result_store is not None
+                    else {"enabled": False}
+                ),
+            },
             # stats() carries the total_compile_seconds rollup; the per-spec
             # map makes warmup cost visible without grepping logs (ISSUE 7)
             "compile_hub": {
@@ -592,6 +696,324 @@ class ServingApp:
             status=status,
         ).inc()
 
+    # -- the result tier (ISSUE 19, HTTP-free) -----------------------------
+
+    def _on_result_evict(self, n: int) -> None:
+        # fired from inside the store's lock — a counter bump only (the
+        # bytes gauge is refreshed outside the lock, see
+        # _publish_result_bytes)
+        self.registry.counter(
+            SERVING_RESULT_CACHE_EVICT_TOTAL,
+            help="result-tier entries evicted by tier (LRU pressure, "
+            "explicit evict, or a failed verify-on-read)",
+            tier="replica",
+        ).inc(n)
+
+    def _publish_result_bytes(self) -> None:
+        # called once from __init__ (before self.registry is aliased), so
+        # reach through self.obs directly
+        if self.result_store is not None:
+            self.obs.registry.gauge(
+                SERVING_RESULT_CACHE_BYTES,
+                help="resident bytes in the replica result store",
+            ).set(self.result_store.bytes)
+
+    def result_version(self) -> str:
+        """The program-identity half of every result key, pinned once.
+
+        Resolved lazily (``compilehub.persist`` imports jax) under its
+        own lock, then constant for the process's lifetime — versions
+        cannot change under a running server, and a restart with a new
+        algorithm mints a new value, which is the whole invalidation
+        story.
+        """
+        with self._rv_lock:
+            if self._rv_value is None:
+                from nm03_capstone_project_tpu.compilehub.persist import (
+                    result_version,
+                )
+
+                self._rv_value = result_version(self.cfg)
+            return self._rv_value
+
+    def result_digest(self, body: bytes, algo: str, params: dict):
+        """ResultKey digest for one request body, or None (tier off)."""
+        if self.result_store is None:
+            return None
+        return result_key(body, algo, params, self.result_version()).digest()
+
+    def result_lookup(self, digest: str):
+        """Replica-tier store lookup + hit/miss accounting."""
+        entry = self.result_store.lookup(digest)
+        self.registry.counter(
+            SERVING_RESULT_CACHE_HIT_TOTAL if entry is not None
+            else SERVING_RESULT_CACHE_MISS_TOTAL,
+            help="result-tier lookups served from cache, by tier"
+            if entry is not None
+            else "result-tier lookups that fell through to compute, by tier",
+            tier="replica",
+        ).inc()
+        return entry
+
+    def result_fill(self, digest: str, payload: dict, algo: str, fields):
+        """Store the cacheable subset of ``payload``; ('fill'|'miss', etag).
+
+        'miss' is the honest ``X-Nm03-Cache`` value for computed-but-not-
+        stored (an oversize payload): the work was done, nothing cached.
+        Only input-derived fields are stored (never request ids, waits or
+        lane numbers) so the entry's ETag is stable across evict/
+        recompute cycles — the bit-identity contract the tests gate.
+        """
+        stored = {k: payload[k] for k in fields if k in payload}
+        raw = json.dumps(stored, sort_keys=True).encode()
+        entry, created = self.result_store.fill(digest, raw, algo)
+        if entry is None:
+            return "miss", None
+        if created:
+            self.registry.counter(
+                SERVING_RESULT_CACHE_FILL_TOTAL,
+                help="computed results stored into the tier, by tier",
+                tier="replica",
+            ).inc()
+            self._publish_result_bytes()
+        return "fill", entry.etag
+
+    def _payload_from_entry(self, entry, trace_id, volume: bool = False):
+        """A served-from-store response: stored fields + fresh identity.
+
+        Execution-scoped fields are minted per response: batch_size 0 /
+        lane None / z_shards 0 and device_seconds 0.0 are the honest
+        values for work the device never saw.
+        """
+        payload = dict(json.loads(entry.payload.decode()))
+        payload.update(
+            request_id=uuid.uuid4().hex[:12],
+            trace_id=trace_id,
+            queue_wait_s=0.0,
+            requeues=0,
+            device_seconds=0.0,
+            cached=True,
+        )
+        if volume:
+            payload.update(z_shards=0, gang_wait_s=0.0)
+        else:
+            payload.update(
+                batch_size=0, lane=None, degraded=self.executor.degraded
+            )
+        return payload
+
+    def _account_cached_hit(
+        self, trace_id, request_id, volume: bool, t_start: float
+    ) -> None:
+        """A hit is a served request: counted, traced, and charged ZERO
+        device-seconds — the falling ``device_seconds/request`` mean on a
+        repeat-heavy replay is the tier's provable win."""
+        self.ledger.observe_request(0.0)
+        extra = {"volume": True, "z_shards": 0} if volume else {}
+        self.obs.events.emit(
+            SERVE_TRACE_EVENT,
+            trace_id=trace_id,
+            request_id=request_id,
+            lane=None,
+            batch_size=0,
+            queue_wait_s=0.0,
+            probe=False,
+            cached=True,
+            spans=[],
+            **extra,
+        )
+        if volume:
+            self._count_volume_request("ok")
+        else:
+            self.registry.histogram(
+                SERVING_REQUEST_SECONDS,
+                help="end-to-end request latency (admission to payload "
+                "built)",
+                buckets=LATENCY_BUCKETS,
+            ).observe(time.monotonic() - t_start)
+            self._count_request("ok")
+
+    def segment_cached(
+        self,
+        body: bytes,
+        pixels: np.ndarray,
+        render: bool = True,
+        trace_id: Optional[str] = None,
+        probe: bool = False,
+        if_none_match: Optional[str] = None,
+    ):
+        """:meth:`segment` behind the result tier; (payload, state, etag).
+
+        ``state`` None = tier off or probe traffic (plain compute path);
+        'hit' with payload None = 304 Not Modified; 'fill' = computed and
+        stored; 'miss' = computed, not stored. Probes bypass the tier both
+        ways — a canary must exercise the real dispatch path, and its
+        result must not warm the cache for real traffic.
+        """
+        params = {"render": bool(render)}
+        if render:
+            params["jpeg_quality"] = self.jpeg_quality
+        digest = (
+            None if probe else self.result_digest(body, "segment", params)
+        )
+        if digest is None:
+            return (
+                self.segment(
+                    pixels, render=render, trace_id=trace_id, probe=probe
+                ),
+                None,
+                None,
+            )
+        t_start = time.monotonic()
+        entry = self.result_lookup(digest)
+        if entry is not None:
+            if etag_matches(if_none_match, entry.etag):
+                self._account_cached_hit(
+                    trace_id, uuid.uuid4().hex[:12], False, t_start
+                )
+                return None, "hit", entry.etag
+            payload = self._payload_from_entry(entry, trace_id)
+            self._account_cached_hit(
+                trace_id, payload["request_id"], False, t_start
+            )
+            return payload, "hit", entry.etag
+        payload = self.segment(
+            pixels, render=render, trace_id=trace_id, probe=probe,
+            digest=digest,
+        )
+        state, etag = self.result_fill(
+            digest, payload, "segment", _CACHEABLE_SEGMENT_FIELDS
+        )
+        return payload, state, etag
+
+    def segment_volume_cached(
+        self,
+        body: bytes,
+        volume: np.ndarray,
+        trace_id: Optional[str] = None,
+        mhd: bool = False,
+        mhd_compressed: bool = False,
+        include_mask: bool = True,
+        if_none_match: Optional[str] = None,
+        idempotency_key: Optional[str] = None,
+    ):
+        """:meth:`segment_volume` behind the tier; (payload, state, etag).
+
+        The idempotency contract (``X-Nm03-Idempotency-Key``): the key is
+        an alias for the first content digest it arrived with, recorded
+        in a map that OUTLIVES the in-flight window — a client retry
+        after a fleet failover resolves the key to the original digest
+        and either coalesces onto the still-running gang ('hit', the
+        in-flight path inside segment_volume) or returns the stored
+        result ('hit', the store path). A 32-plane gang program is never
+        re-dispatched for a retry.
+        """
+        output = "mhd" if mhd else ("mask" if include_mask else "summary")
+        params = {"output": output, "compressed": bool(mhd_compressed)}
+        digest = self.result_digest(body, "segment-volume", params)
+        if digest is None:
+            return (
+                self.segment_volume(
+                    volume, trace_id=trace_id, mhd=mhd,
+                    mhd_compressed=mhd_compressed, include_mask=include_mask,
+                ),
+                None,
+                None,
+            )
+        alias = f"idem:{idempotency_key}" if idempotency_key else None
+        lookup_digest = digest
+        if alias is not None:
+            aliased = self.volume_inflight.resolve(alias)
+            if aliased is not None:
+                lookup_digest = aliased
+        t_start = time.monotonic()
+        entry = self.result_lookup(lookup_digest)
+        if entry is not None:
+            if etag_matches(if_none_match, entry.etag):
+                self._account_cached_hit(
+                    trace_id, uuid.uuid4().hex[:12], True, t_start
+                )
+                return None, "hit", entry.etag
+            payload = self._payload_from_entry(entry, trace_id, volume=True)
+            self._account_cached_hit(
+                trace_id, payload["request_id"], True, t_start
+            )
+            return payload, "hit", entry.etag
+        payload = self.segment_volume(
+            volume, trace_id=trace_id, mhd=mhd,
+            mhd_compressed=mhd_compressed, include_mask=include_mask,
+            digest=digest, idem_alias=alias,
+        )
+        if payload.pop("_coalesced", False):
+            # rode an in-flight gang (counted tier=inflight inside): the
+            # leader's own fill covers the store, nothing for us to store
+            return payload, "hit", None
+        state, etag = self.result_fill(
+            digest, payload, "segment-volume", _CACHEABLE_VOLUME_FIELDS
+        )
+        return payload, state, etag
+
+    def _join_volume_leader(
+        self, leader, trace_id, include_mask, mhd, mhd_compressed
+    ) -> dict:
+        """Ride an identical in-flight volume: wait on ITS gang, answer
+        from ITS mask — the retry path that never dispatches a second
+        mesh-wide program. The payload is built from the same mask array
+        the leader returns, so the two responses are bit-identical."""
+        from nm03_capstone_project_tpu.serving.volumes import GangUnavailable
+
+        self.registry.counter(
+            SERVING_RESULT_CACHE_HIT_TOTAL,
+            help="result-tier lookups served from cache, by tier",
+            tier="inflight",
+        ).inc()
+        self.registry.gauge(
+            SERVING_INFLIGHT, help="admitted requests not yet responded"
+        ).inc()
+        try:
+            if not leader.wait(self.volume_timeout_s):
+                self._count_volume_request("timeout")
+                raise TimeoutError(
+                    f"coalesced volume request (leader {leader.request_id}) "
+                    f"timed out after {self.volume_timeout_s:.0f}s"
+                )
+            if leader.error is not None:
+                # the rider shares the leader's fate — recomputing here
+                # would defeat the whole point of coalescing
+                self._count_volume_request(
+                    "shed" if isinstance(leader.error, GangUnavailable)
+                    else "error"
+                )
+                raise leader.error
+        finally:
+            self.registry.gauge(
+                SERVING_INFLIGHT, help="admitted requests not yet responded"
+            ).dec()
+        mask = np.ascontiguousarray(leader.mask)
+        payload = {
+            "request_id": uuid.uuid4().hex[:12],
+            "trace_id": trace_id,
+            "shape": [int(s) for s in mask.shape],
+            "z_shards": leader.z_shards,
+            "gang_wait_s": 0.0,
+            "queue_wait_s": 0.0,
+            "requeues": leader.requeues,
+            "grow_converged": leader.converged,
+            "mask_voxels": int(np.count_nonzero(mask)),
+            "mask_sha256": hashlib.sha256(mask.tobytes()).hexdigest(),
+            "cached": True,
+            "_coalesced": True,
+        }
+        if include_mask:
+            payload["mask_b64"] = base64.b64encode(mask.tobytes()).decode(
+                "ascii"
+            )
+        if mhd:
+            payload.update(self._mhd_payload(mask, mhd_compressed))
+        self.ledger.observe_request(0.0)
+        self._count_volume_request("ok")
+        return payload
+
     def decode_request(self, body: bytes, content_type: str) -> np.ndarray:
         """Body -> float32 (h, w) raw-intensity slice, or RequestRejected.
 
@@ -644,7 +1066,7 @@ class ServingApp:
 
     def submit(
         self, pixels: np.ndarray, trace_id: Optional[str] = None,
-        probe: bool = False,
+        probe: bool = False, digest: Optional[str] = None,
     ) -> ServeRequest:
         """Admit one decoded slice; QueueFull/QueueClosed shed at the door.
 
@@ -662,6 +1084,7 @@ class ServingApp:
             dims=(h, w),
             trace=TraceContext(trace_id or new_trace_id()),
             probe=bool(probe),
+            digest=digest,
         )
         self.queue.put(req)  # raises QueueFull / QueueClosed
         self.registry.gauge(
@@ -675,6 +1098,7 @@ class ServingApp:
         render: bool = True,
         trace_id: Optional[str] = None,
         probe: bool = False,
+        digest: Optional[str] = None,
     ) -> dict:
         """The full request path minus HTTP: admit, wait, build the payload.
 
@@ -695,7 +1119,9 @@ class ServingApp:
 
         t_start = time.monotonic()
         try:
-            req = self.submit(pixels, trace_id=trace_id, probe=probe)
+            req = self.submit(
+                pixels, trace_id=trace_id, probe=probe, digest=digest
+            )
         except (QueueFull, QueueClosed):
             if not probe:
                 self.registry.counter(
@@ -739,6 +1165,15 @@ class ServingApp:
             "degraded": self.executor.degraded,
             "mask_pixels": int(np.count_nonzero(req.mask)),
         }
+        if self.result_store is not None and not probe:
+            # the mask's content identity rides the payload when the
+            # result tier is on: it is what the bit-identity gates (bench
+            # result_cache leg, the subprocess drill) compare — a cached
+            # hit must reproduce it exactly
+            payload["mask_sha256"] = hashlib.sha256(
+                np.ascontiguousarray(req.mask).tobytes()
+            ).hexdigest()
+            payload["cached"] = False
         if render:
             from nm03_capstone_project_tpu.render.export import encode_jpeg_bytes
             from nm03_capstone_project_tpu.render.host_render import host_render_pair
@@ -902,6 +1337,8 @@ class ServingApp:
         mhd: bool = False,
         mhd_compressed: bool = False,
         include_mask: bool = True,
+        digest: Optional[str] = None,
+        idem_alias: Optional[str] = None,
     ) -> dict:
         """The whole-volume request path minus HTTP (ISSUE 15).
 
@@ -926,6 +1363,14 @@ class ServingApp:
         except RequestRejected:
             self._count_volume_request("invalid")  # admission guard
             raise
+        if digest is not None and self.volume_inflight is not None:
+            # the in-flight window: an identical volume already riding a
+            # gang answers this request too — join it, never dispatch
+            leader = self.volume_inflight.claim(digest)
+            if leader is not None:
+                return self._join_volume_leader(
+                    leader, trace_id, include_mask, mhd, mhd_compressed
+                )
         try:
             req = self.volumes.submit(volume, (h, w), trace_id=trace_id)
         except (QueueFull, QueueClosed):
@@ -939,6 +1384,16 @@ class ServingApp:
         except ValueError as e:  # depth guard inside the gang
             self._count_volume_request("invalid")
             raise RequestRejected(413, str(e)) from e
+        registered = False
+        if digest is not None and self.volume_inflight is not None:
+            # first-wins leadership: a racing duplicate that registered
+            # between our claim and here keeps the slot, and our already-
+            # admitted request computes normally (the fill is idempotent
+            # on digest — both produce the same bytes)
+            owner = self.volume_inflight.register(
+                digest, req, alias=idem_alias
+            )
+            registered = owner is req
         self.registry.gauge(
             SERVING_INFLIGHT, help="admitted requests not yet responded"
         ).inc()
@@ -956,6 +1411,10 @@ class ServingApp:
                 )
                 raise req.error
         finally:
+            if registered:
+                # release only after done is set: any rider that claimed
+                # us meanwhile finds the event already fired and proceeds
+                self.volume_inflight.release(digest)
             self.registry.gauge(
                 SERVING_INFLIGHT, help="admitted requests not yet responded"
             ).dec()
@@ -971,6 +1430,11 @@ class ServingApp:
             "grow_converged": req.converged,
             "mask_voxels": int(np.count_nonzero(req.mask)),
         }
+        if self.result_store is not None:
+            payload["mask_sha256"] = hashlib.sha256(
+                np.ascontiguousarray(req.mask).tobytes()
+            ).hexdigest()
+            payload["cached"] = False
         if include_mask:
             payload["mask_b64"] = base64.b64encode(
                 np.ascontiguousarray(req.mask).tobytes()
@@ -1043,6 +1507,15 @@ def make_handler(app: ServingApp):
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
+
+        def _reply_not_modified(self, headers=()):
+            # 304 carries no body by RFC 7232 — Content-Length 0, headers
+            # only (the ETag rides along so the client can re-validate)
+            self.send_response(304)
+            self.send_header("Content-Length", "0")
+            for k, v in headers:
+                self.send_header(k, v)
+            self.end_headers()
 
         def _reply_text(self, status: int, text: str, content_type: str):
             data = text.encode()
@@ -1125,6 +1598,26 @@ def make_handler(app: ServingApp):
                     )
                 else:
                     self._reply(200, result)
+            elif path == "/debug/result-cache":
+                # the result tier's admin surface (ISSUE 19): stats +
+                # entries hot-to-cold, the rows `nm03-cache result ls`
+                # renders. {"enabled": false} when the tier is off — an
+                # honest null, not an empty store.
+                if app.result_store is None:
+                    self._reply(200, {"enabled": False})
+                else:
+                    self._reply(
+                        200,
+                        {
+                            **app.result_store.stats(),
+                            "program_version": (
+                                app.result_version()
+                                if app.executor.warm else None
+                            ),
+                            "inflight": app.volume_inflight.stats(),
+                            "ls": app.result_store.ls(),
+                        },
+                    )
             else:
                 self._reply(404, {"error": f"unknown path {path}"})
 
@@ -1132,6 +1625,19 @@ def make_handler(app: ServingApp):
             split = urlsplit(self.path)
             if split.path == "/v1/segment-volume":
                 self._post_volume(split)
+                return
+            if split.path == "/debug/result-cache/evict":
+                # admin evict (?digest=D for one entry, none for all);
+                # the invalidation-triage escape hatch, though the key
+                # contract makes routine invalidation automatic
+                if app.result_store is None:
+                    self._reply(404, {"error": "result tier not enabled"})
+                    return
+                query = parse_qs(split.query)
+                digest = query.get("digest", [None])[0]
+                dropped = app.result_store.evict(digest)
+                app._publish_result_bytes()
+                self._reply(200, {"evicted": dropped})
                 return
             if split.path != "/v1/segment":
                 self._reply(404, {"error": f"unknown path {split.path}"})
@@ -1177,8 +1683,10 @@ def make_handler(app: ServingApp):
                 self._reply(400, {"error": str(e)}, headers=echo)
                 return
             try:
-                payload = app.segment(
-                    pixels, render=render, trace_id=trace_id, probe=is_probe
+                payload, cache_state, etag = app.segment_cached(
+                    body, pixels, render=render, trace_id=trace_id,
+                    probe=is_probe,
+                    if_none_match=self.headers.get("If-None-Match"),
                 )
             except RequestRejected as e:  # guard failures (counted inside)
                 self._reply(e.http_status, {"error": str(e)}, headers=echo)
@@ -1198,6 +1706,14 @@ def make_handler(app: ServingApp):
                     headers=echo,
                 )
             else:
+                cache_headers = []
+                if cache_state is not None:
+                    cache_headers.append(("X-Nm03-Cache", cache_state))
+                if etag is not None:
+                    cache_headers.append(("ETag", etag))
+                if payload is None:  # If-None-Match matched: 304, no body
+                    self._reply_not_modified(headers=[*cache_headers, *echo])
+                    return
                 # the echoed trace id plus the per-request attribution
                 # headers nm03-loadgen records (queue wait / serving lane)
                 self._reply(
@@ -1211,6 +1727,7 @@ def make_handler(app: ServingApp):
                             "X-Nm03-Queue-Wait-Ms",
                             f"{payload['queue_wait_s'] * 1e3:.3f}",
                         ),
+                        *cache_headers,
                     ],
                 )
 
@@ -1261,12 +1778,17 @@ def make_handler(app: ServingApp):
             )
 
             try:
-                payload = app.segment_volume(
+                payload, cache_state, etag = app.segment_volume_cached(
+                    body,
                     volume,
                     trace_id=trace_id,
                     mhd=output == "mhd",
                     mhd_compressed=query.get("compressed", ["0"])[0] == "1",
                     include_mask=output != "summary",
+                    if_none_match=self.headers.get("If-None-Match"),
+                    idempotency_key=self.headers.get(
+                        "X-Nm03-Idempotency-Key"
+                    ),
                 )
             except RequestRejected as e:  # guards (counted inside)
                 self._reply(e.http_status, {"error": str(e)}, headers=echo)
@@ -1288,6 +1810,14 @@ def make_handler(app: ServingApp):
                     headers=echo,
                 )
             else:
+                cache_headers = []
+                if cache_state is not None:
+                    cache_headers.append(("X-Nm03-Cache", cache_state))
+                if etag is not None:
+                    cache_headers.append(("ETag", etag))
+                if payload is None:  # If-None-Match matched: 304, no body
+                    self._reply_not_modified(headers=[*cache_headers, *echo])
+                    return
                 self._reply(
                     200,
                     payload,
@@ -1298,6 +1828,7 @@ def make_handler(app: ServingApp):
                             "X-Nm03-Gang-Wait-Ms",
                             f"{payload['gang_wait_s'] * 1e3:.3f}",
                         ),
+                        *cache_headers,
                     ],
                 )
 
@@ -1405,6 +1936,18 @@ def build_parser() -> argparse.ArgumentParser:
         "not compile-minutes (default: $NM03_COMPILE_CACHE_DIR; unset = "
         "compile every start; docs/OPERATIONS.md compile-cache runbook, "
         "nm03-cache for ls/verify/gc)",
+    )
+    g.add_argument(
+        "--result-cache-bytes",
+        default="0",
+        metavar="BYTES",
+        help="content-addressed result tier budget (ISSUE 19): completed "
+        "segment/segment-volume responses are stored under their "
+        "(input-digest, algo, params, program-version) key and repeats "
+        "are served from memory — LRU by bytes, verify-on-read, "
+        "invalidated by construction when the program version changes. "
+        "Accepts k/m/g suffixes ('512m'); 0 disables the tier "
+        "(docs/OPERATIONS.md 'Running the result tier')",
     )
     g.add_argument(
         "--jpeg-quality", type=int, default=90, help="JPEG encoder quality"
@@ -1547,6 +2090,9 @@ def app_from_args(args: argparse.Namespace, obs=None) -> ServingApp:
             args, "ledger_profile_interval_s", 0.0
         ),
         ledger_profile_ms=getattr(args, "ledger_profile_ms", 200),
+        result_cache_bytes=parse_bytes(
+            getattr(args, "result_cache_bytes", "0") or "0"
+        ),
     )
 
 

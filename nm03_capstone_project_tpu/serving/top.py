@@ -51,6 +51,10 @@ from nm03_capstone_project_tpu.serving.metrics import (
     SERVING_PADDING_WASTE_RATIO,
     SERVING_REQUESTS_TOTAL,
     SERVING_REQUEUES_TOTAL,
+    SERVING_RESULT_CACHE_BYTES,
+    SERVING_RESULT_CACHE_EVICT_TOTAL,
+    SERVING_RESULT_CACHE_HIT_TOTAL,
+    SERVING_RESULT_CACHE_MISS_TOTAL,
     SERVING_SHED_TOTAL,
     SERVING_WINDOW_OCCUPANCY_RATIO,
 )
@@ -112,6 +116,44 @@ def _pie_line(
     if ds_per_req is not None:
         parts.append(f"ds/req {ds_per_req * 1000:.3g}ms")
     return "   ".join(parts)
+
+
+def _cache_block(cur: "Sample", prev: Optional["Sample"]) -> Optional[dict]:
+    """The result-tier row (ISSUE 19), or None when the scraped process
+    runs no tier — the bytes gauge exists (at 0) from startup on any
+    tier-enabled process, so its absence IS the disabled signal; top
+    renders the gauges, it never guesses."""
+    bytes_g = cur.gauge(SERVING_RESULT_CACHE_BYTES)
+    if bytes_g is None:
+        return None
+    hits = cur.counter_totals.get(SERVING_RESULT_CACHE_HIT_TOTAL, 0.0)
+    misses = cur.counter_totals.get(SERVING_RESULT_CACHE_MISS_TOTAL, 0.0)
+    lookups = hits + misses
+    return {
+        "bytes": int(bytes_g),
+        "hits": int(hits),
+        "misses": int(misses),
+        "hit_ratio": round(hits / lookups, 4) if lookups else None,
+        "hit_per_s": _rate(cur, prev, SERVING_RESULT_CACHE_HIT_TOTAL),
+        "evict_per_s": _rate(cur, prev, SERVING_RESULT_CACHE_EVICT_TOTAL),
+    }
+
+
+def _cache_line(cache: Optional[dict]) -> Optional[str]:
+    if cache is None:
+        return None
+
+    def _r(v):
+        return "-" if v is None else v
+
+    hr = cache["hit_ratio"]
+    return (
+        f"result cache {cache['bytes']}B   "
+        f"hit ratio {'-' if hr is None else _fmt(hr, pct=True).strip()} "
+        f"({cache['hits']}/{cache['hits'] + cache['misses']})   "
+        f"hit/s {_r(cache['hit_per_s'])}   "
+        f"evict/s {_r(cache['evict_per_s'])}"
+    )
 
 
 def _slo_block(cur: "Sample") -> Optional[dict]:
@@ -232,6 +274,9 @@ def build_view(cur: Sample, prev: Optional[Sample] = None) -> dict:
         # the SLO row (ISSUE 14): burn rates + budget when the scraped
         # process declared an objective, null otherwise
         "slo": _slo_block(cur),
+        # the result-tier row (ISSUE 19): bytes/hit-ratio/evict rate from
+        # the serving_result_cache_* series, null when the tier is off
+        "result_cache": _cache_block(cur, prev),
         # the device-time pie (ISSUE 16): per-stage shares of sampled
         # device time + mean prorated device-seconds per request — null
         # until the ledger's profile sampler has reduced a capture
@@ -301,6 +346,9 @@ def render_text(view: dict, url: str) -> str:
                 f"{_fmt(ing['upload_overlap_ratio'], pct=True).strip()}"
             ),
         )
+    cache_line = _cache_line(view.get("result_cache"))
+    if cache_line is not None:
+        lines.insert(3, cache_line)
     pie_line = _pie_line(
         view.get("device_time_share"), view.get("device_seconds_per_request")
     )
@@ -419,6 +467,9 @@ def build_fleet_view(
         # the fleet-level SLO row (ISSUE 14): the ROUTER's own burn
         # gauges — the whole-fleet verdict, not any one replica's
         "slo": _slo_block(fleet),
+        # the ROUTER's own result tier (ISSUE 19): the front-end store
+        # that answers repeats without a replica pick — null when off
+        "result_cache": _cache_block(fleet, prev_fleet),
         "device_time_share": fleet_pie,
         "replicas": rows,
         "rates_per_s": {
@@ -456,6 +507,9 @@ def render_fleet_text(view: dict, url: str) -> str:
         f"{'queue':>5} {'busy':>8} {'mfu':>8} {'req/s':>7} "
         f"{'ds/req':>8} {'eject':>5}",
     ]
+    cache_line = _cache_line(view.get("result_cache"))
+    if cache_line is not None:
+        lines.insert(2, cache_line)
     pie_line = _pie_line(view.get("device_time_share"), None)
     if pie_line is not None:
         lines.insert(2, pie_line)

@@ -77,6 +77,19 @@ SURFACE = {
         "serve_in_thread",
         "RestartError",
     ],
+    # the content-addressed result tier (ISSUE 19): store, in-flight
+    # dedup index, key derivation — what docs/API.md's cache section names
+    "nm03_capstone_project_tpu.cache": [
+        "ResultStore",
+        "ResultEntry",
+        "InflightIndex",
+        "ResultKey",
+        "result_key",
+        "digest_bytes",
+        "content_etag",
+        "etag_matches",
+        "parse_bytes",
+    ],
     # online serving incl. whole-volume gang serving (ISSUE 15): what
     # docs/API.md's serving sections name
     "nm03_capstone_project_tpu.serving": [

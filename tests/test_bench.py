@@ -33,6 +33,7 @@ def _emitted(capsys):
 
 
 class TestWorker:
+    @pytest.mark.slow
     def test_cpu_worker_measures_and_appends_sections(
         self, monkeypatch, capsys, tmp_path
     ):
@@ -51,6 +52,7 @@ class TestWorker:
             merged.update(json.loads(line))
         assert merged["xla_tput"] == res["xla_tput"]
 
+    @pytest.mark.slow
     def test_scan_chunk_leg_measures_and_checksums(self, monkeypatch, capsys):
         # the dispatch-amortized leg: chunk distinct batches per dispatch,
         # checksum = chunk x the single-batch checksum (rolled copies);
@@ -716,6 +718,7 @@ class TestBatchScalingNote:
         ) is None
         assert bench._batch_scaling_note({}, None, canvas=256) is None
 
+    @pytest.mark.slow
     def test_worker_emits_note_on_sweep(self, monkeypatch, capsys):
         # tiny sweep on the CPU backend: when a larger batch measures
         # slower, the sections carry batch_note (can't force the slowdown

@@ -1809,6 +1809,7 @@ class TestFleetChaosAcceptanceDrill:
 
 
 class TestRollingRestartAcceptanceDrill:
+    @pytest.mark.slow
     def test_rolling_restart_under_load_with_shared_cache(self, tmp_path):
         """The second ISSUE 13 acceptance bar: `nm03-fleet restart`
         across three replicas sharing one --compile-cache-dir completes

@@ -682,6 +682,7 @@ class TestCheckTelemetrySumRange:
 
 
 class TestLedgerAcceptance:
+    @pytest.mark.slow
     def test_drill_charges_profiles_and_gates(self, tmp_path):
         """The ISSUE 16 acceptance bar: a 4-lane replica under load charges
         real riders to the ``request`` account, lands every request in the

@@ -1,0 +1,921 @@
+"""Content-addressed result tier tests (ISSUE 19).
+
+Covers the contract at every layer: key derivation (the versioned-key
+discipline extended to results), the LRU-by-bytes store with
+verify-on-read, the in-flight dedup index and its idempotency-key alias
+map, the replica tier end to end over loopback HTTP (fill/hit/304 and
+bit-identity across evict/recompute cycles), the batcher's in-flight
+dedup window, the router tier against fake replicas (including the
+mixed-program-version bypass), the FaultPlan ``cache``/``corrupt_entry``
+drill, and the slow subprocess acceptance drills: a zipfian fleet replay
+whose p50 collapses on repeats, and a SIGKILL-mid-fleet idempotent
+volume retry that returns the identical mask without a second gang
+dispatch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from nm03_capstone_project_tpu.cache import (
+    InflightIndex,
+    ResultStore,
+    content_etag,
+    digest_bytes,
+    etag_matches,
+    parse_bytes,
+    result_key,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECKER = os.path.join(REPO, "scripts", "check_telemetry.py")
+CANVAS = 128
+
+
+# -- keys -------------------------------------------------------------------
+
+
+class TestResultKeys:
+    def test_key_is_deterministic_and_total(self):
+        k1 = result_key(b"body", "segment", {"render": True}, "v1")
+        k2 = result_key(b"body", "segment", {"render": True}, "v1")
+        assert k1 == k2 and k1.digest() == k2.digest()
+        assert len(k1.digest()) == 32
+        assert len(k1.input_digest) == 64  # full sha256 hex of the body
+        assert k1.input_digest == digest_bytes(b"body")
+
+    def test_every_component_changes_the_address(self):
+        base = result_key(b"body", "segment", {"a": 1}, "v1").digest()
+        assert result_key(b"BODY", "segment", {"a": 1}, "v1").digest() != base
+        assert (
+            result_key(b"body", "segment-volume", {"a": 1}, "v1").digest()
+            != base
+        )
+        assert result_key(b"body", "segment", {"a": 2}, "v1").digest() != base
+        # the invalidation story: a new program version IS a new keyspace
+        assert result_key(b"body", "segment", {"a": 1}, "v2").digest() != base
+
+    def test_no_params_is_one_identity(self):
+        assert (
+            result_key(b"b", "segment", None, "v").digest()
+            == result_key(b"b", "segment", {}, "v").digest()
+        )
+
+
+class TestEtagHelpers:
+    def test_content_etag_is_quoted_and_content_only(self):
+        e = content_etag(b"payload")
+        assert e.startswith('"') and e.endswith('"') and len(e) == 34
+        assert e == content_etag(b"payload")  # two identical results agree
+        assert e != content_etag(b"payloae")
+
+    def test_etag_matches_rfc7232(self):
+        e = content_etag(b"x")
+        assert not etag_matches(None, e)
+        assert not etag_matches("", e)
+        assert etag_matches("*", e)
+        assert etag_matches(e, e)
+        assert etag_matches(f'"nope", {e}', e)
+        assert etag_matches(f"W/{e}", e)  # weak comparison revalidates
+        assert not etag_matches('"nope"', e)
+
+    def test_parse_bytes(self):
+        assert parse_bytes("1048576") == 1 << 20
+        assert parse_bytes("64m") == 64 << 20
+        assert parse_bytes("2G") == 2 << 30
+        assert parse_bytes("1.5k") == 1536
+        with pytest.raises(ValueError):
+            parse_bytes("")
+        with pytest.raises(ValueError):
+            parse_bytes("lots")
+
+
+# -- the store --------------------------------------------------------------
+
+
+class TestResultStore:
+    def test_fill_lookup_roundtrip(self):
+        store = ResultStore(1 << 20)
+        entry, created = store.fill("d1", b"payload", "segment")
+        assert created and entry.etag == content_etag(b"payload")
+        got = store.lookup("d1")
+        assert got is entry and got.hits == 1
+        assert store.lookup("missing") is None
+        st = store.stats()
+        assert st["hits"] == 1 and st["misses"] == 1 and st["fills"] == 1
+        assert st["hit_ratio"] == 0.5 and st["bytes"] == len(b"payload")
+
+    def test_fill_is_idempotent_on_digest(self):
+        store = ResultStore(1 << 20)
+        e1, c1 = store.fill("d", b"same-bytes", "segment")
+        e2, c2 = store.fill("d", b"same-bytes", "segment")
+        assert c1 and not c2 and e2 is e1
+        assert store.stats()["fills"] == 1 and len(store) == 1
+
+    def test_lru_evicts_cold_end_by_bytes(self):
+        evicted = []
+        store = ResultStore(100, on_evict=evicted.append)
+        store.fill("a", b"x" * 40, "segment")
+        store.fill("b", b"y" * 40, "segment")
+        store.lookup("a")  # touch: a is now hot, b cold
+        store.fill("c", b"z" * 40, "segment")  # must evict b, not a
+        assert store.lookup("a") is not None
+        assert store.lookup("b") is None
+        assert evicted == [1] and store.bytes <= 100
+
+    def test_oversize_payload_rejected_not_stored(self):
+        store = ResultStore(10)
+        entry, created = store.fill("big", b"x" * 11, "segment")
+        assert entry is None and not created
+        st = store.stats()
+        assert st["oversize_rejects"] == 1 and st["entries"] == 0
+        assert st["evictions"] == 0  # nothing was sacrificed for it
+
+    def test_explicit_evict_one_and_all(self):
+        store = ResultStore(1 << 20)
+        store.fill("a", b"1", "segment")
+        store.fill("b", b"2", "segment")
+        assert store.evict("a") == 1 and store.evict("a") == 0
+        assert store.evict() == 1 and store.bytes == 0
+
+    def test_verify_on_read_evicts_corrupt_entry(self):
+        """The stale-result-is-never-an-outcome half the drill gates: a
+        payload that no longer hashes to its fill-time ETag is evicted
+        and reported as a miss — one recompute, never a wrong answer."""
+        fire = {"on": False}
+        evicted = []
+        store = ResultStore(
+            1 << 20,
+            corrupt_hook=lambda d: fire["on"],
+            on_evict=evicted.append,
+        )
+        store.fill("d", b"good-bytes", "segment")
+        assert store.lookup("d") is not None  # clean read first
+        fire["on"] = True
+        assert store.lookup("d") is None  # flipped byte -> evict + miss
+        fire["on"] = False
+        assert store.lookup("d") is None  # really gone, not hidden
+        st = store.stats()
+        assert st["corrupt_evictions"] == 1 and evicted == [1]
+
+    def test_ls_is_hot_first(self):
+        store = ResultStore(1 << 20)
+        store.fill("a", b"1", "segment")
+        store.fill("b", b"2", "segment-volume")
+        store.lookup("a")
+        rows = store.ls()
+        assert [r["digest"] for r in rows] == ["a", "b"]
+        assert rows[0]["hits"] == 1 and rows[1]["algo"] == "segment-volume"
+
+
+class TestInflightIndex:
+    def test_first_register_wins(self):
+        idx = InflightIndex()
+        leader = object()
+        rider = object()
+        assert idx.register("d", leader) is leader
+        assert idx.register("d", rider) is leader  # join, don't dispatch
+        assert idx.claim("d") is leader
+        idx.release("d")
+        assert idx.claim("d") is None
+        assert idx.stats()["coalesced"] == 2
+
+    def test_alias_outlives_release(self):
+        """The idempotency contract: a retry AFTER the gang finished and
+        released still resolves its key to the content digest."""
+        idx = InflightIndex()
+        idx.register("digest-1", object(), alias="idem:K")
+        idx.release("digest-1")
+        assert idx.resolve("idem:K") == "digest-1"
+
+    def test_alias_map_is_bounded_fifo(self):
+        idx = InflightIndex(max_aliases=2)
+        for i in range(3):
+            idx.register(f"d{i}", object(), alias=f"idem:{i}")
+        assert idx.resolve("idem:0") is None  # oldest dropped
+        assert idx.resolve("idem:2") == "d2"
+        assert idx.stats()["aliases"] == 2
+
+
+# -- the FaultPlan cache site -----------------------------------------------
+
+
+class TestCacheFaultSite:
+    def test_corrupt_entry_is_a_registered_kind(self):
+        from nm03_capstone_project_tpu.resilience.faultinject import (
+            KINDS_BY_SITE,
+        )
+
+        assert "corrupt_entry" in KINDS_BY_SITE["cache"]
+
+    def test_corrupt_entry_drill_through_the_store(self):
+        """The drill end to end at the store layer: a FaultPlan-driven
+        hook flips a byte, verify-on-read evicts, the next lookup is an
+        honest miss (and the ISSUE 9 io_error rules stay untouched —
+        kinds filtering keeps the budgets separate)."""
+        from nm03_capstone_project_tpu.resilience.faultinject import FaultPlan
+        from nm03_capstone_project_tpu.serving.server import (
+            _result_corrupt_hook,
+        )
+
+        class _Obs:
+            def fault_injected(self, **kw):
+                pass
+
+        plan = FaultPlan.from_spec({
+            "seed": 1,
+            "faults": [
+                {"site": "cache", "kind": "corrupt_entry", "count": 1},
+            ],
+        })
+        hook = _result_corrupt_hook(plan, _Obs())
+        assert hook is not None
+        store = ResultStore(1 << 20, corrupt_hook=hook)
+        store.fill("d", b"payload", "segment")
+        assert store.lookup("d") is None  # the one budgeted fire
+        store.fill("d", b"payload", "segment")
+        assert store.lookup("d") is not None  # budget spent; clean again
+
+    def test_no_cache_rules_no_hook(self):
+        from nm03_capstone_project_tpu.serving.server import (
+            _result_corrupt_hook,
+        )
+
+        assert _result_corrupt_hook(None, None) is None
+
+
+# -- replica tier over loopback HTTP ----------------------------------------
+
+
+def _post(url, body, headers, timeout=60.0):
+    req = urllib.request.Request(url, data=body, headers=headers, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read() or b"", dict(e.headers)
+
+
+def _get_json(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _slice_body(seed=0):
+    from nm03_capstone_project_tpu.data.synthetic import phantom_slice
+
+    return phantom_slice(CANVAS, CANVAS, seed=seed).astype("<f4").tobytes()
+
+
+def _raw_headers(**extra):
+    return {
+        "Content-Type": "application/octet-stream",
+        "X-Nm03-Height": str(CANVAS),
+        "X-Nm03-Width": str(CANVAS),
+        **extra,
+    }
+
+
+def _counter_sum(registry, name, **labels):
+    return sum(
+        m.value for m in registry.series()
+        if m.name == name
+        and all(m.labels.get(k) == v for k, v in labels.items())
+    )
+
+
+@pytest.fixture(scope="module")
+def cached_server():
+    """One warmed loopback replica with the result tier on."""
+    from nm03_capstone_project_tpu.config import PipelineConfig
+    from nm03_capstone_project_tpu.serving.server import (
+        ServingApp,
+        serve_in_thread,
+    )
+
+    app = ServingApp(
+        cfg=PipelineConfig(canvas=CANVAS),
+        queue_capacity=32,
+        buckets=(1, 4),
+        max_wait_s=0.02,
+        request_timeout_s=30.0,
+        lanes=1,
+        result_cache_bytes=8 << 20,
+    )
+    httpd, _, port = serve_in_thread(app)
+    yield app, f"http://127.0.0.1:{port}"
+    app.begin_drain(reason="test_teardown")
+    httpd.shutdown()
+    httpd.server_close()
+    app.close()
+
+
+class TestReplicaTierE2E:
+    def test_fill_then_hit_then_304(self, cached_server):
+        app, base = cached_server
+        body = _slice_body(seed=10)
+        st1, d1, h1 = _post(base + "/v1/segment?output=mask", body, _raw_headers())
+        assert st1 == 200 and h1["X-Nm03-Cache"] == "fill"
+        etag = h1["ETag"]
+        st2, d2, h2 = _post(base + "/v1/segment?output=mask", body, _raw_headers())
+        assert st2 == 200 and h2["X-Nm03-Cache"] == "hit"
+        assert h2["ETag"] == etag
+        p1, p2 = json.loads(d1), json.loads(d2)
+        assert p1["mask_sha256"] == p2["mask_sha256"]
+        assert p1["cached"] is False and p2["cached"] is True
+        # a hit bills zero device time and mints a fresh identity
+        assert p2["device_seconds"] == 0.0 and p2["queue_wait_s"] == 0.0
+        assert p2["request_id"] != p1["request_id"]
+        # conditional revalidation: empty body, the cheapest possible hit
+        st3, d3, h3 = _post(
+            base + "/v1/segment?output=mask", body,
+            _raw_headers(**{"If-None-Match": etag}),
+        )
+        assert st3 == 304 and d3 == b"" and h3["X-Nm03-Cache"] == "hit"
+
+    def test_bit_identity_across_evict_recompute(self, cached_server):
+        """The acceptance contract: cached and recomputed answers are the
+        same bytes — the content ETag (sha256 of the stored payload)
+        survives an evict/recompute cycle unchanged."""
+        app, base = cached_server
+        body = _slice_body(seed=11)
+        _, _, h1 = _post(base + "/v1/segment?output=mask", body, _raw_headers())
+        assert h1["X-Nm03-Cache"] == "fill"
+        req = urllib.request.Request(
+            base + "/debug/result-cache/evict", data=b"", method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json.loads(r.read())["evicted"] >= 1
+        _, _, h2 = _post(base + "/v1/segment?output=mask", body, _raw_headers())
+        assert h2["X-Nm03-Cache"] == "fill"  # store was cold again
+        assert h2["ETag"] == h1["ETag"]
+
+    def test_oversize_result_is_honest_miss(self, cached_server):
+        app, base = cached_server
+        body = _slice_body(seed=12)
+        old_max = app.result_store.max_bytes
+        app.result_store.max_bytes = 1  # nothing fits
+        try:
+            st, _, h = _post(
+                base + "/v1/segment?output=mask", body, _raw_headers()
+            )
+            assert st == 200 and h["X-Nm03-Cache"] == "miss"
+        finally:
+            app.result_store.max_bytes = old_max
+
+    def test_probe_traffic_bypasses_the_tier(self, cached_server):
+        """A probation canary must exercise the real dispatch path and
+        must not warm the cache for real traffic."""
+        import numpy as np
+
+        app, base = cached_server
+        pixels = np.frombuffer(_slice_body(seed=13), "<f4").reshape(
+            CANVAS, CANVAS
+        )
+        payload, state, etag = app.segment_cached(
+            b"probe-body", pixels, render=False, probe=True
+        )
+        assert state is None and etag is None
+        assert payload["mask_pixels"] >= 0
+
+    def test_debug_surface_and_readyz_block(self, cached_server):
+        app, base = cached_server
+        body = _slice_body(seed=14)
+        _post(base + "/v1/segment?output=mask", body, _raw_headers())
+        dbg = _get_json(base + "/debug/result-cache")
+        assert dbg["enabled"] and dbg["entries"] >= 1
+        assert len(dbg["program_version"]) == 16
+        assert {"digest", "algo", "bytes", "etag", "hits"} <= set(
+            dbg["ls"][0]
+        )
+        rz = _get_json(base + "/readyz")
+        assert rz["result_cache"]["enabled"]
+        assert rz["result_cache"]["program_version"] == dbg["program_version"]
+        # the tier-enabled signal nm03-top keys on: the bytes gauge exists
+        assert any(
+            m.name == "serving_result_cache_bytes"
+            for m in app.registry.series()
+        )
+
+    def test_disabled_tier_has_no_surface(self):
+        from nm03_capstone_project_tpu.config import PipelineConfig
+        from nm03_capstone_project_tpu.serving.server import ServingApp
+
+        app = ServingApp(
+            cfg=PipelineConfig(canvas=CANVAS), buckets=(1,), lanes=1
+        )
+        try:
+            assert app.result_store is None and app.volume_inflight is None
+            assert app.result_digest(b"x", "segment", {}) is None
+            assert not any(
+                m.name == "serving_result_cache_bytes"
+                for m in app.registry.series()
+            )
+            assert app.status()["result_cache"]["enabled"] is False
+        finally:
+            app.close()
+
+
+class TestBatcherDedupWindow:
+    def test_identical_inflight_slices_ride_one_dispatch(self):
+        """Four identical requests admitted in one coalescing window:
+        one leader computes, three ride its dispatch (tier=inflight),
+        and all four answers are bit-identical."""
+        from nm03_capstone_project_tpu.config import PipelineConfig
+        from nm03_capstone_project_tpu.serving.server import (
+            ServingApp,
+            serve_in_thread,
+        )
+
+        app = ServingApp(
+            cfg=PipelineConfig(canvas=CANVAS),
+            queue_capacity=32,
+            buckets=(4,),
+            max_wait_s=0.4,  # a window wide enough to admit all four
+            request_timeout_s=30.0,
+            lanes=1,
+            result_cache_bytes=8 << 20,
+        )
+        httpd, _, port = serve_in_thread(app)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            body = _slice_body(seed=20)
+            results = []
+            lock = threading.Lock()
+            barrier = threading.Barrier(4)
+
+            def one():
+                barrier.wait()
+                st, data, h = _post(
+                    base + "/v1/segment?output=mask", body, _raw_headers()
+                )
+                with lock:
+                    results.append((st, json.loads(data), h))
+
+            threads = [threading.Thread(target=one) for _ in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert len(results) == 4
+            assert all(st == 200 for st, _, _ in results)
+            shas = {p["mask_sha256"] for _, p, _ in results}
+            assert len(shas) == 1  # bit-identical answers
+            inflight_hits = _counter_sum(
+                app.registry,
+                "serving_result_cache_hit_total",
+                tier="inflight",
+            )
+            assert inflight_hits >= 1  # the window deduped
+            # riders bill no device time
+            zero_ds = sum(
+                1 for _, p, _ in results if p["device_seconds"] == 0.0
+            )
+            assert zero_ds >= inflight_hits
+        finally:
+            app.begin_drain(reason="test_teardown")
+            httpd.shutdown()
+            httpd.server_close()
+            app.close()
+
+
+# -- router tier against fake replicas --------------------------------------
+
+
+class _FakeCachingReplica:
+    """Stdlib nm03-serve stand-in that publishes a result_cache block on
+    /readyz and answers POSTs with an ETag, counting calls."""
+
+    def __init__(self, program_version="deadbeefcafe0123"):
+        self.program_version = program_version
+        self.posts = 0
+        self._lock = threading.Lock()
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _j(self, status, body, headers=()):
+                data = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._j(200, {
+                    "ready": True, "capacity": 1.0, "queue_depth": 0,
+                    "queue_capacity": 64,
+                    "replica": {"id": "r", "pid": os.getpid()},
+                    "result_cache": {
+                        "enabled": True,
+                        "program_version": fake.program_version,
+                    },
+                })
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                with fake._lock:
+                    fake.posts += 1
+                self._j(200, {
+                    "mask_pixels": 5, "mask_sha256": "m" * 64,
+                    "device_seconds": 0.25, "queue_wait_s": 0.001,
+                    "trace_id": self.headers.get("X-Nm03-Request-Id", "t"),
+                }, [("ETag", content_etag(body)),
+                    ("X-Nm03-Cache", "fill")])
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        ).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class _RouterObs:
+    def __init__(self):
+        from nm03_capstone_project_tpu.obs.metrics import MetricsRegistry
+
+        self.registry = MetricsRegistry()
+        self.events = type("E", (), {"emit": lambda *a, **k: None})()
+
+    def fault_injected(self, **kw):
+        pass
+
+    def close(self, **kw):
+        pass
+
+
+def _router(fakes, **kw):
+    from nm03_capstone_project_tpu.fleet.router import FleetApp
+
+    kw.setdefault("health_interval_s", 3600)
+    app = FleetApp([f.url for f in fakes], obs=_RouterObs(), **kw)
+    app._sweep()
+    return app
+
+
+class TestRouterResultTier:
+    def test_hit_never_touches_a_replica(self):
+        fake = _FakeCachingReplica()
+        app = _router([fake], result_cache_bytes=4 << 20)
+        try:
+            body = bytes(16 * 16 * 4)
+            hdrs = {
+                "Content-Type": "application/octet-stream",
+                "X-Nm03-Height": "16", "X-Nm03-Width": "16",
+            }
+            st1, d1, h1 = app.proxy_segment(body, dict(hdrs), query="output=mask")
+            hm1 = dict(h1)
+            assert st1 == 200 and hm1["X-Nm03-Cache"] == "fill"
+            assert fake.posts == 1
+            st2, d2, h2 = app.proxy_segment(body, dict(hdrs), query="output=mask")
+            hm2 = dict(h2)
+            assert st2 == 200 and hm2["X-Nm03-Cache"] == "hit"
+            assert fake.posts == 1  # never proxied
+            # the REPLICA's ETag is preserved across tiers: one stable
+            # ETag per content, whichever tier answers
+            assert hm2["ETag"] == hm1["ETag"]
+            p2 = json.loads(d2)
+            assert p2["cached"] is True and p2["device_seconds"] == 0.0
+            assert p2["replica_hops"] == 0
+            # a hit spends no WRR round: routed counts only the real proxy
+            assert _counter_sum(
+                app.obs.registry, "fleet_requests_routed_total"
+            ) == 1
+            # 304 at the router: zero bytes move
+            st3, d3, _ = app.proxy_segment(
+                body, {**hdrs, "If-None-Match": hm1["ETag"]},
+                query="output=mask",
+            )
+            assert st3 == 304 and d3 == b"" and fake.posts == 1
+        finally:
+            app.close()
+            fake.stop()
+
+    def test_query_spelling_is_part_of_the_key(self):
+        """The router hashes raw query params — a different spelling is a
+        different key (two misses), never a wrong answer."""
+        fake = _FakeCachingReplica()
+        app = _router([fake], result_cache_bytes=4 << 20)
+        try:
+            body = bytes(16 * 16 * 4)
+            hdrs = {
+                "Content-Type": "application/octet-stream",
+                "X-Nm03-Height": "16", "X-Nm03-Width": "16",
+            }
+            app.proxy_segment(body, dict(hdrs), query="output=mask")
+            app.proxy_segment(body, dict(hdrs), query="output=png")
+            assert fake.posts == 2
+        finally:
+            app.close()
+            fake.stop()
+
+    def test_mixed_program_versions_bypass_the_router_tier(self):
+        """Mid-rolling-restart (old and new code both healthy) the router
+        must not cache: its keyspace cannot name which version computed
+        a result, so the tier disengages until the fleet converges."""
+        a = _FakeCachingReplica(program_version="aaaa000011112222")
+        b = _FakeCachingReplica(program_version="bbbb000011112222")
+        app = _router([a, b], result_cache_bytes=4 << 20)
+        try:
+            assert app._fleet_result_version() is None
+            body = bytes(16 * 16 * 4)
+            hdrs = {
+                "Content-Type": "application/octet-stream",
+                "X-Nm03-Height": "16", "X-Nm03-Width": "16",
+            }
+            for _ in range(3):
+                st, _, h = app.proxy_segment(
+                    body, dict(hdrs), query="output=mask"
+                )
+                assert st == 200
+            assert a.posts + b.posts == 3  # every request proxied
+            assert app.status()["result_cache"]["entries"] == 0
+            # converge the fleet: the tier re-engages on its own
+            b.program_version = a.program_version
+            app._sweep()
+            assert app._fleet_result_version() == a.program_version
+        finally:
+            app.close()
+            a.stop()
+            b.stop()
+
+    def test_disabled_tier_status_and_debug(self):
+        fake = _FakeCachingReplica()
+        app = _router([fake])  # no result_cache_bytes
+        try:
+            assert app.result_store is None
+            assert app.status()["result_cache"]["enabled"] is False
+        finally:
+            app.close()
+            fake.stop()
+
+
+# -- slow subprocess acceptance drills --------------------------------------
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _cpu_env(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)
+    # keep crash dumps out of the repo root if a spawned replica dies
+    env["NM03_FLIGHTREC_DIR"] = str(tmp_path)
+    return env
+
+
+def _wait_ready(urls, timeout_s=300):
+    deadline = time.monotonic() + timeout_s
+    pending = set(urls)
+    while pending and time.monotonic() < deadline:
+        for url in list(pending):
+            try:
+                with urllib.request.urlopen(f"{url}/readyz", timeout=2.0) as r:
+                    if r.status == 200 and json.loads(r.read()).get("ready"):
+                        pending.discard(url)
+            except Exception:  # noqa: BLE001
+                pass
+        time.sleep(0.2)
+    assert not pending, f"never ready: {pending}"
+
+
+def _spawn_replica(port, extra, env):
+    return subprocess.Popen(
+        [
+            sys.executable, "-m",
+            "nm03_capstone_project_tpu.serving.server",
+            "--device", "cpu", "--port", str(port),
+            "--canvas", str(CANVAS), "--buckets", "1,4", "--lanes", "1",
+            "--max-wait-ms", "10", "--heartbeat-s", "0",
+            "--queue-capacity", "64",
+            "--result-cache-bytes", "64m",
+            *extra,
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO,
+    )
+
+
+def _spawn_fleet(port, targets, metrics_out, env, extra=()):
+    return subprocess.Popen(
+        [
+            sys.executable, "-m",
+            "nm03_capstone_project_tpu.fleet.cli", "serve",
+            "--replicas", targets,
+            "--port", str(port),
+            "--health-interval-s", "0.25",
+            "--health-timeout-s", "2.0",
+            "--proxy-timeout-s", "120",
+            "--result-cache-bytes", "64m",
+            "--metrics-out", str(metrics_out),
+            *extra,
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO,
+    )
+
+
+def _terminate(procs, timeout=30):
+    for p in procs:
+        if p and p.poll() is None:
+            p.terminate()
+    for p in procs:
+        if p:
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=10)
+
+
+@pytest.mark.slow
+class TestResultTierAcceptanceDrill:
+    def test_zipfian_replay_collapses_p50_and_device_seconds(self, tmp_path):
+        """The ISSUE 19 acceptance bar: a fleet of two cached replicas
+        behind a cached router under an `nm03-loadgen --zipf 1.1` replay
+        over 32 studies — hit ratio >= 0.5, repeat p50 under a quarter of
+        the miss p50, hits billing zero device-seconds, gated through
+        check_telemetry on the router's own counters."""
+        from nm03_capstone_project_tpu.serving import loadgen
+
+        env = _cpu_env(tmp_path)
+        ports = _free_ports(3)
+        metrics_out = tmp_path / "fleet_metrics.json"
+        replicas = [
+            _spawn_replica(ports[0], [], env),
+            _spawn_replica(ports[1], [], env),
+        ]
+        fleet = None
+        try:
+            _wait_ready([f"http://127.0.0.1:{p}" for p in ports[:2]])
+            targets = f"127.0.0.1:{ports[0]},127.0.0.1:{ports[1]}"
+            fleet = _spawn_fleet(ports[2], targets, metrics_out, env)
+            fleet_url = f"http://127.0.0.1:{ports[2]}"
+            _wait_ready([fleet_url])
+            results_json = tmp_path / "zipf_summary.json"
+            rc = loadgen.main([
+                "--url", fleet_url,
+                "--requests", "96", "--concurrency", "8",
+                "--zipf", "1.1", "--keyspace", "32",
+                "--height", str(CANVAS), "--width", str(CANVAS),
+                "--warmup", "0", "--timeout-s", "60",
+                "--results-json", str(results_json),
+            ])
+            assert rc == 0
+            summary = json.loads(results_json.read_text())
+            assert summary["requests_ok"] == summary["requests_total"] == 96
+            assert summary["zipf"] == {"s": 1.1, "keyspace": 32}
+            # the headline gates
+            assert summary["cache_hit_ratio"] >= 0.5, summary["cache"]
+            cache = summary["cache"]
+            assert cache["states"].get("hit", 0) > 0
+            hit_p50 = cache["hit_latency_ms"]["p50"]
+            miss_p50 = cache["miss_latency_ms"]["p50"]
+            assert hit_p50 < 0.25 * miss_p50, (hit_p50, miss_p50)
+            # hits bill no device time -> the per-request mean falls on
+            # a repeat-heavy replay
+            ds = summary["device_seconds_ms"]
+            assert ds["hit_mean"] == 0.0
+            assert ds["miss_mean"] is None or ds["miss_mean"] >= 0.0
+            # drain the fleet so its registry lands in --metrics-out,
+            # then gate the same events server-side
+            _terminate([fleet])
+            fleet = None
+            assert metrics_out.exists()
+            check = subprocess.run(
+                [
+                    sys.executable, CHECKER,
+                    "--metrics", str(metrics_out),
+                    "--expect-counter",
+                    "serving_result_cache_hit_total=10",
+                    "--expect-counter",
+                    "serving_result_cache_fill_total=5",
+                    "--expect-counter",
+                    "serving_result_cache_miss_total=5",
+                ],
+                capture_output=True, text=True, timeout=60,
+            )
+            assert check.returncode == 0, check.stdout + check.stderr
+        finally:
+            _terminate([fleet, *replicas])
+
+    def test_sigkill_idempotent_volume_retry_is_bit_identical(self, tmp_path):
+        """A whole-study request survives losing its replica: the client
+        retries with the same X-Nm03-Idempotency-Key after the serving
+        replica is SIGKILLed, and the answer comes back bit-identical
+        (same ETag, same mask_sha256) from the router's store — no gang
+        program runs a second time anywhere."""
+        env = _cpu_env(tmp_path)
+        # the volume gang spans lanes=2 chips; fake them on the host
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        ports = _free_ports(3)
+        metrics_out = tmp_path / "fleet_metrics.json"
+        vol_extra = [
+            "--volume-serving", "--volume-depth-buckets", "8",
+            "--lanes", "2",
+        ]
+        replicas = [
+            _spawn_replica(ports[0], vol_extra, env),
+            _spawn_replica(ports[1], vol_extra, env),
+        ]
+        fleet = None
+        try:
+            import numpy as np
+
+            from nm03_capstone_project_tpu.data.synthetic import (
+                phantom_volume,
+            )
+
+            _wait_ready([f"http://127.0.0.1:{p}" for p in ports[:2]])
+            targets = f"127.0.0.1:{ports[0]},127.0.0.1:{ports[1]}"
+            fleet = _spawn_fleet(ports[2], targets, metrics_out, env)
+            fleet_url = f"http://127.0.0.1:{ports[2]}"
+            _wait_ready([fleet_url])
+            vol = np.asarray(
+                phantom_volume(8, CANVAS, CANVAS, seed=7), np.float32
+            )
+            body = vol.astype("<f4").tobytes()
+            headers = {
+                "Content-Type": "application/octet-stream",
+                "X-Nm03-Depth": "8",
+                "X-Nm03-Height": str(CANVAS),
+                "X-Nm03-Width": str(CANVAS),
+                "X-Nm03-Idempotency-Key": "study-42-attempt",
+            }
+            st1, d1, h1 = _post(
+                fleet_url + "/v1/segment-volume?output=summary",
+                body, dict(headers), timeout=240.0,
+            )
+            assert st1 == 200, d1[:300]
+            p1 = json.loads(d1)
+            served_by = h1.get("X-Nm03-Replica")
+            assert served_by in targets.split(",")
+            # kill the replica that computed it — the fleet failover
+            # window an idempotent retry must survive
+            victim = replicas[targets.split(",").index(served_by)]
+            victim.send_signal(signal.SIGKILL)
+            victim.wait(timeout=30)
+            st2, d2, h2 = _post(
+                fleet_url + "/v1/segment-volume?output=summary",
+                body, dict(headers), timeout=240.0,
+            )
+            assert st2 == 200
+            p2 = json.loads(d2)
+            # bit-identical: same content ETag, same mask digest — and
+            # served from the store (zero device seconds, zero hops)
+            assert h2["X-Nm03-Cache"] == "hit"
+            assert h2["ETag"] == h1["ETag"]
+            assert p2["mask_sha256"] == p1["mask_sha256"]
+            assert p2["cached"] is True and p2["device_seconds"] == 0.0
+            assert h2["X-Nm03-Replica-Hops"] == "0"
+            # no second gang dispatch: the SURVIVING replica never saw a
+            # volume request at all
+            survivor_port = ports[1] if served_by.endswith(
+                str(ports[0])
+            ) else ports[0]
+            snap = _get_json(
+                f"http://127.0.0.1:{survivor_port}/metrics.json"
+            )
+            gang_dispatches = sum(
+                s.get("value", 0)
+                for s in snap["metrics"]
+                if s["name"] == "serving_volume_requests_total"
+            )
+            assert gang_dispatches == 0
+        finally:
+            _terminate([fleet, *replicas])

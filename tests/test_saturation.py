@@ -716,6 +716,7 @@ class TestExpectGaugeRange:
 
 
 class TestSaturationAcceptance:
+    @pytest.mark.slow
     def test_four_lane_drill_with_loadgen_and_top(self, tmp_path):
         """The ISSUE 10 acceptance bar: ``nm03-serve --lanes 4`` under a
         32-request loadgen reports per-lane busy fractions, padding waste

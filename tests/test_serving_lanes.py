@@ -260,6 +260,7 @@ class TestMultiLaneServingE2E:
 
 
 class TestServeCliAcceptance:
+    @pytest.mark.slow
     def test_eight_lane_subprocess_serves_all_lanes(self, tmp_path):
         """The ISSUE 6 acceptance bar, end to end in a real process:
         ``nm03-serve`` on 8 forced virtual CPU devices serves concurrent

@@ -581,6 +581,7 @@ class TestLoadgenVolumeMode:
 
 
 class TestAcceptanceDrill:
+    @pytest.mark.slow
     def test_served_volume_bit_identical_to_driver(self, tmp_path):
         """ISSUE 15 acceptance: nm03-serve on 4 forced virtual devices
         serves a whole synthetic study; the mask equals ``nm03-volume

@@ -496,6 +496,7 @@ class TestServingSLOAndDebug:
         assert snap["reason"] == "debug_pull"
         assert snap["threads"]  # the serving threads' rings are in there
 
+    @pytest.mark.slow
     def test_debug_profile_pull(self, slo_served):
         app, base = slo_served
         status, body = _get(base + "/debug/profile?ms=60")
@@ -507,6 +508,7 @@ class TestServingSLOAndDebug:
         assert _get(base + "/debug/profile?ms=abc")[0] == 400
         assert _get(base + "/debug/profile?ms=1")[0] == 400
 
+    @pytest.mark.slow
     def test_fleet_debug_pull_cli_fans_out(self, slo_served, tmp_path):
         """`nm03-fleet flightrec|profile` against a real replica plus one
         dead target: the live pull lands on disk, the dead one is a

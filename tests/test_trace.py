@@ -981,6 +981,7 @@ def _wait_port_file(proc, port_file, budget_s=300):
 
 
 class TestAcceptanceMultiLaneTrace:
+    @pytest.mark.slow
     def test_four_lane_loadgen_trace_perfetto_loadable(self, tmp_path):
         """The ISSUE 7 acceptance bar: loadgen against ``nm03-serve
         --lanes 4`` yields a Perfetto-loadable export where >=1 coalesced
