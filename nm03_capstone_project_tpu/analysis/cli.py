@@ -36,11 +36,13 @@ from nm03_capstone_project_tpu.analysis.core import (
     collect_files,
     find_repo_root,
     load_baseline,
+    prune_baseline,
     run_rules,
     write_baseline,
 )
 from nm03_capstone_project_tpu.analysis.dtypes import check_dtype_discipline
 from nm03_capstone_project_tpu.analysis.hostsync import check_host_sync
+from nm03_capstone_project_tpu.analysis.lockorder import check_lock_order
 from nm03_capstone_project_tpu.analysis.metricsdocs import check_metrics_docs
 from nm03_capstone_project_tpu.analysis.retrace import check_retrace
 from nm03_capstone_project_tpu.analysis.staginghome import check_staging_home
@@ -58,6 +60,7 @@ ALL_RULES = (
     check_cache_key,
     check_metrics_docs,
     check_staging_home,
+    check_lock_order,
 )
 
 RULE_CATALOG = {
@@ -76,6 +79,9 @@ RULE_CATALOG = {
     "NM381": "cache-key: CompileSpec field not consumed by the persist cache key",
     "NM392": "metrics-docs: metric name and docs/OBSERVABILITY.md table drifted",
     "NM401": "staging-home: device_put referenced outside ingest/",
+    "NM421": "lock-order: cycle in the may-hold graph (static deadlock)",
+    "NM422": "lock-order: blocking call (dispatch/IO/sleep/join) under a lock",
+    "NM423": "lock-order: bare acquire() without release() in a try/finally",
     "NM390": "meta: suppression without a reason",
     "NM399": "meta: file does not parse",
 }
@@ -119,6 +125,13 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="write the current findings as the new baseline and exit 0 "
         "(the diff is the review artifact)",
+    )
+    p.add_argument(
+        "--prune-baseline",
+        action="store_true",
+        help="drop baseline entries whose finding no longer reproduces, "
+        "then exit 0 (the baseline must only ever shrink without review; "
+        "growth goes through --update-baseline and its diff)",
     )
     p.add_argument(
         "--no-baseline",
@@ -195,6 +208,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(
             f"nm03-lint: baseline updated with {len(findings)} finding(s) "
             f"at {baseline_path}"
+        )
+        return 0
+
+    if args.prune_baseline:
+        if (args.select or args.paths) and not args.baseline:
+            # same whole-tree-truth rule as --update-baseline: a narrowed
+            # run reproduces only a slice of the findings and would prune
+            # every entry outside that slice
+            print(
+                "nm03-lint: refusing --prune-baseline on a narrowed run "
+                "(--select/path arguments present); rerun with the default "
+                "scope, or pass an explicit --baseline",
+                file=sys.stderr,
+            )
+            return 2
+        kept, dropped = prune_baseline(baseline_path, findings)
+        print(
+            f"nm03-lint: baseline pruned: {dropped} stale entr"
+            f"{'y' if dropped == 1 else 'ies'} dropped, {kept} kept"
         )
         return 0
 

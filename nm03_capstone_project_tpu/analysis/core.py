@@ -288,6 +288,43 @@ def write_baseline(path: Path, findings: Sequence[Finding]) -> None:
     os.replace(tmp, path)
 
 
+def prune_baseline(path: Path, findings: Sequence[Finding]) -> Tuple[int, int]:
+    """Drop baseline entries whose finding no longer reproduces.
+
+    ``findings`` must come from a FULL default-scope run (the CLI refuses
+    narrowed runs for the same reason --update-baseline does): an entry is
+    kept only up to the multiplicity the current tree still produces, so a
+    fixed finding leaves the baseline the moment it is fixed instead of
+    accreting forever. Returns ``(kept, dropped)``; the file is rewritten
+    (tmp+rename) only when something was dropped.
+    """
+    if not path.exists():
+        return (0, 0)
+    data = json.loads(path.read_text())
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path} has version {data.get('version')!r}; "
+            f"this nm03-lint writes version {BASELINE_VERSION}"
+        )
+    live: Dict[str, int] = {}
+    for f in findings:
+        live[f.fingerprint] = live.get(f.fingerprint, 0) + 1
+    kept: List[dict] = []
+    dropped = 0
+    for e in data.get("entries", []):
+        if live.get(e.get("fingerprint"), 0) > 0:
+            live[e["fingerprint"]] -= 1
+            kept.append(e)
+        else:
+            dropped += 1
+    if dropped:
+        payload = {"version": BASELINE_VERSION, "entries": kept}
+        tmp = Path(f"{path}.tmp")
+        tmp.write_text(json.dumps(payload, indent=1, sort_keys=True) + "\n")
+        os.replace(tmp, path)
+    return (len(kept), dropped)
+
+
 def apply_baseline(
     findings: Sequence[Finding], baseline: Dict[str, int]
 ) -> Tuple[List[Finding], int]:

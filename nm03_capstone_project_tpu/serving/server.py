@@ -2154,6 +2154,13 @@ def main(argv=None) -> int:
 
     common.apply_device_env(args.device)
     configure_reporting(verbose=args.verbose)
+    # NM03_LOCKDEP=1: instrument every lock the app is ABOUT to create
+    # (docs/STATIC_ANALYSIS.md, NM421/NM422 runtime twin) — must run
+    # before any serving object exists, since only post-install creation
+    # sites are wrapped; a no-op (zero overhead) without the env gate
+    from nm03_capstone_project_tpu.utils import lockdep
+
+    lockdep.install_from_env()
     # arm the flight recorder before any backend work: SIGUSR2 dumps,
     # degradation auto-dumps, and crash dumps all come through here
     from nm03_capstone_project_tpu.obs import flightrec

@@ -49,6 +49,8 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from nm03_capstone_project_tpu.config import PipelineConfig
+from nm03_capstone_project_tpu.serving.batcher import DynamicBatcher
+from nm03_capstone_project_tpu.serving.executor import WarmExecutor
 from nm03_capstone_project_tpu.serving.queue import AdmissionQueue
 from nm03_capstone_project_tpu.utils.reporter import get_logger
 
@@ -120,8 +122,12 @@ class VolumeGang:
     def __init__(
         self,
         cfg: PipelineConfig,
-        executor,
-        batcher,
+        # typed so the lock-order analysis (NM42x) can trace the gang's
+        # held-set through executor/batcher calls — the whole volume path
+        # runs under gang_parked(), and every lock it reaches must be an
+        # explained edge in the static may-hold graph
+        executor: WarmExecutor,
+        batcher: DynamicBatcher,
         obs=None,
         queue_capacity: int = 4,
         depth_buckets: Tuple[int, ...] = DEFAULT_VOLUME_DEPTH_BUCKETS,
@@ -433,6 +439,7 @@ class VolumeGang:
                 return mask, conv
 
             try:
+                # nm03-lint: disable=NM422 the canonical gang hold: the WHOLE mesh program runs under the parked batcher — that exclusivity is what makes a volume dispatch safe (ISSUE 15)
                 mask, conv = sup.run(
                     primary, fallback=None, label="volume_dispatch"
                 )

@@ -119,6 +119,13 @@ def enable(registry=None) -> SanitizeState:
     counter is created at 0 immediately so a sanitized run's snapshot
     always carries it, even when nothing ever compiles.
     """
+    # the lock-order twin (NM421/NM422) rides the same opt-in, but stays
+    # env-gated on NM03_LOCKDEP: instrumented locks only help when enable()
+    # runs BEFORE the threaded objects exist, and only the caller knows
+    # that — the env flag is that assertion. jax-free, zero cost when off.
+    from nm03_capstone_project_tpu.utils import lockdep
+
+    lockdep.install_from_env()
     import jax
 
     jax.config.update("jax_debug_nans", True)

@@ -18,12 +18,19 @@ Phases (each independently reported, all must pass):
    run only when ruff is installed: the container this repo grows in does
    not ship it, and a gate that fails on missing tooling rather than bad
    code would train everyone to ignore it. When absent, the phase reports
-   SKIPPED loudly instead of passing silently.
+   SKIPPED loudly instead of passing silently;
+4. **lockdep witness** (``--lockdep-witness PATH``, optional) — gate a
+   ``lockdep_witness.json`` produced by an instrumented serving run
+   (``NM03_LOCKDEP=1``, utils/lockdep.py) against the static may-hold
+   graph: zero inversions, zero observed cycles, every observed edge
+   either statically derivable or targeting an obs/ leaf lock. The
+   runtime face of NM421 (docs/STATIC_ANALYSIS.md).
 
 Usage:
     python scripts/check_static.py
     python scripts/check_static.py --update-baseline
     python scripts/check_static.py --skip-ruff
+    python scripts/check_static.py --lockdep-witness results/lockdep_witness.json
 """
 
 from __future__ import annotations
@@ -121,6 +128,41 @@ def run_ruff_phase(skip: bool) -> int:
     return 0
 
 
+def run_lockdep_phase(witness_path) -> int:
+    """Gate an observed-lock-order witness against the static graph."""
+    if not witness_path:
+        return 0
+    if str(REPO) not in sys.path:
+        sys.path.insert(0, str(REPO))
+    from nm03_capstone_project_tpu.analysis import lockorder
+    from nm03_capstone_project_tpu.analysis.core import collect_files
+
+    p = Path(witness_path)
+    if not p.exists():
+        print(f"lockdep: witness file not found: {p}")
+        return 1
+    try:
+        witness = json.loads(p.read_text())
+    except json.JSONDecodeError as e:
+        print(f"lockdep: unparseable witness {p}: {e}")
+        return 1
+    files = collect_files(
+        [REPO / "nm03_capstone_project_tpu", REPO / "scripts", REPO / "bench.py"],
+        REPO,
+    )
+    graph = lockorder.build_lock_graph(files)
+    problems = lockorder.explain_witness(witness, graph)
+    for prob in problems:
+        print(f"lockdep: {prob}")
+    if problems:
+        return len(problems)
+    print(
+        f"lockdep: witness OK — {len(witness.get('edges', []))} edge(s) over "
+        f"{len(witness.get('sites', []))} site(s), 0 inversions, 0 cycles"
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument(
@@ -131,6 +173,13 @@ def main(argv=None) -> int:
     p.add_argument(
         "--skip-ruff", action="store_true", help="skip the ruff phase"
     )
+    p.add_argument(
+        "--lockdep-witness",
+        default=None,
+        metavar="JSON",
+        help="gate a utils/lockdep.py witness against the static "
+        "may-hold graph (analysis/lockorder.py)",
+    )
     args = p.parse_args(argv)
 
     failures = 0
@@ -139,6 +188,7 @@ def main(argv=None) -> int:
     failures += parse_failures
     failures += run_lint_phase(args.update_baseline)
     failures += run_ruff_phase(args.skip_ruff)
+    failures += run_lockdep_phase(args.lockdep_witness)
     if failures:
         print(f"check_static: FAIL ({failures} problem(s))")
         return 1

@@ -1,0 +1,351 @@
+"""Runtime lockdep tests (ISSUE 20): the instrumented-lock witness.
+
+Three tiers:
+
+* unit — the wrapper itself: package-frame scoping, RLock reentrancy,
+  Condition pass-through, budget accounting, uninstall restoration;
+* the ABBA battery — two fixture locks taken in opposite orders on two
+  threads (sequentially, so nothing actually deadlocks): the witness must
+  record the inversion NAMING BOTH STACKS, and ``explain_witness`` must
+  refuse it;
+* the live drill — a real mixed slice+volume serving run constructed
+  INSIDE the lockdep window, whose witness must gate clean against the
+  static may-hold graph (zero inversions, zero cycles, every observed
+  edge statically explained or an obs/ leaf), end-to-end through
+  ``scripts/check_static.py --lockdep-witness``.
+
+Every test uninstalls in a finally: the factory patch is process-global.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from nm03_capstone_project_tpu.analysis.core import collect_files
+from nm03_capstone_project_tpu.analysis.lockorder import (
+    build_lock_graph,
+    explain_witness,
+)
+from nm03_capstone_project_tpu.utils import lockdep
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+PKG = "nm03_capstone_project_tpu"
+HERE = pathlib.Path(__file__).resolve().parent
+
+
+@pytest.fixture
+def installed():
+    """Lockdep installed with this test file's directory instrumented."""
+    st = lockdep.install(extra_prefixes=(str(HERE),))
+    try:
+        yield st
+    finally:
+        lockdep.uninstall()
+
+
+def _static_graph():
+    files = collect_files(
+        [REPO / PKG, REPO / "scripts", REPO / "bench.py"], REPO
+    )
+    return build_lock_graph(files)
+
+
+class TestWrapperUnit:
+    def test_package_frame_scoping_and_uninstall(self, installed):
+        lock = threading.Lock()  # created HERE -> instrumented
+        assert type(lock).__name__ == "_InstrumentedLock"
+        with lock:
+            assert lock.locked()
+        assert not lock.locked()
+        lockdep.uninstall()
+        assert threading.Lock().__class__.__module__ == "_thread"
+        # idempotent double-uninstall, and the fixture's finally is a no-op
+        assert lockdep.uninstall() is None
+        lockdep.install(extra_prefixes=(str(HERE),))  # fixture rebalances
+
+    def test_stdlib_event_and_thread_locks_not_misattributed(self, installed):
+        """threading.Event()/Thread() build locks from threading.py frames
+        (and numpy builds them from C): none may claim a package site."""
+        before = set(installed.snapshot()["sites"] and [])
+        ev = threading.Event()
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        t.join()
+        ev.set()
+        snap = json.dumps(installed.snapshot()["sites"])
+        assert "test_lockdep" not in snap, snap
+
+    def test_rlock_reentrancy_records_no_self_edge(self, installed):
+        r = threading.RLock()
+        assert type(r).__name__ == "_InstrumentedRLock"
+        with r:
+            with r:
+                assert r.locked()
+        snap = installed.snapshot()
+        assert all(e["src"] != e["dst"] for e in snap["edges"])
+
+    def test_condition_wait_flows_through_tracked_path(self, installed):
+        """Condition(instrumented-lock): the wait's release/re-acquire uses
+        the wrapper's plain acquire()/release() (no _release_save exposed),
+        so the held-set stays balanced across a real wait."""
+        inner = threading.Lock()
+        cond = threading.Condition(inner)
+        done = []
+
+        def waiter():
+            with cond:
+                cond.wait(timeout=5)
+                done.append(True)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        with cond:
+            cond.notify()
+        t.join(timeout=5)
+        assert done == [True]
+        # the waiter thread's held stack drained to empty: a fresh acquire
+        # records no edge from a stale entry
+        probe = threading.Lock()
+        with probe:
+            pass
+        snap = installed.snapshot()
+        assert all(e["src"] != e["dst"] for e in snap["edges"])
+
+    def test_hold_budget_flags_slow_hold(self):
+        st = lockdep.install(budget_s=0.001, extra_prefixes=(str(HERE),))
+        try:
+            slow = threading.Lock()
+            with slow:
+                time.sleep(0.02)
+            over = st.snapshot()["over_budget"]
+            assert any(o["held_s"] >= 0.01 for o in over)
+        finally:
+            lockdep.uninstall()
+
+    def test_witness_dump_is_atomic_and_versioned(self, installed, tmp_path):
+        lock = threading.Lock()
+        with lock:
+            pass
+        out = lockdep.dump_witness(tmp_path / "w" / "witness.json", installed)
+        payload = json.loads(out.read_text())
+        assert payload["version"] == 1
+        assert not (tmp_path / "w" / "witness.json.tmp").exists()
+        assert any(s["acquires"] >= 1 for s in payload["sites"])
+
+
+class TestAbbaBattery:
+    def test_inversion_names_both_stacks(self, installed):
+        """The runtime NM421: opposite orders on two threads — caught on
+        the second ordering's FIRST acquisition, with the fix's two call
+        paths named, not the eventual deadlock's silence."""
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def forward_path():
+            with a:
+                with b:
+                    pass
+
+        def backward_path():
+            with b:
+                with a:
+                    pass
+
+        t1 = threading.Thread(target=forward_path)
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=backward_path)
+        t2.start()
+        t2.join()
+
+        snap = installed.snapshot()
+        assert len(snap["inversions"]) == 1
+        inv = snap["inversions"][0]
+        assert inv["first"] != inv["second"]
+        assert any("backward_path" in fr for fr in inv["stack"]), inv
+        assert any("forward_path" in fr for fr in inv["prior_stack"]), inv
+
+    def test_explain_witness_refuses_the_abba_witness(self, installed):
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def nested(first, second):
+            with first:
+                with second:
+                    pass
+
+        for order in ((a, b), (b, a)):
+            t = threading.Thread(target=nested, args=order)
+            t.start()
+            t.join()
+        witness = installed.snapshot()
+        problems = explain_witness(witness, _static_graph())
+        assert any("inversion" in p for p in problems)
+        assert any("cycle" in p for p in problems)
+
+    def test_consistent_order_gates_clean(self, installed):
+        """Fixture sites outside the package are identity-mapped and only
+        cycle-checked: a consistent ABAB discipline passes the gate."""
+        a = threading.Lock()
+        b = threading.Lock()
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        problems = explain_witness(installed.snapshot(), _static_graph())
+        assert problems == []
+
+
+class TestServingDrill:
+    """The acceptance drill: the mixed slice+volume serving test re-run
+    under instrumented locks, its witness gated against the static graph."""
+
+    @pytest.fixture(scope="class")
+    def drill_witness(self, tmp_path_factory):
+        """Construct a 4-lane volume-serving app INSIDE the lockdep window
+        (only post-install lock creations are instrumented), drive mixed
+        slice+volume traffic over live HTTP, drain, dump the witness."""
+        import numpy as np
+
+        st = lockdep.install()
+        try:
+            from nm03_capstone_project_tpu.config import PipelineConfig
+            from nm03_capstone_project_tpu.data.synthetic import phantom_volume
+            from nm03_capstone_project_tpu.obs import flightrec
+            from nm03_capstone_project_tpu.serving.loadgen import (
+                LoadResult,
+                _make_payloads,
+                run_load,
+            )
+            from nm03_capstone_project_tpu.serving.server import (
+                ServingApp,
+                make_http_server,
+            )
+
+            flightrec.configure(
+                dump_dir=str(tmp_path_factory.mktemp("flight"))
+            )
+            app = ServingApp(
+                cfg=PipelineConfig(canvas=64, min_dim=16),
+                buckets=(1, 2),
+                lanes=4,
+                max_wait_s=0.005,
+                volume_serving=True,
+                volume_depth_buckets=(8,),
+            )
+            app.start()
+            httpd = make_http_server(app)
+            t = threading.Thread(target=httpd.serve_forever, daemon=True)
+            t.start()
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+            import urllib.request
+
+            vol = np.asarray(
+                phantom_volume(n_slices=6, height=64, width=64, seed=9),
+                np.float32,
+            )
+            vol_result = {}
+
+            def volume_worker():
+                req = urllib.request.Request(
+                    base + "/v1/segment-volume?output=summary",
+                    data=vol.astype("<f4").tobytes(),
+                    headers={
+                        "Content-Type": "application/octet-stream",
+                        "X-Nm03-Depth": "6",
+                        "X-Nm03-Height": "64",
+                        "X-Nm03-Width": "64",
+                    },
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    vol_result["status"] = r.status
+
+            vt = threading.Thread(target=volume_worker)
+            vt.start()
+            payloads = _make_payloads(64, 64, n_distinct=2, dicom=False)
+            summary = run_load(
+                base + "/v1/segment?output=mask", payloads,
+                n_requests=8, concurrency=4, rate_rps=0.0,
+                timeout_s=120.0, result=LoadResult(),
+            )
+            vt.join(timeout=120)
+            assert vol_result.get("status") == 200
+            assert summary["requests_ok"] == 8, summary["statuses"]
+            app.begin_drain(reason="lockdep-drill")
+            httpd.shutdown()
+            httpd.server_close()
+            app.close()
+            out = tmp_path_factory.mktemp("w") / "lockdep_witness.json"
+            lockdep.dump_witness(out, st)
+        finally:
+            lockdep.uninstall()
+        return out
+
+    def test_witness_covers_the_serving_locks(self, drill_witness):
+        payload = json.loads(drill_witness.read_text())
+        paths = {s["path"] for s in payload["sites"]}
+        assert f"{PKG}/serving/batcher.py" in paths
+        assert f"{PKG}/serving/executor.py" in paths
+        # held-across edges were actually observed (gang -> executor at
+        # minimum: every dispatched window holds the gang gate)
+        assert payload["edges"], "drill recorded no nesting at all"
+        assert payload["inversions"] == []
+
+    def test_witness_gates_clean_against_static_graph(self, drill_witness):
+        """THE tentpole acceptance: zero inversions, zero cycles, every
+        observed edge explained by the static may-hold graph (or an obs/
+        leaf) — 'the lock discipline is sound' as a checked claim."""
+        witness = json.loads(drill_witness.read_text())
+        problems = explain_witness(witness, _static_graph())
+        assert problems == [], "\n".join(problems)
+
+    def test_check_static_gate_subprocess(self, drill_witness):
+        """Exit-code aggregation: the --lockdep-witness phase rides the
+        same pass/fail contract as parse/lint/ruff."""
+        proc = subprocess.run(
+            [
+                sys.executable,
+                str(REPO / "scripts" / "check_static.py"),
+                "--lockdep-witness",
+                str(drill_witness),
+            ],
+            capture_output=True, text=True, cwd=REPO, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "lockdep: witness OK" in proc.stdout
+
+    def test_check_static_gate_fails_on_inverted_witness(
+        self, drill_witness, tmp_path
+    ):
+        """Break drill for the gate itself: inject a reversed copy of an
+        observed edge — the gate must go red, nonzero exit."""
+        witness = json.loads(drill_witness.read_text())
+        assert witness["edges"]
+        e = dict(witness["edges"][0])
+        witness["edges"].append(
+            {"src": e["dst"], "dst": e["src"], "count": 1,
+             "stack": ["fabricated:1 in drill"]}
+        )
+        bad = tmp_path / "bad_witness.json"
+        bad.write_text(json.dumps(witness))
+        proc = subprocess.run(
+            [
+                sys.executable,
+                str(REPO / "scripts" / "check_static.py"),
+                "--lockdep-witness",
+                str(bad),
+            ],
+            capture_output=True, text=True, cwd=REPO, timeout=300,
+        )
+        assert proc.returncode != 0
+        assert "check_static: FAIL" in proc.stdout
